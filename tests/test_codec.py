@@ -1,0 +1,89 @@
+"""Codec tests.
+
+Reference test model: components/codec/src/number.rs + byte.rs inline
+tests (ordering properties, roundtrips).
+"""
+
+import random
+
+import pytest
+
+from tikv_tpu.codec import (
+    decode_bytes_memcomparable,
+    decode_i64,
+    decode_record_handle,
+    decode_var_i64,
+    decode_var_u64,
+    encode_bytes_memcomparable,
+    encode_i64,
+    encode_var_i64,
+    encode_var_u64,
+    table_record_key,
+    table_record_range,
+)
+from tikv_tpu.codec.mc_datum import decode_mc_datum, encode_mc_datum
+
+INTS = [-(2**63), -(2**32), -255, -1, 0, 1, 255, 2**32, 2**63 - 1]
+
+
+def test_i64_roundtrip_and_order():
+    encs = [encode_i64(v) for v in INTS]
+    assert [decode_i64(e) for e in encs] == INTS
+    assert encs == sorted(encs)  # byte order == numeric order
+
+
+def test_var_int_roundtrip():
+    for v in [0, 1, 127, 128, 300, 2**32, 2**63 - 1]:
+        assert decode_var_u64(encode_var_u64(v))[0] == v
+    for v in [0, -1, 1, -(2**62), 2**62, 12345, -12345]:
+        assert decode_var_i64(encode_var_i64(v))[0] == v
+
+
+def test_bytes_memcomparable_roundtrip_and_order():
+    samples = [b"", b"a", b"abcdefg", b"abcdefgh", b"abcdefghi",
+               b"\x00", b"\x00\x01", b"\xff" * 20]
+    for s in samples:
+        enc = encode_bytes_memcomparable(s)
+        dec, off = decode_bytes_memcomparable(enc)
+        assert dec == s and off == len(enc)
+    rnd = random.Random(0)
+    raws = [bytes(rnd.randrange(256) for _ in range(rnd.randrange(0, 30)))
+            for _ in range(200)]
+    encs = [encode_bytes_memcomparable(r) for r in raws]
+    assert [e for _, e in sorted(zip(raws, encs))] == sorted(encs)
+
+
+def test_record_key_order_and_handle():
+    keys = [table_record_key(5, h) for h in INTS]
+    assert keys == sorted(keys)
+    for h, k in zip(INTS, keys):
+        assert decode_record_handle(k) == h
+    start, end = table_record_range(5)
+    for k in keys:
+        assert start <= k < end
+    assert not (start <= table_record_key(6, 0) < end)
+
+
+def test_mc_datum_roundtrip_and_order():
+    vals = [None, -5, 0, 7, 3.14, -2.5, b"abc", b"abd"]
+    for v in vals:
+        enc = encode_mc_datum(v)
+        dec, off = decode_mc_datum(enc)
+        assert dec == v and off == len(enc)
+    # NULL sorts first; ints ordered
+    assert encode_mc_datum(None) < encode_mc_datum(-(2**60))
+    ints = [-(2**62), -1, 0, 1, 2**62]
+    encs = [encode_mc_datum(v) for v in ints]
+    assert encs == sorted(encs)
+    floats = [-1e300, -1.5, -0.0, 0.0, 1.5, 1e300]
+    fencs = [encode_mc_datum(v) for v in floats]
+    assert fencs == sorted(fencs)
+
+
+def test_corrupt_memcomparable_bytes_detected():
+    good = encode_bytes_memcomparable(b"abc")
+    corrupt = good[:-1] + bytes([0xF0])  # invalid pad marker
+    with pytest.raises(ValueError):
+        decode_bytes_memcomparable(corrupt)
+    with pytest.raises(ValueError):
+        decode_bytes_memcomparable(good[:5])  # truncated

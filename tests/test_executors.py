@@ -1,0 +1,207 @@
+"""Executor pipeline tests (host path).
+
+Reference test model: tidb_query_executors/src/*_executor.rs inline tests +
+tests/integrations coprocessor cases over test_coprocessor fixtures.
+"""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.datatype import EvalType
+from tikv_tpu.executors.runner import BatchExecutorsRunner
+from tikv_tpu.expr import Expr
+from tikv_tpu.testing import DagSelect, init_with_data, product_table
+from tikv_tpu.testing.fixture import int_table
+
+
+@pytest.fixture
+def store_and_table():
+    table = product_table()
+    rows = [
+        (1, {"name": b"alpha", "count": 10}),
+        (2, {"name": b"beta", "count": 20}),
+        (3, {"name": None, "count": 30}),
+        (4, {"name": b"delta", "count": None}),
+        (5, {"name": b"eps", "count": 20}),
+    ]
+    return init_with_data(table, rows), table
+
+
+def run(dag, storage):
+    return BatchExecutorsRunner(dag, storage).handle_request()
+
+
+def test_table_scan_all(store_and_table):
+    storage, t = store_and_table
+    res = run(DagSelect.from_table(t).build(), storage)
+    rows = res.rows()
+    assert len(rows) == 5
+    assert rows[0] == (1, b"alpha", 10)
+    assert rows[2] == (3, None, 30)
+    assert rows[3] == (4, b"delta", None)
+
+
+def test_table_scan_subset_columns(store_and_table):
+    storage, t = store_and_table
+    res = run(DagSelect.from_table(t, ["count", "id"]).build(), storage)
+    assert res.rows() == [(10, 1), (20, 2), (30, 3), (None, 4), (20, 5)]
+
+
+def test_selection(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t)
+    dag = q.where(q.col("count") > 15).build()
+    res = run(dag, storage)
+    # NULL count row must be filtered out (predicate NULL ≠ TRUE)
+    assert [r[0] for r in res.rows()] == [2, 3, 5]
+
+
+def test_projection(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t, ["id", "count"])
+    dag = q.project(q.col("id") + q.col("count"), q.col("id") * 2).build()
+    res = run(dag, storage)
+    assert res.rows() == [(11, 2), (22, 4), (33, 6), (None, 8), (25, 10)]
+
+
+def test_simple_agg(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t)
+    dag = q.aggregate([], [("count_star", None), ("count", q.col("count")),
+                          ("sum", q.col("count")), ("avg", q.col("count")),
+                          ("min", q.col("count")), ("max", q.col("count"))]).build()
+    res = run(dag, storage)
+    assert res.rows() == [(5, 4, 80, 20.0, 10, 30)]
+
+
+def test_simple_agg_empty_input(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t)
+    dag = q.where(q.col("count") > 1000) \
+           .aggregate([], [("count_star", None), ("sum", q.col("count"))]).build()
+    res = run(dag, storage)
+    assert res.rows() == [(0, None)]
+
+
+def test_hash_agg_group_by_int(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t)
+    dag = q.aggregate([q.col("count")],
+                      [("count_star", None), ("sum", q.col("id"))]).build()
+    res = run(dag, storage)
+    got = sorted(res.rows(), key=lambda r: (r[2] is None, r[2]))
+    # groups: 10→{1}, 20→{2,5}, 30→{3}, NULL→{4}
+    assert got == [(1, 1, 10), (2, 7, 20), (1, 3, 30), (1, 4, None)]
+
+
+def test_hash_agg_group_by_bytes(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t)
+    dag = q.aggregate([q.col("name")], [("count_star", None)]).build()
+    res = run(dag, storage)
+    assert len(res.rows()) == 5  # all names distinct incl. NULL group
+
+
+def test_topn_asc_nulls_first(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t)
+    dag = q.order_by(q.col("count"), desc=False, limit=3).build()
+    res = run(dag, storage)
+    assert [r[0] for r in res.rows()] == [4, 1, 2]  # NULL first, then 10, 20
+
+
+def test_topn_desc_nulls_last(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t)
+    dag = q.order_by(q.col("count"), desc=True, limit=3).build()
+    res = run(dag, storage)
+    assert [r[0] for r in res.rows()] == [3, 2, 5]  # 30, then 20s by row order
+
+
+def test_limit(store_and_table):
+    storage, t = store_and_table
+    dag = DagSelect.from_table(t).limit(2).build()
+    res = run(dag, storage)
+    assert len(res.rows()) == 2
+
+
+def test_index_scan(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_index(t, "count")
+    res = run(q.build(), storage)
+    # index order: NULL first, then 10,20,20,30; handle tie-break
+    assert res.rows() == [(None, 4), (10, 1), (20, 2), (20, 5), (30, 3)]
+
+
+def test_index_scan_selection(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_index(t, "count")
+    dag = q.where(q.col("count").eq(20)).build()
+    res = run(dag, storage)
+    assert [r[1] for r in res.rows()] == [2, 5]
+
+
+def test_output_offsets(store_and_table):
+    storage, t = store_and_table
+    dag = DagSelect.from_table(t).output_offsets([2, 0]).build()
+    res = run(dag, storage)
+    assert res.rows()[0] == (10, 1)
+
+
+def test_exec_summaries(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t)
+    dag = q.where(q.col("count") > 15).build()
+    res = run(dag, storage)
+    assert len(res.exec_summaries) == 2
+    scan, sel = res.exec_summaries
+    assert scan.num_produced_rows == 5
+    assert sel.num_produced_rows == 3
+    assert scan.num_iterations >= 1
+
+
+def test_larger_pipeline_grouped_sum():
+    t = int_table(2)
+    n = 5000
+    rows = [(h, {"c0": h % 7, "c1": h}) for h in range(n)]
+    storage = init_with_data(t, rows, with_indexes=False)
+    q = DagSelect.from_table(t)
+    dag = (q.where(q.col("c1") >= 1000)
+            .aggregate([q.col("c0")], [("count_star", None),
+                                       ("sum", q.col("c1"))]).build())
+    res = run(dag, storage)
+    got = {r[2]: (r[0], r[1]) for r in res.rows()}
+    expect = {}
+    for h in range(1000, n):
+        k = h % 7
+        c, s = expect.get(k, (0, 0))
+        expect[k] = (c + 1, s + h)
+    assert got == expect
+
+
+def test_topn_bytes_order_by(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t)
+    res = run(q.order_by(q.col("name"), desc=False, limit=3).build(), storage)
+    # NULL name first, then alpha, beta
+    assert [r[0] for r in res.rows()] == [3, 1, 2]
+    q2 = DagSelect.from_table(t)
+    res = run(q2.order_by(q2.col("name"), desc=True, limit=5).build(), storage)
+    assert [r[0] for r in res.rows()] == [5, 4, 2, 1, 3]  # NULL last
+
+
+def test_real_expr_sugar_keeps_real_sigs(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t, ["id", "count"])
+    cnt_real = Expr.call("CastIntAsReal", q.col("count"))
+    dag = q.project((cnt_real + 1.0) * 0.5).build()
+    res = run(dag, storage)
+    assert res.rows() == [(5.5,), (10.5,), (15.5,), (None,), (10.5,)]
+
+
+def test_first_agg_bytes_and_empty_groups(store_and_table):
+    storage, t = store_and_table
+    q = DagSelect.from_table(t)
+    dag = q.aggregate([], [("first", q.col("name"))]).build()
+    res = run(dag, storage)
+    assert res.rows() == [(b"alpha",)]

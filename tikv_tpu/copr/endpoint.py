@@ -1,0 +1,96 @@
+"""Coprocessor endpoint — request parsing + handler dispatch.
+
+Reference: src/coprocessor/endpoint.rs (Endpoint::parse_and_handle_unary_
+request :546, request type dispatch mod.rs:57-59: DAG=103, ANALYZE=104,
+CHECKSUM=105) and dag/mod.rs (DagHandlerBuilder). The endpoint owns:
+
+- snapshot acquisition from the storage layer (here: a ScanStorage
+  provider keyed by region — the MVCC snapshot feed once layers 0-4 land);
+- backend routing: device (TPU) runner for plans/sizes that profit, host
+  numpy runner otherwise (reference routes everything to CPU;
+  SURVEY.md §7 "Latency" requires keeping the CPU fast path);
+- exec summary / warning collection into the response.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from typing import TYPE_CHECKING
+
+from .dag import DAGRequest
+
+if TYPE_CHECKING:  # avoid circular import (executors.runner uses copr.dag)
+    from ..executors.runner import SelectResult
+    from ..executors.storage import ScanStorage
+
+REQ_TYPE_DAG = 103
+REQ_TYPE_ANALYZE = 104
+REQ_TYPE_CHECKSUM = 105
+
+
+@dataclass
+class CopRequest:
+    """Reference: coppb::Request (tp + data + ranges + start_ts)."""
+
+    tp: int
+    dag: DAGRequest
+    # device routing hint; None = auto (estimated row count)
+    force_backend: Optional[str] = None
+
+
+@dataclass
+class CopResponse:
+    result: "SelectResult"
+    elapsed_ns: int = 0
+    backend: str = "host"
+
+    def rows(self):
+        return self.result.rows()
+
+
+class Endpoint:
+    """Unary coprocessor endpoint over a snapshot provider.
+
+    ``snapshot_provider()`` returns a ScanStorage view of committed data —
+    the seam where MVCC snapshots plug in (reference: endpoint.rs acquires
+    an engine snapshot per request, then TikvStorage adapts it).
+    """
+
+    def __init__(self, snapshot_provider: Callable[[CopRequest], "ScanStorage"],
+                 device_runner: Optional[object] = None,
+                 device_row_threshold: int = 262144):
+        self._snapshot_provider = snapshot_provider
+        self._device_runner = device_runner
+        self._device_row_threshold = device_row_threshold
+
+    def handle(self, req: CopRequest) -> CopResponse:
+        if req.tp != REQ_TYPE_DAG:
+            raise NotImplementedError(f"request type {req.tp}")
+        t0 = time.perf_counter_ns()
+        storage = self._snapshot_provider(req)
+        backend = self._pick_backend(req, storage)
+        if backend == "device":
+            result = self._device_runner.handle_request(req.dag, storage)
+        else:
+            from ..executors.runner import BatchExecutorsRunner
+            result = BatchExecutorsRunner(req.dag, storage).handle_request()
+        return CopResponse(result, time.perf_counter_ns() - t0, backend)
+
+    def _pick_backend(self, req: CopRequest, storage) -> str:
+        if req.force_backend in ("host", "device"):
+            if req.force_backend == "device" and self._device_runner is None:
+                raise RuntimeError("no device runner registered")
+            if req.force_backend == "device" and \
+                    not self._device_runner.supports(req.dag):
+                raise RuntimeError("plan not supported by device backend")
+            return req.force_backend
+        if self._device_runner is None or not self._device_runner.supports(req.dag):
+            return "host"
+        est = getattr(storage, "estimated_rows", None)
+        n = est() if callable(est) else None
+        if n is not None and n >= self._device_row_threshold:
+            return "device"
+        return "host"

@@ -1,0 +1,120 @@
+"""Device tiles: static-shape padded column blocks.
+
+XLA wants static shapes (SURVEY.md §7 "Dynamic shapes": reference batches
+grow 32→1024 and the last batch is ragged — tidb_query_executors/src/
+runner.rs:38-45). The device representation is therefore a *tile*: a dense
+value array padded to a fixed row count plus a validity mask that doubles as
+the ragged-tail mask. All device kernels take (values, validity) pairs and
+are jit-compiled once per (tile_rows, dtype) bucket.
+
+Device dtype policy (TPU v5e):
+- INT  → int32 when the column fits, else int64 (XLA pair-emulates i64);
+  aggregation accumulators are always int64.
+- REAL → float32 values, float64 *not* used on device; SUM/AVG accumulate
+  in float64-emulated pairs on host merge, and in f32 + compensation on
+  device (see ops/agg.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .column import Column, ColumnBatch
+from .eval_type import EvalType
+
+# Default device tile: 1 Mi rows. The reference's BATCH_MAX_SIZE is 1024
+# (runner.rs:45) because its unit of work is a CPU cache tile; on TPU the
+# unit of work must amortize dispatch + HBM latency, so tiles are large and
+# the 8×128 VPU lanes are filled by reshaping to (rows/128, 128) internally.
+TILE_ROWS = 1 << 20
+
+
+def _device_dtype(eval_type: EvalType, values: np.ndarray) -> np.dtype:
+    if eval_type in (EvalType.INT, EvalType.DURATION, EvalType.DECIMAL):
+        if values.size and (values.min() < -(2**31) or values.max() >= 2**31):
+            return np.dtype(np.int64)
+        return np.dtype(np.int32)
+    if eval_type is EvalType.REAL:
+        return np.dtype(np.float32)
+    if eval_type in (EvalType.DATETIME, EvalType.ENUM, EvalType.SET):
+        return np.dtype(np.uint32) if not values.size or values.max() < 2**32 \
+            else np.dtype(np.uint64)
+    raise ValueError(f"{eval_type} has no device-native representation")
+
+
+def pad_to_tile(values: np.ndarray, validity: np.ndarray,
+                tile_rows: int = TILE_ROWS,
+                dtype: Optional[np.dtype] = None) -> tuple[np.ndarray, np.ndarray]:
+    """Pad a ragged column to ``tile_rows`` with invalid zero rows."""
+    n = len(values)
+    assert n <= tile_rows, (n, tile_rows)
+    out_dtype = dtype if dtype is not None else values.dtype
+    v = np.zeros(tile_rows, dtype=out_dtype)
+    v[:n] = values.astype(out_dtype, copy=False)
+    m = np.zeros(tile_rows, dtype=np.bool_)
+    m[:n] = validity
+    return v, m
+
+
+@dataclass
+class Tile:
+    """One device-ready column block: padded values + validity mask.
+
+    ``n_rows`` is the logical (unpadded) row count; rows >= n_rows have
+    validity False.
+    """
+
+    eval_type: EvalType
+    values: np.ndarray      # shape (tile_rows,), device dtype
+    validity: np.ndarray    # shape (tile_rows,), bool
+    n_rows: int
+
+    @staticmethod
+    def from_column(col: Column, tile_rows: int = TILE_ROWS,
+                    dtype: Optional[np.dtype] = None) -> "Tile":
+        dt = dtype if dtype is not None else _device_dtype(col.eval_type, col.values)
+        v, m = pad_to_tile(col.values, col.validity, tile_rows, dt)
+        return Tile(col.eval_type, v, m, len(col))
+
+
+@dataclass
+class TileBatch:
+    """A batch of tiles sharing one row dimension — the unit shipped to
+    device kernels. Mirrors ColumnBatch at device granularity."""
+
+    tiles: list[Tile]
+    n_rows: int
+    tile_rows: int
+
+    @staticmethod
+    def from_batch(batch: ColumnBatch, tile_rows: int = TILE_ROWS) -> list["TileBatch"]:
+        """Split a ColumnBatch into tile-sized chunks (last one padded).
+
+        The device dtype is decided once per *column* (whole-column range),
+        not per chunk — otherwise one column's tiles could mix int32/int64
+        and defeat the per-(shape, dtype) jit cache.
+        """
+        dtypes = [_device_dtype(c.eval_type, c.values) for c in batch.columns]
+        out = []
+        for start in range(0, max(batch.num_rows, 1), tile_rows):
+            chunk = batch.slice(start, min(start + tile_rows, batch.num_rows))
+            tiles = [Tile.from_column(c, tile_rows, dtype=dt)
+                     for c, dt in zip(chunk.columns, dtypes)]
+            out.append(TileBatch(tiles, chunk.num_rows, tile_rows))
+        return out
+
+
+def column_chunks(values: np.ndarray, validity: np.ndarray,
+                  tile_rows: int = TILE_ROWS):
+    """Yield (padded_values, padded_validity, n) chunks for streaming feeds."""
+    n_total = len(values)
+    for start in range(0, max(n_total, 1), tile_rows):
+        stop = min(start + tile_rows, n_total)
+        if stop - start == tile_rows:
+            yield values[start:stop], validity[start:stop], tile_rows
+        else:
+            v, m = pad_to_tile(values[start:stop], validity[start:stop], tile_rows)
+            yield v, m, stop - start

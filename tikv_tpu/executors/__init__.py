@@ -1,0 +1,32 @@
+"""Batch executor pipeline.
+
+Rebuild of components/tidb_query_executors (16k LoC): the pull-based
+vectorized Volcano model — ``BatchExecutor::next_batch(scan_rows)``
+(interface.rs:21-31) pulling ColumnBatches up a pipeline of
+TableScan/IndexScan → Selection → Projection → Agg/TopN/Limit, driven by
+``BatchExecutorsRunner`` (runner.rs).
+
+Two execution paths share the plan and the expression engine:
+
+- **host path** (this package, numpy): exact reference semantics, serves
+  small/latency-bound requests and all general cases;
+- **device path** (device_runner.py): pattern-matched plan shapes compiled
+  to fused JAX tile kernels with psum-merged partial aggregates (the
+  TPU north star, BASELINE.md).
+"""
+
+from .interface import BatchExecutor, BatchExecuteResult, ExecSummary
+from .ranges import KeyRange
+from .storage import ScanStorage, FixtureStorage
+from .runner import BatchExecutorsRunner, build_executors
+
+__all__ = [
+    "BatchExecutor",
+    "BatchExecuteResult",
+    "ExecSummary",
+    "KeyRange",
+    "ScanStorage",
+    "FixtureStorage",
+    "BatchExecutorsRunner",
+    "build_executors",
+]

@@ -1,0 +1,293 @@
+"""Aggregation executors (host path).
+
+Reference: tidb_query_executors/src/simple_aggr_executor.rs,
+fast_hash_aggr_executor.rs (single int/bytes key — specialised hashmap),
+slow_hash_aggr_executor.rs (general multi-key), stream_aggr_executor.rs
+(input sorted by group key). Output schema follows the reference: aggregate
+result columns first, then group-by columns
+(util/aggr_executor.rs schema layout).
+
+Host implementations are vectorized numpy (np.unique dictionary-encoding +
+np.add.at scatter) rather than per-row state structs; the device analogues
+live in ops/agg.py and are selected by the device runner.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..datatype import Column, ColumnBatch, EvalType, FieldType
+from ..expr import build_rpn, eval_rpn
+from .interface import BatchExecuteResult, TimedExecutor
+
+
+def _agg_ret_ft(kind: str, arg_et: Optional[EvalType]) -> FieldType:
+    if kind in ("count", "count_star"):
+        return FieldType.long(not_null=True)
+    if kind == "avg":
+        return FieldType.double()
+    if arg_et is EvalType.REAL:
+        return FieldType.double()
+    if arg_et is EvalType.BYTES:
+        return FieldType.var_char()
+    return FieldType.long()
+
+
+class _AggState:
+    """Per-group growable state arrays for one agg spec."""
+
+    def __init__(self, kind: str, et: Optional[EvalType]):
+        self.kind = kind
+        self.et = et
+        dtype = np.float64 if et is EvalType.REAL else np.int64
+        self.obj = et is EvalType.BYTES
+        self.sum = np.zeros(0, dtype=dtype) if not self.obj else None
+        self.count = np.zeros(0, dtype=np.int64)
+        if kind in ("min", "max"):
+            if self.obj:
+                self.vals: list = []
+            else:
+                ident = (np.inf if kind == "min" else -np.inf) \
+                    if dtype == np.float64 else \
+                    (np.iinfo(np.int64).max if kind == "min"
+                     else np.iinfo(np.int64).min)
+                self.ident = ident
+                self.vals = np.zeros(0, dtype=dtype)
+        if kind == "first":
+            self.first_vals: list = []
+            self.first_set: list = []
+
+    def grow(self, n_groups: int):
+        cur = len(self.count)
+        if n_groups <= cur:
+            return
+        extra = n_groups - cur
+        self.count = np.concatenate([self.count, np.zeros(extra, np.int64)])
+        if self.sum is not None:
+            self.sum = np.concatenate([self.sum,
+                                       np.zeros(extra, self.sum.dtype)])
+        if self.kind in ("min", "max"):
+            if self.obj:
+                self.vals.extend([None] * extra)
+            else:
+                self.vals = np.concatenate(
+                    [self.vals, np.full(extra, self.ident, self.vals.dtype)])
+        if self.kind == "first":
+            self.first_vals.extend([None] * extra)
+            self.first_set.extend([False] * extra)
+
+    def update(self, gids: np.ndarray, values, validity):
+        """Scatter one batch into group states. gids: int group id per row."""
+        kind = self.kind
+        if kind == "count_star":
+            np.add.at(self.count, gids, 1)
+            return
+        ok = validity
+        oki = ok.astype(np.int64)
+        if kind == "count":
+            np.add.at(self.count, gids, oki)
+        elif kind in ("sum", "avg"):
+            np.add.at(self.count, gids, oki)
+            masked = np.where(ok, values, 0).astype(self.sum.dtype)
+            np.add.at(self.sum, gids, masked)
+        elif kind in ("min", "max"):
+            np.add.at(self.count, gids, oki)
+            if self.obj:
+                for g, v, o in zip(gids, values, ok):
+                    if o:
+                        cur = self.vals[g]
+                        if cur is None or (v < cur if kind == "min" else v > cur):
+                            self.vals[g] = v
+            else:
+                filled = np.where(ok, values, self.ident)
+                (np.minimum if kind == "min" else np.maximum).at(
+                    self.vals, gids, filled)
+        elif kind == "first":
+            for g, v, o in zip(gids, values, ok):
+                if not self.first_set[g]:
+                    self.first_set[g] = True
+                    if not o:
+                        self.first_vals[g] = None
+                    else:
+                        self.first_vals[g] = v.item() if hasattr(v, "item") else v
+        else:
+            raise ValueError(kind)
+
+    def finalize_column(self, n_groups: int) -> Column:
+        kind = self.kind
+        if kind in ("count", "count_star"):
+            return Column.from_values(EvalType.INT, self.count[:n_groups].copy())
+        if kind == "sum":
+            et = EvalType.REAL if self.sum.dtype == np.float64 else EvalType.INT
+            validity = self.count[:n_groups] > 0
+            return Column(et, self.sum[:n_groups].copy(), validity)
+        if kind == "avg":
+            validity = self.count[:n_groups] > 0
+            denom = np.maximum(self.count[:n_groups], 1)
+            return Column(EvalType.REAL,
+                          self.sum[:n_groups] / denom, validity)
+        if kind in ("min", "max"):
+            validity = self.count[:n_groups] > 0
+            if self.obj:
+                return Column.from_list(EvalType.BYTES, self.vals[:n_groups])
+            vals = np.where(validity, self.vals[:n_groups], 0)
+            et = EvalType.REAL if vals.dtype == np.float64 else EvalType.INT
+            return Column(et, vals.astype(self.vals.dtype), validity)
+        if kind == "first":
+            et = self.et or EvalType.INT
+            return Column.from_list(et, self.first_vals[:n_groups])
+        raise ValueError(kind)
+
+
+class _HashAggBase(TimedExecutor):
+    """Shared machinery: dictionary-encode group keys per batch, scatter
+    into growable per-group states, emit on drain."""
+
+    def __init__(self, child, desc):
+        super().__init__()
+        self._child = child
+        self._desc = desc
+        self._group_rpns = [build_rpn(e) for e in desc.group_by]
+        self._agg_rpns = [build_rpn(a.arg) if a.arg is not None else None
+                          for a in desc.aggs]
+        arg_ets = [r.ret_type if r else None for r in self._agg_rpns]
+        self._states = [_AggState(a.kind, et)
+                        for a, et in zip(desc.aggs, arg_ets)]
+        self._group_index: dict = {}       # key tuple -> group id
+        self._group_keys: list = []        # group id -> key tuple
+        self._done = False
+        group_fts = []
+        for rpn in self._group_rpns:
+            et = rpn.ret_type
+            group_fts.append(FieldType.double() if et is EvalType.REAL
+                             else FieldType.var_char() if et is EvalType.BYTES
+                             else FieldType.long())
+        self._schema = [_agg_ret_ft(a.kind, et)
+                        for a, et in zip(desc.aggs, arg_ets)] + group_fts
+
+    @property
+    def schema(self) -> list[FieldType]:
+        return self._schema
+
+    def _gids_for(self, batch: ColumnBatch) -> np.ndarray:
+        """Map each row to a global group id (assigning new ids)."""
+        n = batch.num_rows
+        cols = [(c.values, c.validity) for c in batch.columns]
+        key_cols = []
+        for rpn in self._group_rpns:
+            v, ok = eval_rpn(rpn, cols, n, np)
+            key_cols.append((np.broadcast_to(v, (n,)),
+                             np.broadcast_to(ok, (n,))))
+        # batch-local dictionary encode: single int key fast path
+        if len(key_cols) == 1 and key_cols[0][0].dtype.kind in "iuf":
+            v, ok = key_cols[0]
+            # NULL → sentinel via separate channel in the tuple key
+            uniq, inverse = np.unique(
+                np.stack([np.where(ok, v, 0), ok.astype(v.dtype)]),
+                axis=1, return_inverse=True)
+            local_keys = [((uniq[0, j].item() if uniq[1, j] else None),)
+                          for j in range(uniq.shape[1])]
+        else:
+            rows = list(zip(*[
+                [vv.item() if o and hasattr(vv, "item") else (vv if o else None)
+                 for vv, o in zip(v, ok)] for v, ok in key_cols]))
+            uniq_map: dict = {}
+            inverse = np.empty(n, dtype=np.int64)
+            local_keys = []
+            for i, key in enumerate(rows):
+                j = uniq_map.get(key)
+                if j is None:
+                    j = len(local_keys)
+                    uniq_map[key] = j
+                    local_keys.append(key)
+                inverse[i] = j
+        # local id -> global id
+        l2g = np.empty(len(local_keys), dtype=np.int64)
+        for j, key in enumerate(local_keys):
+            g = self._group_index.get(key)
+            if g is None:
+                g = len(self._group_keys)
+                self._group_index[key] = g
+                self._group_keys.append(key)
+            l2g[j] = g
+        return l2g[inverse]
+
+    def _update(self, batch: ColumnBatch):
+        n = batch.num_rows
+        if n == 0 and self._desc.group_by:
+            return
+        gids = self._gids_for(batch) if self._desc.group_by else \
+            np.zeros(n, dtype=np.int64)
+        if not self._desc.group_by and not self._group_keys:
+            self._group_keys.append(())
+        n_groups = len(self._group_keys)
+        cols = [(c.values, c.validity) for c in batch.columns]
+        for st, rpn in zip(self._states, self._agg_rpns):
+            st.grow(n_groups)
+            if rpn is None:
+                st.update(gids, None, None)
+            else:
+                v, ok = eval_rpn(rpn, cols, n, np)
+                st.update(gids, np.broadcast_to(v, (n,)),
+                          np.broadcast_to(ok, (n,)))
+
+    def _emit(self) -> ColumnBatch:
+        n_groups = len(self._group_keys)
+        agg_cols = [st.finalize_column(n_groups) for st in self._states]
+        group_cols = []
+        for k in range(len(self._group_rpns)):
+            et = self._group_rpns[k].ret_type
+            group_cols.append(Column.from_list(
+                et, [key[k] for key in self._group_keys]))
+        return ColumnBatch(self._schema, agg_cols + group_cols)
+
+    def _next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        if self._done:
+            return BatchExecuteResult(ColumnBatch.empty(self._schema), True)
+        while True:
+            r = self._child.next_batch(scan_rows)
+            self._update(r.batch)
+            if r.is_drained:
+                self._done = True
+                return BatchExecuteResult(self._emit(), True, r.warnings)
+
+
+class BatchFastHashAggExecutor(_HashAggBase):
+    """Reference: fast_hash_aggr_executor.rs — single group-by key."""
+
+
+class BatchSlowHashAggExecutor(_HashAggBase):
+    """Reference: slow_hash_aggr_executor.rs — multi-column group keys."""
+
+
+class BatchSimpleAggExecutor(_HashAggBase):
+    """Reference: simple_aggr_executor.rs — no group by; exactly one
+    output row even for empty input (COUNT()=0, SUM()=NULL)."""
+
+    def _next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        if self._done:
+            return BatchExecuteResult(ColumnBatch.empty(self._schema), True)
+        while True:
+            r = self._child.next_batch(scan_rows)
+            if not self._group_keys:
+                self._group_keys.append(())
+            self._update(r.batch)
+            if r.is_drained:
+                self._done = True
+                for st in self._states:
+                    st.grow(1)
+                return BatchExecuteResult(self._emit(), True, r.warnings)
+
+
+class BatchStreamAggExecutor(_HashAggBase):
+    """Reference: stream_aggr_executor.rs — input sorted by group key;
+    groups complete when the key changes, so memory is O(1) groups.
+
+    Host implementation reuses the hash machinery but flushes completed
+    groups per batch (correct for sorted input; asserts are on the plan
+    builder, as in the reference)."""
+
+    # For round 1 the pipeline result is identical to hash agg (all groups
+    # emitted at drain); streaming emission arrives with the paging support.

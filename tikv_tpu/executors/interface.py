@@ -1,0 +1,72 @@
+"""Executor interfaces.
+
+Reference: tidb_query_executors/src/interface.rs — ``BatchExecutor`` trait
+(:21): ``schema()``, ``next_batch(scan_rows) -> BatchExecuteResult``
+(physical columns + logical rows + is_drained), and exec-summary collection
+(:45, ExecSummaryCollector). We fold logical-rows into the batch itself
+(executors emit already-filtered batches — simpler, and the device path
+works on masks anyway).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+from ..datatype import ColumnBatch, FieldType
+
+
+@dataclass
+class ExecSummary:
+    """Per-operator execution summary.
+
+    Reference: tipb ExecutorExecutionSummary, filled by runner.rs
+    (collect_exec_stats): rows produced, #next_batch calls, wall time.
+    """
+
+    num_produced_rows: int = 0
+    num_iterations: int = 0
+    time_processed_ns: int = 0
+
+    def record(self, rows: int, elapsed_ns: int):
+        self.num_produced_rows += rows
+        self.num_iterations += 1
+        self.time_processed_ns += elapsed_ns
+
+
+@dataclass
+class BatchExecuteResult:
+    batch: ColumnBatch
+    is_drained: bool
+    # warnings carried upward (reference: EvalContext warnings)
+    warnings: list = field(default_factory=list)
+
+
+class BatchExecutor(Protocol):
+    summary: ExecSummary
+
+    @property
+    def schema(self) -> list[FieldType]: ...
+
+    def next_batch(self, scan_rows: int) -> BatchExecuteResult: ...
+
+
+class TimedExecutor:
+    """Base class handling exec-summary timing around next_batch."""
+
+    def __init__(self):
+        self.summary = ExecSummary()
+
+    @property
+    def schema(self) -> list[FieldType]:
+        raise NotImplementedError
+
+    def _next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        raise NotImplementedError
+
+    def next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        t0 = time.perf_counter_ns()
+        r = self._next_batch(scan_rows)
+        self.summary.record(r.batch.num_rows, time.perf_counter_ns() - t0)
+        return r

@@ -1,0 +1,146 @@
+"""Pipeline builder + driver.
+
+Reference: tidb_query_executors/src/runner.rs — ``build_executors`` (:181)
+maps tipb Executor descriptors to BatchExecutor impls (scan must be first;
+agg picks simple/fast-hash/slow-hash/stream by plan shape, :293-318), and
+``BatchExecutorsRunner::handle_request`` (:498,:641) drives the pipeline
+with batch sizes growing 32 → (×2) → 1024 (:38-45), collecting exec
+summaries and encoding result chunks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..copr.dag import (
+    AggregationDesc,
+    DAGRequest,
+    IndexScanDesc,
+    LimitDesc,
+    ProjectionDesc,
+    SelectionDesc,
+    TableScanDesc,
+    TopNDesc,
+)
+from ..datatype import ColumnBatch, EvalType
+from .aggregation import (
+    BatchFastHashAggExecutor,
+    BatchSimpleAggExecutor,
+    BatchSlowHashAggExecutor,
+    BatchStreamAggExecutor,
+)
+from .interface import BatchExecutor, ExecSummary
+from .scan import BatchIndexScanExecutor, BatchTableScanExecutor
+from .simple import (
+    BatchLimitExecutor,
+    BatchProjectionExecutor,
+    BatchSelectionExecutor,
+)
+from .storage import ScanStorage
+from .top_n import BatchTopNExecutor
+
+BATCH_INITIAL_SIZE = 32
+BATCH_MAX_SIZE = 1024
+BATCH_GROW_FACTOR = 2
+
+
+def build_executors(dag: DAGRequest, storage: ScanStorage) -> BatchExecutor:
+    """Reference: runner.rs build_executors — first descriptor must be a
+    scan; aggregation executor choice mirrors runner.rs:293-318."""
+    descs = dag.executors
+    if not descs:
+        raise ValueError("empty executor list")
+    head = descs[0]
+    if isinstance(head, TableScanDesc):
+        ex: BatchExecutor = BatchTableScanExecutor(storage, head, dag.ranges)
+    elif isinstance(head, IndexScanDesc):
+        ex = BatchIndexScanExecutor(storage, head, dag.ranges)
+    else:
+        raise ValueError(f"pipeline must start with a scan, got {head}")
+    for d in descs[1:]:
+        if isinstance(d, SelectionDesc):
+            ex = BatchSelectionExecutor(ex, d)
+        elif isinstance(d, ProjectionDesc):
+            ex = BatchProjectionExecutor(ex, d)
+        elif isinstance(d, AggregationDesc):
+            if not d.group_by:
+                ex = BatchSimpleAggExecutor(ex, d)
+            elif d.streamed:
+                ex = BatchStreamAggExecutor(ex, d)
+            elif len(d.group_by) == 1 and _is_fast_key(d.group_by[0]):
+                ex = BatchFastHashAggExecutor(ex, d)
+            else:
+                ex = BatchSlowHashAggExecutor(ex, d)
+        elif isinstance(d, TopNDesc):
+            ex = BatchTopNExecutor(ex, d)
+        elif isinstance(d, LimitDesc):
+            ex = BatchLimitExecutor(ex, d)
+        else:
+            raise ValueError(f"unsupported executor {d}")
+    return ex
+
+
+def _is_fast_key(e) -> bool:
+    # fast hash agg: single column ref or int-typed expression
+    et = e.eval_type if e.kind != "call" else None
+    from ..expr.functions import FUNCTIONS
+    if e.kind == "call":
+        et = FUNCTIONS[e.sig].ret
+    return et in (EvalType.INT, EvalType.REAL)
+
+
+@dataclass
+class SelectResult:
+    """Decoded response: final columns + per-executor summaries."""
+
+    batch: ColumnBatch
+    exec_summaries: list
+    warnings: list = field(default_factory=list)
+
+    def rows(self):
+        return self.batch.rows()
+
+
+class BatchExecutorsRunner:
+    """Drives the pipeline to completion (unary request).
+
+    Reference: runner.rs handle_request/internal_handle_request.
+    """
+
+    def __init__(self, dag: DAGRequest, storage: ScanStorage):
+        self._dag = dag
+        self._out = build_executors(dag, storage)
+
+    def handle_request(self) -> SelectResult:
+        batch_size = BATCH_INITIAL_SIZE
+        chunks: list[ColumnBatch] = []
+        warnings: list = []
+        while True:
+            r = self._out.next_batch(batch_size)
+            if r.batch.num_rows:
+                chunks.append(r.batch)
+            warnings.extend(r.warnings)
+            if r.is_drained:
+                break
+            if batch_size < BATCH_MAX_SIZE:
+                batch_size = min(batch_size * BATCH_GROW_FACTOR,
+                                 BATCH_MAX_SIZE)
+        schema = self._out.schema
+        batch = ColumnBatch.concat(chunks) if chunks \
+            else ColumnBatch.empty(schema)
+        if self._dag.output_offsets is not None:
+            batch = ColumnBatch(
+                [batch.schema[i] for i in self._dag.output_offsets],
+                [batch.columns[i] for i in self._dag.output_offsets])
+        summaries = _collect_summaries(self._out)
+        return SelectResult(batch, summaries, warnings)
+
+
+def _collect_summaries(ex) -> list[ExecSummary]:
+    out = []
+    cur = ex
+    while cur is not None:
+        out.append(cur.summary)
+        cur = getattr(cur, "_child", None)
+    return list(reversed(out))  # scan first, like the reference

@@ -1,0 +1,121 @@
+"""Executor-facing storage feed.
+
+Reference: components/tidb_query_common/src/storage/mod.rs:21-32 — the
+3-method ``Storage`` trait (``begin_scan`` / ``scan_next`` / ``get``) that
+decouples executors from MVCC/engine details; implemented in production by
+``TikvStorage`` over MVCC scanners (src/coprocessor/dag/storage_impl.rs:14)
+and in tests by fixture stores (components/test_coprocessor).
+
+TPU-first addition: ``scan_batch`` — pull up to N pairs at once so the host
+decode loop is a single pass feeding pinned columnar buffers (SURVEY.md §7
+"Decode on the hot path"); the per-pair ``scan_next`` remains for parity.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Iterable, Optional, Protocol, Sequence
+
+from .ranges import KeyRange
+
+
+class ScanStorage(Protocol):
+    def begin_scan(self, ranges: Sequence[KeyRange], desc: bool = False) -> None: ...
+
+    def scan_next(self) -> Optional[tuple[bytes, bytes]]: ...
+
+    def scan_batch(self, n: int) -> list[tuple[bytes, bytes]]: ...
+
+    def get(self, key: bytes) -> Optional[bytes]: ...
+
+
+class FixtureStorage:
+    """Sorted in-memory KV — the zero-Raft, zero-engine feed.
+
+    Reference: test fixtures in components/test_coprocessor/src/fixture.rs
+    (fixture store used by all executor benches) and the ``FixtureStorage``
+    in tidb_query_executors tests.
+    """
+
+    def __init__(self, pairs: Iterable[tuple[bytes, bytes]] = ()):
+        data = sorted(pairs)
+        self._keys = [k for k, _ in data]
+        self._vals = [v for _, v in data]
+        self._ranges: list[KeyRange] = []
+        self._desc = False
+        self._range_idx = 0
+        self._pos = 0
+        self._stop = 0
+
+    # -- construction helpers ------------------------------------------------
+
+    def put(self, key: bytes, value: bytes) -> None:
+        i = bisect.bisect_left(self._keys, key)
+        if i < len(self._keys) and self._keys[i] == key:
+            self._vals[i] = value
+        else:
+            self._keys.insert(i, key)
+            self._vals.insert(i, value)
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    # -- ScanStorage ---------------------------------------------------------
+
+    def begin_scan(self, ranges: Sequence[KeyRange], desc: bool = False) -> None:
+        self._ranges = list(ranges)
+        self._desc = desc
+        self._range_idx = 0
+        self._load_range()
+
+    def _load_range(self) -> None:
+        while self._range_idx < len(self._ranges):
+            r = self._ranges[self._range_idx]
+            lo = bisect.bisect_left(self._keys, r.start)
+            hi = bisect.bisect_left(self._keys, r.end)
+            if lo < hi:
+                if self._desc:
+                    self._pos, self._stop = hi - 1, lo - 1
+                else:
+                    self._pos, self._stop = lo, hi
+                return
+            self._range_idx += 1
+        self._pos = self._stop = 0
+
+    def scan_next(self) -> Optional[tuple[bytes, bytes]]:
+        while True:
+            if self._range_idx >= len(self._ranges):
+                return None
+            if self._pos != self._stop:
+                i = self._pos
+                self._pos += -1 if self._desc else 1
+                return self._keys[i], self._vals[i]
+            self._range_idx += 1
+            self._load_range()
+
+    def scan_batch(self, n: int) -> list[tuple[bytes, bytes]]:
+        out: list[tuple[bytes, bytes]] = []
+        while len(out) < n:
+            if self._range_idx >= len(self._ranges):
+                break
+            if self._pos == self._stop:
+                self._range_idx += 1
+                self._load_range()
+                continue
+            if self._desc:
+                take = min(n - len(out), self._pos - self._stop)
+                for i in range(self._pos, self._pos - take, -1):
+                    out.append((self._keys[i], self._vals[i]))
+                self._pos -= take
+            else:
+                take = min(n - len(out), self._stop - self._pos)
+                out.extend(zip(self._keys[self._pos:self._pos + take],
+                               self._vals[self._pos:self._pos + take]))
+                self._pos += take
+        return out
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        i = bisect.bisect_left(self._keys, key)
+        if i < len(self._keys) and self._keys[i] == key:
+            return self._vals[i]
+        return None

@@ -1,0 +1,119 @@
+"""TopN executor (host path).
+
+Reference: tidb_query_executors/src/top_n_executor.rs — keeps a k-sized
+heap of rows ordered by the ORDER BY expressions. Host implementation is
+vectorized: per batch, evaluate sort keys, concatenate with the running
+k candidate rows, lexsort, keep k. NULLs sort first ASC / last DESC
+(MySQL), ties broken by arrival order (stable, like the reference's heap).
+BYTES sort keys use a comparison sort (candidate set is bounded by
+k + batch, so the Python comparison cost is O((k+1024) log) per fold).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..datatype import ColumnBatch, EvalType, FieldType
+from ..expr import build_rpn, eval_rpn
+from .interface import BatchExecuteResult, TimedExecutor
+
+
+class BatchTopNExecutor(TimedExecutor):
+    def __init__(self, child, desc):
+        super().__init__()
+        self._child = child
+        self._desc = desc
+        self._rpns = [build_rpn(e) for e, _ in desc.order_by]
+        self._descs = [d for _, d in desc.order_by]
+        self._k = desc.limit
+        self._cand: ColumnBatch | None = None
+        self._cand_keys: list | None = None   # per ORDER BY: (values, validity)
+        self._cand_seq: np.ndarray | None = None
+        self._next_seq = 0
+        self._done = False
+
+    @property
+    def schema(self) -> list[FieldType]:
+        return self._child.schema
+
+    def _eval_keys(self, batch: ColumnBatch) -> list[tuple]:
+        n = batch.num_rows
+        cols = [(c.values, c.validity) for c in batch.columns]
+        keys = []
+        for rpn in self._rpns:
+            v, ok = eval_rpn(rpn, cols, n, np)
+            keys.append((np.broadcast_to(v, (n,)), np.broadcast_to(ok, (n,))))
+        return keys
+
+    def _order(self, keys: list[tuple], seq: np.ndarray) -> np.ndarray:
+        """Indices of the best-first ordering over the candidate set."""
+        has_obj = any(v.dtype == np.dtype(object) for v, _ in keys)
+        if not has_obj:
+            lex: list[np.ndarray] = [seq]
+            for (v, ok), desc in zip(reversed(keys),
+                                     reversed(self._descs)):
+                fv = v.astype(np.float64, copy=False)
+                if desc:
+                    lex.append(np.where(ok, -fv, np.inf))   # NULL last
+                else:
+                    lex.append(np.where(ok, fv, -np.inf))   # NULL first
+            return np.lexsort(tuple(lex))[:self._k]
+
+        n = len(seq)
+        descs = self._descs
+
+        def cmp(i: int, j: int) -> int:
+            for (v, ok), desc in zip(keys, descs):
+                a_null, b_null = not ok[i], not ok[j]
+                if a_null or b_null:
+                    if a_null and b_null:
+                        continue
+                    # ASC: NULL first (NULL is "smaller"); DESC: NULL last
+                    null_wins = not desc
+                    if a_null:
+                        return -1 if null_wins else 1
+                    return 1 if null_wins else -1
+                a, b = v[i], v[j]
+                if a == b:
+                    continue
+                lt = a < b
+                if desc:
+                    lt = not lt
+                return -1 if lt else 1
+            return -1 if seq[i] < seq[j] else 1
+
+        order = sorted(range(n), key=functools.cmp_to_key(cmp))[:self._k]
+        return np.asarray(order, dtype=np.int64)
+
+    def _fold(self, batch: ColumnBatch):
+        if batch.num_rows == 0:
+            return
+        keys = self._eval_keys(batch)
+        seq = np.arange(self._next_seq, self._next_seq + batch.num_rows,
+                        dtype=np.int64)
+        self._next_seq += batch.num_rows
+        if self._cand is None:
+            cand, ckeys, cseq = batch, keys, seq
+        else:
+            cand = ColumnBatch.concat([self._cand, batch])
+            ckeys = [(np.concatenate([av, bv]), np.concatenate([am, bm]))
+                     for (av, am), (bv, bm) in zip(self._cand_keys, keys)]
+            cseq = np.concatenate([self._cand_seq, seq])
+        order = self._order(ckeys, cseq)
+        self._cand = cand.take(order)
+        self._cand_keys = [(v[order], ok[order]) for v, ok in ckeys]
+        self._cand_seq = cseq[order]
+
+    def _next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        if self._done:
+            return BatchExecuteResult(ColumnBatch.empty(self.schema), True)
+        while True:
+            r = self._child.next_batch(scan_rows)
+            self._fold(r.batch)
+            if r.is_drained:
+                self._done = True
+                out = self._cand if self._cand is not None \
+                    else ColumnBatch.empty(self.schema)
+                return BatchExecuteResult(out, True, r.warnings)

@@ -1,0 +1,33 @@
+"""Vectorized scalar expression engine.
+
+Rebuild of the reference's ``components/tidb_query_expr`` (30.6k LoC):
+``RpnExpression`` postfix programs (types/expr.rs:12), the stack-machine
+evaluator (types/expr_eval.rs:161), the tree→RPN builder
+(types/expr_builder.rs) and the ``ScalarFuncSig`` function registry
+(lib.rs map_expr_node_to_rpn_func, 425 sigs).
+
+TPU-first redesign: instead of per-opcode dynamic dispatch over chunked
+vectors, an RPN program is *traced* once into a pure JAX function over
+(values, validity) array pairs and jit-compiled per (plan, tile-shape)
+bucket — XLA then fuses the whole expression (and the surrounding
+filter/aggregate) into a single kernel. The same trace runs under numpy for
+the host fast path (small requests, SURVEY.md §7 "Latency").
+"""
+
+from .tree import Expr
+from .rpn import RpnExpression, RpnConst, RpnColumnRef, RpnFnCall, build_rpn
+from .functions import FUNCTIONS, RpnFnMeta, rpn_fn
+from .eval import eval_rpn
+
+__all__ = [
+    "Expr",
+    "RpnExpression",
+    "RpnConst",
+    "RpnColumnRef",
+    "RpnFnCall",
+    "build_rpn",
+    "FUNCTIONS",
+    "RpnFnMeta",
+    "rpn_fn",
+    "eval_rpn",
+]

@@ -1,0 +1,76 @@
+"""RPN stack-machine evaluation.
+
+Reference: tidb_query_expr/src/types/expr_eval.rs:161 (eval over
+LazyBatchColumnVec). Here the evaluator is *trace-friendly*: given column
+(values, validity) array pairs it applies pure array ops, so the same
+function body serves three backends:
+
+- numpy on host (small-request fast path, SURVEY.md §7 "Latency");
+- jax.numpy under ``jax.jit`` — the whole expression fuses into one XLA
+  computation together with the surrounding filter/aggregate;
+- jax.numpy under ``shard_map`` for cross-chip plans.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from .rpn import RpnColumnRef, RpnConst, RpnExpression, RpnFnCall
+
+
+def _const_pair(xp, node: RpnConst, device: bool):
+    if node.value is None:
+        # NULL literal: dtype matches the eval type's device/host policy.
+        from ..datatype import EvalType
+        if node.eval_type is EvalType.REAL:
+            dt = "float32" if device else "float64"
+        else:
+            dt = "int32" if device else "int64"
+        return xp.zeros((), dtype=dt), xp.zeros((), dtype=bool)
+    v = node.value
+    if isinstance(v, float):
+        dt = "float32" if device else "float64"
+    elif isinstance(v, int):
+        if device:
+            dt = "int32" if -(2**31) <= v < 2**31 else "int64"
+        else:
+            dt = "int64"
+    else:
+        return np.asarray(v, dtype=object), np.ones((), dtype=bool)
+    return xp.asarray(v, dtype=dt), xp.ones((), dtype=bool)
+
+
+def eval_rpn(rpn: RpnExpression, columns: Sequence[tuple], n_rows, xp=np):
+    """Evaluate ``rpn`` over ``columns`` (list of (values, validity) pairs).
+
+    Returns a (values, validity) pair of length ``n_rows`` (scalars are
+    broadcast). ``xp`` is numpy or jax.numpy; under jax.numpy the call is
+    traceable and jit-safe (no data-dependent Python control flow — the
+    program structure itself is static per plan).
+    """
+    device = xp is not np
+    stack: list[tuple] = []
+    for node in rpn.nodes:
+        if isinstance(node, RpnConst):
+            stack.append(_const_pair(xp, node, device))
+        elif isinstance(node, RpnColumnRef):
+            stack.append(columns[node.col_idx])
+        elif isinstance(node, RpnFnCall):
+            if node.n_args:
+                args = stack[-node.n_args:]
+                del stack[-node.n_args:]
+            else:
+                args = []
+            stack.append(node.meta.fn(xp, *args))
+        else:  # pragma: no cover
+            raise AssertionError(node)
+    assert len(stack) == 1, f"malformed RPN: stack depth {len(stack)}"
+    values, validity = stack[0]
+    # broadcast scalar results (e.g. constant predicates) to n_rows
+    if getattr(values, "ndim", 0) == 0:
+        values = xp.broadcast_to(values, (n_rows,))
+    if getattr(validity, "ndim", 0) == 0:
+        validity = xp.broadcast_to(validity, (n_rows,))
+    return values, validity

@@ -1,0 +1,495 @@
+"""ScalarFuncSig registry — vectorized scalar functions.
+
+Reference: components/tidb_query_expr/src/lib.rs ``map_expr_node_to_rpn_func``
+(425 ScalarFuncSig mappings) and the impl_* modules (impl_arithmetic.rs,
+impl_compare.rs, impl_op.rs, impl_math.rs, impl_control.rs, impl_cast.rs).
+Signature names match the reference's ScalarFuncSig variants one-for-one so
+parity can be audited per sig.
+
+Each implementation is written against an array namespace ``xp`` (numpy for
+the host fast path, jax.numpy under trace) and maps
+``(values, validity) × arity → (values, validity)``:
+
+- NULL slots hold value 0, so kernels never see garbage;
+- tri-state logic follows MySQL (impl_op.rs logical_and/logical_or);
+- division by zero yields NULL (impl_arithmetic.rs int_divide/real_divide
+  under non-ERROR_FOR_DIVISION_BY_ZERO mode);
+- boolean-valued results are int (0/1) in the *compact* int dtype (int32 on
+  device tiles, promoted as needed on host).
+
+Known deviations (tracked for later rounds): integer overflow wraps instead
+of erroring; DECIMAL sigs operate on scaled int64.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..datatype import EvalType
+
+Pair = tuple  # (values, validity)
+
+
+@dataclass(frozen=True)
+class RpnFnMeta:
+    name: str
+    arity: Optional[int]          # None = variadic
+    ret: EvalType
+    args: tuple                   # arg EvalTypes; for variadic, the repeated type
+    fn: Callable                  # fn(xp, *pairs) -> pair
+
+
+FUNCTIONS: dict[str, RpnFnMeta] = {}
+
+
+def rpn_fn(name: str, arity: Optional[int], ret: EvalType, args: tuple):
+    def deco(fn):
+        FUNCTIONS[name] = RpnFnMeta(name, arity, ret, args, fn)
+        return fn
+    return deco
+
+
+def _bool_dtype(xp):
+    return xp.int32
+
+
+def _ibool(xp, cond):
+    return cond.astype(_bool_dtype(xp)) if hasattr(cond, "astype") \
+        else xp.asarray(cond, dtype=_bool_dtype(xp))
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic — reference: impl_arithmetic.rs
+# ---------------------------------------------------------------------------
+
+def _register_arith():
+    I, R = EvalType.INT, EvalType.REAL
+
+    def binop(name, ret, ty, op):
+        @rpn_fn(name, 2, ret, (ty, ty))
+        def _f(xp, a, b, _op=op):
+            (av, am), (bv, bm) = a, b
+            return _op(xp, av, bv), am & bm
+        return _f
+
+    binop("PlusInt", I, I, lambda xp, a, b: a + b)
+    binop("MinusInt", I, I, lambda xp, a, b: a - b)
+    binop("MultiplyInt", I, I, lambda xp, a, b: a * b)
+    binop("PlusReal", R, R, lambda xp, a, b: a + b)
+    binop("MinusReal", R, R, lambda xp, a, b: a - b)
+    binop("MultiplyReal", R, R, lambda xp, a, b: a * b)
+
+    @rpn_fn("DivideReal", 2, R, (R, R))
+    def divide_real(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        zero = bv == 0
+        safe = xp.where(zero, xp.ones_like(bv), bv)
+        return av / safe, am & bm & ~zero
+
+    @rpn_fn("IntDivideInt", 2, I, (I, I))
+    def int_divide_int(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        zero = bv == 0
+        safe = xp.where(zero, xp.ones_like(bv), bv)
+        # MySQL DIV truncates toward zero; // floors — correct the sign case.
+        q = av // safe
+        r = av - q * safe
+        q = xp.where((r != 0) & ((av < 0) != (bv < 0)), q + 1, q)
+        return q, am & bm & ~zero
+
+    @rpn_fn("ModInt", 2, I, (I, I))
+    def mod_int(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        zero = bv == 0
+        safe = xp.where(zero, xp.ones_like(bv), bv)
+        # MySQL % takes the sign of the dividend (truncated division).
+        m = av - (xp.where((av - (av // safe) * safe != 0)
+                           & ((av < 0) != (bv < 0)),
+                           av // safe + 1, av // safe)) * safe
+        return m, am & bm & ~zero
+
+    @rpn_fn("ModReal", 2, R, (R, R))
+    def mod_real(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        zero = bv == 0
+        safe = xp.where(zero, xp.ones_like(bv), bv)
+        m = av - xp.trunc(av / safe) * safe
+        return m, am & bm & ~zero
+
+    @rpn_fn("UnaryMinusInt", 1, I, (I,))
+    def unary_minus_int(xp, a):
+        (av, am) = a
+        return -av, am
+
+    @rpn_fn("UnaryMinusReal", 1, R, (R,))
+    def unary_minus_real(xp, a):
+        (av, am) = a
+        return -av, am
+
+    @rpn_fn("AbsInt", 1, I, (I,))
+    def abs_int(xp, a):
+        (av, am) = a
+        return xp.abs(av), am
+
+    @rpn_fn("AbsReal", 1, R, (R,))
+    def abs_real(xp, a):
+        (av, am) = a
+        return xp.abs(av), am
+
+
+# ---------------------------------------------------------------------------
+# Comparison — reference: impl_compare.rs
+# ---------------------------------------------------------------------------
+
+def _register_compare():
+    I, R = EvalType.INT, EvalType.REAL
+    cmps = {
+        "Gt": lambda xp, a, b: a > b,
+        "Ge": lambda xp, a, b: a >= b,
+        "Lt": lambda xp, a, b: a < b,
+        "Le": lambda xp, a, b: a <= b,
+        "Eq": lambda xp, a, b: a == b,
+        "Ne": lambda xp, a, b: a != b,
+    }
+    for stem, op in cmps.items():
+        for suffix, ty in (("Int", I), ("Real", R)):
+            @rpn_fn(stem + suffix, 2, I, (ty, ty))
+            def _f(xp, a, b, _op=op):
+                (av, am), (bv, bm) = a, b
+                return _ibool(xp, _op(xp, av, bv)), am & bm
+
+    for suffix, ty in (("Int", I), ("Real", R)):
+        @rpn_fn("NullEq" + suffix, 2, I, (ty, ty))
+        def null_eq(xp, a, b):
+            (av, am), (bv, bm) = a, b
+            both_null = ~am & ~bm
+            eq = am & bm & (av == bv)
+            ones = xp.ones_like(am)
+            return _ibool(xp, both_null | eq), ones
+
+    for suffix, ty in (("Int", I), ("Real", R)):
+        @rpn_fn("GreatestInt" if ty is I else "GreatestReal", None, ty, (ty,))
+        def greatest(xp, *pairs):
+            vals = [p[0] for p in pairs]
+            masks = [p[1] for p in pairs]
+            out = vals[0]
+            for v in vals[1:]:
+                out = xp.maximum(out, v)
+            valid = masks[0]
+            for m in masks[1:]:
+                valid = valid & m
+            return out, valid
+
+        @rpn_fn("LeastInt" if ty is I else "LeastReal", None, ty, (ty,))
+        def least(xp, *pairs):
+            vals = [p[0] for p in pairs]
+            masks = [p[1] for p in pairs]
+            out = vals[0]
+            for v in vals[1:]:
+                out = xp.minimum(out, v)
+            valid = masks[0]
+            for m in masks[1:]:
+                valid = valid & m
+            return out, valid
+
+    for suffix, ty in (("Int", I), ("Real", R)):
+        @rpn_fn("In" + suffix, None, I, (ty,))
+        def in_list(xp, *pairs):
+            # pairs[0] is the probe; the rest the list. MySQL IN: NULL if no
+            # match and any list element (or the probe) is NULL.
+            (pv, pm) = pairs[0]
+            hit = None
+            any_null = ~pm
+            for (lv, lm) in pairs[1:]:
+                h = pm & lm & (pv == lv)
+                hit = h if hit is None else (hit | h)
+                any_null = any_null | ~lm
+            if hit is None:
+                hit = xp.zeros_like(pm)
+            return _ibool(xp, hit), hit | ~any_null
+
+
+# ---------------------------------------------------------------------------
+# Logical / predicate ops — reference: impl_op.rs
+# ---------------------------------------------------------------------------
+
+def _register_logic():
+    I, R = EvalType.INT, EvalType.REAL
+
+    @rpn_fn("LogicalAnd", 2, I, (I, I))
+    def logical_and(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        a_false = am & (av == 0)
+        b_false = bm & (bv == 0)
+        value = _ibool(xp, ~(a_false | b_false))
+        valid = (am & bm) | a_false | b_false
+        return value, valid
+
+    @rpn_fn("LogicalOr", 2, I, (I, I))
+    def logical_or(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        a_true = am & (av != 0)
+        b_true = bm & (bv != 0)
+        value = _ibool(xp, a_true | b_true)
+        valid = (am & bm) | a_true | b_true
+        return value, valid
+
+    @rpn_fn("LogicalXor", 2, I, (I, I))
+    def logical_xor(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        return _ibool(xp, (av != 0) ^ (bv != 0)), am & bm
+
+    @rpn_fn("UnaryNotInt", 1, I, (I,))
+    def unary_not_int(xp, a):
+        (av, am) = a
+        return _ibool(xp, av == 0), am
+
+    @rpn_fn("UnaryNotReal", 1, I, (R,))
+    def unary_not_real(xp, a):
+        (av, am) = a
+        return _ibool(xp, av == 0), am
+
+    for suffix, ty in (("Int", I), ("Real", R)):
+        @rpn_fn("IsNull" + suffix, 1, I, (ty,))
+        def is_null(xp, a):
+            (av, am) = a
+            return _ibool(xp, ~am), xp.ones_like(am)
+
+    @rpn_fn("IntIsTrue", 1, I, (I,))
+    def int_is_true(xp, a):
+        (av, am) = a
+        return _ibool(xp, am & (av != 0)), xp.ones_like(am)
+
+    @rpn_fn("IntIsFalse", 1, I, (I,))
+    def int_is_false(xp, a):
+        (av, am) = a
+        return _ibool(xp, am & (av == 0)), xp.ones_like(am)
+
+    @rpn_fn("RealIsTrue", 1, I, (R,))
+    def real_is_true(xp, a):
+        (av, am) = a
+        return _ibool(xp, am & (av != 0)), xp.ones_like(am)
+
+    @rpn_fn("RealIsFalse", 1, I, (R,))
+    def real_is_false(xp, a):
+        (av, am) = a
+        return _ibool(xp, am & (av == 0)), xp.ones_like(am)
+
+    # Bit ops — always-valid int semantics (impl_op.rs bit_and etc.)
+    @rpn_fn("BitAndSig", 2, I, (I, I))
+    def bit_and(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        return av & bv, am & bm
+
+    @rpn_fn("BitOrSig", 2, I, (I, I))
+    def bit_or(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        return av | bv, am & bm
+
+    @rpn_fn("BitXorSig", 2, I, (I, I))
+    def bit_xor(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        return av ^ bv, am & bm
+
+    @rpn_fn("BitNegSig", 1, I, (I,))
+    def bit_neg(xp, a):
+        (av, am) = a
+        return ~av, am
+
+    @rpn_fn("LeftShift", 2, I, (I, I))
+    def left_shift(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        big = (bv < 0) | (bv >= 64)
+        safe = xp.where(big, xp.zeros_like(bv), bv)
+        return xp.where(big, xp.zeros_like(av), av << safe), am & bm
+
+    @rpn_fn("RightShift", 2, I, (I, I))
+    def right_shift(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        big = (bv < 0) | (bv >= 64)
+        safe = xp.where(big, xp.zeros_like(bv), bv)
+        return xp.where(big, xp.zeros_like(av), av >> safe), am & bm
+
+
+# ---------------------------------------------------------------------------
+# Control — reference: impl_control.rs
+# ---------------------------------------------------------------------------
+
+def _register_control():
+    I, R = EvalType.INT, EvalType.REAL
+    for suffix, ty in (("Int", I), ("Real", R)):
+        @rpn_fn("If" + suffix, 3, ty, (I, ty, ty))
+        def if_fn(xp, c, t, f):
+            (cv, cm), (tv, tm), (fv, fm) = c, t, f
+            cond = cm & (cv != 0)
+            return xp.where(cond, tv, fv), xp.where(cond, tm, fm)
+
+        @rpn_fn("IfNull" + suffix, 2, ty, (ty, ty))
+        def if_null(xp, a, b):
+            (av, am), (bv, bm) = a, b
+            return xp.where(am, av, bv), am | bm
+
+        @rpn_fn("CaseWhen" + suffix, None, ty, (ty,))
+        def case_when(xp, *pairs):
+            # pairs: cond1, res1, cond2, res2, ..., [else]. First true cond wins.
+            n = len(pairs)
+            has_else = n % 2 == 1
+            conds = [(pairs[i], pairs[i + 1]) for i in range(0, n - 1, 2)]
+            if has_else:
+                out_v, out_m = pairs[-1]
+            else:
+                (v0, m0) = conds[0][1]
+                out_v, out_m = xp.zeros_like(v0), xp.zeros_like(m0)
+            for (cv, cm), (rv, rm) in reversed(conds):
+                hit = cm & (cv != 0)
+                out_v = xp.where(hit, rv, out_v)
+                out_m = xp.where(hit, rm, out_m)
+            return out_v, out_m
+
+        @rpn_fn("Coalesce" + suffix, None, ty, (ty,))
+        def coalesce(xp, *pairs):
+            out_v, out_m = pairs[-1]
+            for (v, m) in reversed(pairs[:-1]):
+                out_v = xp.where(m, v, out_v)
+                out_m = m | out_m
+            return out_v, out_m
+
+
+# ---------------------------------------------------------------------------
+# Casts — reference: impl_cast.rs
+# ---------------------------------------------------------------------------
+
+def _register_cast():
+    I, R = EvalType.INT, EvalType.REAL
+
+    @rpn_fn("CastIntAsInt", 1, I, (I,))
+    def cast_int_int(xp, a):
+        return a
+
+    @rpn_fn("CastRealAsReal", 1, R, (R,))
+    def cast_real_real(xp, a):
+        return a
+
+    @rpn_fn("CastIntAsReal", 1, R, (I,))
+    def cast_int_real(xp, a):
+        (av, am) = a
+        dt = "float32" if xp.__name__.startswith("jax") else "float64"
+        return av.astype(dt), am
+
+    @rpn_fn("CastRealAsInt", 1, I, (R,))
+    def cast_real_int(xp, a):
+        # MySQL rounds half away from zero on cast.
+        (av, am) = a
+        rounded = xp.where(av >= 0, xp.floor(av + 0.5), xp.ceil(av - 0.5))
+        dt = "int32" if xp.__name__.startswith("jax") else "int64"
+        return rounded.astype(dt), am
+
+
+# ---------------------------------------------------------------------------
+# Math — reference: impl_math.rs
+# ---------------------------------------------------------------------------
+
+def _register_math():
+    I, R = EvalType.INT, EvalType.REAL
+
+    def unary_real(name, op, domain=None):
+        @rpn_fn(name, 1, R, (R,))
+        def _f(xp, a, _op=op, _dom=domain):
+            (av, am) = a
+            if _dom is not None:
+                ok = _dom(xp, av)
+                safe = xp.where(ok, av, xp.ones_like(av))
+                return _op(xp, safe), am & ok
+            return _op(xp, av), am
+
+    unary_real("Sqrt", lambda xp, v: xp.sqrt(v), lambda xp, v: v >= 0)
+    unary_real("Exp", lambda xp, v: xp.exp(v))
+    unary_real("Ln", lambda xp, v: xp.log(v), lambda xp, v: v > 0)
+    unary_real("Log2", lambda xp, v: xp.log2(v), lambda xp, v: v > 0)
+    unary_real("Log10", lambda xp, v: xp.log10(v), lambda xp, v: v > 0)
+    unary_real("Sin", lambda xp, v: xp.sin(v))
+    unary_real("Cos", lambda xp, v: xp.cos(v))
+    unary_real("Tan", lambda xp, v: xp.tan(v))
+    unary_real("Cot", lambda xp, v: 1.0 / xp.tan(v), lambda xp, v: xp.sin(v) != 0)
+    unary_real("Asin", lambda xp, v: xp.arcsin(v), lambda xp, v: xp.abs(v) <= 1)
+    unary_real("Acos", lambda xp, v: xp.arccos(v), lambda xp, v: xp.abs(v) <= 1)
+    unary_real("Atan1Arg", lambda xp, v: xp.arctan(v))
+    unary_real("CeilReal", lambda xp, v: xp.ceil(v))
+    unary_real("FloorReal", lambda xp, v: xp.floor(v))
+    unary_real("RoundReal",
+               lambda xp, v: xp.where(v >= 0, xp.floor(v + 0.5), xp.ceil(v - 0.5)))
+    unary_real("Radians", lambda xp, v: v * (3.141592653589793 / 180.0))
+    unary_real("Degrees", lambda xp, v: v * (180.0 / 3.141592653589793))
+
+    @rpn_fn("Atan2Args", 2, R, (R, R))
+    def atan2(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        return xp.arctan2(av, bv), am & bm
+
+    @rpn_fn("Pow", 2, R, (R, R))
+    def pow_(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        # guard 0^negative and negative^fractional
+        bad = ((av == 0) & (bv < 0)) | ((av < 0) & (bv != xp.trunc(bv)))
+        safe_a = xp.where(bad, xp.ones_like(av), av)
+        return xp.power(safe_a, bv), am & bm & ~bad
+
+    @rpn_fn("Pi", 0, R, ())
+    def pi(xp):
+        one = xp.ones((), dtype=bool)
+        return xp.asarray(3.141592653589793), one
+
+    @rpn_fn("SignReal", 1, I, (R,))
+    def sign(xp, a):
+        (av, am) = a
+        return xp.sign(av).astype(_bool_dtype(xp)), am
+
+    @rpn_fn("SignInt", 1, I, (I,))
+    def sign_int(xp, a):
+        (av, am) = a
+        return xp.sign(av), am
+
+    @rpn_fn("CeilIntToInt", 1, I, (I,))
+    def ceil_int(xp, a):
+        return a
+
+    @rpn_fn("FloorIntToInt", 1, I, (I,))
+    def floor_int(xp, a):
+        return a
+
+    @rpn_fn("RoundInt", 1, I, (I,))
+    def round_int(xp, a):
+        return a
+
+    @rpn_fn("TruncateReal", 2, R, (R, I))
+    def truncate_real(xp, a, d):
+        (av, am), (dv, dm) = a, d
+        scale = xp.power(10.0, dv.astype(av.dtype))
+        return xp.trunc(av * scale) / scale, am & dm
+
+    @rpn_fn("TruncateInt", 2, I, (I, I))
+    def truncate_int(xp, a, d):
+        (av, am), (dv, dm) = a, d
+        neg = xp.where(dv < 0, -dv, xp.zeros_like(dv))
+        neg = xp.minimum(neg, 18)
+        p = xp.asarray(10, dtype=av.dtype) ** neg.astype(av.dtype)
+        return xp.where(dv < 0, (av // p) * p, av), am & dm
+
+    @rpn_fn("CRC32", 1, I, (EvalType.BYTES,))
+    def crc32(xp, a):
+        # host-only (bytes); handled by the numpy path in eval.py
+        import zlib
+        import numpy as np
+        (av, am) = a
+        out = np.fromiter((zlib.crc32(x) for x in av), dtype=np.int64,
+                          count=len(av))
+        return out, am
+
+
+_register_arith()
+_register_compare()
+_register_logic()
+_register_control()
+_register_cast()
+_register_math()

@@ -1,0 +1,34 @@
+"""Device kernels for the coprocessor execution backend.
+
+Replaces the reference's per-row CPU inner loops (tidb_query_aggr impl_*,
+tidb_query_executors hash-agg/top-n update loops) with masked array kernels
+that XLA fuses and tiles onto the TPU VPU/MXU. All kernels operate on
+static-shape tiles (datatype/tile.py) and return *partial states* that are
+psum/merge-able across chips (SURVEY.md §2.8, §5.7: "partial per-shard
+compute + mergeable partial states").
+"""
+
+from .agg import (
+    AggSpec,
+    simple_agg_tile,
+    merge_simple_states,
+    finalize_simple,
+    hash_agg_tile,
+    merge_hash_states,
+    finalize_hash,
+)
+from .topn import topn_init, topn_update_tile, topn_merge, topn_finalize
+
+__all__ = [
+    "AggSpec",
+    "simple_agg_tile",
+    "merge_simple_states",
+    "finalize_simple",
+    "hash_agg_tile",
+    "merge_hash_states",
+    "finalize_hash",
+    "topn_init",
+    "topn_update_tile",
+    "topn_merge",
+    "topn_finalize",
+]

@@ -1,0 +1,339 @@
+"""Aggregate kernels with psum-mergeable partial states.
+
+Reference: components/tidb_query_aggr (impl_count.rs, impl_sum.rs,
+impl_avg.rs, impl_max_min.rs, impl_first.rs) and the hash-agg executors
+(tidb_query_executors/src/fast_hash_aggr_executor.rs,
+simple_aggr_executor.rs). The reference updates per-group state structs row
+by row; here a *tile* of rows is reduced at once with masked array ops, and
+the state is a pytree of dense arrays so that cross-chip merging is exactly
+``psum`` / ``pmax`` / ``pmin`` (SURVEY.md §5.7: partial states are
+psum-mergeable by construction).
+
+State shapes (G = group capacity; G=1 for simple agg):
+- COUNT  → {"count": i64[G]}
+- SUM    → {"sum": v[G], "nonnull": i64[G]}     (SUM of all-NULL is NULL)
+- AVG    → {"sum": v[G], "count": i64[G]}
+- MIN    → {"min": v[G] (identity-filled), "nonnull": i64[G]}
+- MAX    → symmetric
+- FIRST  → {"value": v[G], "pos": i64[G] (global row pos, identity MAX)}
+
+Hash-agg fast path: when the int key range fits the capacity, the group id
+is ``key - base`` (direct indexing — the reference's FastHashAgg plays the
+same trick with its int-key specialised hashmap). NULL keys get their own
+trailing slot (MySQL GROUP BY treats NULL as one group). Keys outside the
+range raise the ``overflow`` flag and the executor routes the batch to the
+host general path (dictionary-encode via np.unique).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..datatype import EvalType
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    """One aggregate function instance in a plan.
+
+    ``kind``: count | sum | avg | min | max | first | count_star
+    ``arg``: index of the source column pair in the kernel inputs (ignored
+    for count_star).
+    """
+
+    kind: str
+    arg: int = 0
+    eval_type: EvalType = EvalType.INT
+
+
+def _scatter_add(xp, target, idx, vals):
+    if xp is np:
+        np.add.at(target, idx, vals)
+        return target
+    return target.at[idx].add(vals)
+
+
+def _scatter_max(xp, target, idx, vals):
+    if xp is np:
+        np.maximum.at(target, idx, vals)
+        return target
+    return target.at[idx].max(vals)
+
+
+def _scatter_min(xp, target, idx, vals):
+    if xp is np:
+        np.minimum.at(target, idx, vals)
+        return target
+    return target.at[idx].min(vals)
+
+
+def _acc_dtype(xp, values) -> str:
+    """Accumulator dtype: int sums widen to int64; real stays float."""
+    if values.dtype.kind in "iu":
+        return "int64"
+    return str(values.dtype)
+
+
+def _minmax_identity(xp, dtype, is_min: bool):
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        return dt.type(np.inf) if is_min else dt.type(-np.inf)
+    info = np.iinfo(dt)
+    return dt.type(info.max) if is_min else dt.type(info.min)
+
+
+# ---------------------------------------------------------------------------
+# Simple (single-group) aggregation — reference: simple_aggr_executor.rs
+# ---------------------------------------------------------------------------
+
+def simple_agg_tile(xp, specs: Sequence[AggSpec], cols: Sequence[tuple],
+                    n_valid_rows=None) -> list[dict]:
+    """Reduce one tile to per-spec scalar partial states.
+
+    ``cols[i]`` is the (values, validity) pair for specs referencing arg i.
+    ``n_valid_rows``: logical row count (for count_star with padding, the
+    validity mask of col 0 is NOT usable — padding rows must not count), so
+    callers pass the tile's row-validity mask as a column or the scalar count.
+    """
+    states = []
+    for spec in specs:
+        if spec.kind == "count_star":
+            assert n_valid_rows is not None
+            states.append({"count": xp.asarray(n_valid_rows, dtype="int64")})
+            continue
+        values, validity = cols[spec.arg]
+        vmask = validity
+        nonnull = xp.sum(vmask, dtype="int64")
+        if spec.kind == "count":
+            states.append({"count": nonnull})
+        elif spec.kind == "sum":
+            acc = _acc_dtype(xp, values)
+            s = xp.sum(xp.where(vmask, values, xp.zeros_like(values)),
+                       dtype=acc)
+            states.append({"sum": s, "nonnull": nonnull})
+        elif spec.kind == "avg":
+            acc = _acc_dtype(xp, values)
+            s = xp.sum(xp.where(vmask, values, xp.zeros_like(values)),
+                       dtype=acc)
+            states.append({"sum": s, "count": nonnull})
+        elif spec.kind in ("min", "max"):
+            ident = _minmax_identity(xp, values.dtype, spec.kind == "min")
+            filled = xp.where(vmask, values, xp.full_like(values, ident))
+            v = xp.min(filled) if spec.kind == "min" else xp.max(filled)
+            states.append({spec.kind: v, "nonnull": nonnull})
+        elif spec.kind == "first":
+            # position-ordered: tracked on host merge (deterministic across
+            # tiles); device partial = value at first valid index in tile.
+            n = values.shape[0]
+            idxs = xp.arange(n, dtype="int64")
+            big = xp.asarray(np.iinfo(np.int64).max, dtype="int64")
+            pos = xp.min(xp.where(vmask, idxs, big))
+            safe = xp.minimum(pos, n - 1)
+            states.append({"value": values[safe], "pos": pos})
+        else:
+            raise ValueError(f"unknown agg kind {spec.kind}")
+    return states
+
+
+def merge_simple_states(xp, specs, a: list[dict], b: list[dict],
+                        b_pos_offset=0) -> list[dict]:
+    out = []
+    for spec, sa, sb in zip(specs, a, b):
+        if spec.kind in ("count", "count_star"):
+            out.append({"count": sa["count"] + sb["count"]})
+        elif spec.kind == "sum":
+            out.append({"sum": sa["sum"] + sb["sum"],
+                        "nonnull": sa["nonnull"] + sb["nonnull"]})
+        elif spec.kind == "avg":
+            out.append({"sum": sa["sum"] + sb["sum"],
+                        "count": sa["count"] + sb["count"]})
+        elif spec.kind == "min":
+            out.append({"min": xp.minimum(sa["min"], sb["min"]),
+                        "nonnull": sa["nonnull"] + sb["nonnull"]})
+        elif spec.kind == "max":
+            out.append({"max": xp.maximum(sa["max"], sb["max"]),
+                        "nonnull": sa["nonnull"] + sb["nonnull"]})
+        elif spec.kind == "first":
+            big = np.iinfo(np.int64).max
+            # "no valid row" sentinel (int64 max) must not be shifted — it
+            # would wrap negative and beat real positions.
+            bpos = xp.where(sb["pos"] == big, sb["pos"],
+                            sb["pos"] + b_pos_offset)
+            take_b = bpos < sa["pos"]
+            out.append({"value": xp.where(take_b, sb["value"], sa["value"]),
+                        "pos": xp.where(take_b, bpos, sa["pos"])})
+        else:
+            raise ValueError(spec.kind)
+    return out
+
+
+def finalize_simple(specs, states: list[dict]) -> list:
+    """Produce final scalar results (Python values; None = NULL)."""
+    out = []
+    for spec, s in zip(specs, states):
+        if spec.kind in ("count", "count_star"):
+            out.append(int(s["count"]))
+        elif spec.kind == "sum":
+            out.append(None if int(s["nonnull"]) == 0 else _item(s["sum"]))
+        elif spec.kind == "avg":
+            c = int(s["count"])
+            out.append(None if c == 0 else float(s["sum"]) / c)
+        elif spec.kind in ("min", "max"):
+            out.append(None if int(s["nonnull"]) == 0 else _item(s[spec.kind]))
+        elif spec.kind == "first":
+            out.append(None if int(s["pos"]) == np.iinfo(np.int64).max
+                       else _item(s["value"]))
+    return out
+
+
+def _item(x):
+    v = np.asarray(x).item()
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Hash (group-by) aggregation — reference: fast_hash_aggr_executor.rs
+# ---------------------------------------------------------------------------
+
+def hash_agg_tile(xp, specs: Sequence[AggSpec], key: tuple,
+                  cols: Sequence[tuple], capacity: int, base: int,
+                  row_mask=None) -> dict:
+    """Direct-index group-by over one tile.
+
+    ``key``: (values, validity) int key pair. Group id = key - base for keys
+    in [base, base+capacity); NULL keys map to slot ``capacity`` (their own
+    group); out-of-range keys set ``overflow`` and land in a scrap slot that
+    finalize ignores.
+
+    Returns {"present": bool[C+2], "overflow": bool, "states": [per-spec
+    dict of arrays shaped (C+2,)]}. Slot layout: [0..C) groups, C = NULL
+    group, C+1 = scrap.
+    """
+    kv, km = key
+    n = kv.shape[0]
+    if row_mask is None:
+        row_mask = xp.ones((n,), dtype=bool)
+    slots = capacity + 2
+    null_slot = capacity
+    scrap = capacity + 1
+
+    shifted = kv.astype("int64") - base
+    in_range = (shifted >= 0) & (shifted < capacity)
+    idx = xp.where(km & in_range, shifted, 0).astype("int32")
+    idx = xp.where(km, xp.where(in_range, idx, scrap), null_slot)
+    idx = xp.where(row_mask, idx, scrap)
+
+    overflow = xp.any(row_mask & km & ~in_range)
+    present = xp.zeros((slots,), dtype=bool)
+    present = _scatter_max(xp, present, idx, row_mask)
+
+    states = []
+    for spec in specs:
+        if spec.kind == "count_star":
+            c = _scatter_add(xp, xp.zeros((slots,), dtype="int64"), idx,
+                             row_mask.astype("int64"))
+            states.append({"count": c})
+            continue
+        values, validity = cols[spec.arg]
+        ok = row_mask & validity
+        oki = ok.astype("int64")
+        if spec.kind == "count":
+            c = _scatter_add(xp, xp.zeros((slots,), dtype="int64"), idx, oki)
+            states.append({"count": c})
+        elif spec.kind in ("sum", "avg"):
+            acc = _acc_dtype(xp, values)
+            masked = xp.where(ok, values, xp.zeros_like(values)).astype(acc)
+            s = _scatter_add(xp, xp.zeros((slots,), dtype=acc), idx, masked)
+            c = _scatter_add(xp, xp.zeros((slots,), dtype="int64"), idx, oki)
+            states.append({"sum": s, "nonnull": c} if spec.kind == "sum"
+                          else {"sum": s, "count": c})
+        elif spec.kind in ("min", "max"):
+            ident = _minmax_identity(xp, values.dtype, spec.kind == "min")
+            filled = xp.where(ok, values, xp.full_like(values, ident))
+            t = xp.full((slots,), ident, dtype=values.dtype)
+            t = (_scatter_min if spec.kind == "min" else _scatter_max)(
+                xp, t, idx, filled)
+            c = _scatter_add(xp, xp.zeros((slots,), dtype="int64"), idx, oki)
+            states.append({spec.kind: t, "nonnull": c})
+        elif spec.kind == "first":
+            big = np.iinfo(np.int64).max
+            rowpos = xp.arange(n, dtype="int64")
+            p = xp.full((slots,), big, dtype="int64")
+            p = _scatter_min(xp, p, idx, xp.where(ok, rowpos, big))
+            # value lookup happens at finalize on host (gather by pos)
+            states.append({"pos": p})
+        else:
+            raise ValueError(spec.kind)
+    return {"present": present, "overflow": overflow, "states": states}
+
+
+def merge_hash_states(xp, specs, a: dict, b: dict) -> dict:
+    out_states = []
+    for spec, sa, sb in zip(specs, a["states"], b["states"]):
+        if spec.kind in ("count", "count_star"):
+            out_states.append({"count": sa["count"] + sb["count"]})
+        elif spec.kind == "sum":
+            out_states.append({"sum": sa["sum"] + sb["sum"],
+                               "nonnull": sa["nonnull"] + sb["nonnull"]})
+        elif spec.kind == "avg":
+            out_states.append({"sum": sa["sum"] + sb["sum"],
+                               "count": sa["count"] + sb["count"]})
+        elif spec.kind == "min":
+            out_states.append({"min": xp.minimum(sa["min"], sb["min"]),
+                               "nonnull": sa["nonnull"] + sb["nonnull"]})
+        elif spec.kind == "max":
+            out_states.append({"max": xp.maximum(sa["max"], sb["max"]),
+                               "nonnull": sa["nonnull"] + sb["nonnull"]})
+        elif spec.kind == "first":
+            out_states.append({"pos": xp.minimum(sa["pos"], sb["pos"])})
+        else:
+            raise ValueError(spec.kind)
+    return {
+        "present": a["present"] | b["present"],
+        "overflow": a["overflow"] | b["overflow"],
+        "states": out_states,
+    }
+
+
+def finalize_hash(specs, state: dict, base: int, capacity: int):
+    """Produce (group_keys, per-spec result columns) for present groups.
+
+    Groups are emitted in ascending key order (deterministic), NULL group
+    last — matches what the reference's tests canonicalize to.
+    Returns (keys: list[Optional[int]], results: list[list]).
+    """
+    present = np.asarray(state["present"])
+    slots = np.nonzero(present[:capacity])[0]
+    has_null = bool(present[capacity])
+    keys: list[Optional[int]] = [int(s) + base for s in slots]
+    all_slots = list(slots)
+    if has_null:
+        keys.append(None)
+        all_slots.append(capacity)
+    sel = np.asarray(all_slots, dtype=np.int64)
+
+    results = []
+    for spec, s in zip(specs, state["states"]):
+        if spec.kind in ("count", "count_star"):
+            results.append([int(x) for x in np.asarray(s["count"])[sel]])
+        elif spec.kind == "sum":
+            sums = np.asarray(s["sum"])[sel]
+            nn = np.asarray(s["nonnull"])[sel]
+            results.append([None if c == 0 else sums[i].item()
+                            for i, c in enumerate(nn)])
+        elif spec.kind == "avg":
+            sums = np.asarray(s["sum"])[sel]
+            cnt = np.asarray(s["count"])[sel]
+            results.append([None if c == 0 else float(sums[i]) / int(c)
+                            for i, c in enumerate(cnt)])
+        elif spec.kind in ("min", "max"):
+            vals = np.asarray(s[spec.kind])[sel]
+            nn = np.asarray(s["nonnull"])[sel]
+            results.append([None if c == 0 else vals[i].item()
+                            for i, c in enumerate(nn)])
+        else:
+            raise ValueError(f"finalize_hash: {spec.kind} unsupported here")
+    return keys, results

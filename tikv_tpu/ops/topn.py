@@ -1,0 +1,89 @@
+"""Top-N kernel: running top-k merge over tiles.
+
+Reference: tidb_query_executors/src/top_n_executor.rs — the reference keeps
+a binary heap of row references and compares lazily-decoded sort keys row
+by row. TPU-first redesign: maintain a running (k-sized) state of sort keys
+plus the *global row indices* of the winners; each tile is reduced with a
+three-key ``lax.sort`` (null-rank, key, rowid) against the concatenated
+running state. Payload columns are gathered once at finalize (host) from
+the winning row indices, so the device loop touches only the sort-key
+column.
+
+Sort keys are exact: integer columns sort as int64 (DESC via bitwise-not,
+which reverses order without overflow); real columns sort in their native
+float dtype (negated for DESC). NULLs order first for ASC, last for DESC
+(MySQL); ties break by global row index (stable, like the reference's
+heap). State is merge-able across chips: concatenate + re-sort (the
+parallel module all_gathers states then merges).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_ROWID_MAX = np.iinfo(np.int64).max
+
+
+def _rank_and_key(xp, values, validity, desc: bool):
+    """(null_rank, key) such that ascending (rank, key) == output order."""
+    if values.dtype.kind in "iu":
+        v = values.astype("int64")
+        key = xp.where(validity, ~v if desc else v, xp.zeros_like(v))
+    else:
+        key = xp.where(validity, -values if desc else values,
+                       xp.zeros_like(values))
+    if desc:
+        rank = xp.where(validity, 0, 1).astype("int32")  # NULL last
+    else:
+        rank = xp.where(validity, 1, 0).astype("int32")  # NULL first
+    return rank, key
+
+
+def topn_init(xp, k: int, key_dtype="int64"):
+    return {
+        "rank": xp.full((k,), 2, dtype="int32"),  # 2 = empty slot, sorts last
+        "key": xp.zeros((k,), dtype=key_dtype),
+        "rowid": xp.full((k,), _ROWID_MAX, dtype="int64"),
+    }
+
+
+def _topk(xp, rank, key, rowid, k: int):
+    """Keep k best by ascending (rank, key, rowid)."""
+    if xp is np:
+        order = np.lexsort((rowid, key, rank))[:k]
+        return rank[order], key[order], rowid[order]
+    import jax
+    sr, sk, srow = jax.lax.sort((rank, key, rowid), num_keys=3)
+    return sr[:k], sk[:k], srow[:k]
+
+
+def topn_update_tile(xp, state: dict, values, validity, row_mask,
+                     tile_row_offset, k: int, desc: bool):
+    """Fold one tile into the running top-k state."""
+    n = values.shape[0]
+    rank, key = _rank_and_key(xp, values, validity, desc)
+    rank = xp.where(row_mask, rank, 2)
+    key = xp.where(row_mask, key, xp.zeros_like(key))
+    rowid = xp.where(row_mask, xp.arange(n, dtype="int64") + tile_row_offset,
+                     _ROWID_MAX)
+    all_rank = xp.concatenate([state["rank"], rank])
+    all_key = xp.concatenate([state["key"], key.astype(state["key"].dtype)])
+    all_rowid = xp.concatenate([state["rowid"], rowid])
+    r, kk, rid = _topk(xp, all_rank, all_key, all_rowid, k)
+    return {"rank": r, "key": kk, "rowid": rid}
+
+
+def topn_merge(xp, a: dict, b: dict, k: int):
+    r = xp.concatenate([a["rank"], b["rank"]])
+    kk = xp.concatenate([a["key"], b["key"]])
+    rid = xp.concatenate([a["rowid"], b["rowid"]])
+    tr, tk, trid = _topk(xp, r, kk, rid, k)
+    return {"rank": tr, "key": tk, "rowid": trid}
+
+
+def topn_finalize(state: dict, n_total_rows: int) -> np.ndarray:
+    """Winning global row indices, best-first, empty slots dropped."""
+    rowid = np.asarray(state["rowid"])
+    rank = np.asarray(state["rank"])
+    ok = (rowid < n_total_rows) & (rank < 2)
+    return rowid[ok].astype(np.int64)

@@ -1,0 +1,13 @@
+"""Test fixtures.
+
+Rebuild of components/test_coprocessor (fixture.rs:24-47 ProductTable +
+init_with_data, dag.rs:18 DagSelect): schema/table builders and a DAG
+request builder so coprocessor tests and benches run against an in-memory
+store with no cluster at all (SURVEY.md §4).
+"""
+
+from .fixture import Table, TableColumn, product_table, init_with_data
+from .dag import DagSelect
+
+__all__ = ["Table", "TableColumn", "product_table", "init_with_data",
+           "DagSelect"]

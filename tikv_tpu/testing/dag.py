@@ -1,0 +1,123 @@
+"""DAG request builder for tests.
+
+Reference: components/test_coprocessor/src/dag.rs:18 — ``DagSelect``:
+fluent builder producing coppb Requests (from_index/from_table, where_expr,
+group_by, aggregations, order_by, limit, output_offsets, build).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..codec.keys import index_key_prefix, table_record_range
+from ..copr.dag import (
+    AggExprDesc,
+    AggregationDesc,
+    DAGRequest,
+    IndexScanDesc,
+    LimitDesc,
+    ProjectionDesc,
+    SelectionDesc,
+    TableScanDesc,
+    TopNDesc,
+)
+from ..datatype import EvalType
+from ..executors.ranges import KeyRange
+from ..expr import Expr
+from .fixture import Table, TableColumn
+
+
+class DagSelect:
+    """Fluent DAGRequest builder over a fixture Table."""
+
+    def __init__(self, table: Table):
+        self._table = table
+        self._scan = None
+        self._execs: list = []
+        self._ranges: Optional[list[KeyRange]] = None
+        self._output_offsets = None
+        self._scan_cols: list[TableColumn] = []
+
+    # -- scan sources -------------------------------------------------------
+
+    @staticmethod
+    def from_table(table: Table, columns: Optional[Sequence[str]] = None) -> "DagSelect":
+        s = DagSelect(table)
+        cols = [table[c] for c in columns] if columns else list(table.columns)
+        s._scan_cols = cols
+        infos = tuple(table.column_info(c.name) for c in cols)
+        s._scan = TableScanDesc(table.table_id, infos)
+        start, end = table_record_range(table.table_id)
+        s._ranges = [KeyRange(start, end)]
+        return s
+
+    @staticmethod
+    def from_index(table: Table, column: str, with_handle: bool = True) -> "DagSelect":
+        s = DagSelect(table)
+        col = table[column]
+        assert col.index_id is not None, f"{column} has no index"
+        cols = [col]
+        infos = [table.column_info(col.name)]
+        if with_handle:
+            handle = next(c for c in table.columns if c.is_pk_handle)
+            cols.append(handle)
+            infos.append(table.column_info(handle.name))
+        s._scan_cols = cols
+        s._scan = IndexScanDesc(table.table_id, col.index_id, tuple(infos))
+        prefix = index_key_prefix(table.table_id, col.index_id)
+        s._ranges = [KeyRange(prefix, prefix + b"\xff" * 10)]
+        return s
+
+    # -- helpers ------------------------------------------------------------
+
+    def col(self, name: str) -> Expr:
+        """Column reference by name → offset in the scan output."""
+        for i, c in enumerate(self._scan_cols):
+            if c.name == name:
+                return Expr.column(i, c.field_type.eval_type)
+        raise KeyError(name)
+
+    # -- pipeline stages ----------------------------------------------------
+
+    def where(self, *conditions: Expr) -> "DagSelect":
+        self._execs.append(SelectionDesc(tuple(conditions)))
+        return self
+
+    def project(self, *exprs: Expr) -> "DagSelect":
+        self._execs.append(ProjectionDesc(tuple(exprs)))
+        return self
+
+    def aggregate(self, group_by: Sequence[Expr],
+                  aggs: Sequence[tuple], streamed: bool = False) -> "DagSelect":
+        """aggs: [(kind, arg_expr_or_None)]"""
+        specs = tuple(AggExprDesc(kind, arg) for kind, arg in aggs)
+        self._execs.append(AggregationDesc(tuple(group_by), specs, streamed))
+        return self
+
+    def count(self) -> "DagSelect":
+        return self.aggregate([], [("count_star", None)])
+
+    def sum(self, expr: Expr) -> "DagSelect":
+        return self.aggregate([], [("sum", expr)])
+
+    def order_by(self, expr: Expr, desc: bool = False,
+                 limit: int = 10) -> "DagSelect":
+        self._execs.append(TopNDesc(((expr, desc),), limit))
+        return self
+
+    def limit(self, n: int) -> "DagSelect":
+        self._execs.append(LimitDesc(n))
+        return self
+
+    def output_offsets(self, offsets: Sequence[int]) -> "DagSelect":
+        self._output_offsets = tuple(offsets)
+        return self
+
+    def build(self, start_ts: int = 0) -> DAGRequest:
+        assert self._scan is not None
+        return DAGRequest(
+            executors=(self._scan,) + tuple(self._execs),
+            ranges=tuple(self._ranges),
+            start_ts=start_ts,
+            output_offsets=self._output_offsets,
+        )

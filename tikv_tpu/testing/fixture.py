@@ -1,0 +1,115 @@
+"""Table fixtures.
+
+Reference: components/test_coprocessor/src/{table.rs, column.rs,
+fixture.rs}: ``ProductTable`` (id int pk, name varchar, count int) and
+``init_with_data`` which writes encoded rows into a store.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+from ..codec import encode_row, table_record_key
+from ..codec.mc_datum import encode_mc_datum
+from ..codec.keys import index_key_prefix
+from ..codec.number import encode_u64
+from ..copr.dag import ColumnInfo
+from ..datatype import FieldType, FieldTypeTp
+from ..executors.storage import FixtureStorage
+
+
+@dataclass(frozen=True)
+class TableColumn:
+    name: str
+    col_id: int
+    field_type: FieldType
+    is_pk_handle: bool = False
+    index_id: Optional[int] = None  # secondary index over this column
+
+
+@dataclass(frozen=True)
+class Table:
+    table_id: int
+    columns: tuple
+
+    def __getitem__(self, name: str) -> TableColumn:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(name)
+
+    def column_info(self, name: str) -> ColumnInfo:
+        c = self[name]
+        return ColumnInfo(c.col_id, c.field_type, c.is_pk_handle)
+
+    def all_column_infos(self) -> list[ColumnInfo]:
+        return [ColumnInfo(c.col_id, c.field_type, c.is_pk_handle)
+                for c in self.columns]
+
+
+_NEXT_ID = [1]
+
+
+def _next_id() -> int:
+    _NEXT_ID[0] += 1
+    return _NEXT_ID[0]
+
+
+def product_table() -> Table:
+    """Reference: fixture.rs:24 ProductTable — id (pk), name, count."""
+    tid = _next_id()
+    return Table(tid, (
+        TableColumn("id", 1, FieldType.long(not_null=True), is_pk_handle=True),
+        TableColumn("name", 2, FieldType.var_char(), index_id=1),
+        TableColumn("count", 3, FieldType.long(), index_id=2),
+    ))
+
+
+def int_table(n_cols: int = 2, table_id: Optional[int] = None) -> Table:
+    """id pk + n int columns c0..c{n-1} (benchmark shapes)."""
+    tid = table_id if table_id is not None else _next_id()
+    cols = [TableColumn("id", 1, FieldType.long(not_null=True),
+                        is_pk_handle=True)]
+    for i in range(n_cols):
+        cols.append(TableColumn(f"c{i}", 2 + i, FieldType.long(),
+                                index_id=i + 1))
+    return Table(tid, tuple(cols))
+
+
+def encode_table_row(table: Table, handle: int, row: dict) -> tuple[bytes, bytes]:
+    """row: {column name: value}. Returns (key, value) for the record."""
+    payload = {}
+    for c in table.columns:
+        if c.is_pk_handle:
+            continue
+        if c.name in row:
+            payload[c.col_id] = row[c.name]
+    return table_record_key(table.table_id, handle), encode_row(payload)
+
+
+def index_entries(table: Table, handle: int, row: dict):
+    """Yield (key, value) index entries for one row (non-unique indexes)."""
+    for c in table.columns:
+        if c.index_id is None or c.is_pk_handle:
+            continue
+        v = row.get(c.name)
+        key = (index_key_prefix(table.table_id, c.index_id)
+               + encode_mc_datum(v) + encode_mc_datum(handle))
+        yield key, b""
+
+
+def init_with_data(table: Table, rows: Sequence[tuple[int, dict]],
+                   with_indexes: bool = True) -> FixtureStorage:
+    """rows: [(handle, {col name: value})] → FixtureStorage.
+
+    Reference: fixture.rs init_with_data (store + commit per row); here the
+    fixture bypasses MVCC (the executor feed sees committed values only),
+    matching FixtureStorage usage in the reference's executor benches.
+    """
+    pairs = []
+    for handle, row in rows:
+        pairs.append(encode_table_row(table, handle, row))
+        if with_indexes:
+            pairs.extend(index_entries(table, handle, row))
+    return FixtureStorage(pairs)
