@@ -1,0 +1,110 @@
+"""North-star benchmark (BASELINE.md config 4): SUM + GROUP BY over int
+rows — device fused pipeline vs host CPU BatchExecutor pipeline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs:
+  TIKV_TPU_BENCH_ROWS       device-side row count      (default 2**25)
+  TIKV_TPU_BENCH_HOST_ROWS  host-baseline row count    (default 2**22)
+  TIKV_TPU_BENCH_GROUPS     group cardinality          (default 1024)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_inputs(n: int, groups: int, seed: int = 7):
+    from tikv_tpu.datatype import Column, EvalType, FieldType
+    from tikv_tpu.executors.columnar import ColumnarTable
+    from tikv_tpu.testing.fixture import Table, TableColumn
+
+    rng = np.random.default_rng(seed)
+    table = Table(99, (
+        TableColumn("id", 1, FieldType.long(not_null=True), is_pk_handle=True),
+        TableColumn("k", 2, FieldType.long()),
+        TableColumn("v", 3, FieldType.long()),
+    ))
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"k": Column(EvalType.INT, rng.integers(0, groups, n).astype(np.int64),
+                     np.ones(n, dtype=np.bool_)),
+         "v": Column(EvalType.INT, rng.integers(-1000, 1000, n).astype(np.int64),
+                     np.ones(n, dtype=np.bool_))})
+    return table, snap
+
+
+def make_dag(table):
+    from tikv_tpu.testing.dag import DagSelect
+    sel = DagSelect.from_table(table, ["id", "k", "v"])
+    return sel.aggregate(
+        [sel.col("k")],
+        [("count_star", None), ("sum", sel.col("v"))]).build()
+
+
+def time_runner(fn, repeats: int = 3) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    n_dev = int(os.environ.get("TIKV_TPU_BENCH_ROWS", 1 << 25))
+    n_host = int(os.environ.get("TIKV_TPU_BENCH_HOST_ROWS", 1 << 22))
+    groups = int(os.environ.get("TIKV_TPU_BENCH_GROUPS", 1024))
+
+    from tikv_tpu.executors.runner import BatchExecutorsRunner
+
+    # ---- host CPU baseline (vectorized numpy BatchExecutor pipeline) ----
+    table_h, snap_h = build_inputs(n_host, groups)
+    dag_h = make_dag(table_h)
+    host_s = time_runner(
+        lambda: BatchExecutorsRunner(dag_h, snap_h).handle_request(), 2)
+    host_rps = n_host / host_s
+
+    # ---- device fused pipeline ----
+    from tikv_tpu.device import DeviceRunner
+    import jax
+
+    table_d, snap_d = build_inputs(n_dev, groups)
+    dag_d = make_dag(table_d)
+    runner = DeviceRunner()
+    dev_result = {}
+
+    def run_device():
+        dev_result["r"] = runner.handle_request(dag_d, snap_d)
+
+    run_device()                       # warmup (compile)
+    dev_s = time_runner(run_device, 3)
+    dev_rps = n_dev / dev_s
+
+    # sanity: device result must match numpy ground truth
+    k = snap_d.columns[2].values
+    v = snap_d.columns[3].values
+    rows = {r[-1]: r[:-1] for r in dev_result["r"].rows()}
+    total = sum(c for c, _ in rows.values())
+    assert total == n_dev, (total, n_dev)
+    assert sum(s for _, s in rows.values()) == int(v.sum())
+
+    print(json.dumps({
+        "metric": "copr_hash_agg_rows_per_sec",
+        "value": round(dev_rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(dev_rps / host_rps, 3),
+    }))
+    print(f"# device: {n_dev} rows in {dev_s:.4f}s on "
+          f"{jax.devices()[0].platform}:{len(jax.devices())} "
+          f"| host baseline: {n_host} rows in {host_s:.4f}s "
+          f"({host_rps:,.0f} rows/s)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

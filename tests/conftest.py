@@ -2,13 +2,24 @@
 
 Multi-chip hardware is not available in CI; shardings are validated on a
 virtual CPU mesh (xla_force_host_platform_device_count), as the driver's
-dryrun does. Must run before jax is imported anywhere.
+dryrun does.  The environment may pre-register a tunneled TPU backend (and
+force ``jax_platforms`` from a site hook), so the CPU selection is applied
+both via env and via jax.config, before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_sessionstart(session):
+    assert len(jax.devices()) == 8, \
+        f"expected 8-device CPU mesh, got {jax.devices()}"

@@ -1,0 +1,101 @@
+"""Regression tests for review findings (meta-cache poisoning, desc
+multi-range scans, TruncateInt, int64 TopN precision, i64::MAX handle)."""
+
+import numpy as np
+
+from tikv_tpu.datatype import Column, EvalType, FieldType
+from tikv_tpu.device import DeviceRunner
+from tikv_tpu.executors.columnar import ColumnarTable
+from tikv_tpu.executors.ranges import KeyRange
+from tikv_tpu.executors.runner import BatchExecutorsRunner
+from tikv_tpu.executors.storage import FixtureStorage
+from tikv_tpu.expr import Expr, build_rpn, eval_rpn
+from tikv_tpu.testing.dag import DagSelect
+from tikv_tpu.testing.fixture import Table, TableColumn
+
+
+def _table(tid=8100):
+    return Table(tid, (
+        TableColumn("id", 1, FieldType.long(not_null=True), is_pk_handle=True),
+        TableColumn("k", 2, FieldType.long()),
+        TableColumn("v", 3, FieldType.long()),
+    ))
+
+
+def _snap(table, n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"k": rng.integers(0, 50, n).astype(np.int64),
+         "v": rng.integers(-100, 100, n).astype(np.int64)})
+
+
+def test_meta_cache_not_shared_across_plans():
+    """Two plans over the same columns/ranges must not share hash bounds."""
+    table = _table()
+    snap = _snap(table)
+    r = DeviceRunner(chunk_rows=1 << 12)
+    s1 = DagSelect.from_table(table, ["id", "k", "v"])
+    dag1 = s1.aggregate([s1.col("k")], [("sum", s1.col("v"))]).build()
+    s2 = DagSelect.from_table(table, ["id", "k", "v"])
+    dag2 = s2.aggregate(
+        [Expr.call("PlusInt", s2.col("k"), Expr.const(1000, EvalType.INT))],
+        [("sum", s2.col("v"))]).build()
+    out1 = r.handle_request(dag1, snap)
+    out2 = r.handle_request(dag2, snap)
+    host2 = BatchExecutorsRunner(dag2, snap).handle_request()
+    assert sorted(out2.rows()) == sorted(host2.rows())
+    keys1 = {row[-1] for row in out1.rows()}
+    keys2 = {row[-1] for row in out2.rows()}
+    assert keys2 == {k + 1000 for k in keys1}
+
+
+def test_fixture_desc_multi_range():
+    pairs = [(bytes([i]), bytes([i])) for i in range(10)]
+    st = FixtureStorage(pairs)
+    ranges = [KeyRange(bytes([0]), bytes([3])), KeyRange(bytes([5]), bytes([8]))]
+    st.begin_scan(ranges, desc=True)
+    keys = []
+    while True:
+        kv = st.scan_next()
+        if kv is None:
+            break
+        keys.append(kv[0][0])
+    assert keys == [7, 6, 5, 2, 1, 0]
+
+
+def test_truncate_int_negative():
+    rpn = build_rpn(Expr.call(
+        "TruncateInt",
+        Expr.column(0, EvalType.INT),
+        Expr.const(-1, EvalType.INT)))
+    vals = np.array([-15, 15, -20, -1, 19], dtype=np.int64)
+    ok = np.ones(5, dtype=bool)
+    v, m = eval_rpn(rpn, [(vals, ok)], 5, np)
+    assert list(v) == [-10, 10, -20, 0, 10]   # MySQL truncates toward zero
+
+
+def test_topn_int64_exact_above_2p53():
+    table = _table(8101)
+    big = 1 << 53
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(4, dtype=np.int64),
+        {"k": np.zeros(4, dtype=np.int64),
+         "v": np.array([big, big + 1, big - 1, 5], dtype=np.int64)})
+    sel = DagSelect.from_table(table, ["id", "k", "v"])
+    dag = sel.order_by(sel.col("v"), desc=True, limit=1).build()
+    out = BatchExecutorsRunner(dag, snap).handle_request()
+    assert out.rows()[0][2] == big + 1
+
+
+def test_i64_max_handle_included():
+    table = _table(8102)
+    hmax = 2**63 - 1
+    snap = ColumnarTable.from_arrays(
+        table, np.array([1, 2, hmax], dtype=np.int64),
+        {"k": np.array([1, 2, 3], dtype=np.int64),
+         "v": np.array([10, 20, 30], dtype=np.int64)})
+    sel = DagSelect.from_table(table, ["id", "k", "v"])
+    dag = sel.build()   # full-table range: prefix + 0xff*9 end key
+    out = BatchExecutorsRunner(dag, snap).handle_request()
+    assert [r[0] for r in out.rows()] == [1, 2, hmax]

@@ -1,0 +1,5 @@
+"""Device (TPU) execution backend for the coprocessor layer."""
+
+from .runner import DeviceRunner
+
+__all__ = ["DeviceRunner"]

@@ -1,0 +1,232 @@
+"""MXU group-by aggregation — one-hot matmul kernels.
+
+XLA lowers ``scatter``-with-duplicate-indices poorly on TPU (measured
+~15M rows/s for int64 scatter-add vs >150M rows/s for the MXU path on the
+same shapes), so the hash-agg hot path (BASELINE.md config 4) computes
+per-group COUNT/SUM via ``dot_general`` against a one-hot slot matrix:
+
+- the group-id per row (slot index: key-base, NULL slot, scrap slot —
+  mirror of ops/agg.hash_agg_tile's layout) selects a one-hot column;
+- integer values are **byte-split** into int8 planes (biased to [-128,127])
+  so the whole aggregation is exact int8×int8→int32 MXU work, widened to
+  int64 between blocks: sum(v) = Σ_k 2^(8k)·S_k + count·BIAS_OFFSET;
+- real values ride a separate f32 matmul, accumulated in f64 across blocks;
+- rows are processed in ``lax.scan`` blocks so the transient one-hot
+  (block × slots) stays small and int32 partials cannot overflow
+  (block ≤ 2^16 rows × |int8| ≤ 127 < 2^23).
+
+Plane layout: plane 0 is always the row mask (→ present + count_star);
+each aggregate appends its own validity plane and value planes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+BLOCK_ROWS = 1 << 16
+
+
+def slot_pad(slots: int) -> int:
+    """Round the one-hot width up to the MXU lane count."""
+    return ((slots + 127) // 128) * 128
+
+
+def int_planes_needed(vmin: int, vmax: int) -> int:
+    """Bytes needed to represent [vmin, vmax] biased to unsigned."""
+    for nb in (1, 2, 3, 4, 8):
+        lo, hi = -(1 << (8 * nb - 1)), (1 << (8 * nb - 1)) - 1
+        if lo <= vmin and vmax <= hi:
+            return min(nb, 8)
+    return 8
+
+
+def bias_offset(nb: int) -> int:
+    """sum(v) correction: v = Σ(c_k+128)·2^(8k) − 2^(8nb−1)."""
+    return 128 * sum(1 << (8 * k) for k in range(nb)) - (1 << (8 * nb - 1))
+
+
+@dataclass(frozen=True)
+class PlaneLayout:
+    """Static description of one spec's planes in the stacked matrices.
+
+    ``ok_plane``: index of the validity int8 plane (None → use plane 0).
+    ``byte_planes``: int8 plane indices of the value bytes (LSB first).
+    ``f32_plane``: index into the f32 matrix for real sums.
+    ``nb``: byte count for the int value split.
+    """
+
+    kind: str
+    ok_plane: Optional[int] = None
+    byte_planes: tuple = ()
+    f32_plane: Optional[int] = None
+    nb: int = 0
+
+
+def build_layouts(specs, arg_is_real: Sequence[bool],
+                  arg_nbytes: Sequence[int]):
+    """→ (layouts, n_int8_planes, n_f32_planes). Plane 0 = row mask."""
+    layouts = []
+    p8 = 1
+    pf = 0
+    for spec, is_real, nb in zip(specs, arg_is_real, arg_nbytes):
+        if spec.kind == "count_star":
+            layouts.append(PlaneLayout("count_star"))
+        elif spec.kind == "count":
+            layouts.append(PlaneLayout("count", ok_plane=p8))
+            p8 += 1
+        elif spec.kind in ("sum", "avg"):
+            if is_real:
+                layouts.append(PlaneLayout(spec.kind, ok_plane=p8,
+                                           f32_plane=pf))
+                p8 += 1
+                pf += 1
+            else:
+                bp = tuple(range(p8 + 1, p8 + 1 + nb))
+                layouts.append(PlaneLayout(spec.kind, ok_plane=p8,
+                                           byte_planes=bp, nb=nb))
+                p8 += 1 + nb
+        else:
+            raise ValueError(f"matmul path cannot handle {spec.kind}")
+    return layouts, p8, pf
+
+
+def matmul_supported(specs) -> bool:
+    return all(s.kind in ("count", "count_star", "sum", "avg") for s in specs)
+
+
+def make_planes(layouts, specs, cols, mask):
+    """Build the stacked int8 / f32 plane matrices for one row chunk.
+
+    ``cols[i]``: (values, validity) for spec i (values int or f32).
+    Returns (L8: (P8, n) int8, Lf: (Pf, n) f32 | None).
+    """
+    n = mask.shape[0]
+    int8_planes = [mask.astype(jnp.int8)]
+    f32_planes = []
+    for lay, spec, col in zip(layouts, specs, cols):
+        if lay.kind == "count_star":
+            continue
+        values, validity = col
+        ok = mask & validity
+        int8_planes.append(ok.astype(jnp.int8))
+        if lay.f32_plane is not None:
+            f32_planes.append(
+                jnp.where(ok, values, jnp.zeros_like(values))
+                .astype(jnp.float32))
+        elif lay.byte_planes:
+            nb = lay.nb
+            v64 = values.astype(jnp.int64) if nb > 4 else \
+                values.astype(jnp.int32)
+            biased = (v64 + (1 << (8 * nb - 1))).astype(
+                jnp.uint64 if nb > 4 else jnp.uint32)
+            for k in range(nb):
+                byte = ((biased >> (8 * k)) & 0xFF).astype(jnp.int32) - 128
+                int8_planes.append(
+                    jnp.where(ok, byte, jnp.zeros_like(byte))
+                    .astype(jnp.int8))
+    L8 = jnp.stack(int8_planes)
+    Lf = jnp.stack(f32_planes) if f32_planes else None
+    return L8, Lf
+
+
+def matmul_groupby(idx, L8, Lf, slots: int, block: int = BLOCK_ROWS,
+                   vary_axes: tuple = ()):
+    """Blocked one-hot matmuls: → (S8: (P8, slots) int64,
+    Sf: (Pf, slots) float64 | None).
+
+    ``vary_axes``: when called inside shard_map, the mesh axis names — the
+    scan carry must be marked device-varying (lax.pvary) to match the body
+    output's varying-manual-axes type."""
+    G = slot_pad(slots)
+    n = idx.shape[0]
+    block = min(block, n)
+    nblk = n // block
+    assert nblk * block == n, (n, block)
+    p8 = L8.shape[0]
+    iota = jnp.arange(G, dtype=jnp.int32)
+
+    idx_b = idx.reshape(nblk, block)
+    l8_b = L8.reshape(p8, nblk, block).transpose(1, 0, 2)
+    if Lf is not None:
+        pf = Lf.shape[0]
+        lf_b = Lf.reshape(pf, nblk, block).transpose(1, 0, 2)
+        xs = (idx_b, l8_b, lf_b)
+        carry = (jnp.zeros((p8, G), jnp.int64),
+                 jnp.zeros((pf, G), jnp.float64))
+    else:
+        xs = (idx_b, l8_b)
+        carry = (jnp.zeros((p8, G), jnp.int64), None)
+    if vary_axes:
+        carry = tuple(None if c is None else lax.pvary(c, vary_axes)
+                      for c in carry)
+
+    def body(carry, xs):
+        c8, cf = carry
+        if Lf is not None:
+            i_b, l8, lf = xs
+        else:
+            i_b, l8 = xs
+        onehot8 = (i_b[:, None] == iota[None, :]).astype(jnp.int8)
+        prod8 = lax.dot_general(l8, onehot8, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+        c8 = c8 + prod8.astype(jnp.int64)
+        if Lf is not None:
+            onehotf = (i_b[:, None] == iota[None, :]).astype(jnp.float32)
+            prodf = lax.dot_general(lf, onehotf, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            cf = cf + prodf.astype(jnp.float64)
+        return (c8, cf), None
+
+    (S8, Sf), _ = lax.scan(body, carry, xs)
+    return S8[:, :slots], (None if Sf is None else Sf[:, :slots])
+
+
+def states_from_matmul(layouts, specs, S8, Sf, xp=jnp):
+    """Reassemble hash-agg state dicts (ops/agg.py layout) from the matmul
+    partials.  Also returns the present mask (mask-plane count > 0).
+    ``xp``: jnp in-kernel, or numpy for host-side finalize after a packed
+    device→host transfer."""
+    mask_count = S8[0]
+    present = mask_count > 0
+    states = []
+    for lay, spec in zip(layouts, specs):
+        if lay.kind == "count_star":
+            states.append({"count": mask_count})
+            continue
+        okc = S8[lay.ok_plane]
+        if lay.kind == "count":
+            states.append({"count": okc})
+        elif lay.f32_plane is not None:     # real sum/avg
+            s = Sf[lay.f32_plane]
+            states.append({"sum": s, "nonnull": okc} if lay.kind == "sum"
+                          else {"sum": s, "count": okc})
+        else:                               # int sum/avg
+            total = xp.zeros_like(okc)
+            for k, p in enumerate(lay.byte_planes):
+                total = total + (S8[p] << (8 * k))
+            total = total + okc * bias_offset(lay.nb)
+            states.append({"sum": total, "nonnull": okc}
+                          if lay.kind == "sum"
+                          else {"sum": total, "count": okc})
+    return present, states
+
+
+def slot_index(key_pair, capacity: int, base, row_mask):
+    """Row → slot id (group / NULL / scrap), mirroring
+    ops/agg.hash_agg_tile's layout.  Returns (idx int32, overflow bool)."""
+    kv, km = key_pair
+    null_slot = capacity
+    scrap = capacity + 1
+    shifted = kv.astype(jnp.int64) - base
+    in_range = (shifted >= 0) & (shifted < capacity)
+    idx = jnp.where(km & in_range, shifted, 0).astype(jnp.int32)
+    idx = jnp.where(km, jnp.where(in_range, idx, scrap), null_slot)
+    idx = jnp.where(row_mask, idx, scrap)
+    overflow = jnp.any(row_mask & km & ~in_range)
+    return idx, overflow

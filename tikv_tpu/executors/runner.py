@@ -53,7 +53,13 @@ def build_executors(dag: DAGRequest, storage: ScanStorage) -> BatchExecutor:
         raise ValueError("empty executor list")
     head = descs[0]
     if isinstance(head, TableScanDesc):
-        ex: BatchExecutor = BatchTableScanExecutor(storage, head, dag.ranges)
+        if hasattr(storage, "scan_columns"):
+            # columnar snapshot feed — no row decode (executors/columnar.py)
+            from .columnar import BatchColumnarTableScanExecutor
+            ex: BatchExecutor = BatchColumnarTableScanExecutor(
+                storage, head, dag.ranges)
+        else:
+            ex = BatchTableScanExecutor(storage, head, dag.ranges)
     elif isinstance(head, IndexScanDesc):
         ex = BatchIndexScanExecutor(storage, head, dag.ranges)
     else:

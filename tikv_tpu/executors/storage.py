@@ -63,7 +63,9 @@ class FixtureStorage:
     # -- ScanStorage ---------------------------------------------------------
 
     def begin_scan(self, ranges: Sequence[KeyRange], desc: bool = False) -> None:
-        self._ranges = list(ranges)
+        # desc scans walk the (sorted) range list in reverse so keys come
+        # out in global reverse order (reference reverses ranges too)
+        self._ranges = list(reversed(ranges)) if desc else list(ranges)
         self._desc = desc
         self._range_idx = 0
         self._load_range()

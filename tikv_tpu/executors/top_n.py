@@ -54,6 +54,18 @@ class BatchTopNExecutor(TimedExecutor):
             lex: list[np.ndarray] = [seq]
             for (v, ok), desc in zip(reversed(keys),
                                      reversed(self._descs)):
+                if v.dtype.kind in "iu":
+                    # exact int ordering (f64 would collapse above 2^53);
+                    # reserve int64 min as the NULL sentinel
+                    iv = np.maximum(v.astype(np.int64, copy=False),
+                                    np.iinfo(np.int64).min + 2)
+                    if desc:
+                        lex.append(np.where(ok, -iv,
+                                            np.iinfo(np.int64).max))
+                    else:
+                        lex.append(np.where(ok, iv,
+                                            np.iinfo(np.int64).min))
+                    continue
                 fv = v.astype(np.float64, copy=False)
                 if desc:
                     lex.append(np.where(ok, -fv, np.inf))   # NULL last

@@ -474,7 +474,10 @@ def _register_math():
         neg = xp.where(dv < 0, -dv, xp.zeros_like(dv))
         neg = xp.minimum(neg, 18)
         p = xp.asarray(10, dtype=av.dtype) ** neg.astype(av.dtype)
-        return xp.where(dv < 0, (av // p) * p, av), am & dm
+        # MySQL truncates toward zero; // floors — correct negative values
+        q = av // p
+        q = xp.where((av < 0) & (q * p != av), q + 1, q)
+        return xp.where(dv < 0, q * p, av), am & dm
 
     @rpn_fn("CRC32", 1, I, (EvalType.BYTES,))
     def crc32(xp, a):

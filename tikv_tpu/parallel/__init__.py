@@ -1,0 +1,23 @@
+"""Mesh / sharding layer — TiKV's range+bucket sharding as TPU mesh axes."""
+
+from .mesh import (
+    RANGE_AXIS,
+    ROW_AXES,
+    TILE_AXIS,
+    make_mesh,
+    num_shards,
+    pad_rows_for,
+    replicated,
+    row_sharding,
+)
+
+__all__ = [
+    "RANGE_AXIS",
+    "ROW_AXES",
+    "TILE_AXIS",
+    "make_mesh",
+    "num_shards",
+    "pad_rows_for",
+    "replicated",
+    "row_sharding",
+]

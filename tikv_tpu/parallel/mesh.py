@@ -1,0 +1,69 @@
+"""Device mesh construction + sharding helpers.
+
+TiKV's parallelism axes (SURVEY.md §2.8) map onto a 2-D TPU mesh:
+
+- ``range``  — range sharding: a region (contiguous key range) pins to one
+  mesh slice the way TiKV pins a region to a store
+  (components/raftstore/src/store/worker/split_check.rs drives splits,
+  worker/pd.rs balances).  Coarse axis; rides DCN between hosts.
+- ``tile``   — in-region buckets: finer-grained parallelism inside one
+  region (pd_client/src/lib.rs:118-240 buckets give the coprocessor
+  sub-region parallel units).  Fine axis; rides ICI between chips.
+
+Row blocks are sharded over the *flattened* ("range", "tile") product; the
+psum-mergeable aggregation states (ops/agg.py) are merged over both axes.
+This is the scaling-book recipe: name the axes, annotate shardings, let XLA
+place collectives on ICI.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+RANGE_AXIS = "range"
+TILE_AXIS = "tile"
+ROW_AXES = (RANGE_AXIS, TILE_AXIS)
+
+
+def _factor2(n: int) -> tuple[int, int]:
+    """Split n into (a, b), a*b == n, as square as possible, a <= b."""
+    a = int(math.isqrt(n))
+    while a > 1 and n % a:
+        a -= 1
+    return a, n // a
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              shape: Optional[tuple[int, int]] = None) -> Mesh:
+    """Build the ("range", "tile") mesh over the given (default: all) devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if shape is None:
+        shape = _factor2(n)
+    assert shape[0] * shape[1] == n, (shape, n)
+    arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, ROW_AXES)
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded across every device (leading axis)."""
+    return NamedSharding(mesh, P(ROW_AXES))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def num_shards(mesh: Mesh) -> int:
+    return mesh.devices.size
+
+
+def pad_rows_for(mesh: Mesh, n_rows: int, multiple: int = 8) -> int:
+    """Smallest row count >= n_rows divisible by n_shards * multiple."""
+    unit = num_shards(mesh) * multiple
+    return max(unit, ((n_rows + unit - 1) // unit) * unit)
