@@ -1,0 +1,122 @@
+"""Engine trait conformance suite.
+
+Reference: components/engine_traits_tests — the trait-level suite every
+engine implementation must pass; parametrized over implementations the
+way engine_test's factories switch by cargo feature.
+"""
+
+import pytest
+
+from tikv_tpu.engine import (
+    CF_DEFAULT,
+    CF_LOCK,
+    CF_WRITE,
+    MemoryEngine,
+    PanicEngine,
+)
+
+ENGINES = [MemoryEngine]
+
+
+@pytest.fixture(params=ENGINES)
+def engine(request):
+    return request.param()
+
+
+def test_point_ops(engine):
+    assert engine.get_value(b"k") is None
+    engine.put_cf(CF_DEFAULT, b"k", b"v")
+    assert engine.get_value(b"k") == b"v"
+    engine.put_cf(CF_DEFAULT, b"k", b"v2")
+    assert engine.get_value(b"k") == b"v2"
+    engine.delete_cf(CF_DEFAULT, b"k")
+    assert engine.get_value(b"k") is None
+
+
+def test_cf_isolation(engine):
+    engine.put_cf(CF_DEFAULT, b"k", b"d")
+    engine.put_cf(CF_LOCK, b"k", b"l")
+    engine.put_cf(CF_WRITE, b"k", b"w")
+    assert engine.get_value_cf(CF_DEFAULT, b"k") == b"d"
+    assert engine.get_value_cf(CF_LOCK, b"k") == b"l"
+    assert engine.get_value_cf(CF_WRITE, b"k") == b"w"
+    engine.delete_cf(CF_LOCK, b"k")
+    assert engine.get_value_cf(CF_LOCK, b"k") is None
+    assert engine.get_value_cf(CF_DEFAULT, b"k") == b"d"
+
+
+def test_write_batch_atomic_view(engine):
+    wb = engine.write_batch()
+    assert wb.is_empty()
+    wb.put_cf(CF_DEFAULT, b"a", b"1")
+    wb.put_cf(CF_LOCK, b"b", b"2")
+    wb.delete_cf(CF_DEFAULT, b"missing")
+    assert wb.count() == 3
+    assert engine.get_value(b"a") is None   # nothing applied yet
+    engine.write(wb)
+    assert engine.get_value(b"a") == b"1"
+    assert engine.get_value_cf(CF_LOCK, b"b") == b"2"
+    wb.clear()
+    assert wb.is_empty()
+
+
+def test_write_batch_delete_range(engine):
+    for i in range(10):
+        engine.put_cf(CF_DEFAULT, bytes([i]), b"v")
+    wb = engine.write_batch()
+    wb.delete_range_cf(CF_DEFAULT, bytes([3]), bytes([7]))
+    engine.write(wb)
+    remaining = [i for i in range(10)
+                 if engine.get_value(bytes([i])) is not None]
+    assert remaining == [0, 1, 2, 7, 8, 9]
+
+
+def test_iterator_seek_and_bounds(engine):
+    for i in (1, 3, 5, 7):
+        engine.put_cf(CF_DEFAULT, bytes([i]), bytes([i * 10]))
+    it = engine.iterator_cf(CF_DEFAULT, lower=bytes([2]), upper=bytes([7]))
+    assert it.seek_to_first() and it.key() == bytes([3])
+    assert it.next() and it.key() == bytes([5])
+    assert not it.next()    # 7 excluded by upper bound
+    assert it.seek(bytes([4])) and it.key() == bytes([5])
+    assert it.seek_for_prev(bytes([4])) and it.key() == bytes([3])
+    assert it.seek_to_last() and it.key() == bytes([5])
+    assert it.prev() and it.key() == bytes([3])
+    assert not it.prev()
+
+
+def test_snapshot_isolation(engine):
+    engine.put_cf(CF_DEFAULT, b"k", b"old")
+    snap = engine.snapshot()
+    engine.put_cf(CF_DEFAULT, b"k", b"new")
+    engine.put_cf(CF_DEFAULT, b"k2", b"x")
+    assert snap.get_value_cf(CF_DEFAULT, b"k") == b"old"
+    assert snap.get_value_cf(CF_DEFAULT, b"k2") is None
+    assert engine.get_value(b"k") == b"new"
+    # iterators on the snapshot see the pinned generation
+    it = snap.iterator_cf(CF_DEFAULT)
+    assert it.seek_to_first() and it.key() == b"k" and it.value() == b"old"
+    assert not it.next()
+
+
+def test_iterator_stable_under_writes(engine):
+    engine.put_cf(CF_DEFAULT, b"a", b"1")
+    engine.put_cf(CF_DEFAULT, b"c", b"3")
+    it = engine.iterator_cf(CF_DEFAULT)
+    engine.put_cf(CF_DEFAULT, b"b", b"2")   # after iterator creation
+    keys = []
+    ok = it.seek_to_first()
+    while ok:
+        keys.append(it.key())
+        ok = it.next()
+    assert keys == [b"a", b"c"]
+
+
+def test_panic_engine_is_complete():
+    """Every trait method exists and raises (engine_panic's role)."""
+    e = PanicEngine()
+    for name in ("snapshot", "write_batch", "write", "get_value_cf",
+                 "get_value", "iterator_cf", "put_cf", "delete_cf",
+                 "flush"):
+        with pytest.raises(NotImplementedError):
+            getattr(e, name)()

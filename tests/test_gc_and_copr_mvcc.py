@@ -1,0 +1,146 @@
+"""GC rules + the MVCC→coprocessor feed (end-to-end layers 4→5)."""
+
+import pytest
+
+from tikv_tpu.copr import CopRequest, Endpoint, REQ_TYPE_DAG
+from tikv_tpu.copr.storage_impl import MvccScanStorage
+from tikv_tpu.engine.traits import CF_WRITE
+from tikv_tpu.storage import Storage
+from tikv_tpu.storage.mvcc import MvccReader
+from tikv_tpu.storage.mvcc.txn import MvccTxn
+from tikv_tpu.storage.txn import commands as cmds
+from tikv_tpu.storage.txn.actions import Mutation
+from tikv_tpu.storage.txn.gc import gc_range
+from tikv_tpu.kv.engine import SnapContext, WriteData
+from tikv_tpu.storage.txn_types import compose_ts
+from tikv_tpu.testing.dag import DagSelect
+from tikv_tpu.testing.fixture import encode_table_row, int_table
+
+
+def ts(n):
+    return compose_ts(n, 0)
+
+
+def put(store, key, value, start, commit):
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", key, value)], key, ts(start)))
+    store.sched_txn_command(cmds.Commit([key], ts(start), ts(commit)))
+
+
+def run_gc(store, start, end, safe_point):
+    snap = store.engine.snapshot(SnapContext())
+    reader = MvccReader(snap)
+    txn = MvccTxn(0)
+    removed = gc_range(txn, reader, start, end, safe_point)
+    if not txn.is_empty():
+        store.engine.write(SnapContext(), WriteData.from_txn(txn))
+    return removed
+
+
+def count_write_versions(store):
+    snap = store.engine.snapshot(SnapContext())
+    it = snap.iterator_cf(CF_WRITE)
+    n = 0
+    ok = it.seek_to_first()
+    while ok:
+        n += 1
+        ok = it.next()
+    return n
+
+
+def test_gc_exact_semantics():
+    store = Storage()
+    put(store, b"k", b"v0", 10, 11)
+    put(store, b"k", b"v1", 20, 21)
+    put(store, b"k", b"v2", 30, 31)
+    removed = run_gc(store, None, None, ts(25))
+    assert removed == 1                      # only @11 dropped
+    assert store.get(b"k", ts(25)) == b"v1"  # visible version intact
+    assert store.get(b"k", ts(40)) == b"v2"
+
+    # a DELETE at/below safe point erases the whole key
+    store2 = Storage()
+    put(store2, b"d", b"v", 10, 11)
+    store2.sched_txn_command(cmds.Prewrite(
+        [Mutation("delete", b"d")], b"d", ts(20)))
+    store2.sched_txn_command(cmds.Commit([b"d"], ts(20), ts(21)))
+    removed = run_gc(store2, None, None, ts(30))
+    assert removed == 2
+    assert count_write_versions(store2) == 0
+
+
+def test_gc_drops_rollback_records():
+    store = Storage()
+    store.sched_txn_command(cmds.Rollback([b"k"], ts(10)))
+    put(store, b"k", b"v", 20, 21)
+    assert count_write_versions(store) == 2
+    removed = run_gc(store, None, None, ts(30))
+    assert removed == 1
+    assert store.get(b"k", ts(40)) == b"v"
+
+
+def test_gc_large_value_cleans_default_cf():
+    store = Storage()
+    big0, big1 = b"a" * 5000, b"b" * 5000
+    put(store, b"k", big0, 10, 11)
+    put(store, b"k", big1, 20, 21)
+    run_gc(store, None, None, ts(30))
+    assert store.get(b"k", ts(40)) == big1
+    from tikv_tpu.engine.traits import CF_DEFAULT
+    snap = store.engine.snapshot(SnapContext())
+    it = snap.iterator_cf(CF_DEFAULT)
+    vals = []
+    ok = it.seek_to_first()
+    while ok:
+        vals.append(it.value())
+        ok = it.next()
+    assert vals == [big1]   # big0's default-CF slot removed
+
+
+# ---------------------------------------------------------- copr over MVCC
+
+
+def test_coprocessor_over_mvcc_snapshot():
+    """Full slice: txn writes → MVCC snapshot → DAG request (§3.4)."""
+    store = Storage()
+    table = int_table(2, table_id=5001)
+    for h in range(200):
+        key, value = encode_table_row(table, h, {"c0": h % 10, "c1": h})
+        put(store, key, value, 10 + h, 11 + h)
+
+    def provider(req):
+        reader = MvccReader(store.engine.snapshot(SnapContext()))
+        return MvccScanStorage(reader, req.dag.start_ts)
+
+    ep = Endpoint(provider)
+    sel = DagSelect.from_table(table, ["id", "c0", "c1"])
+    dag = sel.where(sel.col("c0").eq(3)).aggregate(
+        [], [("count_star", None), ("sum", sel.col("c1"))]
+    ).build(start_ts=ts(1000))
+    rows = ep.handle(CopRequest(REQ_TYPE_DAG, dag)).rows()
+    expect = [h for h in range(200) if h % 10 == 3]
+    assert rows == [(len(expect), sum(expect))]
+
+    # snapshot cut: read_ts below half the commits sees fewer rows
+    dag_cut = DagSelect.from_table(table, ["id"]).count().build(
+        start_ts=ts(11 + 99))
+    rows = ep.handle(CopRequest(REQ_TYPE_DAG, dag_cut)).rows()
+    assert rows == [(100,)]
+
+
+def test_copr_mvcc_sees_uncommitted_lock():
+    from tikv_tpu.storage.mvcc import KeyIsLocked
+    store = Storage()
+    table = int_table(1, table_id=5002)
+    key, value = encode_table_row(table, 1, {"c0": 1})
+    put(store, key, value, 10, 11)
+    key2, value2 = encode_table_row(table, 2, {"c0": 2})
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", key2, value2)], key2, ts(20)))
+
+    reader = MvccReader(store.engine.snapshot(SnapContext()))
+    feed = MvccScanStorage(reader, ts(30))
+    ep = Endpoint(lambda req: feed)
+    dag = DagSelect.from_table(table, ["id", "c0"]).build(start_ts=ts(30))
+    with pytest.raises(KeyIsLocked):
+        ep.handle(CopRequest(REQ_TYPE_DAG, dag))

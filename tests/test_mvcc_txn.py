@@ -1,0 +1,365 @@
+"""MVCC + transaction layer tests.
+
+Mirrors the reference's inline suites in src/storage/mvcc/ (point getter,
+scanner, txn) and src/storage/txn/actions+commands (prewrite/commit
+conflicts, rollback, check_txn_status, resolve, pessimistic flows).
+"""
+
+import pytest
+
+from tikv_tpu.storage import Storage
+from tikv_tpu.storage.mvcc import (
+    AlreadyExist,
+    Committed,
+    KeyIsLocked,
+    PessimisticLockRolledBack,
+    TxnLockNotFound,
+    WriteConflict,
+)
+from tikv_tpu.storage.txn.actions import Mutation
+from tikv_tpu.storage.txn import commands as cmds
+from tikv_tpu.storage.txn_types import (
+    Lock,
+    LockType,
+    Write,
+    WriteType,
+    append_ts,
+    compose_ts,
+    decode_key,
+    encode_key,
+    split_ts,
+)
+
+
+def ts(n):
+    """Logical test timestamps with controllable physical part (TTL)."""
+    return compose_ts(n, 0)
+
+
+@pytest.fixture
+def store():
+    return Storage()
+
+
+def put(store, key, value, start, commit):
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", key, value)], key, ts(start)))
+    store.sched_txn_command(cmds.Commit([key], ts(start), ts(commit)))
+
+
+# ------------------------------------------------------------- codecs
+
+
+def test_key_ts_roundtrip():
+    enc = encode_key(b"hello\x00world")
+    assert decode_key(enc) == b"hello\x00world"
+    kts = append_ts(enc, 42)
+    k, t = split_ts(kts)
+    assert (k, t) == (enc, 42)
+    # higher ts sorts first
+    assert append_ts(enc, 100) < append_ts(enc, 50)
+
+
+def test_lock_write_roundtrip():
+    lock = Lock(LockType.PUT, b"pk", 7, ttl=100, short_value=b"v",
+                for_update_ts=9, txn_size=3, min_commit_ts=8)
+    assert Lock.from_bytes(lock.to_bytes()) == lock
+    w = Write(WriteType.ROLLBACK, 5, None, True)
+    assert Write.from_bytes(w.to_bytes()) == w
+    w2 = Write(WriteType.PUT, 5, b"short")
+    assert Write.from_bytes(w2.to_bytes()) == w2
+
+
+# ------------------------------------------------------------- basic txn
+
+
+def test_prewrite_commit_get(store):
+    put(store, b"k", b"v1", 10, 20)
+    assert store.get(b"k", ts(25)) == b"v1"
+    assert store.get(b"k", ts(15)) is None      # before commit_ts
+    put(store, b"k", b"v2", 30, 40)
+    assert store.get(b"k", ts(45)) == b"v2"
+    assert store.get(b"k", ts(35)) == b"v1"     # old version visible
+
+
+def test_large_value_goes_to_default_cf(store):
+    big = b"x" * 5000
+    put(store, b"k", big, 10, 20)
+    assert store.get(b"k", ts(25)) == big
+
+
+def test_delete_version(store):
+    put(store, b"k", b"v", 10, 20)
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("delete", b"k")], b"k", ts(30)))
+    store.sched_txn_command(cmds.Commit([b"k"], ts(30), ts(40)))
+    assert store.get(b"k", ts(45)) is None
+    assert store.get(b"k", ts(25)) == b"v"
+
+
+def test_read_blocked_by_lock(store):
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"k", b"v")], b"k", ts(10)))
+    with pytest.raises(KeyIsLocked):
+        store.get(b"k", ts(15))
+    assert store.get(b"k", ts(5)) is None       # reads before lock ts pass
+    # bypass for resolved txns
+    assert store.get(b"k", ts(15), bypass_locks=(ts(10),)) is None
+
+
+def test_write_conflict(store):
+    put(store, b"k", b"v", 10, 20)
+    with pytest.raises(WriteConflict):
+        store.sched_txn_command(cmds.Prewrite(
+            [Mutation("put", b"k", b"x")], b"k", ts(15)))
+
+
+def test_prewrite_locked_by_other(store):
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"k", b"v")], b"k", ts(10)))
+    with pytest.raises(KeyIsLocked):
+        store.sched_txn_command(cmds.Prewrite(
+            [Mutation("put", b"k", b"x")], b"k", ts(12)))
+    # duplicate prewrite of the same txn is idempotent
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"k", b"v")], b"k", ts(10)))
+
+
+def test_commit_without_lock_raises(store):
+    with pytest.raises(TxnLockNotFound):
+        store.sched_txn_command(cmds.Commit([b"k"], ts(10), ts(20)))
+
+
+def test_commit_idempotent(store):
+    put(store, b"k", b"v", 10, 20)
+    store.sched_txn_command(cmds.Commit([b"k"], ts(10), ts(20)))   # again
+
+
+def test_insert_checks_existence(store):
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("insert", b"k", b"v")], b"k", ts(10)))
+    store.sched_txn_command(cmds.Commit([b"k"], ts(10), ts(20)))
+    with pytest.raises(AlreadyExist):
+        store.sched_txn_command(cmds.Prewrite(
+            [Mutation("insert", b"k", b"w")], b"k", ts(30)))
+    # after delete, insert succeeds
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("delete", b"k")], b"k", ts(40)))
+    store.sched_txn_command(cmds.Commit([b"k"], ts(40), ts(50)))
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("insert", b"k", b"w")], b"k", ts(60)))
+
+
+# ------------------------------------------------------------- rollback
+
+
+def test_rollback_prevents_late_prewrite(store):
+    store.sched_txn_command(cmds.Rollback([b"k"], ts(10)))
+    with pytest.raises(WriteConflict):
+        store.sched_txn_command(cmds.Prewrite(
+            [Mutation("put", b"k", b"v")], b"k", ts(10)))
+
+
+def test_rollback_removes_lock(store):
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"k", b"v" * 5000)], b"k", ts(10)))
+    store.sched_txn_command(cmds.Rollback([b"k"], ts(10)))
+    assert store.get(b"k", ts(20)) is None
+    with pytest.raises(TxnLockNotFound):
+        store.sched_txn_command(cmds.Commit([b"k"], ts(10), ts(20)))
+
+
+def test_rollback_after_commit_raises(store):
+    put(store, b"k", b"v", 10, 20)
+    with pytest.raises(Committed):
+        store.sched_txn_command(cmds.Rollback([b"k"], ts(10)))
+
+
+# ------------------------------------------------------------- status/resolve
+
+
+def test_check_txn_status_flows(store):
+    # locked, alive
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"k", b"v")], b"k", ts(10), lock_ttl=1000))
+    r = store.sched_txn_command(cmds.CheckTxnStatus(b"k", ts(10), 0, ts(500)))
+    assert r["status"] == "locked"
+    # expired → rolled back
+    r = store.sched_txn_command(cmds.CheckTxnStatus(b"k", ts(10), 0, ts(5000)))
+    assert r["status"] == "ttl_expired"
+    r = store.sched_txn_command(cmds.CheckTxnStatus(b"k", ts(10), 0, ts(6000)))
+    assert r["status"] == "rolled_back"
+    # committed txn reports commit_ts
+    put(store, b"c", b"v", 20, 30)
+    r = store.sched_txn_command(cmds.CheckTxnStatus(b"c", ts(20), 0, ts(5000)))
+    assert r == {"status": "committed", "ts": ts(30)}
+    # unknown txn: rollback record written
+    r = store.sched_txn_command(cmds.CheckTxnStatus(b"n", ts(40), 0, ts(5000)))
+    assert r["status"] == "rolled_back"
+
+
+def test_resolve_lock_commit_and_rollback(store):
+    for k in (b"a", b"b", b"c"):
+        store.sched_txn_command(cmds.Prewrite(
+            [Mutation("put", k, b"v-" + k)], b"a", ts(10)))
+    r = store.sched_txn_command(cmds.ResolveLock(ts(10), ts(20)))
+    assert r["resolved"] == 3
+    assert store.get(b"b", ts(25)) == b"v-b"
+
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"x", b"v")], b"x", ts(30)))
+    store.sched_txn_command(cmds.ResolveLock(ts(30), 0))    # rollback
+    assert store.get(b"x", ts(40)) is None
+
+
+# ------------------------------------------------------------- pessimistic
+
+
+def test_pessimistic_flow(store):
+    put(store, b"k", b"v0", 5, 6)
+    r = store.sched_txn_command(cmds.AcquirePessimisticLock(
+        [b"k"], b"k", ts(10), ts(10), return_values=True))
+    assert r["values"] == [b"v0"]
+    # other txn blocked
+    with pytest.raises(KeyIsLocked):
+        store.sched_txn_command(cmds.AcquirePessimisticLock(
+            [b"k"], b"k", ts(12), ts(12)))
+    # reads NOT blocked by pessimistic lock
+    assert store.get(b"k", ts(15)) == b"v0"
+    # prewrite converts the lock, commit finishes
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"k", b"v1")], b"k", ts(10),
+        is_pessimistic_lock=[True]))
+    store.sched_txn_command(cmds.Commit([b"k"], ts(10), ts(20)))
+    assert store.get(b"k", ts(25)) == b"v1"
+
+
+def test_pessimistic_write_conflict(store):
+    put(store, b"k", b"v", 10, 20)
+    with pytest.raises(WriteConflict):
+        store.sched_txn_command(cmds.AcquirePessimisticLock(
+            [b"k"], b"k", ts(5), ts(15)))   # for_update_ts < commit 20
+
+
+def test_pessimistic_rollback(store):
+    store.sched_txn_command(cmds.AcquirePessimisticLock(
+        [b"k"], b"k", ts(10), ts(10)))
+    store.sched_txn_command(cmds.PessimisticRollback([b"k"], ts(10), ts(10)))
+    # key free again
+    store.sched_txn_command(cmds.AcquirePessimisticLock(
+        [b"k"], b"k", ts(12), ts(12)))
+
+
+def test_pessimistic_prewrite_without_lock_rejected(store):
+    with pytest.raises(PessimisticLockRolledBack):
+        store.sched_txn_command(cmds.Prewrite(
+            [Mutation("put", b"k", b"v")], b"k", ts(10),
+            is_pessimistic_lock=[True]))
+
+
+def test_txn_heart_beat(store):
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"k", b"v")], b"k", ts(10), lock_ttl=100))
+    r = store.sched_txn_command(cmds.TxnHeartBeat(b"k", ts(10), 5000))
+    assert r["ttl"] == 5000
+    r = store.sched_txn_command(cmds.TxnHeartBeat(b"k", ts(10), 50))
+    assert r["ttl"] == 5000     # never shrinks
+    with pytest.raises(TxnLockNotFound):
+        store.sched_txn_command(cmds.TxnHeartBeat(b"z", ts(10), 50))
+
+
+# ------------------------------------------------------------- scan
+
+
+def test_scan_versions_and_locks(store):
+    for i in range(5):
+        put(store, b"k%d" % i, b"v%d" % i, 10 + i, 20 + i)
+    got = store.scan(b"k0", b"k9", 10, ts(100))
+    assert got == [(b"k%d" % i, b"v%d" % i) for i in range(5)]
+    # limit
+    assert len(store.scan(b"k0", b"k9", 2, ts(100))) == 2
+    # snapshot cut: only commits <= read_ts visible
+    got = store.scan(b"k0", b"k9", 10, ts(22))
+    assert got == [(b"k0", b"v0"), (b"k1", b"v1"), (b"k2", b"v2")]
+    # desc
+    got = store.scan(b"k0", b"k9", 10, ts(100), desc=True)
+    assert got == [(b"k%d" % i, b"v%d" % i) for i in reversed(range(5))]
+    # deleted keys skipped
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("delete", b"k2")], b"k2", ts(50)))
+    store.sched_txn_command(cmds.Commit([b"k2"], ts(50), ts(51)))
+    got = store.scan(b"k0", b"k9", 10, ts(100))
+    assert [k for k, _ in got] == [b"k0", b"k1", b"k3", b"k4"]
+    # conflicting lock in range raises
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"k3", b"x")], b"k3", ts(60)))
+    with pytest.raises(KeyIsLocked):
+        store.scan(b"k0", b"k9", 10, ts(100))
+    # ... but not when limit stops before the locked key
+    assert len(store.scan(b"k0", b"k9", 2, ts(100))) == 2
+    # lock on never-written key still conflicts
+    store.sched_txn_command(cmds.Rollback([b"k3"], ts(60)))
+    store.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"k9", b"x")], b"k9", ts(70)))
+    with pytest.raises(KeyIsLocked):
+        store.scan(b"k0", b"k9z", 10, ts(100))
+
+
+def test_batch_get(store):
+    put(store, b"a", b"1", 10, 20)
+    put(store, b"c", b"3", 10, 20)
+    got = store.batch_get([b"a", b"b", b"c"], ts(30))
+    assert got == [(b"a", b"1"), (b"b", None), (b"c", b"3")]
+
+
+# ------------------------------------------------------------- raw KV
+
+
+def test_raw_kv(store):
+    store.raw_put(b"k1", b"v1")
+    store.raw_batch_put([(b"k2", b"v2"), (b"k3", b"v3")])
+    assert store.raw_get(b"k1") == b"v1"
+    assert store.raw_batch_get([b"k1", b"kx"]) == [(b"k1", b"v1"),
+                                                   (b"kx", None)]
+    assert store.raw_scan(b"k1", None, 10) == [
+        (b"k1", b"v1"), (b"k2", b"v2"), (b"k3", b"v3")]
+    assert store.raw_scan(b"k1", b"k3", 10) == [
+        (b"k1", b"v1"), (b"k2", b"v2")]
+    store.raw_delete(b"k2")
+    assert store.raw_get(b"k2") is None
+    store.raw_delete_range(b"k1", b"k9")
+    assert store.raw_scan(b"k0", None, 10) == []
+
+
+def test_raw_and_txn_keyspaces_disjoint(store):
+    store.raw_put(b"k", b"raw")
+    put(store, b"k", b"txn", 10, 20)
+    assert store.raw_get(b"k") == b"raw"
+    assert store.get(b"k", ts(30)) == b"txn"
+
+
+# ------------------------------------------------------------- latches
+
+
+def test_latches_serialize_conflicts():
+    import threading
+    from tikv_tpu.storage.txn.latch import Latches
+    latches = Latches(16)
+    order = []
+    c1 = latches.gen_cid()
+    c2 = latches.gen_cid()
+    s1 = latches.acquire(c1, [b"a", b"b"])
+
+    def second():
+        s2 = latches.acquire(c2, [b"b", b"c"])
+        order.append("c2")
+        latches.release(c2, s2)
+
+    t = threading.Thread(target=second)
+    t.start()
+    import time
+    time.sleep(0.05)
+    order.append("c1-release")
+    latches.release(c1, s1)
+    t.join(timeout=5)
+    assert order == ["c1-release", "c2"]

@@ -99,3 +99,57 @@ def test_i64_max_handle_included():
     dag = sel.build()   # full-table range: prefix + 0xff*9 end key
     out = BatchExecutorsRunner(dag, snap).handle_request()
     assert [r[0] for r in out.rows()] == [1, 2, hmax]
+
+
+def test_mvcc_feed_desc_multi_range():
+    """MvccScanStorage must emit desc multi-range keys in global reverse."""
+    from tikv_tpu.copr.storage_impl import MvccScanStorage
+    from tikv_tpu.kv.engine import SnapContext
+    from tikv_tpu.storage import Storage
+    from tikv_tpu.storage.mvcc import MvccReader
+    from tikv_tpu.storage.txn import commands as cmds
+    from tikv_tpu.storage.txn.actions import Mutation
+
+    store = Storage()
+    for i in range(10):
+        k = bytes([i])
+        store.sched_txn_command(cmds.Prewrite(
+            [Mutation("put", k, b"v%d" % i)], k, 10 + i))
+        store.sched_txn_command(cmds.Commit([k], 10 + i, 20 + i))
+    reader = MvccReader(store.engine.snapshot(SnapContext()))
+    feed = MvccScanStorage(reader, 1000)
+    feed.begin_scan([KeyRange(bytes([0]), bytes([3])),
+                     KeyRange(bytes([5]), bytes([8]))], desc=True)
+    keys = [kv[0][0] for kv in feed.scan_batch(100)]
+    assert keys == [7, 6, 5, 2, 1, 0]
+
+
+def test_device_topn_desc_nulls_last():
+    """DESC TopN puts NULLs last even when NULL count exceeds the limit."""
+    n = 64
+    table = _table(8103)
+    v = np.arange(n, dtype=np.int64)
+    valid = v >= 40                          # 40 NULLs
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"k": np.zeros(n, dtype=np.int64),
+         "v": Column(EvalType.INT, v, valid)})
+    r = DeviceRunner(chunk_rows=1 << 12)
+    sel = DagSelect.from_table(table, ["id", "k", "v"])
+    dag = sel.order_by(sel.col("v"), desc=True, limit=30).build()
+    out = r.handle_request(dag, snap)
+    vals = [row[2] for row in out.rows()]
+    assert vals[:24] == list(range(63, 39, -1))
+    assert all(x is None for x in vals[24:])
+
+
+def test_unaligned_chunk_rows_multi_device():
+    """chunk_rows not divisible by the shard unit must still work."""
+    table = _table(8104)
+    snap = _snap(table, n=4000, seed=3)
+    r = DeviceRunner(chunk_rows=1001)
+    sel = DagSelect.from_table(table, ["id", "k", "v"])
+    dag = sel.aggregate([sel.col("k")], [("sum", sel.col("v"))]).build()
+    dev = r.handle_request(dag, snap)
+    host = BatchExecutorsRunner(dag, snap).handle_request()
+    assert sorted(dev.rows()) == sorted(host.rows())

@@ -143,11 +143,13 @@ def matmul_groupby(idx, L8, Lf, slots: int, block: int = BLOCK_ROWS,
     ``vary_axes``: when called inside shard_map, the mesh axis names — the
     scan carry must be marked device-varying (lax.pvary) to match the body
     output's varying-manual-axes type."""
+    import math
     G = slot_pad(slots)
     n = idx.shape[0]
-    block = min(block, n)
+    # the block length must divide n (lax.scan over equal blocks); chunk
+    # sizes are powers of two in practice, so this stays == BLOCK_ROWS
+    block = math.gcd(n, min(block, n))
     nblk = n // block
-    assert nblk * block == n, (n, block)
     p8 = L8.shape[0]
     iota = jnp.arange(G, dtype=jnp.int32)
 

@@ -287,7 +287,9 @@ class DeviceRunner:
     def _chunk_size_for(self, n: int) -> int:
         unit = num_shards(self._mesh) * 8
         if n >= self._chunk_rows:
-            return self._chunk_rows
+            # chunk must split evenly across shards (device_put over the
+            # row axis) — round the configured size up to the unit
+            return ((self._chunk_rows + unit - 1) // unit) * unit
         target = max(unit, _next_pow2(max(n, 1)))
         return ((target + unit - 1) // unit) * unit
 
@@ -946,18 +948,25 @@ class DeviceRunner:
         ok = np.concatenate([p[2] for p in parts])
         sel = mask & (gidx < n)
         gidx, ok = gidx[sel], ok[sel]
-        # exact host ordering over <= k * n_chunks * n_shards candidates
+        # exact host ordering over <= k * n_chunks * n_shards candidates:
+        # evaluate the order expression only on the gathered candidate rows
         # (plan rpns are remapped onto host_cols positions)
-        ov, _om = eval_rpn(plan.order_rpn, host_cols(), n, np)
-        ov = np.broadcast_to(ov, (n,))
+        cand_cols = [(v[gidx], m[gidx]) for v, m in host_cols()]
+        ov, _om = eval_rpn(plan.order_rpn, cand_cols, len(gidx), np)
+        ov = np.broadcast_to(ov, (len(gidx),))
         if plan.order_rpn.ret_type is EvalType.INT:
-            # exact int ordering (no f64 collapse above 2^53); NULL smallest
-            vals = np.asarray(ov, dtype=np.int64)[gidx]
-            lo = np.iinfo(np.int64).min
-            key = np.where(ok, np.maximum(vals, lo + 1), lo)
-            order = np.lexsort((gidx, -key if plan.order_desc else key))
+            # exact int ordering (no f64 collapse above 2^53); NULL is the
+            # smallest value, so asc → NULL first, desc → NULL last.
+            # Clamp to min+2 so negation cannot overflow int64.min.
+            lo, hi = np.iinfo(np.int64).min, np.iinfo(np.int64).max
+            vals = np.maximum(np.asarray(ov, dtype=np.int64), lo + 2)
+            if plan.order_desc:
+                key = np.where(ok, -vals, hi)
+            else:
+                key = np.where(ok, vals, lo)
+            order = np.lexsort((gidx, key))
         else:
-            vals = np.asarray(ov, dtype=np.float64)[gidx]
+            vals = np.asarray(ov, dtype=np.float64)
             keyf = np.where(ok, vals, -np.inf)      # NULL smallest
             order = np.lexsort((gidx, -keyf if plan.order_desc else keyf))
         take = gidx[order[:plan.limit]]

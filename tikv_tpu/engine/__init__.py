@@ -1,0 +1,28 @@
+"""Storage engine abstraction layer.
+
+Reference: components/engine_traits (KvEngine engine.rs:13, Peekable
+peekable.rs:11, Iterable iterable.rs:120, WriteBatch write_batch.rs:72,
+Snapshot snapshot.rs:11, cf_defs.rs:4-11) with conformance suite parity
+(components/engine_traits_tests).
+"""
+
+from .traits import (
+    CF_DEFAULT,
+    CF_LOCK,
+    CF_RAFT,
+    CF_WRITE,
+    DATA_CFS,
+    Iterator,
+    KvEngine,
+    Peekable,
+    Snapshot,
+    WriteBatch,
+)
+from .memory import MemoryEngine
+from .panic import PanicEngine
+
+__all__ = [
+    "CF_DEFAULT", "CF_LOCK", "CF_WRITE", "CF_RAFT", "DATA_CFS",
+    "Iterator", "KvEngine", "Peekable", "Snapshot", "WriteBatch",
+    "MemoryEngine", "PanicEngine",
+]

@@ -1,0 +1,23 @@
+"""Panic-stub engine — compile-time template proving trait completeness.
+
+Reference: components/engine_panic (a KvEngine whose every method panics;
+new engines start by copying it, and it keeps the trait surface honest).
+"""
+
+from __future__ import annotations
+
+
+def _panic(*_a, **_k):
+    raise NotImplementedError("PanicEngine: method intentionally unimplemented")
+
+
+class PanicEngine:
+    snapshot = _panic
+    write_batch = _panic
+    write = _panic
+    get_value_cf = _panic
+    get_value = _panic
+    iterator_cf = _panic
+    put_cf = _panic
+    delete_cf = _panic
+    flush = _panic

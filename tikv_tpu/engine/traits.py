@@ -1,0 +1,118 @@
+"""Engine traits — the seam every storage backend implements.
+
+Reference: components/engine_traits/src/:
+- ``KvEngine`` (engine.rs:13): multi-CF KV store with snapshots + batches
+- ``Peekable`` (peekable.rs:11): point reads
+- ``Iterable`` (iterable.rs:120): ordered iteration (here: ``Iterator``)
+- ``WriteBatch`` (write_batch.rs:72): atomic multi-CF write batches
+- ``Snapshot`` (snapshot.rs:11): immutable point-in-time view
+- column families (cf_defs.rs:4-11): default / lock / write / raft
+
+The conformance suite (tests/test_engine_conformance.py, mirroring
+components/engine_traits_tests) runs against every implementation;
+``PanicEngine`` proves the surface is complete the way engine_panic does.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Protocol, Sequence, runtime_checkable
+
+CF_DEFAULT = "default"
+CF_LOCK = "lock"
+CF_WRITE = "write"
+CF_RAFT = "raft"
+DATA_CFS = (CF_DEFAULT, CF_LOCK, CF_WRITE)
+ALL_CFS = (CF_DEFAULT, CF_LOCK, CF_WRITE, CF_RAFT)
+
+
+class Iterator(Protocol):
+    """Ordered CF iterator.
+
+    Reference: engine_traits Iterator (iterable.rs) — seek/valid/next/prev
+    with key()/value() accessors; positions are [start, end) bounded by the
+    creating call.
+    """
+
+    def valid(self) -> bool: ...
+
+    def seek(self, key: bytes) -> bool:
+        """Position at first key >= ``key``; returns valid()."""
+        ...
+
+    def seek_for_prev(self, key: bytes) -> bool:
+        """Position at last key <= ``key``; returns valid()."""
+        ...
+
+    def seek_to_first(self) -> bool: ...
+
+    def seek_to_last(self) -> bool: ...
+
+    def next(self) -> bool: ...
+
+    def prev(self) -> bool: ...
+
+    def key(self) -> bytes: ...
+
+    def value(self) -> bytes: ...
+
+
+class Peekable(Protocol):
+    def get_value_cf(self, cf: str, key: bytes) -> Optional[bytes]: ...
+
+    def get_value(self, key: bytes) -> Optional[bytes]: ...
+
+
+class Snapshot(Peekable, Protocol):
+    """Immutable view.  Reference: snapshot.rs:11."""
+
+    def iterator_cf(self, cf: str,
+                    lower: Optional[bytes] = None,
+                    upper: Optional[bytes] = None) -> Iterator: ...
+
+
+class WriteBatch(Protocol):
+    """Atomic multi-CF batch.  Reference: write_batch.rs:72."""
+
+    def put_cf(self, cf: str, key: bytes, value: bytes) -> None: ...
+
+    def delete_cf(self, cf: str, key: bytes) -> None: ...
+
+    def delete_range_cf(self, cf: str, start: bytes, end: bytes) -> None: ...
+
+    def put(self, key: bytes, value: bytes) -> None: ...
+
+    def delete(self, key: bytes) -> None: ...
+
+    def count(self) -> int: ...
+
+    def is_empty(self) -> bool: ...
+
+    def clear(self) -> None: ...
+
+
+@runtime_checkable
+class KvEngine(Protocol):
+    """Reference: engine.rs:13 (KvEngine: Peekable + Iterable + WriteBatchExt
+    + snapshot())."""
+
+    def snapshot(self) -> Snapshot: ...
+
+    def write_batch(self) -> WriteBatch: ...
+
+    def write(self, batch: WriteBatch) -> None:
+        """Apply a batch atomically."""
+        ...
+
+    def get_value_cf(self, cf: str, key: bytes) -> Optional[bytes]: ...
+
+    def get_value(self, key: bytes) -> Optional[bytes]: ...
+
+    def iterator_cf(self, cf: str,
+                    lower: Optional[bytes] = None,
+                    upper: Optional[bytes] = None) -> Iterator: ...
+
+    def put_cf(self, cf: str, key: bytes, value: bytes) -> None: ...
+
+    def delete_cf(self, cf: str, key: bytes) -> None: ...
+
+    def flush(self) -> None: ...

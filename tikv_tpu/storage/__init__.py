@@ -1,0 +1,106 @@
+"""Storage — the transactional KV facade.
+
+Reference: src/storage/mod.rs:188 ``Storage<E, L, F>``: transactional
+reads (get :597 / batch_get :1166 / scan :1360), txn command scheduling
+(sched_txn_command :1702), and the raw KV API (:1860-2915).  Reads take
+an engine snapshot and resolve Percolator state through MvccReader; writes
+go through the latch-serialized TxnScheduler.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..kv.engine import Engine, LocalEngine, SnapContext, WriteData
+from .mvcc.reader import MvccReader
+from .txn.commands import Command
+from .txn.scheduler import TxnScheduler
+from ..engine.traits import CF_DEFAULT
+
+RAW_PREFIX = b"r"       # raw and txn keyspaces must not overlap (ApiV2
+                        # keyspace prefixes, api_version/src/keyspace.rs)
+
+
+class Storage:
+    def __init__(self, engine: Optional[Engine] = None):
+        self._engine = engine if engine is not None else LocalEngine()
+        self._sched = TxnScheduler(self._engine)
+
+    @property
+    def engine(self) -> Engine:
+        return self._engine
+
+    # -- transactional reads (mod.rs:597,1166,1360) --
+
+    def get(self, key: bytes, read_ts: int,
+            bypass_locks=()) -> Optional[bytes]:
+        reader = MvccReader(self._engine.snapshot(SnapContext(read_ts=read_ts)))
+        return reader.get(key, read_ts, bypass_locks)
+
+    def batch_get(self, keys: Sequence[bytes], read_ts: int,
+                  bypass_locks=()) -> list:
+        reader = MvccReader(self._engine.snapshot(SnapContext(read_ts=read_ts)))
+        return [(k, reader.get(k, read_ts, bypass_locks)) for k in keys]
+
+    def scan(self, start: Optional[bytes], end: Optional[bytes], limit: int,
+             read_ts: int, desc: bool = False, bypass_locks=()) -> list:
+        reader = MvccReader(self._engine.snapshot(SnapContext(read_ts=read_ts)))
+        return reader.scan(start, end, limit, read_ts, desc, bypass_locks)
+
+    # -- txn writes (mod.rs:1702) --
+
+    def sched_txn_command(self, cmd: Command):
+        return self._sched.run(cmd)
+
+    # -- raw KV (mod.rs:1860-2915; ApiV1 semantics, raw/ module) --
+
+    def _raw_key(self, key: bytes) -> bytes:
+        return RAW_PREFIX + key
+
+    def raw_put(self, key: bytes, value: bytes) -> None:
+        self._engine.write(SnapContext(), WriteData(
+            [("put", CF_DEFAULT, self._raw_key(key), value)]))
+
+    def raw_batch_put(self, pairs: Sequence[tuple]) -> None:
+        self._engine.write(SnapContext(), WriteData(
+            [("put", CF_DEFAULT, self._raw_key(k), v) for k, v in pairs]))
+
+    def raw_get(self, key: bytes) -> Optional[bytes]:
+        snap = self._engine.snapshot(SnapContext())
+        return snap.get_value_cf(CF_DEFAULT, self._raw_key(key))
+
+    def raw_batch_get(self, keys: Sequence[bytes]) -> list:
+        snap = self._engine.snapshot(SnapContext())
+        return [(k, snap.get_value_cf(CF_DEFAULT, self._raw_key(k)))
+                for k in keys]
+
+    def raw_delete(self, key: bytes) -> None:
+        self._engine.write(SnapContext(), WriteData(
+            [("del", CF_DEFAULT, self._raw_key(key), None)]))
+
+    def raw_delete_range(self, start: bytes, end: bytes) -> None:
+        snap = self._engine.snapshot(SnapContext())
+        it = snap.iterator_cf(CF_DEFAULT, self._raw_key(start),
+                              self._raw_key(end))
+        mods = []
+        ok = it.seek_to_first()
+        while ok:
+            mods.append(("del", CF_DEFAULT, it.key(), None))
+            ok = it.next()
+        if mods:
+            self._engine.write(SnapContext(), WriteData(mods))
+
+    def raw_scan(self, start: bytes, end: Optional[bytes], limit: int,
+                 desc: bool = False) -> list:
+        snap = self._engine.snapshot(SnapContext())
+        # end=None → everything in the raw keyspace: bound by the next
+        # one-byte prefix (raw keys all start with RAW_PREFIX)
+        upper = self._raw_key(end) if end is not None else \
+            bytes([RAW_PREFIX[0] + 1])
+        it = snap.iterator_cf(CF_DEFAULT, self._raw_key(start), upper)
+        out = []
+        ok = it.seek_to_last() if desc else it.seek_to_first()
+        while ok and len(out) < limit:
+            out.append((it.key()[len(RAW_PREFIX):], it.value()))
+            ok = it.prev() if desc else it.next()
+        return out

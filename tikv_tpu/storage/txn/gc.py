@@ -1,0 +1,60 @@
+"""MVCC garbage collection.
+
+Reference: src/storage/txn/actions/gc.rs (legacy per-key GC) and
+src/server/gc_worker/compaction_filter.rs (the production path folds the
+same rule into RocksDB compaction).  Rule per key, given safe_point:
+keep every version with commit_ts > safe_point; of the versions with
+commit_ts <= safe_point keep only the newest, and only if it is a PUT
+(a DELETE at/below the safe point erases the key entirely); ROLLBACK/LOCK
+records at/below the safe point always drop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...engine.traits import CF_WRITE
+from ..mvcc.reader import MvccReader, _PAST_VERSIONS
+from ..mvcc.txn import MvccTxn
+from ..txn_types import Write, WriteType, decode_key, encode_key, split_ts
+
+
+def gc_key(txn: MvccTxn, reader: MvccReader, key: bytes,
+           safe_point: int) -> int:
+    """GC one key; returns number of versions removed."""
+    removed = 0
+    found = reader.seek_write(key, safe_point)
+    kept_newest = False
+    while found is not None:
+        commit_ts, write = found
+        drop = True
+        if not kept_newest:
+            if write.write_type is WriteType.PUT:
+                drop = False
+            # DELETE/LOCK/ROLLBACK as the newest ≤ safe_point: droppable
+            # (nothing below is visible anyway)
+            if write.write_type in (WriteType.PUT, WriteType.DELETE):
+                kept_newest = True
+        if drop:
+            txn.delete_write(key, commit_ts)
+            if write.write_type is WriteType.PUT and \
+                    write.short_value is None:
+                txn.delete_value(key, write.start_ts)
+            removed += 1
+        found = reader.seek_write(key, commit_ts - 1) if commit_ts else None
+    return removed
+
+
+def gc_range(txn: MvccTxn, reader: MvccReader, start: Optional[bytes],
+             end: Optional[bytes], safe_point: int) -> int:
+    """GC every key with versions in [start, end)."""
+    lower = encode_key(start) if start else None
+    upper = encode_key(end) if end else None
+    it = reader._snap.iterator_cf(CF_WRITE, lower, upper)
+    removed = 0
+    ok = it.seek_to_first()
+    while ok:
+        enc, _ = split_ts(it.key())
+        removed += gc_key(txn, reader, decode_key(enc), safe_point)
+        ok = it.seek(enc + _PAST_VERSIONS)
+    return removed

@@ -1,0 +1,44 @@
+"""Txn command scheduler — latch, snapshot, execute, flush.
+
+Reference: src/storage/txn/scheduler.rs — ``TxnScheduler``: every write
+command acquires latches on its keys (:396), takes an engine snapshot
+(:1174), runs ``process_write`` (:1252) buffering into MvccTxn, flushes
+through ``Engine::async_write``, then releases latches (:544) waking
+queued commands.  The Python surface is synchronous per command but safe
+for concurrent caller threads (the reference runs commands on a worker
+pool; conflicting commands serialize on latches either way).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...kv.engine import Engine, SnapContext, WriteData
+from ..mvcc.reader import MvccReader
+from ..mvcc.txn import MvccTxn
+from .commands import Command, ResolveLock
+from .latch import Latches
+
+
+class TxnScheduler:
+    def __init__(self, engine: Engine, latches: Optional[Latches] = None):
+        self._engine = engine
+        self._latches = latches if latches is not None else Latches()
+
+    def run(self, cmd: Command, ctx: Optional[SnapContext] = None):
+        ctx = ctx if ctx is not None else SnapContext()
+        if isinstance(cmd, ResolveLock):
+            # read phase before latching (resolve_lock.rs scan → write)
+            cmd.prepare(MvccReader(self._engine.snapshot(ctx)))
+        cid = self._latches.gen_cid()
+        slots = self._latches.acquire(cid, cmd.write_keys())
+        try:
+            snapshot = self._engine.snapshot(ctx)
+            reader = MvccReader(snapshot)
+            txn = MvccTxn(cmd.start_ts)
+            result = cmd.process_write(txn, reader)
+            if not txn.is_empty():
+                self._engine.write(ctx, WriteData.from_txn(txn))
+            return result
+        finally:
+            self._latches.release(cid, slots)
