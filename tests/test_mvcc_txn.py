@@ -363,3 +363,15 @@ def test_latches_serialize_conflicts():
     latches.release(c1, s1)
     t.join(timeout=5)
     assert order == ["c1-release", "c2"]
+
+
+def test_raw_cannot_clobber_txn_keyspace(store):
+    """Raw writes at adversarial keys must never alias txn records."""
+    from tikv_tpu.storage.txn_types import append_ts, encode_key
+    big = b"V" * 5000
+    put(store, b"rabcdefg", big, 10, 20)
+    # adversarial raw key shaped like the txn default-CF slot
+    alias = append_ts(encode_key(b"rabcdefg"), ts(10))[1:]
+    store.raw_put(alias, b"CLOBBERED")
+    assert store.get(b"rabcdefg", ts(30)) == big
+    assert store.raw_get(alias) == b"CLOBBERED"

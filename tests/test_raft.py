@@ -1,0 +1,295 @@
+"""Raft core tests: elections, replication, conflicts, partitions,
+snapshots, conf changes, leader transfer.
+
+Mirrors the behaviors the reference gets from raft-rs and exercises in
+tests/integrations/raftstore/ (test_conf_change.rs, test_lease_read.rs,
+transport_simulate-based partition tests).
+"""
+
+import pytest
+
+from tikv_tpu.raft import (
+    ConfChange,
+    ConfChangeType,
+    Entry,
+    Message,
+    MsgType,
+    RawNode,
+    MemoryRaftStorage,
+)
+from tikv_tpu.raft.network import RaftNetwork
+from tikv_tpu.raft.raw_node import LEADER, FOLLOWER, NotLeader, ProposalDropped
+
+
+def make_net(n=3, **kw):
+    return RaftNetwork(list(range(1, n + 1)), **kw)
+
+
+# ------------------------------------------------------------- elections
+
+
+def test_single_node_self_elects():
+    net = make_net(1)
+    net.tick_all(25)
+    assert net.leader() == 1
+
+
+def test_three_node_election_by_timeout():
+    net = make_net(3)
+    net.tick_all(40)
+    assert net.leader() is not None
+    # exactly one leader at the max term
+    leaders = [n for n in net.nodes.values() if n.state == LEADER]
+    assert len(leaders) == 1
+
+
+def test_election_requires_quorum():
+    net = make_net(3)
+    net.isolate(1)
+    net.isolate(2)
+    net.isolate(3)
+    net.tick_all(60)
+    assert net.leader() is None     # nobody can win alone
+
+
+def test_leader_steps_down_on_higher_term():
+    net = make_net(3)
+    net.elect(1)
+    net.isolate(1)
+    net.tick_all(50)                # majority elects a new leader
+    new_lead = net.leader()
+    assert new_lead in (2, 3)
+    net.heal()
+    net.tick_all(5)
+    assert net.nodes[1].state == FOLLOWER
+    assert net.nodes[1].term >= net.nodes[new_lead].term
+
+
+def test_pre_vote_prevents_term_inflation():
+    net = make_net(3, pre_vote=True)
+    net.elect(1)
+    term_before = net.nodes[1].term
+    f = net.isolate(3)
+    net.tick_all(100)               # node 3 keeps pre-campaigning, alone
+    net.heal(f)
+    net.tick_all(5)
+    # without pre-vote node 3's term would have exploded and deposed the
+    # leader; with pre-vote the cluster is undisturbed
+    assert net.leader() == 1
+    assert net.nodes[1].term == term_before
+
+
+# ------------------------------------------------------------- replication
+
+
+def test_propose_replicates_to_all():
+    net = make_net(3)
+    net.elect(1)
+    net.propose(b"a")
+    net.propose(b"b")
+    for nid in net.nodes:
+        assert net.committed_data(nid) == [b"a", b"b"]
+
+
+def test_proposals_commit_with_minority_down():
+    net = make_net(5)
+    net.elect(1)
+    net.isolate(4)
+    net.isolate(5)
+    net.propose(b"x")
+    assert net.committed_data(1) == [b"x"]
+
+
+def test_no_commit_without_quorum():
+    net = make_net(3)
+    net.elect(1)
+    net.isolate(2)
+    net.isolate(3)
+    idx = net.nodes[1].propose(b"x")
+    net.deliver_all()
+    assert net.nodes[1].commit < idx
+    assert net.committed_data(1) == []
+
+
+def test_follower_catches_up_after_heal():
+    net = make_net(3)
+    net.elect(1)
+    f = net.isolate(3)
+    for i in range(5):
+        net.propose(b"v%d" % i)
+    assert net.committed_data(3) == []
+    net.heal(f)
+    net.tick_all(4)                 # heartbeat → append catch-up
+    assert net.committed_data(3) == [b"v%d" % i for i in range(5)]
+
+
+def test_divergent_log_truncated():
+    """A deposed leader's uncommitted entries are overwritten (§5.3)."""
+    net = make_net(3)
+    net.elect(1)
+    net.propose(b"committed")
+    f = net.isolate(1)
+    # stale leader appends entries it can never commit
+    net.nodes[1].propose(b"lost-1")
+    net.nodes[1].propose(b"lost-2")
+    net.deliver_all()
+    net.tick_all(50)                # others elect a new leader
+    new_lead = net.leader()
+    assert new_lead in (2, 3)
+    net.nodes[new_lead].propose(b"kept")
+    net.deliver_all()
+    net.heal(f)
+    net.tick_all(6)
+    for nid in net.nodes:
+        assert net.committed_data(nid) == [b"committed", b"kept"]
+    # old entries truly gone from node 1's log
+    data = [e.data for e in net.nodes[1].storage.entries]
+    assert b"lost-1" not in data and b"lost-2" not in data
+
+
+def test_leader_completeness_vote_rejection():
+    """A candidate with a stale log cannot win (§5.4.1)."""
+    net = make_net(3, pre_vote=False)
+    net.elect(1)
+    f = net.isolate(3)
+    net.propose(b"x")
+    net.heal(f)
+    # force node 3 (stale log) to campaign; 1 and 2 must reject
+    net.nodes[3].step(Message(MsgType.HUP))
+    net.deliver_all()
+    assert net.nodes[3].state != LEADER
+    net.tick_all(50)
+    lead = net.leader()
+    assert lead is not None
+    assert b"x" in net.committed_data(lead)
+
+
+def test_not_leader_errors():
+    net = make_net(3)
+    net.elect(1)
+    with pytest.raises(NotLeader) as ei:
+        net.nodes[2].propose(b"x")
+    assert ei.value.leader_id == 1
+
+
+# ------------------------------------------------------------- snapshot
+
+
+def test_snapshot_catch_up_after_compaction():
+    net = make_net(3)
+    net.elect(1)
+    f = net.isolate(3)
+    for i in range(10):
+        net.propose(b"v%d" % i)
+    # leader compacts its log beyond what node 3 has
+    lead = net.nodes[1]
+    lead.storage.compact(lead.commit)
+    lead.storage.snapshot = type(lead.storage.snapshot)(
+        lead.storage.snapshot.metadata, b"snap-state-10")
+    net.heal(f)
+    net.tick_all(6)
+    assert net.nodes[3].storage.snapshot.metadata.index >= 10
+    assert net.nodes[3].commit == net.nodes[1].commit
+    # and further replication proceeds normally
+    net.propose(b"after")
+    assert net.committed_data(3)[-1] == b"after"
+
+
+# ------------------------------------------------------------- conf change
+
+
+def test_add_and_remove_node():
+    net = make_net(3)
+    net.elect(1)
+    net.propose(b"before")
+    # add node 4
+    s4 = MemoryRaftStorage(voters=())
+    net.nodes[4] = RawNode(4, s4)
+    net.applied[4] = []
+    net.nodes[1].propose_conf_change(
+        ConfChange(ConfChangeType.ADD_NODE, 4))
+    net.deliver_all()
+    net.tick_all(4)
+    assert 4 in net.nodes[1].voters
+    assert net.committed_data(4)[-1] == b"before"   # caught up via snapshot/log
+    net.propose(b"with-4")
+    assert net.committed_data(4)[-1] == b"with-4"
+    # remove node 3; quorum becomes 3-of-4 → 3-of-3
+    net.nodes[1].propose_conf_change(
+        ConfChange(ConfChangeType.REMOVE_NODE, 3))
+    net.deliver_all()
+    assert 3 not in net.nodes[1].voters
+    net.isolate(3)
+    net.propose(b"without-3")
+    assert net.committed_data(4)[-1] == b"without-3"
+
+
+def test_only_one_conf_change_in_flight():
+    net = make_net(3)
+    net.elect(1)
+    lead = net.nodes[1]
+    f = net.isolate(2)
+    net.isolate(3)
+    lead.propose_conf_change(ConfChange(ConfChangeType.ADD_NODE, 4))
+    with pytest.raises(ProposalDropped):
+        lead.propose_conf_change(ConfChange(ConfChangeType.ADD_NODE, 5))
+
+
+def test_learner_receives_but_does_not_vote():
+    net = make_net(3)
+    net.elect(1)
+    s4 = MemoryRaftStorage(voters=())
+    net.nodes[4] = RawNode(4, s4)
+    net.applied[4] = []
+    net.nodes[1].propose_conf_change(
+        ConfChange(ConfChangeType.ADD_LEARNER, 4))
+    net.deliver_all()
+    net.propose(b"x")
+    assert net.committed_data(4) == [b"x"]
+    assert 4 in net.nodes[1].learners and 4 not in net.nodes[1].voters
+    # learner never campaigns
+    for _ in range(100):
+        net.nodes[4].tick()
+    net.deliver_all()
+    assert net.nodes[4].state == FOLLOWER
+
+
+# ------------------------------------------------------------- transfer
+
+
+def test_leader_transfer():
+    net = make_net(3)
+    net.elect(1)
+    net.propose(b"x")
+    net.nodes[1].transfer_leader(2)
+    net.deliver_all()
+    assert net.leader() == 2
+    assert net.nodes[1].state == FOLLOWER
+    net.propose(b"y")
+    assert net.committed_data(3) == [b"x", b"y"]
+
+
+def test_transfer_waits_for_catch_up():
+    net = make_net(3)
+    net.elect(1)
+    f = net.isolate(3)
+    net.propose(b"a")
+    net.heal(f)
+    net.nodes[1].transfer_leader(3)     # 3 lags; must catch up first
+    net.deliver_all()
+    assert net.leader() == 3
+    assert net.committed_data(3) == [b"a"]
+
+
+# ------------------------------------------------------------- determinism
+
+
+def test_deterministic_replay():
+    def run():
+        net = make_net(3, seed=42)
+        net.tick_all(40)
+        net.propose(b"p")
+        return (net.leader(),
+                [(nid, n.term, n.commit) for nid, n in
+                 sorted(net.nodes.items())])
+    assert run() == run()

@@ -1,0 +1,255 @@
+"""Raftstore integration: replication, restart recovery, split, conf
+change, snapshot catch-up, partition tolerance, and txn-over-raft.
+
+Mirrors tests/integrations/raftstore/ (test_split_region.rs,
+test_conf_change.rs, test_single.rs) over the in-process Cluster fixture
+(components/test_raftstore parity).
+"""
+
+import pytest
+
+from tikv_tpu.kv.engine import SnapContext, WriteData
+from tikv_tpu.raftstore import NotLeaderError, Peer
+from tikv_tpu.testing.cluster import Cluster
+
+
+def make_cluster(n=3):
+    c = Cluster(n)
+    c.bootstrap()
+    c.start()
+    return c
+
+
+def test_basic_replication():
+    c = make_cluster(3)
+    c.must_put(b"k1", b"v1")
+    c.must_put(b"k2", b"v2")
+    assert c.must_get(b"k1") == b"v1"
+    # every store's applied state has the data
+    for sid in c.stores:
+        assert c.get_on_store(sid, b"k1") == b"v1"
+        assert c.get_on_store(sid, b"k2") == b"v2"
+
+
+def test_write_requires_leader():
+    c = make_cluster(3)
+    follower_sid = next(sid for sid in c.stores
+                        if sid != c.leader_store(1))
+    peer = c.stores[follower_sid].region_peer(1)
+    from tikv_tpu.raftstore import RaftCmd
+    with pytest.raises(NotLeaderError) as ei:
+        peer.propose(RaftCmd(1, peer.region.epoch, ()), lambda r: None)
+    assert ei.value.leader is not None      # hint points at the leader
+
+
+def test_leader_failover():
+    c = make_cluster(3)
+    c.must_put(b"k", b"v")
+    dead = c.leader_store(1)
+    c.stop_store(dead)
+    # remaining stores elect a new leader after timeouts
+    c.tick_all(40)
+    new_lead = c.leader_store(1)
+    assert new_lead is not None and new_lead != dead
+    c.must_put(b"k2", b"v2")
+    assert c.must_get(b"k") == b"v"
+    assert c.must_get(b"k2") == b"v2"
+
+
+def test_restart_recovers_state():
+    c = make_cluster(3)
+    for i in range(5):
+        c.must_put(b"k%d" % i, b"v%d" % i)
+    victim = next(sid for sid in c.stores if sid != c.leader_store(1))
+    c.stop_store(victim)
+    c.must_put(b"during", b"x")
+    c.restart_store(victim)
+    c.tick_all(6)
+    # restarted store catches up from the leader's log
+    assert c.get_on_store(victim, b"during") == b"x"
+    for i in range(5):
+        assert c.get_on_store(victim, b"k%d" % i) == b"v%d" % i
+
+
+def test_full_cluster_restart():
+    c = make_cluster(3)
+    c.must_put(b"persist", b"me")
+    for sid in list(c.stores):
+        c.stop_store(sid)
+    for sid in (1, 2, 3):
+        c.restart_store(sid)
+    c.tick_all(40)
+    assert c.leader_store(1) is not None
+    assert c.must_get(b"persist") == b"me"
+
+
+def test_split_region():
+    c = make_cluster(3)
+    for i in range(10):
+        c.must_put(b"k%02d" % i, b"v%d" % i)
+    right = c.split_region(1, b"k05")
+    c.pump()
+    # both regions exist on every store with correct ranges
+    for sid, store in c.stores.items():
+        left_peer = store.region_peer(1)
+        right_peer = store.region_peer(right.id)
+        assert left_peer.region.end_key == b"k05"
+        assert right_peer.region.start_key == b"k05"
+        assert left_peer.region.epoch.version == 2
+    # the new region has a leader (parent leader's store campaigns)
+    c.pump()
+    assert c.leader_store(right.id) is not None
+    # reads/writes route to the correct region
+    assert c.must_get(b"k02") == b"v2"
+    assert c.must_get(b"k07") == b"v7"
+    c.must_put(b"k03", b"left")
+    c.must_put(b"k08", b"right")
+    assert c.must_get(b"k03") == b"left"
+    assert c.must_get(b"k08") == b"right"
+    # epoch-stale command rejected
+    from tikv_tpu.raftstore import EpochNotMatch, RaftCmd, WriteOp
+    from tikv_tpu.raftstore.metapb import RegionEpoch
+    lead = c.leader_peer(1)
+    stale = RaftCmd(1, RegionEpoch(1, 1),
+                    (WriteOp("put", "default", b"k00", b"x"),))
+    with pytest.raises(EpochNotMatch):
+        lead.propose(stale, lambda r: None)
+
+
+def test_split_then_pd_routing():
+    c = make_cluster(3)
+    c.must_put(b"a", b"1")
+    c.must_put(b"m", b"2")
+    right = c.split_region(1, b"m")
+    c.pump()
+    # PD heard about both regions via heartbeats
+    left_pd = c.pd.get_region(b"a")
+    right_pd = c.pd.get_region(b"z")
+    assert left_pd.id == 1 and right_pd.id == right.id
+
+
+def test_add_peer_via_snapshot():
+    """New store joins; leader ships a region snapshot to initialize it."""
+    c = Cluster(4)
+    # bootstrap only on stores 1-3
+    from tikv_tpu.raftstore import Region, RegionEpoch
+    peers = tuple(Peer(100 + sid, sid) for sid in (1, 2, 3))
+    region = Region(1, b"", b"", RegionEpoch(1, 1), peers)
+    for sid in (1, 2, 3):
+        c.stores[sid].bootstrap_region(region)
+    from tikv_tpu.raftstore.metapb import Store as StoreMeta
+    c.pd.bootstrap_cluster(StoreMeta(1), region)
+    c.elect_leader(1, 1)
+    c.must_put(b"k", b"v")
+    # add a peer on store 4
+    new_peer = Peer(c.pd.alloc_id(), 4)
+    c.change_peer(1, "add", new_peer)
+    c.tick_all(8)
+    assert c.get_on_store(4, b"k") == b"v"
+    c.must_put(b"k2", b"v2")
+    c.tick_all(2)
+    assert c.get_on_store(4, b"k2") == b"v2"
+
+
+def test_remove_peer():
+    c = make_cluster(3)
+    c.must_put(b"k", b"v")
+    victim_sid = next(sid for sid in c.stores
+                      if sid != c.leader_store(1))
+    victim_peer = c.stores[victim_sid].region_peer(1).meta
+    c.change_peer(1, "remove", victim_peer)
+    c.pump()
+    # peer destroyed on the victim store
+    assert 1 not in c.stores[victim_sid].peers
+    # cluster of 2 still makes progress
+    c.must_put(b"k2", b"v2")
+    assert c.must_get(b"k2") == b"v2"
+
+
+def test_partition_and_heal():
+    c = make_cluster(3)
+    c.must_put(b"k", b"v")
+    lead = c.leader_store(1)
+    others = [sid for sid in c.stores if sid != lead]
+
+    def filt(frm, to, rid, msg):
+        return not ((frm == lead and to in others) or
+                    (frm in others and to == lead))
+    c.transport.filters.append(filt)
+    c.tick_all(40)      # majority side elects a new leader
+    new_lead = c.leader_store(1)
+    assert new_lead in others
+    c.must_put(b"k2", b"v2")
+    c.transport.filters.clear()
+    c.tick_all(6)
+    # old leader rejoined as follower and caught up
+    assert c.get_on_store(lead, b"k2") == b"v2"
+
+
+def test_log_compaction_and_snapshot_catch_up():
+    c = make_cluster(3)
+    lagger = next(sid for sid in c.stores if sid != c.leader_store(1))
+
+    def filt(frm, to, rid, msg):
+        return to != lagger and frm != lagger
+    c.transport.filters.append(filt)
+    for i in range(8):
+        c.must_put(b"k%d" % i, b"v%d" % i)
+    # leader compacts its log so the lagger cannot be served by appends
+    lead_peer = c.leader_peer(1)
+    from tikv_tpu.raftstore import AdminCmd, RaftCmd
+    cmd = RaftCmd(1, lead_peer.region.epoch, admin=AdminCmd(
+        "compact_log", compact_index=lead_peer.node.commit))
+    box = {}
+    lead_peer.propose(cmd, lambda r: box.__setitem__("r", r))
+    c._drive_until(lambda: "r" in box)
+    assert lead_peer.node.storage.first_index() > 1
+    c.transport.filters.clear()
+    c.tick_all(8)
+    for i in range(8):
+        assert c.get_on_store(lagger, b"k%d" % i) == b"v%d" % i
+
+
+def test_transfer_leader():
+    c = make_cluster(3)
+    c.must_put(b"k", b"v")
+    target = next(sid for sid in c.stores if sid != c.leader_store(1))
+    c.transfer_leader(1, target)
+    assert c.leader_store(1) == target
+    c.must_put(b"k2", b"v2")
+    assert c.must_get(b"k2") == b"v2"
+
+
+def test_read_barrier_snapshot_isolation():
+    c = make_cluster(3)
+    c.must_put(b"k", b"v1")
+    snap = c.kvs[c.leader_store(1)].snapshot(SnapContext(region_id=1))
+    c.must_put(b"k", b"v2")
+    from tikv_tpu.engine.traits import CF_DEFAULT
+    assert snap.get_value_cf(CF_DEFAULT, b"k") == b"v1"     # frozen view
+    assert c.must_get(b"k") == b"v2"
+
+
+def test_txn_storage_over_raft_cluster():
+    """Full stack: Percolator txns over a replicated 3-store cluster."""
+    from tikv_tpu.storage import Storage
+    from tikv_tpu.storage.txn import commands as cmds
+    from tikv_tpu.storage.txn.actions import Mutation
+
+    c = make_cluster(3)
+    lead = c.leader_store(1)
+    storage = Storage(engine=c.kvs[lead])
+    ts1 = c.pd.tso()
+    storage.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"acct", b"100")], b"acct", ts1))
+    ts2 = c.pd.tso()
+    storage.sched_txn_command(cmds.Commit([b"acct"], ts1, ts2))
+    ts3 = c.pd.tso()
+    assert storage.get(b"acct", ts3) == b"100"
+    # the lock/write CF records replicated to every store
+    from tikv_tpu.engine.traits import CF_WRITE
+    from tikv_tpu.storage.txn_types import encode_key
+    for sid in c.stores:
+        from tikv_tpu.raftstore.peer_storage import data_key
+        it = c.engines[sid].iterator_cf(CF_WRITE)
+        assert it.seek_to_first()       # at least one write record

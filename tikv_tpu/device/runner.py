@@ -285,10 +285,15 @@ class DeviceRunner:
     # --------------------------------------------------------------- kernels
 
     def _chunk_size_for(self, n: int) -> int:
-        unit = num_shards(self._mesh) * 8
+        from .kernels import BLOCK_ROWS
+        S = num_shards(self._mesh)
+        unit = S * 8
         if n >= self._chunk_rows:
-            # chunk must split evenly across shards (device_put over the
-            # row axis) — round the configured size up to the unit
+            # a chunk must split evenly across shards (device_put over the
+            # row axis) and each shard's slice must divide into full scan
+            # blocks, or matmul_groupby degrades to tiny gcd-sized blocks
+            if self._chunk_rows >= S * BLOCK_ROWS:
+                unit = S * BLOCK_ROWS
             return ((self._chunk_rows + unit - 1) // unit) * unit
         target = max(unit, _next_pow2(max(n, 1)))
         return ((target + unit - 1) // unit) * unit

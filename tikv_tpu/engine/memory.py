@@ -11,6 +11,7 @@ generation, and the first write after a snapshot clones the CF arrays
 from __future__ import annotations
 
 import bisect
+import threading
 from typing import Optional
 
 from .traits import ALL_CFS, CF_DEFAULT
@@ -137,6 +138,10 @@ class MemoryEngine:
 
     def __init__(self, cfs=ALL_CFS):
         self._cfs: dict[str, _CfData] = {cf: _CfData() for cf in cfs}
+        # one mutex serializes mutation vs snapshot-pinning so snapshots
+        # never observe a half-applied batch (the reference gets this from
+        # RocksDB; scheduler threads rely on it)
+        self._mu = threading.RLock()
 
     # -- copy-on-write plumbing --
 
@@ -150,19 +155,24 @@ class MemoryEngine:
     # -- KvEngine --
 
     def snapshot(self) -> MemorySnapshot:
-        for data in self._cfs.values():
-            data.pinned = True
-        return MemorySnapshot(dict(self._cfs))
+        with self._mu:
+            for data in self._cfs.values():
+                data.pinned = True
+            return MemorySnapshot(dict(self._cfs))
 
     def write_batch(self) -> MemoryWriteBatch:
         return MemoryWriteBatch()
 
     def write(self, batch: MemoryWriteBatch) -> None:
+        with self._mu:
+            self._write_locked(batch)
+
+    def _write_locked(self, batch: MemoryWriteBatch) -> None:
         for op in batch._ops:
             if op[0] == "put":
-                self.put_cf(op[1], op[2], op[3])
+                self._put_locked(op[1], op[2], op[3])
             elif op[0] == "del":
-                self.delete_cf(op[1], op[2])
+                self._delete_locked(op[1], op[2])
             else:
                 self._delete_range(op[1], op[2], op[3])
 
@@ -178,11 +188,16 @@ class MemoryEngine:
 
     def iterator_cf(self, cf: str, lower: Optional[bytes] = None,
                     upper: Optional[bytes] = None) -> _MemIterator:
-        data = self._cfs[cf]
-        data.pinned = True      # iterator sees a stable generation
-        return _MemIterator(data, lower, upper)
+        with self._mu:
+            data = self._cfs[cf]
+            data.pinned = True      # iterator sees a stable generation
+            return _MemIterator(data, lower, upper)
 
     def put_cf(self, cf: str, key: bytes, value: bytes) -> None:
+        with self._mu:
+            self._put_locked(cf, key, value)
+
+    def _put_locked(self, cf: str, key: bytes, value: bytes) -> None:
         data = self._writable(cf)
         i = bisect.bisect_left(data.keys, key)
         if i < len(data.keys) and data.keys[i] == key:
@@ -192,6 +207,10 @@ class MemoryEngine:
             data.vals.insert(i, value)
 
     def delete_cf(self, cf: str, key: bytes) -> None:
+        with self._mu:
+            self._delete_locked(cf, key)
+
+    def _delete_locked(self, cf: str, key: bytes) -> None:
         data = self._writable(cf)
         i = bisect.bisect_left(data.keys, key)
         if i < len(data.keys) and data.keys[i] == key:

@@ -20,10 +20,14 @@ from ..engine.traits import KvEngine, Snapshot
 @dataclass
 class SnapContext:
     """Read context.  Reference: kvproto Context + SnapContext (tikv_kv):
-    region routing + read options; placeholder fields land with raftstore."""
+    region routing + read options.  ``key_hint`` (an engine-keyspace key)
+    lets the consensus engine route when region_id is unset — the
+    reference's clients attach the region from PD; standalone callers
+    route by key."""
 
     region_id: int = 0
     read_ts: int = 0
+    key_hint: bytes = b""
 
 
 @dataclass

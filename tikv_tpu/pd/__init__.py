@@ -1,0 +1,10 @@
+"""Placement driver client + in-memory mock.
+
+Reference: components/pd_client (PdClient trait, lib.rs:267) and the test
+fixture components/test_raftstore/src/pd.rs (full in-memory PD: id
+allocation, region heartbeats, split bookkeeping, TSO).
+"""
+
+from .client import MockPd, PdClient
+
+__all__ = ["MockPd", "PdClient"]

@@ -1,0 +1,103 @@
+"""Raft wire types.
+
+Reference: the eraftpb protobuf consumed by raft-rs (Entry, Message,
+HardState, Snapshot, ConfChange) — plain dataclasses here; the transport
+layer owns serialization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum, auto
+from typing import Optional
+
+
+class EntryType(Enum):
+    NORMAL = auto()
+    CONF_CHANGE = auto()
+
+
+@dataclass(frozen=True)
+class Entry:
+    term: int
+    index: int
+    data: bytes = b""
+    entry_type: EntryType = EntryType.NORMAL
+
+
+class ConfChangeType(Enum):
+    ADD_NODE = auto()
+    REMOVE_NODE = auto()
+    ADD_LEARNER = auto()
+
+
+@dataclass(frozen=True)
+class ConfChange:
+    change_type: ConfChangeType
+    node_id: int
+    context: bytes = b""
+
+    def to_bytes(self) -> bytes:
+        return b"%d:%d:%s" % (self.change_type.value, self.node_id,
+                              self.context)
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "ConfChange":
+        t, n, ctx = b.split(b":", 2)
+        return ConfChange(ConfChangeType(int(t)), int(n), ctx)
+
+
+@dataclass
+class HardState:
+    """Durable before any message send (raft paper §5)."""
+
+    term: int = 0
+    vote: int = 0
+    commit: int = 0
+
+
+@dataclass(frozen=True)
+class SnapshotMetadata:
+    index: int
+    term: int
+    voters: tuple = ()
+    learners: tuple = ()
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    metadata: SnapshotMetadata
+    data: bytes = b""
+
+
+class MsgType(Enum):
+    HUP = auto()                # local: start election
+    BEAT = auto()               # local: leader heartbeat tick
+    PROPOSE = auto()            # local: client proposal
+    APPEND = auto()
+    APPEND_RESPONSE = auto()
+    REQUEST_VOTE = auto()
+    REQUEST_VOTE_RESPONSE = auto()
+    PRE_VOTE = auto()
+    PRE_VOTE_RESPONSE = auto()
+    HEARTBEAT = auto()
+    HEARTBEAT_RESPONSE = auto()
+    SNAPSHOT = auto()
+    TRANSFER_LEADER = auto()    # local: admin transfer
+    TIMEOUT_NOW = auto()
+
+
+@dataclass
+class Message:
+    msg_type: MsgType
+    to: int = 0
+    frm: int = 0
+    term: int = 0
+    # append/vote payloads
+    log_term: int = 0           # term of entry at ``index``
+    index: int = 0              # prev log index (append) / last index (vote)
+    entries: tuple = ()
+    commit: int = 0
+    reject: bool = False
+    reject_hint: int = 0        # follower's last index, speeds backtracking
+    snapshot: Optional[Snapshot] = None

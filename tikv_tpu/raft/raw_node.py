@@ -1,0 +1,603 @@
+"""The Raft state machine (RawNode + Ready interface).
+
+Reference capability: raft-rs (RawNode::tick/step/propose/ready/advance),
+which the reference's raftstore drives from its poll loop
+(components/raftstore/src/store/fsm/peer.rs).  Implements the raft paper
+with the extensions TiKV relies on: pre-vote (§9.6 extension), leader
+transfer via TIMEOUT_NOW, rejection hints for fast log backtracking,
+snapshot-based catch-up, and single-step membership change with the
+one-in-flight rule.
+
+Deviations tracked for later rounds: joint consensus (the reference
+supports it via raft-rs; tests/integrations test_joint_consensus.rs),
+check-quorum/lease-read safety is provided one layer up (raftstore lease).
+
+Determinism: no wall clock, no global RNG — ``tick()`` advances logical
+time and election timeouts are drawn from a node-seeded PRNG, so cluster
+tests replay identically.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from .messages import (
+    ConfChange,
+    ConfChangeType,
+    Entry,
+    EntryType,
+    HardState,
+    Message,
+    MsgType,
+    Snapshot,
+)
+from .storage import MemoryRaftStorage
+
+FOLLOWER = "follower"
+PRE_CANDIDATE = "pre_candidate"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+# Progress replication states (raft-rs progress.rs)
+PROBE = "probe"
+REPLICATE = "replicate"
+SNAPSHOT = "snapshot"
+
+_MAX_APPEND_ENTRIES = 256
+
+
+@dataclass
+class Progress:
+    """Leader's view of one follower (raft-rs Progress)."""
+
+    match: int = 0
+    next: int = 1
+    state: str = PROBE
+    pending_snapshot: int = 0
+    paused: bool = False
+
+
+@dataclass
+class Ready:
+    """Work handed to the application per turn (raft-rs Ready)."""
+
+    messages: list = field(default_factory=list)
+    entries: list = field(default_factory=list)          # persist these
+    committed_entries: list = field(default_factory=list)  # apply these
+    hard_state: Optional[HardState] = None               # persist if set
+    snapshot: Optional[Snapshot] = None                  # install if set
+    soft_state: Optional[tuple] = None                   # (leader_id, role)
+
+
+class RawNode:
+    def __init__(self, node_id: int, storage: MemoryRaftStorage,
+                 election_tick: int = 10, heartbeat_tick: int = 2,
+                 pre_vote: bool = True, seed: int = 0):
+        self.id = node_id
+        self.storage = storage
+        self._election_tick = election_tick
+        self._heartbeat_tick = heartbeat_tick
+        self._pre_vote = pre_vote
+        self._rng = random.Random((seed << 16) ^ node_id)
+
+        hs, voters, learners = storage.initial_state()
+        self.term = hs.term
+        self.vote = hs.vote
+        self.commit = hs.commit
+        self.voters: set[int] = set(voters)
+        self.learners: set[int] = set(learners)
+
+        self.state = FOLLOWER
+        self.leader_id = 0
+        self.progress: dict[int, Progress] = {}
+        self._votes: dict[int, bool] = {}
+        self._msgs: list[Message] = []
+        self._elapsed = 0
+        self._timeout = 0
+        self._reset_timeout()
+
+        self.applied = storage.snapshot.metadata.index
+        self._stable_index = storage.last_index()
+        self._last_applied_snapshot = storage.snapshot.metadata.index
+        self._pending_snapshot: Optional[Snapshot] = None
+        self._pending_conf_index = storage.last_index() \
+            if self._has_pending_conf_entry() else 0
+        self._lead_transferee = 0
+        self._prev_hs = HardState(self.term, self.vote, self.commit)
+        self._prev_soft = (self.leader_id, self.state)
+
+    # ------------------------------------------------------------- helpers
+
+    def _has_pending_conf_entry(self) -> bool:
+        for e in self.storage.entries:
+            if e.entry_type is EntryType.CONF_CHANGE and \
+                    e.index > self.applied:
+                return True
+        return False
+
+    def _reset_timeout(self) -> None:
+        self._elapsed = 0
+        self._timeout = self._rng.randint(self._election_tick,
+                                          2 * self._election_tick - 1)
+
+    def last_index(self) -> int:
+        return self.storage.last_index()
+
+    def last_term(self) -> int:
+        t = self.storage.term(self.last_index())
+        return t if t is not None else 0
+
+    def _quorum(self) -> int:
+        return len(self.voters) // 2 + 1
+
+    def _send(self, m: Message) -> None:
+        m.frm = self.id
+        if m.term == 0 and m.msg_type not in (MsgType.PRE_VOTE,):
+            m.term = self.term
+        self._msgs.append(m)
+
+    # ------------------------------------------------------------- roles
+
+    def _become_follower(self, term: int, leader_id: int) -> None:
+        if term > self.term:
+            self.term = term
+            self.vote = 0
+        self.state = FOLLOWER
+        self.leader_id = leader_id
+        self._lead_transferee = 0
+        self._reset_timeout()
+
+    def _become_pre_candidate(self) -> None:
+        self.state = PRE_CANDIDATE
+        self.leader_id = 0
+        self._votes = {self.id: True}
+        self._reset_timeout()
+
+    def _become_candidate(self) -> None:
+        self.state = CANDIDATE
+        self.term += 1
+        self.vote = self.id
+        self.leader_id = 0
+        self._votes = {self.id: True}
+        self._reset_timeout()
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.id
+        self._lead_transferee = 0
+        last = self.last_index()
+        self.progress = {
+            nid: Progress(match=0, next=last + 1)
+            for nid in self.voters | self.learners if nid != self.id
+        }
+        self.progress[self.id] = Progress(match=last, next=last + 1,
+                                          state=REPLICATE)
+        # noop entry to commit entries from previous terms (§5.4.2)
+        self._append_entries([Entry(self.term, last + 1)])
+        self._broadcast_append()
+        self._maybe_commit()
+
+    # ------------------------------------------------------------- ticking
+
+    def tick(self) -> None:
+        self._elapsed += 1
+        if self.state == LEADER:
+            if self._elapsed >= self._heartbeat_tick:
+                self._elapsed = 0
+                self._broadcast_heartbeat()
+        else:
+            if self._elapsed >= self._timeout and \
+                    self.id in self.voters:
+                self._reset_timeout()
+                self.campaign()
+
+    def campaign(self, force: bool = False) -> None:
+        if self._pre_vote and not force:
+            self._become_pre_candidate()
+            if self._tally() >= self._quorum():     # single node
+                self._campaign_real()
+                return
+            for nid in self.voters:
+                if nid == self.id:
+                    continue
+                self._msgs.append(Message(
+                    MsgType.PRE_VOTE, to=nid, frm=self.id,
+                    term=self.term + 1, log_term=self.last_term(),
+                    index=self.last_index()))
+        else:
+            self._campaign_real()
+
+    def _campaign_real(self) -> None:
+        self._become_candidate()
+        if self._tally() >= self._quorum():         # single node wins now
+            self._become_leader()
+            return
+        for nid in self.voters:
+            if nid == self.id:
+                continue
+            self._send(Message(
+                MsgType.REQUEST_VOTE, to=nid, term=self.term,
+                log_term=self.last_term(), index=self.last_index()))
+
+    def _tally(self) -> int:
+        return sum(1 for nid, granted in self._votes.items()
+                   if granted and nid in self.voters)
+
+    # ------------------------------------------------------------- propose
+
+    def propose(self, data: bytes) -> int:
+        """Append a proposal; returns its index.  Raises if not leader."""
+        if self.state != LEADER:
+            raise NotLeader(self.leader_id)
+        if self._lead_transferee:
+            raise ProposalDropped("leader transfer in progress")
+        index = self.last_index() + 1
+        self._append_entries([Entry(self.term, index, data)])
+        self._broadcast_append()
+        self._maybe_commit()
+        return index
+
+    def propose_conf_change(self, cc: ConfChange) -> int:
+        if self.state != LEADER:
+            raise NotLeader(self.leader_id)
+        if self._pending_conf_index > self.applied:
+            raise ProposalDropped("conf change already in flight")
+        index = self.last_index() + 1
+        self._append_entries([Entry(self.term, index, cc.to_bytes(),
+                                    EntryType.CONF_CHANGE)])
+        self._pending_conf_index = index
+        self._broadcast_append()
+        self._maybe_commit()
+        return index
+
+    def apply_conf_change(self, cc: ConfChange) -> None:
+        """Called by the application after applying a conf-change entry."""
+        if cc.change_type is ConfChangeType.ADD_NODE:
+            self.learners.discard(cc.node_id)
+            self.voters.add(cc.node_id)
+            if self.state == LEADER and cc.node_id not in self.progress:
+                self.progress[cc.node_id] = Progress(
+                    match=0, next=self.last_index() + 1)
+        elif cc.change_type is ConfChangeType.ADD_LEARNER:
+            self.voters.discard(cc.node_id)
+            self.learners.add(cc.node_id)
+            if self.state == LEADER and cc.node_id not in self.progress:
+                self.progress[cc.node_id] = Progress(
+                    match=0, next=self.last_index() + 1)
+        else:
+            self.voters.discard(cc.node_id)
+            self.learners.discard(cc.node_id)
+            self.progress.pop(cc.node_id, None)
+        self.storage.set_conf(sorted(self.voters), sorted(self.learners))
+        if self.state == LEADER:
+            self._maybe_commit()    # quorum may have shrunk
+
+    def transfer_leader(self, target: int) -> None:
+        self.step(Message(MsgType.TRANSFER_LEADER, to=self.id,
+                          frm=target, term=self.term))
+
+    # ------------------------------------------------------------- log ops
+
+    def _append_entries(self, entries: Sequence[Entry]) -> None:
+        self.storage.append(list(entries))
+        if self.state == LEADER:
+            pr = self.progress[self.id]
+            pr.match = self.last_index()
+            pr.next = pr.match + 1
+
+    def _broadcast_append(self) -> None:
+        for nid in list(self.progress):
+            if nid != self.id:
+                self._send_append(nid)
+
+    def _send_append(self, to: int) -> None:
+        pr = self.progress[to]
+        if pr.state == SNAPSHOT or pr.paused:
+            return
+        prev_index = pr.next - 1
+        prev_term = self.storage.term(prev_index)
+        if prev_term is None:   # compacted: ship a snapshot
+            self._send_snapshot(to)
+            return
+        hi = min(self.last_index() + 1, pr.next + _MAX_APPEND_ENTRIES)
+        entries = tuple(self.storage.slice(pr.next, hi))
+        if pr.state == PROBE and entries:
+            pr.paused = True    # one probe in flight until acked
+        self._send(Message(
+            MsgType.APPEND, to=to, term=self.term, log_term=prev_term,
+            index=prev_index, entries=entries, commit=self.commit))
+
+    def _send_snapshot(self, to: int) -> None:
+        snap = self.storage.snapshot_for_send()
+        if snap.metadata.index == 0:
+            return
+        pr = self.progress[to]
+        pr.state = SNAPSHOT
+        pr.pending_snapshot = snap.metadata.index
+        self._send(Message(MsgType.SNAPSHOT, to=to, term=self.term,
+                           snapshot=snap))
+
+    def _broadcast_heartbeat(self) -> None:
+        for nid, pr in self.progress.items():
+            if nid == self.id:
+                continue
+            self._send(Message(MsgType.HEARTBEAT, to=nid, term=self.term,
+                               commit=min(pr.match, self.commit)))
+
+    def _maybe_commit(self) -> bool:
+        matches = sorted((pr.match for nid, pr in self.progress.items()
+                          if nid in self.voters), reverse=True)
+        if not matches:
+            return False
+        n = matches[self._quorum() - 1]
+        if n > self.commit and self.storage.term(n) == self.term:
+            self.commit = n
+            return True
+        return False
+
+    # ------------------------------------------------------------- step
+
+    def step(self, m: Message) -> None:
+        if m.msg_type is MsgType.HUP:
+            self.campaign()
+            return
+        if m.msg_type is MsgType.TRANSFER_LEADER:
+            self._handle_transfer(m)
+            return
+
+        # term bookkeeping (raft-rs raft.rs Step)
+        if m.term > self.term:
+            if m.msg_type in (MsgType.PRE_VOTE,):
+                pass    # pre-vote never bumps terms
+            elif m.msg_type is MsgType.PRE_VOTE_RESPONSE and not m.reject:
+                pass    # counted below; term bump happens on real campaign
+            else:
+                lead = m.frm if m.msg_type in (
+                    MsgType.APPEND, MsgType.HEARTBEAT, MsgType.SNAPSHOT) \
+                    else 0
+                self._become_follower(m.term, lead)
+        elif m.term < self.term:
+            if m.msg_type in (MsgType.APPEND, MsgType.HEARTBEAT,
+                              MsgType.SNAPSHOT):
+                # stale leader: tell it the new term
+                self._send(Message(MsgType.APPEND_RESPONSE, to=m.frm,
+                                   term=self.term, reject=True,
+                                   reject_hint=self.last_index()))
+            elif m.msg_type is MsgType.PRE_VOTE:
+                self._send(Message(MsgType.PRE_VOTE_RESPONSE, to=m.frm,
+                                   term=self.term, reject=True))
+            return
+
+        handler = {
+            MsgType.PRE_VOTE: self._handle_pre_vote,
+            MsgType.PRE_VOTE_RESPONSE: self._handle_pre_vote_response,
+            MsgType.REQUEST_VOTE: self._handle_vote,
+            MsgType.REQUEST_VOTE_RESPONSE: self._handle_vote_response,
+            MsgType.APPEND: self._handle_append,
+            MsgType.APPEND_RESPONSE: self._handle_append_response,
+            MsgType.HEARTBEAT: self._handle_heartbeat,
+            MsgType.HEARTBEAT_RESPONSE: self._handle_heartbeat_response,
+            MsgType.SNAPSHOT: self._handle_snapshot,
+            MsgType.TIMEOUT_NOW: self._handle_timeout_now,
+        }.get(m.msg_type)
+        if handler is not None:
+            handler(m)
+
+    # -- elections --
+
+    def _log_up_to_date(self, m: Message) -> bool:
+        lt, li = self.last_term(), self.last_index()
+        return m.log_term > lt or (m.log_term == lt and m.index >= li)
+
+    def _handle_pre_vote(self, m: Message) -> None:
+        # grant if we'd grant a real vote at that term and have no live
+        # leader contact (approximated by elapsed timeout share)
+        grant = m.term > self.term and self._log_up_to_date(m) and \
+            (self.leader_id == 0 or self._elapsed >= self._timeout)
+        self._send(Message(MsgType.PRE_VOTE_RESPONSE, to=m.frm,
+                           term=m.term, reject=not grant))
+
+    def _handle_pre_vote_response(self, m: Message) -> None:
+        if self.state != PRE_CANDIDATE:
+            return
+        self._votes[m.frm] = not m.reject
+        if self._tally() >= self._quorum():
+            self._campaign_real()
+        elif sum(1 for nid, g in self._votes.items()
+                 if not g and nid in self.voters) >= self._quorum():
+            self._become_follower(self.term, 0)
+
+    def _handle_vote(self, m: Message) -> None:
+        can_vote = (self.vote == 0 and self.leader_id == 0) or \
+            self.vote == m.frm
+        grant = can_vote and self._log_up_to_date(m)
+        if grant:
+            self.vote = m.frm
+            self._reset_timeout()
+        self._send(Message(MsgType.REQUEST_VOTE_RESPONSE, to=m.frm,
+                           term=self.term, reject=not grant))
+
+    def _handle_vote_response(self, m: Message) -> None:
+        if self.state != CANDIDATE:
+            return
+        self._votes[m.frm] = not m.reject
+        if self._tally() >= self._quorum():
+            self._become_leader()
+        elif sum(1 for nid, g in self._votes.items()
+                 if not g and nid in self.voters) >= self._quorum():
+            self._become_follower(self.term, 0)
+
+    # -- replication (follower side) --
+
+    def _handle_append(self, m: Message) -> None:
+        self.leader_id = m.frm
+        self._reset_timeout()
+        if m.index < self.commit:
+            # stale prefix; never truncate below commit
+            self._send(Message(MsgType.APPEND_RESPONSE, to=m.frm,
+                               term=self.term, index=self.commit))
+            return
+        local_term = self.storage.term(m.index)
+        if local_term is None or local_term != m.log_term:
+            self._send(Message(
+                MsgType.APPEND_RESPONSE, to=m.frm, term=self.term,
+                reject=True, index=m.index,
+                reject_hint=min(self.last_index(), m.index)))
+            return
+        # find first conflicting entry; truncate from there
+        to_append: list[Entry] = []
+        for e in m.entries:
+            t = self.storage.term(e.index)
+            if t is None or t != e.term:
+                to_append = [x for x in m.entries if x.index >= e.index]
+                break
+        if to_append:
+            self.storage.append(to_append)
+            if to_append[0].index <= self._stable_index:
+                self._stable_index = to_append[0].index - 1
+        last_new = m.index + len(m.entries)
+        if m.commit > self.commit:
+            self.commit = min(m.commit, last_new)
+        self._send(Message(MsgType.APPEND_RESPONSE, to=m.frm,
+                           term=self.term, index=last_new))
+
+    def _handle_heartbeat(self, m: Message) -> None:
+        self.leader_id = m.frm
+        self._reset_timeout()
+        if m.commit > self.commit:
+            self.commit = min(m.commit, self.last_index())
+        self._send(Message(MsgType.HEARTBEAT_RESPONSE, to=m.frm,
+                           term=self.term, index=self.last_index()))
+
+    def _handle_snapshot(self, m: Message) -> None:
+        self.leader_id = m.frm
+        self._reset_timeout()
+        meta = m.snapshot.metadata
+        if meta.index <= self.commit:
+            self._send(Message(MsgType.APPEND_RESPONSE, to=m.frm,
+                               term=self.term, index=self.commit))
+            return
+        # fast-forward: restore config + log position from the snapshot
+        self._pending_snapshot = m.snapshot
+        self.storage.apply_snapshot(m.snapshot)
+        self.voters = set(meta.voters)
+        self.learners = set(meta.learners)
+        self.commit = meta.index
+        self.applied = meta.index
+        self._stable_index = meta.index
+        self._send(Message(MsgType.APPEND_RESPONSE, to=m.frm,
+                           term=self.term, index=meta.index))
+
+    def _handle_timeout_now(self, m: Message) -> None:
+        if self.id in self.voters:
+            self.campaign(force=True)
+
+    # -- replication (leader side) --
+
+    def _handle_append_response(self, m: Message) -> None:
+        if self.state != LEADER:
+            return
+        pr = self.progress.get(m.frm)
+        if pr is None:
+            return
+        pr.paused = False
+        if m.reject:
+            if m.term > self.term:
+                return      # already stepped down in step()
+            pr.next = max(min(m.reject_hint, pr.next - 1), pr.match + 1)
+            pr.state = PROBE
+            self._send_append(m.frm)
+            return
+        if pr.state == SNAPSHOT and m.index >= pr.pending_snapshot:
+            pr.state = PROBE
+            pr.pending_snapshot = 0
+        if m.index > pr.match:
+            pr.match = m.index
+            pr.next = max(pr.next, m.index + 1)
+            pr.state = REPLICATE
+            if self._maybe_commit():
+                self._broadcast_append()
+            elif pr.next <= self.last_index():
+                self._send_append(m.frm)
+            if m.frm == self._lead_transferee and \
+                    pr.match == self.last_index():
+                self._send(Message(MsgType.TIMEOUT_NOW, to=m.frm,
+                                   term=self.term))
+
+    def _handle_heartbeat_response(self, m: Message) -> None:
+        if self.state != LEADER:
+            return
+        pr = self.progress.get(m.frm)
+        if pr is None:
+            return
+        pr.paused = False
+        if pr.match < self.last_index():
+            self._send_append(m.frm)
+
+    def _handle_transfer(self, m: Message) -> None:
+        target = m.frm
+        if self.state != LEADER or target == self.id or \
+                target not in self.voters:
+            return
+        self._lead_transferee = target
+        pr = self.progress[target]
+        if pr.match == self.last_index():
+            self._send(Message(MsgType.TIMEOUT_NOW, to=target,
+                               term=self.term))
+        else:
+            self._send_append(target)
+
+    # ------------------------------------------------------------- ready
+
+    def has_ready(self) -> bool:
+        hs = HardState(self.term, self.vote, self.commit)
+        return bool(self._msgs) or \
+            self.last_index() > self._stable_index or \
+            self.commit > self.applied or \
+            self._pending_snapshot is not None or \
+            (hs.term, hs.vote, hs.commit) != \
+            (self._prev_hs.term, self._prev_hs.vote, self._prev_hs.commit) \
+            or (self.leader_id, self.state) != self._prev_soft
+
+    def ready(self) -> Ready:
+        rd = Ready()
+        rd.messages, self._msgs = self._msgs, []
+        if self.last_index() > self._stable_index:
+            lo = max(self._stable_index + 1, self.storage.first_index())
+            rd.entries = self.storage.slice(lo, self.last_index() + 1)
+        if self.commit > self.applied:
+            lo = max(self.applied + 1, self.storage.first_index())
+            rd.committed_entries = self.storage.slice(lo, self.commit + 1)
+        hs = HardState(self.term, self.vote, self.commit)
+        if (hs.term, hs.vote, hs.commit) != \
+                (self._prev_hs.term, self._prev_hs.vote, self._prev_hs.commit):
+            rd.hard_state = hs
+        soft = (self.leader_id, self.state)
+        if soft != self._prev_soft:
+            rd.soft_state = soft
+        rd.snapshot = self._pending_snapshot
+        return rd
+
+    def advance(self, rd: Ready) -> None:
+        if rd.entries:
+            self._stable_index = rd.entries[-1].index
+        if rd.committed_entries:
+            self.applied = rd.committed_entries[-1].index
+        if rd.hard_state is not None:
+            self.storage.set_hard_state(rd.hard_state)
+            self._prev_hs = rd.hard_state
+        if rd.soft_state is not None:
+            self._prev_soft = rd.soft_state
+        self._pending_snapshot = None
+
+
+class NotLeader(Exception):
+    def __init__(self, leader_id: int):
+        super().__init__(f"not leader (hint: {leader_id})")
+        self.leader_id = leader_id
+
+
+class ProposalDropped(Exception):
+    pass

@@ -1,0 +1,28 @@
+"""Multi-raft replication layer.
+
+Reference: components/raftstore (69k LoC): peers multiplexed per store,
+apply path, region lifecycle (split / conf change / snapshot catch-up),
+and RaftKv — the consensus-backed kv.Engine.
+"""
+
+from .cmd import AdminCmd, RaftCmd, WriteOp
+from .metapb import (
+    EpochNotMatch,
+    KeyNotInRegion,
+    NotLeaderError,
+    Peer,
+    Region,
+    RegionEpoch,
+    RegionNotFound,
+    Store,
+)
+from .peer import RaftPeer, RegionSnapshot
+from .raftkv import RaftKv
+from .store import RaftStore, Transport
+
+__all__ = [
+    "AdminCmd", "RaftCmd", "WriteOp", "EpochNotMatch", "KeyNotInRegion",
+    "NotLeaderError", "Peer", "Region", "RegionEpoch", "RegionNotFound",
+    "Store", "RaftPeer", "RegionSnapshot", "RaftKv", "RaftStore",
+    "Transport",
+]

@@ -1,0 +1,145 @@
+"""Raft command payloads — what gets proposed into the raft log.
+
+Reference: the kvproto ``raft_cmdpb`` messages (RaftCmdRequest with
+either CmdType requests Put/Delete/DeleteRange or one AdminCmdType
+request: Split / ChangePeer / CompactLog / TransferLeader —
+components/raftstore/src/store/fsm/apply.rs exec_raft_cmd :1370-1740).
+
+Serialization: a compact tagged binary format (length-prefixed fields) —
+entries must be self-contained bytes so logs survive restarts and can
+later cross the wire; no Python pickling.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from .metapb import Peer, Region, RegionEpoch
+
+
+def _pack_bytes(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+def _unpack_bytes(buf: bytes, off: int) -> tuple[bytes, int]:
+    (n,) = struct.unpack_from(">I", buf, off)
+    off += 4
+    return buf[off:off + n], off + n
+
+
+@dataclass(frozen=True)
+class WriteOp:
+    """One KV mutation (CmdType::Put/Delete/DeleteRange)."""
+
+    op: str         # put | delete | delete_range
+    cf: str
+    key: bytes
+    value: bytes = b""
+
+    def to_bytes(self) -> bytes:
+        return (_pack_bytes(self.op.encode()) + _pack_bytes(self.cf.encode())
+                + _pack_bytes(self.key) + _pack_bytes(self.value))
+
+    @staticmethod
+    def from_bytes(buf: bytes, off: int) -> tuple["WriteOp", int]:
+        op, off = _unpack_bytes(buf, off)
+        cf, off = _unpack_bytes(buf, off)
+        key, off = _unpack_bytes(buf, off)
+        value, off = _unpack_bytes(buf, off)
+        return WriteOp(op.decode(), cf.decode(), key, value), off
+
+
+@dataclass(frozen=True)
+class AdminCmd:
+    """Admin command.  kind: split | change_peer | compact_log.
+
+    split: split_key + new_region_id + new_peer_ids
+    change_peer: change_type(add|remove|add_learner) + peer
+    compact_log: compact_index
+    """
+
+    kind: str
+    split_key: bytes = b""
+    new_region_id: int = 0
+    new_peer_ids: tuple = ()
+    change_type: str = ""
+    peer: Optional[Peer] = None
+    compact_index: int = 0
+
+    def to_bytes(self) -> bytes:
+        parts = [_pack_bytes(self.kind.encode()), _pack_bytes(self.split_key),
+                 struct.pack(">QQ", self.new_region_id, self.compact_index),
+                 struct.pack(">I", len(self.new_peer_ids))]
+        parts += [struct.pack(">Q", p) for p in self.new_peer_ids]
+        parts.append(_pack_bytes(self.change_type.encode()))
+        if self.peer is not None:
+            parts.append(struct.pack(">BQQB", 1, self.peer.id,
+                                     self.peer.store_id,
+                                     int(self.peer.is_learner)))
+        else:
+            parts.append(struct.pack(">B", 0))
+        return b"".join(parts)
+
+    @staticmethod
+    def from_bytes(buf: bytes, off: int) -> tuple["AdminCmd", int]:
+        kind, off = _unpack_bytes(buf, off)
+        split_key, off = _unpack_bytes(buf, off)
+        new_region_id, compact_index = struct.unpack_from(">QQ", buf, off)
+        off += 16
+        (n,) = struct.unpack_from(">I", buf, off)
+        off += 4
+        ids = []
+        for _ in range(n):
+            (pid,) = struct.unpack_from(">Q", buf, off)
+            ids.append(pid)
+            off += 8
+        change_type, off = _unpack_bytes(buf, off)
+        (has_peer,) = struct.unpack_from(">B", buf, off)
+        off += 1
+        peer = None
+        if has_peer:
+            pid, sid, learner = struct.unpack_from(">QQB", buf, off)
+            off += 17
+            peer = Peer(pid, sid, bool(learner))
+        return AdminCmd(kind.decode(), split_key, new_region_id, tuple(ids),
+                        change_type.decode(), peer, compact_index), off
+
+
+@dataclass(frozen=True)
+class RaftCmd:
+    """One proposed command: header (routing + epoch check) + payload."""
+
+    region_id: int
+    epoch: RegionEpoch
+    ops: tuple = ()                    # tuple[WriteOp]
+    admin: Optional[AdminCmd] = None
+
+    def to_bytes(self) -> bytes:
+        head = struct.pack(">QII", self.region_id, self.epoch.conf_ver,
+                           self.epoch.version)
+        if self.admin is not None:
+            return head + b"A" + self.admin.to_bytes()
+        body = struct.pack(">I", len(self.ops))
+        for op in self.ops:
+            body += op.to_bytes()
+        return head + b"W" + body
+
+    @staticmethod
+    def from_bytes(buf: bytes) -> "RaftCmd":
+        region_id, conf_ver, version = struct.unpack_from(">QII", buf, 0)
+        off = 16
+        tag = buf[off:off + 1]
+        off += 1
+        epoch = RegionEpoch(conf_ver, version)
+        if tag == b"A":
+            admin, _ = AdminCmd.from_bytes(buf, off)
+            return RaftCmd(region_id, epoch, (), admin)
+        (n,) = struct.unpack_from(">I", buf, off)
+        off += 4
+        ops = []
+        for _ in range(n):
+            op, off = WriteOp.from_bytes(buf, off)
+            ops.append(op)
+        return RaftCmd(region_id, epoch, tuple(ops))

@@ -1,0 +1,88 @@
+"""Cluster metadata types.
+
+Reference: the kvproto ``metapb`` messages (Region, Peer, RegionEpoch,
+Store) used throughout raftstore and pd_client.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Sequence
+
+
+@dataclass(frozen=True)
+class Peer:
+    id: int
+    store_id: int
+    is_learner: bool = False
+
+
+@dataclass(frozen=True)
+class RegionEpoch:
+    """conf_ver bumps on membership change; version on split/merge."""
+
+    conf_ver: int = 1
+    version: int = 1
+
+
+@dataclass(frozen=True)
+class Region:
+    """A contiguous key range replicated by one raft group.
+
+    ``start_key``/``end_key`` are user keys; empty end_key = +inf.
+    """
+
+    id: int
+    start_key: bytes = b""
+    end_key: bytes = b""
+    epoch: RegionEpoch = RegionEpoch()
+    peers: tuple = ()
+
+    def contains(self, key: bytes) -> bool:
+        if key < self.start_key:
+            return False
+        return not self.end_key or key < self.end_key
+
+    def peer_on_store(self, store_id: int):
+        for p in self.peers:
+            if p.store_id == store_id:
+                return p
+        return None
+
+    def with_peers(self, peers: Sequence[Peer],
+                   bump_conf: bool = True) -> "Region":
+        epoch = RegionEpoch(self.epoch.conf_ver + (1 if bump_conf else 0),
+                            self.epoch.version)
+        return replace(self, peers=tuple(peers), epoch=epoch)
+
+
+@dataclass(frozen=True)
+class Store:
+    id: int
+    address: str = ""
+
+
+class EpochNotMatch(Exception):
+    def __init__(self, current: Region):
+        super().__init__(f"epoch not match; current {current.epoch}")
+        self.current = current
+
+
+class NotLeaderError(Exception):
+    def __init__(self, region_id: int, leader=None):
+        super().__init__(f"region {region_id}: not leader")
+        self.region_id = region_id
+        self.leader = leader
+
+
+class KeyNotInRegion(Exception):
+    def __init__(self, key: bytes, region: Region):
+        super().__init__(f"{key!r} not in region {region.id}")
+        self.key = key
+        self.region = region
+
+
+class RegionNotFound(Exception):
+    def __init__(self, region_id: int):
+        super().__init__(f"region {region_id} not found")
+        self.region_id = region_id

@@ -1,0 +1,326 @@
+"""Peer — one replica of one region: raft driving + apply.
+
+Reference: components/raftstore/src/store/peer.rs (Peer: propose :3612,
+handle_raft_ready_append :2565) and fsm/apply.rs (exec_raft_cmd
+:1370-1740 — write commands, and admin commands: split :1692,
+change peer, compact log).  The reference splits raft-ready handling and
+apply onto separate pollers connected by channels (SURVEY.md §2.8 item 3);
+here both run in the store's drive loop — the pipeline split returns when
+the native runtime lands.
+
+Read path: reads are proposed as read-barrier entries through the log
+(the unoptimized ReadIndex).  Lease-based local reads
+(store/worker/read.rs LocalReader) are a later-round optimization;
+correctness never depends on them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..engine.traits import KvEngine
+from ..raft.messages import ConfChange, ConfChangeType, EntryType, Message
+from ..raft.raw_node import LEADER, NotLeader, RawNode
+from .cmd import AdminCmd, RaftCmd, WriteOp
+from .metapb import (
+    EpochNotMatch,
+    KeyNotInRegion,
+    NotLeaderError,
+    Peer as PeerMeta,
+    Region,
+    RegionEpoch,
+)
+from .peer_storage import PeerStorage, data_key
+
+
+@dataclass
+class Proposal:
+    index: int
+    term: int
+    cb: Callable            # cb(result | Exception)
+
+
+class RegionSnapshot:
+    """Engine snapshot clamped to one region, with the data-key prefix
+    applied transparently (reference: raftstore RegionSnapshot)."""
+
+    def __init__(self, snap, region: Region):
+        self._snap = snap
+        self.region = region
+
+    def _check(self, key: bytes) -> bytes:
+        if not self.region.contains(key):
+            raise KeyNotInRegion(key, self.region)
+        return data_key(key)
+
+    def get_value_cf(self, cf: str, key: bytes):
+        return self._snap.get_value_cf(cf, self._check(key))
+
+    def get_value(self, key: bytes):
+        from ..engine.traits import CF_DEFAULT
+        return self.get_value_cf(CF_DEFAULT, key)
+
+    def iterator_cf(self, cf: str, lower: Optional[bytes] = None,
+                    upper: Optional[bytes] = None):
+        from .peer_storage import region_data_bounds
+        rlo, rhi = region_data_bounds(self.region)
+        lo = rlo if lower is None else max(rlo, data_key(lower))
+        hi = rhi if upper is None else min(rhi, data_key(upper))
+        return _PrefixStripIterator(self._snap.iterator_cf(cf, lo, hi))
+
+
+class _PrefixStripIterator:
+    """Strips the data-key prefix so layers above see user keys."""
+
+    def __init__(self, it):
+        self._it = it
+
+    def valid(self):
+        return self._it.valid()
+
+    def seek(self, key: bytes):
+        return self._it.seek(data_key(key))
+
+    def seek_for_prev(self, key: bytes):
+        return self._it.seek_for_prev(data_key(key))
+
+    def seek_to_first(self):
+        return self._it.seek_to_first()
+
+    def seek_to_last(self):
+        return self._it.seek_to_last()
+
+    def next(self):
+        return self._it.next()
+
+    def prev(self):
+        return self._it.prev()
+
+    def key(self) -> bytes:
+        return self._it.key()[1:]
+
+    def value(self) -> bytes:
+        return self._it.value()
+
+
+class RaftPeer:
+    def __init__(self, store, region: Region, peer_meta: PeerMeta,
+                 engine: KvEngine, **raft_cfg):
+        self.store = store
+        self.meta = peer_meta
+        self.engine = engine
+        self.peer_storage = PeerStorage(engine, region)
+        ms, applied = self.peer_storage.load()
+        ms.snapshot_provider = self._make_snapshot
+        self.node = RawNode(peer_meta.id, ms, **raft_cfg)
+        self.node.applied = max(self.node.applied, applied)
+        self.proposals: list[Proposal] = []
+        self.pending_destroy = False
+        # sender metas seen on incoming messages — lets an uninitialized
+        # peer route responses before it learns the region's peer list
+        # (reference: peer.rs Peer::peer_cache)
+        self.peer_cache: dict[int, PeerMeta] = {}
+
+    # ------------------------------------------------------------- props
+
+    @property
+    def region(self) -> Region:
+        return self.peer_storage.region
+
+    def is_leader(self) -> bool:
+        return self.node.state == LEADER
+
+    def leader_peer(self) -> Optional[PeerMeta]:
+        lid = self.node.leader_id
+        for p in self.region.peers:
+            if p.id == lid:
+                return p
+        return None
+
+    # ------------------------------------------------------------- propose
+
+    def _check_header(self, cmd: RaftCmd) -> None:
+        region = self.region
+        if cmd.epoch.version != region.epoch.version or \
+                (cmd.admin is not None and
+                 cmd.epoch.conf_ver != region.epoch.conf_ver):
+            raise EpochNotMatch(region)
+        for op in cmd.ops:
+            if not region.contains(op.key):
+                raise KeyNotInRegion(op.key, region)
+
+    def propose(self, cmd: RaftCmd, cb: Callable) -> int:
+        if not self.is_leader():
+            raise NotLeaderError(self.region.id, self.leader_peer())
+        self._check_header(cmd)
+        if cmd.admin is not None and cmd.admin.kind == "change_peer":
+            a = cmd.admin
+            cc_type = {"add": ConfChangeType.ADD_NODE,
+                       "add_learner": ConfChangeType.ADD_LEARNER,
+                       "remove": ConfChangeType.REMOVE_NODE}[a.change_type]
+            index = self.node.propose_conf_change(
+                ConfChange(cc_type, a.peer.id, cmd.to_bytes()))
+        else:
+            index = self.node.propose(cmd.to_bytes())
+        self.proposals.append(Proposal(index, self.node.term, cb))
+        return index
+
+    def propose_read(self, cb: Callable) -> int:
+        """Read barrier through the log (see module docstring)."""
+        if not self.is_leader():
+            raise NotLeaderError(self.region.id, self.leader_peer())
+        index = self.node.propose(b"")
+
+        def on_applied(_result):
+            if isinstance(_result, Exception):
+                cb(_result)
+            else:
+                cb(RegionSnapshot(self.engine.snapshot(), self.region))
+        self.proposals.append(Proposal(index, self.node.term, on_applied))
+        return index
+
+    # ------------------------------------------------------------- ready
+
+    def handle_ready(self) -> list[Message]:
+        """Persist, apply, return messages to send.  Reference:
+        handle_raft_ready_append + the apply poller, collapsed."""
+        out: list[Message] = []
+        while self.node.has_ready():
+            rd = self.node.ready()
+            wb = self.engine.write_batch()
+            if rd.snapshot is not None:
+                region = self.peer_storage.apply_snapshot(wb, rd.snapshot)
+                self.store.on_region_changed(self, region)
+            meta = self.node.storage.snapshot.metadata
+            self.peer_storage.persist(wb, rd.entries, rd.hard_state,
+                                      truncated=(meta.index, meta.term))
+            for entry in rd.committed_entries:
+                self._apply_entry(wb, entry)
+            if rd.committed_entries:
+                self.peer_storage.persist_apply(
+                    wb, rd.committed_entries[-1].index)
+            if not wb.is_empty():
+                self.engine.write(wb)
+            out.extend(rd.messages)
+            self.node.advance(rd)
+        return out
+
+    # ------------------------------------------------------------- apply
+
+    def _take_proposal(self, index: int, term: int) -> Optional[Proposal]:
+        while self.proposals and self.proposals[0].index <= index:
+            p = self.proposals.pop(0)
+            if p.index == index and p.term == term:
+                return p
+            p.cb(NotLeaderError(self.region.id, self.leader_peer()))
+        return None
+
+    def _apply_entry(self, wb, entry) -> None:
+        prop = self._take_proposal(entry.index, entry.term)
+        if not entry.data:
+            if prop is not None:
+                prop.cb({})     # read barrier / leader noop
+            return
+        if entry.entry_type is EntryType.CONF_CHANGE:
+            cc = ConfChange.from_bytes(entry.data)
+            cmd = RaftCmd.from_bytes(cc.context)
+            result = self._exec_admin(wb, cmd.admin, cc=cc)
+        else:
+            cmd = RaftCmd.from_bytes(entry.data)
+            try:
+                self._check_epoch_at_apply(cmd)
+            except EpochNotMatch as e:
+                if prop is not None:
+                    prop.cb(e)
+                return
+            if cmd.admin is not None:
+                result = self._exec_admin(wb, cmd.admin)
+            else:
+                result = self._exec_write(wb, cmd)
+        if prop is not None:
+            prop.cb(result)
+
+    def _check_epoch_at_apply(self, cmd: RaftCmd) -> None:
+        region = self.region
+        if cmd.epoch.version != region.epoch.version:
+            raise EpochNotMatch(region)
+
+    def _exec_write(self, wb, cmd: RaftCmd) -> dict:
+        for op in cmd.ops:
+            if op.op == "put":
+                wb.put_cf(op.cf, data_key(op.key), op.value)
+            elif op.op == "delete":
+                wb.delete_cf(op.cf, data_key(op.key))
+            elif op.op == "delete_range":
+                wb.delete_range_cf(op.cf, data_key(op.key),
+                                   data_key(op.value))
+            else:   # pragma: no cover
+                raise ValueError(op.op)
+        return {}
+
+    def _exec_admin(self, wb, admin: AdminCmd,
+                    cc: Optional[ConfChange] = None) -> dict:
+        if admin.kind == "split":
+            return self._exec_split(wb, admin)
+        if admin.kind == "change_peer":
+            return self._exec_change_peer(wb, admin, cc)
+        if admin.kind == "compact_log":
+            return self._exec_compact_log(wb, admin)
+        raise ValueError(admin.kind)    # pragma: no cover
+
+    def _exec_split(self, wb, admin: AdminCmd) -> dict:
+        """fsm/apply.rs exec_batch_split: left keeps the id, right is the
+        new region [split_key, end); both bump epoch.version."""
+        region = self.region
+        from dataclasses import replace
+        new_epoch = RegionEpoch(region.epoch.conf_ver,
+                                region.epoch.version + 1)
+        right_peers = tuple(
+            PeerMeta(pid, p.store_id, p.is_learner)
+            for pid, p in zip(admin.new_peer_ids, region.peers))
+        right = Region(admin.new_region_id, admin.split_key,
+                       region.end_key, new_epoch, right_peers)
+        left = replace(region, end_key=admin.split_key, epoch=new_epoch)
+        self.peer_storage.persist_region(wb, left)
+        self.store.create_split_peer(wb, right, was_leader=self.is_leader())
+        self.store.on_region_changed(self, left)
+        return {"left": left, "right": right}
+
+    def _exec_change_peer(self, wb, admin: AdminCmd,
+                          cc: Optional[ConfChange]) -> dict:
+        region = self.region
+        peers = list(region.peers)
+        p = admin.peer
+        if admin.change_type in ("add", "add_learner"):
+            peers = [x for x in peers if x.id != p.id]
+            peers.append(PeerMeta(p.id, p.store_id,
+                                  admin.change_type == "add_learner"))
+        else:
+            peers = [x for x in peers if x.id != p.id]
+        new_region = region.with_peers(peers)
+        self.peer_storage.persist_region(wb, new_region)
+        if cc is not None:
+            self.node.apply_conf_change(cc)
+        self.store.on_region_changed(self, new_region)
+        if admin.change_type == "remove" and p.id == self.meta.id:
+            self.pending_destroy = True
+        return {"region": new_region}
+
+    def _exec_compact_log(self, wb, admin: AdminCmd) -> dict:
+        index = min(admin.compact_index, self.node.applied)
+        if index > self.node.storage.snapshot.metadata.index:
+            self.node.storage.compact(index)
+            self.peer_storage.compact_log(wb, index)
+        return {}
+
+    # ------------------------------------------------------------- misc
+
+    def _make_snapshot(self, index: int, term: int):
+        return self.peer_storage.generate_snapshot(index, term, self.region)
+
+    def step(self, msg: Message) -> None:
+        self.node.step(msg)
+
+    def tick(self) -> None:
+        self.node.tick()

@@ -1,0 +1,239 @@
+"""Peer storage — durable raft state in the engine.
+
+Reference: components/raftstore/src/store/peer_storage.rs (RaftLocalState,
+RaftApplyState, RegionLocalState persisted in CF_RAFT) and
+components/keys/src/lib.rs (region raft key layout).  The RawNode runs on
+an in-memory log (raft/storage.py); this class mirrors every persisted
+Ready into the engine so a restarted store reconstructs the exact raft
+state, and generates region snapshots for follower catch-up.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional, Sequence
+
+from ..engine.traits import CF_RAFT, DATA_CFS, KvEngine
+from ..raft.messages import (
+    Entry,
+    EntryType,
+    HardState,
+    Snapshot,
+    SnapshotMetadata,
+)
+from ..raft.storage import MemoryRaftStorage
+from .cmd import _pack_bytes, _unpack_bytes
+from .metapb import Peer, Region, RegionEpoch
+
+LOCAL_PREFIX = b"\x01"
+REGION_PREFIX = LOCAL_PREFIX + b"r"
+DATA_PREFIX = b"z"
+
+
+def raft_log_key(region_id: int, index: int) -> bytes:
+    return REGION_PREFIX + struct.pack(">Q", region_id) + b"l" + \
+        struct.pack(">Q", index)
+
+
+def raft_state_key(region_id: int) -> bytes:
+    return REGION_PREFIX + struct.pack(">Q", region_id) + b"s"
+
+
+def apply_state_key(region_id: int) -> bytes:
+    return REGION_PREFIX + struct.pack(">Q", region_id) + b"a"
+
+
+def region_state_key(region_id: int) -> bytes:
+    return REGION_PREFIX + struct.pack(">Q", region_id) + b"m"
+
+
+def data_key(key: bytes) -> bytes:
+    return DATA_PREFIX + key
+
+
+def region_data_bounds(region: Region) -> tuple[bytes, Optional[bytes]]:
+    lower = DATA_PREFIX + region.start_key
+    upper = DATA_PREFIX + region.end_key if region.end_key else \
+        bytes([DATA_PREFIX[0] + 1])
+    return lower, upper
+
+
+# -- serialization of the three local states --
+
+def encode_region(region: Region) -> bytes:
+    out = struct.pack(">QII", region.id, region.epoch.conf_ver,
+                      region.epoch.version)
+    out += _pack_bytes(region.start_key) + _pack_bytes(region.end_key)
+    out += struct.pack(">I", len(region.peers))
+    for p in region.peers:
+        out += struct.pack(">QQB", p.id, p.store_id, int(p.is_learner))
+    return out
+
+
+def decode_region(buf: bytes) -> Region:
+    rid, conf_ver, version = struct.unpack_from(">QII", buf, 0)
+    off = 16
+    start, off = _unpack_bytes(buf, off)
+    end, off = _unpack_bytes(buf, off)
+    (n,) = struct.unpack_from(">I", buf, off)
+    off += 4
+    peers = []
+    for _ in range(n):
+        pid, sid, learner = struct.unpack_from(">QQB", buf, off)
+        off += 17
+        peers.append(Peer(pid, sid, bool(learner)))
+    return Region(rid, start, end, RegionEpoch(conf_ver, version),
+                  tuple(peers))
+
+
+def encode_entry(e: Entry) -> bytes:
+    return struct.pack(">QQB", e.term, e.index,
+                       1 if e.entry_type is EntryType.CONF_CHANGE else 0) \
+        + e.data
+
+
+def decode_entry(buf: bytes) -> Entry:
+    term, index, is_cc = struct.unpack_from(">QQB", buf, 0)
+    return Entry(term, index, buf[17:],
+                 EntryType.CONF_CHANGE if is_cc else EntryType.NORMAL)
+
+
+class PeerRaftStorage(MemoryRaftStorage):
+    """MemoryRaftStorage whose *outgoing* snapshots are generated on
+    demand from region data (leader side of follower catch-up); the
+    compaction marker ``self.snapshot`` stays the log-arithmetic anchor."""
+
+    def __init__(self, voters: Sequence[int] = ()):
+        super().__init__(voters)
+        self.snapshot_provider = None   # fn(index, term) -> Snapshot
+
+    def snapshot_for_send(self):
+        if self.snapshot_provider is not None:
+            meta = self.snapshot.metadata
+            return self.snapshot_provider(meta.index, meta.term)
+        return self.snapshot
+
+
+class PeerStorage:
+    """Durability mirror of one peer's raft state."""
+
+    def __init__(self, engine: KvEngine, region: Region):
+        self.engine = engine
+        self.region = region
+
+    # -- restart/load --
+
+    def load(self) -> tuple[PeerRaftStorage, int]:
+        """→ (raft storage for RawNode, applied_index)."""
+        rid = self.region.id
+        ms = PeerRaftStorage(voters=tuple(
+            p.id for p in self.region.peers if not p.is_learner))
+        ms.set_conf(
+            [p.id for p in self.region.peers if not p.is_learner],
+            [p.id for p in self.region.peers if p.is_learner])
+        raw = self.engine.get_value_cf(CF_RAFT, raft_state_key(rid))
+        applied = 0
+        if raw is not None:
+            term, vote, commit, trunc_idx, trunc_term = \
+                struct.unpack_from(">QQQQQ", raw, 0)
+            ms.set_hard_state(HardState(term, vote, commit))
+            if trunc_idx:
+                meta = ms.snapshot.metadata
+                ms.snapshot = Snapshot(SnapshotMetadata(
+                    trunc_idx, trunc_term, meta.voters, meta.learners))
+            # replay the persisted log tail
+            it = self.engine.iterator_cf(
+                CF_RAFT, raft_log_key(rid, 0),
+                raft_log_key(rid, 2**64 - 1))
+            ok = it.seek_to_first()
+            entries = []
+            while ok:
+                entries.append(decode_entry(it.value()))
+                ok = it.next()
+            if entries:
+                ms.append(entries)
+        rawa = self.engine.get_value_cf(CF_RAFT, apply_state_key(rid))
+        if rawa is not None:
+            (applied,) = struct.unpack_from(">Q", rawa, 0)
+        return ms, applied
+
+    # -- persist one Ready --
+
+    def persist(self, wb, entries: Sequence[Entry],
+                hard_state: Optional[HardState],
+                truncated: tuple = (0, 0)) -> None:
+        rid = self.region.id
+        for e in entries:
+            wb.put_cf(CF_RAFT, raft_log_key(rid, e.index), encode_entry(e))
+        if entries:
+            # drop any stale conflicting suffix beyond the new last entry
+            wb.delete_range_cf(CF_RAFT,
+                               raft_log_key(rid, entries[-1].index + 1),
+                               raft_log_key(rid, 2**64 - 1))
+        if hard_state is not None:
+            wb.put_cf(CF_RAFT, raft_state_key(rid), struct.pack(
+                ">QQQQQ", hard_state.term, hard_state.vote,
+                hard_state.commit, truncated[0], truncated[1]))
+
+    def persist_apply(self, wb, applied_index: int) -> None:
+        wb.put_cf(CF_RAFT, apply_state_key(self.region.id),
+                  struct.pack(">Q", applied_index))
+
+    def persist_region(self, wb, region: Region) -> None:
+        self.region = region
+        wb.put_cf(CF_RAFT, region_state_key(region.id),
+                  encode_region(region))
+
+    def compact_log(self, wb, to_index: int) -> None:
+        rid = self.region.id
+        wb.delete_range_cf(CF_RAFT, raft_log_key(rid, 0),
+                           raft_log_key(rid, to_index + 1))
+
+    def destroy(self, wb) -> None:
+        rid = self.region.id
+        wb.delete_range_cf(CF_RAFT, REGION_PREFIX + struct.pack(">Q", rid),
+                           REGION_PREFIX + struct.pack(">Q", rid + 1))
+
+    # -- region snapshots (follower catch-up; store/snap.rs role) --
+
+    def generate_snapshot(self, index: int, term: int,
+                          region: Region) -> Snapshot:
+        snap = self.engine.snapshot()
+        lower, upper = region_data_bounds(region)
+        parts = [encode_region(region)]
+        for cf in DATA_CFS:
+            pairs = []
+            it = snap.iterator_cf(cf, lower, upper)
+            ok = it.seek_to_first()
+            while ok:
+                pairs.append((it.key(), it.value()))
+                ok = it.next()
+            body = struct.pack(">I", len(pairs))
+            for k, v in pairs:
+                body += _pack_bytes(k) + _pack_bytes(v)
+            parts.append(_pack_bytes(cf.encode()) + body)
+        voters = tuple(p.id for p in region.peers if not p.is_learner)
+        learners = tuple(p.id for p in region.peers if p.is_learner)
+        return Snapshot(SnapshotMetadata(index, term, voters, learners),
+                        _pack_bytes(parts[0]) + b"".join(parts[1:]))
+
+    def apply_snapshot(self, wb, snap: Snapshot) -> Region:
+        """Install region data from a snapshot; returns the region meta."""
+        buf = snap.data
+        region_raw, off = _unpack_bytes(buf, 0)
+        region = decode_region(region_raw)
+        lower, upper = region_data_bounds(region)
+        for cf in DATA_CFS:
+            wb.delete_range_cf(cf, lower, upper)
+        for _ in range(len(DATA_CFS)):
+            cf_raw, off = _unpack_bytes(buf, off)
+            cf = cf_raw.decode()
+            (n,) = struct.unpack_from(">I", buf, off)
+            off += 4
+            for _ in range(n):
+                k, off = _unpack_bytes(buf, off)
+                v, off = _unpack_bytes(buf, off)
+                wb.put_cf(cf, k, v)
+        self.persist_region(wb, region)
+        self.persist_apply(wb, snap.metadata.index)
+        return region

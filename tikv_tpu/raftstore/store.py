@@ -1,0 +1,186 @@
+"""RaftStore — all the peers living on one store.
+
+Reference: components/raftstore/src/store/fsm/store.rs (StoreFsm +
+store meta: region ranges → peers) and fsm/peer.rs message dispatch; the
+batch-system actor runtime (components/batch-system) is collapsed into a
+synchronous ``drive()`` loop — the reference's poll loop shape
+(batch.rs:340) without threads, which the in-process cluster fixture and
+the standalone server both pump.
+
+Peer lifecycle handled here: bootstrap, create-on-message (a raft message
+for an unknown region creates an uninitialized peer that a leader
+snapshot then initializes — store/fsm/store.rs maybe_create_peer), split
+(create_split_peer), and destroy on conf-change removal.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Optional
+
+from ..engine.traits import CF_RAFT, KvEngine
+from ..raft.messages import Message, MsgType
+from .cmd import RaftCmd
+from .metapb import Peer as PeerMeta, Region, RegionNotFound
+from .peer import RaftPeer
+from .peer_storage import (
+    REGION_PREFIX,
+    decode_region,
+    region_state_key,
+)
+
+
+class Transport:
+    """Store-to-store raft message channel.
+
+    Reference: src/server/raft_client.rs (buffered per-peer connections)
+    — here an interface; the in-process cluster and the network server
+    provide impls.  ``send(to_store, region_id, to_peer, from_peer, msg)``.
+    """
+
+    def send(self, to_store: int, region_id: int, to_peer: PeerMeta,
+             from_peer: PeerMeta, msg: Message) -> None:
+        raise NotImplementedError
+
+
+class RaftStore:
+    def __init__(self, store_id: int, engine: KvEngine,
+                 transport: Transport, election_tick: int = 10,
+                 heartbeat_tick: int = 2, pre_vote: bool = True,
+                 seed: int = 0):
+        self.store_id = store_id
+        self.engine = engine
+        self.transport = transport
+        self.peers: dict[int, RaftPeer] = {}
+        self._raft_cfg = dict(election_tick=election_tick,
+                              heartbeat_tick=heartbeat_tick,
+                              pre_vote=pre_vote, seed=seed)
+        self._campaign_on_create: set[int] = set()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def load_peers(self) -> None:
+        """Restart path: recreate every peer persisted in the engine."""
+        it = self.engine.iterator_cf(
+            CF_RAFT, REGION_PREFIX,
+            REGION_PREFIX[:-1] + bytes([REGION_PREFIX[-1] + 1]))
+        regions = []
+        ok = it.seek_to_first()
+        while ok:
+            if it.key().endswith(b"m"):
+                regions.append(decode_region(it.value()))
+            ok = it.next()
+        for region in regions:
+            meta = region.peer_on_store(self.store_id)
+            if meta is not None:
+                self._add_peer(region, meta)
+
+    def bootstrap_region(self, region: Region) -> None:
+        """First-start path: persist + create the initial region's peer."""
+        meta = region.peer_on_store(self.store_id)
+        assert meta is not None, (region, self.store_id)
+        peer = self._add_peer(region, meta)
+        wb = self.engine.write_batch()
+        peer.peer_storage.persist_region(wb, region)
+        self.engine.write(wb)
+
+    def _add_peer(self, region: Region, meta: PeerMeta) -> RaftPeer:
+        peer = RaftPeer(self, region, meta, self.engine, **self._raft_cfg)
+        self.peers[region.id] = peer
+        return peer
+
+    def create_split_peer(self, wb, right: Region,
+                          was_leader: bool) -> None:
+        """Apply-time creation of the right half of a split."""
+        meta = right.peer_on_store(self.store_id)
+        if meta is None or right.id in self.peers:
+            return
+        peer = self._add_peer(right, meta)
+        peer.peer_storage.persist_region(wb, right)
+        if was_leader:
+            # the parent's leader store campaigns the new region at once
+            # so it gets a leader without waiting an election timeout
+            self._campaign_on_create.add(right.id)
+
+    def destroy_peer(self, region_id: int) -> None:
+        peer = self.peers.pop(region_id, None)
+        if peer is not None:
+            wb = self.engine.write_batch()
+            peer.peer_storage.destroy(wb)
+            self.engine.write(wb)
+
+    # ------------------------------------------------------------- routing
+
+    def region_peer(self, region_id: int) -> RaftPeer:
+        peer = self.peers.get(region_id)
+        if peer is None:
+            raise RegionNotFound(region_id)
+        return peer
+
+    def peer_by_key(self, key: bytes) -> RaftPeer:
+        for peer in self.peers.values():
+            if peer.region.contains(key):
+                return peer
+        raise RegionNotFound(-1)
+
+    def on_region_changed(self, peer: RaftPeer, region: Region) -> None:
+        """Metadata hook (split/conf change/snapshot) — the observer
+        host's region-change event (raftstore/src/coprocessor)."""
+        for obs in getattr(self, "observers", ()):
+            obs(self.store_id, region)
+
+    # ------------------------------------------------------------- messages
+
+    def on_raft_message(self, region_id: int, to_peer: PeerMeta,
+                        from_peer: PeerMeta, msg: Message) -> None:
+        peer = self.peers.get(region_id)
+        if peer is None:
+            # a message for a peer we don't have yet (add-peer or slow
+            # split): create an uninitialized shell; the leader's snapshot
+            # initializes it (maybe_create_peer)
+            if msg.msg_type in (MsgType.APPEND, MsgType.HEARTBEAT,
+                                MsgType.SNAPSHOT):
+                region = Region(region_id, peers=(to_peer,))
+                peer = self._add_peer(region, to_peer)
+            else:
+                return
+        if to_peer.id != peer.meta.id:
+            return      # stale peer id
+        peer.peer_cache[from_peer.id] = from_peer
+        peer.step(msg)
+
+    # ------------------------------------------------------------- driving
+
+    def tick(self) -> None:
+        for peer in list(self.peers.values()):
+            peer.tick()
+
+    def drive(self) -> int:
+        """Handle all pending ready work; send messages.  Returns the
+        number of messages sent (0 = quiescent)."""
+        sent = 0
+        for region_id in list(self.peers):
+            peer = self.peers.get(region_id)
+            if peer is None:
+                continue
+            if region_id in self._campaign_on_create:
+                self._campaign_on_create.discard(region_id)
+                peer.node.campaign(force=True)
+            for msg in peer.handle_ready():
+                target = self._peer_meta(peer.region, msg.to) or \
+                    peer.peer_cache.get(msg.to)
+                if target is None:
+                    continue
+                self.transport.send(target.store_id, region_id, target,
+                                    peer.meta, msg)
+                sent += 1
+            if peer.pending_destroy:
+                self.destroy_peer(region_id)
+        return sent
+
+    @staticmethod
+    def _peer_meta(region: Region, peer_id: int) -> Optional[PeerMeta]:
+        for p in region.peers:
+            if p.id == peer_id:
+                return p
+        return None

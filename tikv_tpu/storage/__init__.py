@@ -34,17 +34,27 @@ class Storage:
 
     def get(self, key: bytes, read_ts: int,
             bypass_locks=()) -> Optional[bytes]:
-        reader = MvccReader(self._engine.snapshot(SnapContext(read_ts=read_ts)))
+        from .txn_types import encode_key
+        reader = MvccReader(self._engine.snapshot(
+            SnapContext(read_ts=read_ts, key_hint=encode_key(key))))
         return reader.get(key, read_ts, bypass_locks)
 
     def batch_get(self, keys: Sequence[bytes], read_ts: int,
                   bypass_locks=()) -> list:
-        reader = MvccReader(self._engine.snapshot(SnapContext(read_ts=read_ts)))
-        return [(k, reader.get(k, read_ts, bypass_locks)) for k in keys]
+        from .txn_types import encode_key
+        out = []
+        for k in keys:
+            reader = MvccReader(self._engine.snapshot(
+                SnapContext(read_ts=read_ts, key_hint=encode_key(k))))
+            out.append((k, reader.get(k, read_ts, bypass_locks)))
+        return out
 
     def scan(self, start: Optional[bytes], end: Optional[bytes], limit: int,
              read_ts: int, desc: bool = False, bypass_locks=()) -> list:
-        reader = MvccReader(self._engine.snapshot(SnapContext(read_ts=read_ts)))
+        from .txn_types import encode_key
+        hint = encode_key(start) if start else b""
+        reader = MvccReader(self._engine.snapshot(
+            SnapContext(read_ts=read_ts, key_hint=hint)))
         return reader.scan(start, end, limit, read_ts, desc, bypass_locks)
 
     # -- txn writes (mod.rs:1702) --

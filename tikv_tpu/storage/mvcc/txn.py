@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ...engine.traits import CF_DEFAULT, CF_LOCK, CF_WRITE, WriteBatch
+from ...engine.traits import CF_DEFAULT, CF_LOCK, CF_WRITE
 from ..txn_types import Lock, Write, append_ts, encode_key
 
 
@@ -17,7 +17,6 @@ class MvccTxn:
     def __init__(self, start_ts: int):
         self.start_ts = start_ts
         self.modifies: list[tuple] = []     # (op, cf, key, value?)
-        self.locks_for_1pc: list = []
 
     # -- locks --
 
@@ -49,15 +48,7 @@ class MvccTxn:
         self.modifies.append(("del", CF_DEFAULT,
                               append_ts(encode_key(key), start_ts), None))
 
-    # -- flush --
+    # -- flush (the scheduler wraps ``modifies`` into kv.WriteData) --
 
     def is_empty(self) -> bool:
         return not self.modifies
-
-    def into_write_batch(self, wb: WriteBatch) -> WriteBatch:
-        for op, cf, key, value in self.modifies:
-            if op == "put":
-                wb.put_cf(cf, key, value)
-            else:
-                wb.delete_cf(cf, key)
-        return wb

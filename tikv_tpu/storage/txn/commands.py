@@ -52,6 +52,8 @@ class Prewrite(Command):
 
     def process_write(self, txn, reader):
         flags = self.is_pessimistic_lock or [False] * len(self.mutations)
+        assert len(flags) == len(self.mutations), \
+            "is_pessimistic_lock must match mutations 1:1"
         for m, pess in zip(self.mutations, flags):
             actions.prewrite(txn, reader, m, self.primary, self.lock_ttl,
                              self.txn_size, self.min_commit_ts,

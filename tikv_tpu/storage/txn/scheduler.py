@@ -26,7 +26,10 @@ class TxnScheduler:
         self._latches = latches if latches is not None else Latches()
 
     def run(self, cmd: Command, ctx: Optional[SnapContext] = None):
-        ctx = ctx if ctx is not None else SnapContext()
+        if ctx is None:
+            from ..txn_types import encode_key
+            keys = cmd.write_keys()
+            ctx = SnapContext(key_hint=encode_key(keys[0]) if keys else b"")
         if isinstance(cmd, ResolveLock):
             # read phase before latching (resolve_lock.rs scan → write)
             cmd.prepare(MvccReader(self._engine.snapshot(ctx)))

@@ -45,14 +45,21 @@ def ts_physical(ts: int) -> int:
 
 # ---------------------------------------------------------------- Key
 
+# Keyspace mode prefixes: txn and raw keys must never collide in the
+# engine (reference: api_version/src/keyspace.rs ApiV2 key modes).  The
+# raw keyspace uses b"r" (storage/__init__.py); txn keys get b"x".
+TXN_PREFIX = b"x"
+
+
 def encode_key(user_key: bytes) -> bytes:
-    """User key → engine key (memcomparable, no ts)."""
-    return encode_bytes_memcomparable(user_key)
+    """User key → engine key (mode prefix + memcomparable, no ts)."""
+    return TXN_PREFIX + encode_bytes_memcomparable(user_key)
 
 
 def decode_key(encoded: bytes):
     """Engine key (no ts suffix) → user key."""
-    key, off = decode_bytes_memcomparable(encoded, 0)
+    assert encoded[:1] == TXN_PREFIX, encoded[:1]
+    key, off = decode_bytes_memcomparable(encoded, 1)
     assert off == len(encoded), "trailing bytes after key"
     return key
 

@@ -1,0 +1,253 @@
+"""In-process multi-store cluster fixture.
+
+Reference: components/test_raftstore/src/cluster.rs (``Cluster`` with the
+node simulator — routers wired directly, no RPC) plus
+transport_simulate.rs message filters and the in-memory PD
+(test_raftstore/src/pd.rs).  SURVEY.md §4 names this fixture as the
+foundation of the reference's integration pyramid.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..engine.memory import MemoryEngine
+from ..engine.traits import CF_DEFAULT
+from ..pd import MockPd
+from ..raft.messages import Message
+from ..raftstore import (
+    AdminCmd,
+    NotLeaderError,
+    Peer,
+    RaftCmd,
+    RaftKv,
+    RaftStore,
+    Region,
+    RegionEpoch,
+    Store,
+    WriteOp,
+)
+
+
+class SimTransport:
+    """Shared in-process transport with message-level fault injection."""
+
+    def __init__(self):
+        self.stores: dict[int, RaftStore] = {}
+        self.queue: list[tuple] = []
+        # filters: fn(from_store, to_store, region_id, msg) -> deliver?
+        self.filters: list[Callable] = []
+
+    def send(self, to_store, region_id, to_peer, from_peer, msg) -> None:
+        self.queue.append((to_store, region_id, to_peer, from_peer, msg))
+
+    def route_all(self) -> int:
+        n = 0
+        while self.queue:
+            to_store, region_id, to_peer, from_peer, msg = self.queue.pop(0)
+            if not all(f(from_peer.store_id, to_store, region_id, msg)
+                       for f in self.filters):
+                continue
+            store = self.stores.get(to_store)
+            if store is not None:
+                store.on_raft_message(region_id, to_peer, from_peer, msg)
+                n += 1
+        return n
+
+
+class Cluster:
+    """N stores, one shared transport, one mock PD."""
+
+    def __init__(self, n_stores: int = 3, pd: Optional[MockPd] = None,
+                 seed: int = 0):
+        self.pd = pd if pd is not None else MockPd()
+        self.transport = SimTransport()
+        self.stores: dict[int, RaftStore] = {}
+        self.engines: dict[int, MemoryEngine] = {}
+        self.kvs: dict[int, RaftKv] = {}
+        for i in range(1, n_stores + 1):
+            engine = MemoryEngine()
+            store = RaftStore(i, engine, self.transport, seed=seed)
+            store.observers = [self._on_region_changed]
+            self.engines[i] = engine
+            self.stores[i] = store
+            self.transport.stores[i] = store
+            self.kvs[i] = RaftKv(store, driver=self._drive_until)
+            self.pd.put_store(Store(i))
+
+    # ------------------------------------------------------------- bootstrap
+
+    def bootstrap(self) -> Region:
+        """Create region 1 spanning the whole keyspace on every store."""
+        peers = tuple(Peer(100 + sid, sid) for sid in self.stores)
+        region = Region(1, b"", b"", RegionEpoch(1, 1), peers)
+        for store in self.stores.values():
+            store.bootstrap_region(region)
+        first = Store(1)
+        self.pd.bootstrap_cluster(first, region)
+        return region
+
+    def start(self) -> None:
+        self.elect_leader(1, 1)
+
+    # ------------------------------------------------------------- driving
+
+    def pump(self, max_rounds: int = 200) -> None:
+        """Process messages + ready work until quiescent."""
+        for _ in range(max_rounds):
+            n = 0
+            for store in self.stores.values():
+                n += store.drive()
+            n += self.transport.route_all()
+            if n == 0:
+                self.heartbeat_pd()
+                return
+        raise RuntimeError("cluster did not quiesce")
+
+    def heartbeat_pd(self) -> None:
+        """Leader peers report to PD (worker/pd.rs heartbeat loop)."""
+        for sid, store in self.stores.items():
+            for peer in store.peers.values():
+                if peer.is_leader():
+                    self.pd.region_heartbeat(
+                        peer.region, Peer(peer.meta.id, sid))
+
+    def tick_all(self, times: int = 1) -> None:
+        for _ in range(times):
+            for store in self.stores.values():
+                store.tick()
+            self.pump()
+
+    def _drive_until(self, done: Callable[[], bool]) -> None:
+        for _ in range(500):
+            if done():
+                return
+            self.pump()
+            if done():
+                return
+            self.tick_all()
+        raise TimeoutError("cluster command stalled")
+
+    # ------------------------------------------------------------- helpers
+
+    def elect_leader(self, region_id: int, store_id: int) -> None:
+        peer = self.stores[store_id].region_peer(region_id)
+        peer.node.campaign(force=True)
+        self.pump()
+        assert peer.is_leader(), "election failed"
+
+    def leader_store(self, region_id: int) -> Optional[int]:
+        best = None
+        best_term = -1
+        for sid, store in self.stores.items():
+            peer = store.peers.get(region_id)
+            if peer is not None and peer.is_leader() and \
+                    peer.node.term > best_term:
+                best, best_term = sid, peer.node.term
+        return best
+
+    def leader_peer(self, region_id: int):
+        sid = self.leader_store(region_id)
+        return None if sid is None else \
+            self.stores[sid].region_peer(region_id)
+
+    def region_for(self, key: bytes, store_id: Optional[int] = None):
+        sid = store_id
+        if sid is None:
+            for cand, store in self.stores.items():
+                try:
+                    store.peer_by_key(key)
+                    sid = cand
+                    break
+                except Exception:
+                    continue
+        return self.stores[sid].peer_by_key(key)
+
+    def _on_region_changed(self, store_id: int, region: Region) -> None:
+        peer = self.stores[store_id].peers.get(region.id)
+        if peer is not None and peer.is_leader():
+            self.pd.region_heartbeat(region, Peer(peer.meta.id, store_id))
+
+    # -- KV conveniences (node-simulator style must_put/must_get) --
+
+    def _leader_kv_for(self, key: bytes):
+        best = None
+        best_term = -1
+        for sid, store in self.stores.items():
+            try:
+                peer = store.peer_by_key(key)
+            except Exception:
+                continue
+            if peer.is_leader() and peer.node.term > best_term:
+                best, best_term = (self.kvs[sid], peer), peer.node.term
+        if best is None:
+            raise NotLeaderError(0)
+        return best
+
+    def must_put(self, key: bytes, value: bytes,
+                 cf: str = CF_DEFAULT) -> None:
+        from ..kv.engine import SnapContext, WriteData
+        kv, peer = self._leader_kv_for(key)
+        kv.write(SnapContext(region_id=peer.region.id),
+                 WriteData([("put", cf, key, value)]))
+
+    def must_get(self, key: bytes, cf: str = CF_DEFAULT):
+        from ..kv.engine import SnapContext
+        kv, peer = self._leader_kv_for(key)
+        snap = kv.snapshot(SnapContext(region_id=peer.region.id))
+        return snap.get_value_cf(cf, key)
+
+    def get_on_store(self, store_id: int, key: bytes,
+                     cf: str = CF_DEFAULT):
+        """Read the applied state directly from one store's engine."""
+        from ..raftstore.peer_storage import data_key
+        return self.engines[store_id].get_value_cf(cf, data_key(key))
+
+    # -- admin --
+
+    def split_region(self, region_id: int, split_key: bytes) -> Region:
+        peer = self.leader_peer(region_id)
+        assert peer is not None
+        new_id, new_peer_ids = self.pd.ask_split(peer.region)
+        cmd = RaftCmd(region_id, peer.region.epoch, admin=AdminCmd(
+            "split", split_key=split_key, new_region_id=new_id,
+            new_peer_ids=tuple(new_peer_ids)))
+        box: dict = {}
+        peer.propose(cmd, lambda r: box.__setitem__("result", r))
+        self._drive_until(lambda: "result" in box)
+        if isinstance(box["result"], Exception):
+            raise box["result"]
+        return box["result"]["right"]
+
+    def change_peer(self, region_id: int, change_type: str,
+                    peer_meta: Peer) -> None:
+        peer = self.leader_peer(region_id)
+        assert peer is not None
+        cmd = RaftCmd(region_id, peer.region.epoch, admin=AdminCmd(
+            "change_peer", change_type=change_type, peer=peer_meta))
+        box: dict = {}
+        peer.propose(cmd, lambda r: box.__setitem__("result", r))
+        self._drive_until(lambda: "result" in box)
+        if isinstance(box["result"], Exception):
+            raise box["result"]
+
+    def transfer_leader(self, region_id: int, to_store: int) -> None:
+        peer = self.leader_peer(region_id)
+        target = self.stores[to_store].region_peer(region_id)
+        peer.node.transfer_leader(target.meta.id)
+        self.pump()
+
+    def stop_store(self, store_id: int) -> None:
+        self.transport.stores.pop(store_id, None)
+        self.stores.pop(store_id)
+        self.kvs.pop(store_id)
+
+    def restart_store(self, store_id: int, seed: int = 0) -> None:
+        """Recreate a store over its surviving engine (crash recovery)."""
+        engine = self.engines[store_id]
+        store = RaftStore(store_id, engine, self.transport, seed=seed)
+        store.observers = [self._on_region_changed]
+        store.load_peers()
+        self.stores[store_id] = store
+        self.transport.stores[store_id] = store
+        self.kvs[store_id] = RaftKv(store, driver=self._drive_until)
