@@ -185,5 +185,7 @@ class FieldType:
         return FieldType(tp=FieldTypeTp.VAR_CHAR)
 
     @staticmethod
-    def decimal(flen: int = 20, frac: int = 4) -> "FieldType":
+    def new_decimal(flen: int = 20, frac: int = 4) -> "FieldType":
+        # (named new_decimal: a constructor called "decimal" would shadow
+        # the dataclass field's default with the function object)
         return FieldType(tp=FieldTypeTp.NEW_DECIMAL, flen=flen, decimal=frac)

@@ -141,10 +141,18 @@ class MockPd:
         return self._safe_point
 
     def tso(self) -> int:
-        """Monotonic timestamp oracle (pd_client/src/tso.rs)."""
+        """Monotonic timestamp oracle (pd_client/src/tso.rs): physical =
+        wall-clock ms (lock TTLs are measured against it), logical breaks
+        ties within one millisecond."""
+        import time
         with self._lock:
-            self._tso_logical += 1
-            if self._tso_logical >= (1 << 18):
-                self._tso_physical += 1
+            physical = int(time.time() * 1000)
+            if physical > self._tso_physical:
+                self._tso_physical = physical
                 self._tso_logical = 0
+            else:
+                self._tso_logical += 1
+                if self._tso_logical >= (1 << 18):
+                    self._tso_physical += 1
+                    self._tso_logical = 0
             return compose_ts(self._tso_physical, self._tso_logical)

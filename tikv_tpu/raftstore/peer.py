@@ -106,12 +106,24 @@ class _PrefixStripIterator:
 
 class RaftPeer:
     def __init__(self, store, region: Region, peer_meta: PeerMeta,
-                 engine: KvEngine, **raft_cfg):
+                 engine: KvEngine, initial: bool = False, **raft_cfg):
         self.store = store
         self.meta = peer_meta
         self.engine = engine
         self.peer_storage = PeerStorage(engine, region)
         ms, applied = self.peer_storage.load()
+        if initial and ms.last_index() == 0:
+            # fresh bootstrap/split peer: in-memory marker matching
+            # write_initial_state (the engine copy is in the same batch)
+            from ..raft.messages import HardState, Snapshot, SnapshotMetadata
+            from .peer_storage import RAFT_INIT_LOG_INDEX, RAFT_INIT_LOG_TERM
+            meta0 = ms.snapshot.metadata
+            ms.snapshot = Snapshot(SnapshotMetadata(
+                RAFT_INIT_LOG_INDEX, RAFT_INIT_LOG_TERM,
+                meta0.voters, meta0.learners))
+            ms.set_hard_state(HardState(RAFT_INIT_LOG_TERM, 0,
+                                        RAFT_INIT_LOG_INDEX))
+            applied = RAFT_INIT_LOG_INDEX
         ms.snapshot_provider = self._make_snapshot
         self.node = RawNode(peer_meta.id, ms, **raft_cfg)
         self.node.applied = max(self.node.applied, applied)
@@ -317,7 +329,16 @@ class RaftPeer:
     # ------------------------------------------------------------- misc
 
     def _make_snapshot(self, index: int, term: int):
-        return self.peer_storage.generate_snapshot(index, term, self.region)
+        # Generate at the APPLIED index, not the compaction marker: the
+        # engine data + region meta reflect exactly node.applied, and a
+        # lower stamp would make the receiver re-apply entries (e.g. conf
+        # changes double-bumping conf_ver).  Reference: peer_storage.rs
+        # do_snapshot uses the apply state's applied_index.
+        applied = self.node.applied
+        t = self.node.storage.term(applied)
+        if t is None:
+            t = term
+        return self.peer_storage.generate_snapshot(applied, t, self.region)
 
     def step(self, msg: Message) -> None:
         self.node.step(msg)

@@ -29,6 +29,15 @@ LOCAL_PREFIX = b"\x01"
 REGION_PREFIX = LOCAL_PREFIX + b"r"
 DATA_PREFIX = b"z"
 
+# New regions start their log *after* this index (reference:
+# store/peer_storage.rs RAFT_INIT_LOG_INDEX/TERM = 5).  An empty shell
+# peer (created on first message) can then never be served by log
+# appends — the leader must ship a region snapshot, which carries the
+# authoritative region metadata.  Catch-up via bare log replay would
+# leave the shell's peer list permanently diverged.
+RAFT_INIT_LOG_INDEX = 5
+RAFT_INIT_LOG_TERM = 5
+
 
 def raft_log_key(region_id: int, index: int) -> bytes:
     return REGION_PREFIX + struct.pack(">Q", region_id) + b"l" + \
@@ -174,6 +183,14 @@ class PeerStorage:
             wb.put_cf(CF_RAFT, raft_state_key(rid), struct.pack(
                 ">QQQQQ", hard_state.term, hard_state.vote,
                 hard_state.commit, truncated[0], truncated[1]))
+
+    def write_initial_state(self, wb) -> None:
+        """Bootstrap/split-time state: log begins at RAFT_INIT_LOG_INDEX."""
+        rid = self.region.id
+        wb.put_cf(CF_RAFT, raft_state_key(rid), struct.pack(
+            ">QQQQQ", RAFT_INIT_LOG_TERM, 0, RAFT_INIT_LOG_INDEX,
+            RAFT_INIT_LOG_INDEX, RAFT_INIT_LOG_TERM))
+        self.persist_apply(wb, RAFT_INIT_LOG_INDEX)
 
     def persist_apply(self, wb, applied_index: int) -> None:
         wb.put_cf(CF_RAFT, apply_state_key(self.region.id),

@@ -79,13 +79,16 @@ class RaftStore:
         """First-start path: persist + create the initial region's peer."""
         meta = region.peer_on_store(self.store_id)
         assert meta is not None, (region, self.store_id)
-        peer = self._add_peer(region, meta)
+        peer = self._add_peer(region, meta, initial=True)
         wb = self.engine.write_batch()
+        peer.peer_storage.write_initial_state(wb)
         peer.peer_storage.persist_region(wb, region)
         self.engine.write(wb)
 
-    def _add_peer(self, region: Region, meta: PeerMeta) -> RaftPeer:
-        peer = RaftPeer(self, region, meta, self.engine, **self._raft_cfg)
+    def _add_peer(self, region: Region, meta: PeerMeta,
+                  initial: bool = False) -> RaftPeer:
+        peer = RaftPeer(self, region, meta, self.engine, initial=initial,
+                        **self._raft_cfg)
         self.peers[region.id] = peer
         return peer
 
@@ -95,7 +98,8 @@ class RaftStore:
         meta = right.peer_on_store(self.store_id)
         if meta is None or right.id in self.peers:
             return
-        peer = self._add_peer(right, meta)
+        peer = self._add_peer(right, meta, initial=True)
+        peer.peer_storage.write_initial_state(wb)
         peer.peer_storage.persist_region(wb, right)
         if was_leader:
             # the parent's leader store campaigns the new region at once

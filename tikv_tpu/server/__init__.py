@@ -1,0 +1,14 @@
+"""Networked server layer: gRPC services, node lifecycle, clients, CLI.
+
+Reference: src/server (gRPC service assembly), components/server
+(run_tikv lifecycle), cmd/tikv-server + cmd/tikv-ctl.
+"""
+
+from .client import StoreClient, TxnClient
+from .node import Node
+from .pd_server import PdServer, RemotePdClient
+from .server import TikvServer
+from .wire import RemoteError
+
+__all__ = ["StoreClient", "TxnClient", "Node", "PdServer",
+           "RemotePdClient", "TikvServer", "RemoteError"]

@@ -1,0 +1,139 @@
+"""Command-line entrypoints.
+
+Reference: cmd/tikv-server/src/main.rs (server binary: config + flags →
+run_tikv) and cmd/tikv-ctl (ops CLI: region inspect, split, peer ops,
+KV ops, GC).  Usage:
+
+    python -m tikv_tpu.server pd --addr 127.0.0.1:2379
+    python -m tikv_tpu.server tikv --addr 127.0.0.1:20160 --pd 127.0.0.1:2379
+    python -m tikv_tpu.server ctl --pd 127.0.0.1:2379 put k v
+    python -m tikv_tpu.server ctl --pd 127.0.0.1:2379 get k
+    python -m tikv_tpu.server ctl --pd 127.0.0.1:2379 region --key k
+    python -m tikv_tpu.server ctl --pd 127.0.0.1:2379 split k
+    python -m tikv_tpu.server ctl --pd 127.0.0.1:2379 add-peer 1 2
+    python -m tikv_tpu.server ctl --pd 127.0.0.1:2379 store-status 1
+    python -m tikv_tpu.server ctl --pd 127.0.0.1:2379 gc --safe-point 42
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tikv_tpu.server")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pd_p = sub.add_parser("pd", help="run the placement driver")
+    pd_p.add_argument("--addr", default="127.0.0.1:2379")
+
+    kv_p = sub.add_parser("tikv", help="run a tikv store server")
+    kv_p.add_argument("--addr", default="127.0.0.1:20160")
+    kv_p.add_argument("--pd", required=True)
+    kv_p.add_argument("--with-device", action="store_true",
+                      help="register the TPU device runner on the "
+                           "coprocessor endpoint")
+
+    ctl = sub.add_parser("ctl", help="ops CLI (tikv-ctl analog)")
+    ctl.add_argument("--pd", required=True)
+    ctl_sub = ctl.add_subparsers(dest="op", required=True)
+    sp = ctl_sub.add_parser("put")
+    sp.add_argument("key")
+    sp.add_argument("value")
+    gp = ctl_sub.add_parser("get")
+    gp.add_argument("key")
+    scn = ctl_sub.add_parser("scan")
+    scn.add_argument("start")
+    scn.add_argument("--limit", type=int, default=16)
+    rg = ctl_sub.add_parser("region")
+    rg.add_argument("--key", required=True)
+    spl = ctl_sub.add_parser("split")
+    spl.add_argument("key")
+    ap = ctl_sub.add_parser("add-peer")
+    ap.add_argument("region_id", type=int)
+    ap.add_argument("store_id", type=int)
+    st = ctl_sub.add_parser("store-status")
+    st.add_argument("store_id", type=int)
+    gc = ctl_sub.add_parser("gc")
+    gc.add_argument("--safe-point", type=int, required=True)
+    ctl_sub.add_parser("stores")
+    ctl_sub.add_parser("tso")
+
+    args = p.parse_args(argv)
+
+    if args.cmd == "pd":
+        from .pd_server import PdServer
+        server = PdServer(args.addr)
+        print(f"pd listening on {args.addr}", flush=True)
+        server.start()
+        server.wait()
+        return 0
+
+    if args.cmd == "tikv":
+        from .node import Node
+        from .pd_server import RemotePdClient
+        from .server import TikvServer
+        device_runner = None
+        if args.with_device:
+            from ..device import DeviceRunner
+            device_runner = DeviceRunner()
+        node = Node(args.addr, RemotePdClient(args.pd),
+                    device_runner=device_runner)
+        server = TikvServer(node)
+        server.start()
+        print(f"tikv store {node.store_id} listening on {args.addr}",
+              flush=True)
+        server.wait()
+        return 0
+
+    # ctl
+    from .client import TxnClient
+    c = TxnClient(args.pd)
+    enc = lambda s: s.encode()          # noqa: E731
+
+    if args.op == "put":
+        c.put(enc(args.key), enc(args.value))
+        print("OK")
+    elif args.op == "get":
+        v = c.get(enc(args.key))
+        print(v.decode(errors="replace") if v is not None else "(nil)")
+    elif args.op == "scan":
+        for k, v in c.scan(enc(args.start), None, args.limit):
+            print(k, v)
+    elif args.op == "region":
+        region, leader = c.pd.get_region_with_leader(enc(args.key))
+        print(json.dumps({
+            "id": region.id,
+            "start": region.start_key.decode(errors="replace"),
+            "end": region.end_key.decode(errors="replace"),
+            "epoch": [region.epoch.conf_ver, region.epoch.version],
+            "peers": [[pr.id, pr.store_id] for pr in region.peers],
+            "leader": leader.id if leader else None}))
+    elif args.op == "split":
+        right = c.split(enc(args.key))
+        print(f"new region {right.id} at {args.key!r}")
+    elif args.op == "add-peer":
+        peer = c.add_peer(args.region_id, args.store_id)
+        print(f"added peer {peer.id} on store {peer.store_id}")
+    elif args.op == "store-status":
+        print(json.dumps(c.status(args.store_id), default=repr, indent=2))
+    elif args.op == "gc":
+        total = 0
+        for s in c.pd.stores():
+            from .client import StoreClient
+            total += StoreClient(s.address).call(
+                "KvGC", {"safe_point": args.safe_point})["removed"]
+        c.pd.set_gc_safe_point(args.safe_point)
+        print(f"gc removed {total} versions")
+    elif args.op == "stores":
+        for s in c.pd.stores():
+            print(s.id, s.address)
+    elif args.op == "tso":
+        print(c.tso())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

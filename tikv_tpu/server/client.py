@@ -1,0 +1,254 @@
+"""Clients: per-store RPC stub + the PD-routed transactional client.
+
+Reference: the store stub mirrors what TiDB holds per TiKV
+(src/server/service/kv.rs surface); ``TxnClient`` plays the client-go
+role — PD region routing, 2-phase commit (primary first), lock
+resolution on conflict — which the reference repo itself leaves to its
+callers but its tests exercise via test fixtures.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import grpc
+
+from ..raftstore.metapb import Peer, Region
+from . import wire
+from .pd_server import RemotePdClient
+
+
+class StoreClient:
+    """Raw method stub against one tikv-server."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self._chan = grpc.insecure_channel(addr)
+
+    def call(self, method: str, req: dict, timeout: float = 10) -> dict:
+        fn = self._chan.unary_unary(
+            "/tikv.Tikv/" + method, request_serializer=wire.pack,
+            response_deserializer=wire.unpack)
+        resp = fn(req, timeout=timeout)
+        if resp.get("error"):
+            raise wire.RemoteError(resp["error"])
+        return resp
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda req=None, **kw: self.call(name, req or kw)
+
+
+class TxnError(Exception):
+    pass
+
+
+class TxnClient:
+    """Transactional client: PD routing + Percolator 2PC.
+
+    Reads/writes route to the region leader by key; on KeyIsLocked the
+    client resolves via CheckTxnStatus + ResolveLock (the reference's
+    client-side lock resolution protocol).
+    """
+
+    def __init__(self, pd_addr: str):
+        self.pd = RemotePdClient(pd_addr)
+        self._stores: dict[int, StoreClient] = {}
+
+    # -- routing --
+
+    def _store_client(self, store_id: int) -> StoreClient:
+        c = self._stores.get(store_id)
+        if c is None:
+            c = StoreClient(self.pd.get_store(store_id).address)
+            self._stores[store_id] = c
+        return c
+
+    def _leader_client(self, key: bytes) -> tuple[StoreClient, Region]:
+        region, leader = self.pd.get_region_with_leader(key)
+        if leader is None:
+            leader = region.peers[0]
+        return self._store_client(leader.store_id), region
+
+    def _call_leader(self, key: bytes, method: str, req: dict,
+                     retries: int = 8) -> dict:
+        """Retry NotLeader/EpochNotMatch with fresh routing (client-go
+        region cache invalidation)."""
+        last: Optional[Exception] = None
+        for _ in range(retries):
+            client, _region = self._leader_client(key)
+            try:
+                return client.call(method, req)
+            except wire.RemoteError as e:
+                if e.kind in ("not_leader", "epoch_not_match",
+                              "region_not_found"):
+                    last = e
+                    time.sleep(0.05)
+                    continue
+                raise
+        raise last if last else TxnError("routing failed")
+
+    # -- timestamps --
+
+    def tso(self) -> int:
+        return self.pd.tso()
+
+    # -- simple point API --
+
+    def get(self, key: bytes, version: Optional[int] = None,
+            resolve: bool = True) -> Optional[bytes]:
+        ts = version if version is not None else self.tso()
+        for _ in range(4):
+            try:
+                r = self._call_leader(key, "KvGet",
+                                      {"key": key, "version": ts})
+                return r.get("value")
+            except wire.RemoteError as e:
+                if resolve and e.kind == "key_is_locked":
+                    self._resolve_lock(key, e.err["lock"], ts)
+                    continue
+                raise
+        raise TxnError(f"unresolved lock on {key!r}")
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.txn_write([("put", key, value)])
+
+    def delete(self, key: bytes) -> None:
+        self.txn_write([("delete", key, None)])
+
+    def scan(self, start: bytes, end: Optional[bytes], limit: int,
+             version: Optional[int] = None) -> list:
+        ts = version if version is not None else self.tso()
+        r = self._call_leader(start, "KvScan", {
+            "start_key": start, "end_key": end, "limit": limit,
+            "version": ts})
+        return [(p["key"], p["value"]) for p in r["pairs"]]
+
+    # -- 2PC --
+
+    def txn_write(self, mutations: Sequence[tuple]) -> int:
+        """mutations: [(op, key, value|None)].  Full 2PC: prewrite all
+        keys (primary first group), then commit primary, then commit
+        secondaries.  Returns commit_ts."""
+        assert mutations
+        start_ts = self.tso()
+        primary = mutations[0][1]
+        # group keys by region leader
+        groups: dict[tuple, list] = {}
+        for op, key, value in mutations:
+            client, region = self._leader_client(key)
+            groups.setdefault((client.addr, region.id), []).append(
+                (client, op, key, value))
+        # prewrite every group
+        for (addr, rid), muts in groups.items():
+            client = muts[0][0]
+            self._retryable_prewrite(client, muts, primary, start_ts)
+        # commit primary first — the txn's durability point
+        commit_ts = self.tso()
+        self._call_leader(primary, "KvCommit", {
+            "keys": [primary], "start_version": start_ts,
+            "commit_version": commit_ts})
+        # then secondaries (safe to retry/resolve after the primary commit)
+        secondaries = [k for _, k, _v in mutations if k != primary]
+        for key in secondaries:
+            self._call_leader(key, "KvCommit", {
+                "keys": [key], "start_version": start_ts,
+                "commit_version": commit_ts})
+        return commit_ts
+
+    def _retryable_prewrite(self, client, muts, primary, start_ts,
+                            retries: int = 4) -> None:
+        req = {"mutations": [{"op": op, "key": k, "value": v}
+                             for _c, op, k, v in muts],
+               "primary": primary, "start_version": start_ts}
+        for _ in range(retries):
+            try:
+                client.call("KvPrewrite", req)
+                return
+            except wire.RemoteError as e:
+                if e.kind == "key_is_locked":
+                    self._resolve_lock(e.err["key"], e.err["lock"],
+                                       start_ts)
+                    continue
+                raise
+        raise TxnError("prewrite kept hitting locks")
+
+    # -- lock resolution (client-go resolver protocol) --
+
+    def _resolve_lock(self, key: bytes, lock: dict, caller_ts: int) -> None:
+        primary = lock["primary"]
+        status = self._call_leader(primary, "KvCheckTxnStatus", {
+            "primary_key": primary, "lock_ts": lock["start_ts"],
+            "caller_start_ts": caller_ts, "current_ts": self.tso()})
+        st = status["status"]
+        if st == "committed":
+            self._call_leader(key, "KvResolveLock", {
+                "start_version": lock["start_ts"],
+                "commit_version": status["ts"]})
+        elif st in ("rolled_back", "ttl_expired"):
+            self._call_leader(key, "KvResolveLock", {
+                "start_version": lock["start_ts"], "commit_version": 0})
+        # "locked": still alive — caller retries / backs off
+
+    # -- coprocessor --
+
+    def coprocessor(self, dag, key_hint: Optional[bytes] = None,
+                    force_backend: Optional[str] = None) -> dict:
+        key = key_hint if key_hint is not None else \
+            (dag.ranges[0].start if dag.ranges else b"")
+        return self._call_leader(key, "Coprocessor", {
+            "tp": 103, "dag": wire.enc_dag(dag),
+            "force_backend": force_backend})
+
+    # -- raw --
+
+    def raw_put(self, key: bytes, value: bytes) -> None:
+        self._call_leader(key, "RawPut", {"key": key, "value": value})
+
+    def raw_get(self, key: bytes) -> Optional[bytes]:
+        return self._call_leader(key, "RawGet", {"key": key}).get("value")
+
+    # -- admin (ctl surface) --
+
+    def split(self, split_key: bytes) -> Region:
+        r = self._call_leader(split_key, "SplitRegion",
+                              {"split_key": split_key})
+        return wire.dec_region(r["right"])
+
+    def add_peer(self, region_id: int, store_id: int) -> Peer:
+        region = self.pd.get_region_by_id(region_id)
+        peer = Peer(self.pd.alloc_id(), store_id)
+        self._call_leader_by_region(region, "ChangePeer", {
+            "region_id": region_id, "change_type": "add",
+            "peer": wire.enc_peer(peer)})
+        return peer
+
+    def remove_peer(self, region_id: int, peer: Peer) -> None:
+        region = self.pd.get_region_by_id(region_id)
+        self._call_leader_by_region(region, "ChangePeer", {
+            "region_id": region_id, "change_type": "remove",
+            "peer": wire.enc_peer(peer)})
+
+    def _call_leader_by_region(self, region: Region, method: str,
+                               req: dict, retries: int = 8) -> dict:
+        last = None
+        for _ in range(retries):
+            _r = self.pd.get_region_by_id(region.id) or region
+            reg, leader = self.pd.get_region_with_leader(_r.start_key)
+            if reg.id != region.id or leader is None:
+                leader = _r.peers[0]
+            client = self._store_client(leader.store_id)
+            try:
+                return client.call(method, req)
+            except wire.RemoteError as e:
+                if e.kind in ("not_leader", "epoch_not_match"):
+                    last = e
+                    time.sleep(0.05)
+                    continue
+                raise
+        raise last if last else TxnError("routing failed")
+
+    def status(self, store_id: int) -> dict:
+        return self._store_client(store_id).call("Status", {})

@@ -1,0 +1,283 @@
+"""Node — one tikv-server process: store lifecycle + drive loop + RPC.
+
+Reference: components/server/src/server.rs (run_tikv :208,
+TikvServer::init :325 — PD handshake, engine init, raftstore start,
+service registration) and src/server/node.rs (store bootstrap: alloc
+store id / region from PD).
+
+Threading: one background drive thread owns raft progress (tick + ready
++ outbound raft messages, the poll-loop role of components/batch-system);
+gRPC handler threads propose under the node lock and block on completion
+events the drive thread fires.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+from ..engine.memory import MemoryEngine
+from ..copr.endpoint import Endpoint
+from ..copr.storage_impl import MvccScanStorage
+from ..kv.engine import SnapContext
+from ..raftstore import (
+    AdminCmd,
+    Peer,
+    RaftCmd,
+    RaftKv,
+    RaftStore,
+    Region,
+    RegionEpoch,
+    Transport,
+)
+from ..pd.client import PdClient
+from ..raftstore.metapb import Store as StoreMeta
+from ..storage import Storage
+from ..storage.mvcc.reader import MvccReader
+from ..storage.mvcc.txn import MvccTxn
+from ..storage.txn.gc import gc_range
+from ..kv.engine import WriteData
+from . import wire
+
+
+class GrpcTransport(Transport):
+    """Store-to-store raft transport over gRPC.
+
+    Reference: src/server/raft_client.rs — per-store buffered channels
+    with address resolution through PD (src/server/resolve.rs)."""
+
+    def __init__(self, pd: PdClient):
+        self._pd = pd
+        self._chans: dict[int, grpc.Channel] = {}
+        self._buf: list[tuple] = []
+        self._lock = threading.Lock()
+
+    def send(self, to_store, region_id, to_peer, from_peer, msg) -> None:
+        with self._lock:
+            self._buf.append((to_store, {
+                "region_id": region_id,
+                "to_peer": wire.enc_peer(to_peer),
+                "from_peer": wire.enc_peer(from_peer),
+                "msg": wire.enc_raft_msg(msg)}))
+
+    def flush(self) -> None:
+        with self._lock:
+            buf, self._buf = self._buf, []
+        by_store: dict[int, list] = {}
+        for sid, m in buf:
+            by_store.setdefault(sid, []).append(m)
+        for sid, msgs in by_store.items():
+            try:
+                chan = self._channel(sid)
+                call = chan.unary_unary(
+                    "/tikv.Tikv/BatchRaft",
+                    request_serializer=wire.pack,
+                    response_deserializer=wire.unpack)
+                call({"msgs": msgs}, timeout=5)
+            except Exception:
+                pass    # raft tolerates message loss; retried by protocol
+
+    def _channel(self, store_id: int):
+        chan = self._chans.get(store_id)
+        if chan is None:
+            addr = self._pd.get_store(store_id).address
+            chan = grpc.insecure_channel(addr)
+            self._chans[store_id] = chan
+        return chan
+
+
+class Node:
+    def __init__(self, addr: str, pd: PdClient,
+                 engine: Optional[MemoryEngine] = None,
+                 store_id: Optional[int] = None,
+                 device_runner=None, tick_interval: float = 0.01):
+        self.addr = addr
+        self.pd = pd
+        self.engine = engine if engine is not None else MemoryEngine()
+        self.lock = threading.RLock()
+        self._tick_interval = tick_interval
+        self._wake = threading.Condition(self.lock)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        self.store_id = store_id if store_id is not None else pd.alloc_id()
+        pd.put_store(StoreMeta(self.store_id, addr))
+        self.transport = GrpcTransport(pd)
+        self.raft_store = RaftStore(self.store_id, self.engine,
+                                    self.transport)
+        self.raft_store.observers = [self._report_region]
+        self.raft_kv = RaftKv(self.raft_store, driver=self._wait_driver)
+        self.storage = Storage(engine=self.raft_kv)
+        self.endpoint = Endpoint(self._copr_snapshot,
+                                 device_runner=device_runner)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def bootstrap_or_join(self) -> None:
+        """First store bootstraps region 1; later stores start empty and
+        receive peers via ChangePeer (src/server/node.rs bootstrap)."""
+        self.raft_store.load_peers()
+        if self.raft_store.peers:
+            return      # restart: state recovered from the engine
+        if not self.pd.is_bootstrapped():
+            region_id = 1
+            peer = Peer(self.pd.alloc_id(), self.store_id)
+            region = Region(region_id, b"", b"", RegionEpoch(1, 1), (peer,))
+            self.raft_store.bootstrap_region(region)
+            self.pd.bootstrap_cluster(StoreMeta(self.store_id, self.addr),
+                                      region)
+            self.raft_store.region_peer(region_id).node.campaign(force=True)
+
+    def start(self) -> None:
+        self.bootstrap_or_join()
+        self._thread = threading.Thread(target=self._drive_loop,
+                                        daemon=True, name="raft-drive")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _drive_loop(self) -> None:
+        last_tick = time.monotonic()
+        last_hb = 0.0
+        while not self._stop.is_set():
+            did = 0
+            with self.lock:
+                now = time.monotonic()
+                if now - last_tick >= self._tick_interval:
+                    last_tick = now
+                    self.raft_store.tick()
+                did = self.raft_store.drive()
+                self._wake.notify_all()
+                # periodic PD reporting (worker/pd.rs heartbeat loop)
+                if now - last_hb >= self._tick_interval * 10:
+                    last_hb = now
+                    leaders = [(p.region, Peer(p.meta.id, self.store_id))
+                               for p in self.raft_store.peers.values()
+                               if p.is_leader()]
+                else:
+                    leaders = None
+            self.transport.flush()
+            if leaders is not None:
+                try:
+                    for region, leader in leaders:
+                        self.pd.region_heartbeat(region, leader)
+                    self.pd.store_heartbeat(
+                        self.store_id, {"region_count": len(leaders)})
+                except Exception:
+                    pass    # PD outages must not stall raft
+            if did == 0:
+                time.sleep(self._tick_interval / 4)
+
+    def _wait_driver(self, done) -> None:
+        """RaftKv blocks here while the drive thread makes progress."""
+        deadline = time.monotonic() + 10.0
+        with self.lock:
+            self.raft_store.drive()
+            while not done():
+                if time.monotonic() > deadline:
+                    raise TimeoutError("raft command stalled")
+                self._wake.wait(timeout=0.05)
+                self.raft_store.drive()
+
+    # ---------------------------------------------------------- hooks
+
+    def on_raft_message(self, region_id, to_peer, from_peer, msg) -> None:
+        with self.lock:
+            self.raft_store.on_raft_message(region_id, to_peer, from_peer,
+                                            msg)
+            self._wake.notify_all()
+
+    def _report_region(self, store_id: int, region: Region) -> None:
+        peer = self.raft_store.peers.get(region.id)
+        if peer is not None and peer.is_leader():
+            self.pd.region_heartbeat(region, Peer(peer.meta.id, store_id))
+
+    def _copr_snapshot(self, req):
+        """Coprocessor feed: MVCC over a region snapshot routed by the
+        request's first key range (endpoint.rs snapshot acquisition)."""
+        start = req.dag.ranges[0].start if req.dag.ranges else b""
+        key_hint = encode_first(start)
+        snap = self.raft_kv.snapshot(SnapContext(key_hint=key_hint))
+        return MvccScanStorage(MvccReader(snap), req.dag.start_ts)
+
+    # ---------------------------------------------------------- admin ops
+
+    def split_region(self, region_id: int, split_key: bytes) -> Region:
+        from ..storage.txn_types import encode_key
+        enc_split = encode_key(split_key)
+        with self.lock:
+            if not region_id:
+                peer = self.raft_store.peer_by_key(enc_split)
+            else:
+                peer = self.raft_store.region_peer(region_id)
+            new_id, new_peer_ids = self.pd.ask_split(peer.region)
+            cmd = RaftCmd(peer.region.id, peer.region.epoch,
+                          admin=AdminCmd("split", split_key=enc_split,
+                                         new_region_id=new_id,
+                                         new_peer_ids=tuple(new_peer_ids)))
+            box: dict = {}
+            peer.propose(cmd, lambda r: box.__setitem__("result", r))
+        self._wait_driver(lambda: "result" in box)
+        if isinstance(box["result"], Exception):
+            raise box["result"]
+        return box["result"]["right"]
+
+    def change_peer(self, region_id: int, change_type: str,
+                    peer_meta: Peer) -> None:
+        with self.lock:
+            peer = self.raft_store.region_peer(region_id)
+            cmd = RaftCmd(region_id, peer.region.epoch,
+                          admin=AdminCmd("change_peer",
+                                         change_type=change_type,
+                                         peer=peer_meta))
+            box: dict = {}
+            peer.propose(cmd, lambda r: box.__setitem__("result", r))
+        self._wait_driver(lambda: "result" in box)
+        if isinstance(box["result"], Exception):
+            raise box["result"]
+
+    def transfer_leader(self, region_id: int, to_peer_id: int) -> None:
+        with self.lock:
+            peer = self.raft_store.region_peer(region_id)
+            peer.node.transfer_leader(to_peer_id)
+
+    def run_gc(self, safe_point: int) -> int:
+        """GC every leader region on this store (gc_worker role)."""
+        removed = 0
+        with self.lock:
+            leader_regions = [p.region.id
+                              for p in self.raft_store.peers.values()
+                              if p.is_leader()]
+        for rid in leader_regions:
+            snap = self.raft_kv.snapshot(SnapContext(region_id=rid))
+            reader = MvccReader(snap)
+            txn = MvccTxn(0)
+            removed += gc_range(txn, reader, None, None, safe_point)
+            if not txn.is_empty():
+                self.raft_kv.write(SnapContext(region_id=rid),
+                                   WriteData.from_txn(txn))
+        return removed
+
+    def status(self) -> dict:
+        with self.lock:
+            return {
+                "store_id": self.store_id,
+                "addr": self.addr,
+                "regions": [
+                    {"region": wire.enc_region(p.region),
+                     "leader": p.is_leader(),
+                     "term": p.node.term,
+                     "applied": p.node.applied}
+                    for p in self.raft_store.peers.values()],
+            }
+
+
+def encode_first(start: bytes) -> bytes:
+    from ..storage.txn_types import encode_key
+    return encode_key(start) if start else b""

@@ -1,0 +1,62 @@
+"""gRPC server assembly.
+
+Reference: src/server/server.rs (grpcio Server build_and_bind :288) and
+components/server/src/server.rs service registration (:1122-1296).
+Methods are registered generically under ``/tikv.Tikv/<Method>`` with
+msgpack bodies (wire.py).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from . import wire
+from .node import Node
+from .service import KvService
+
+
+class _GenericHandler(grpc.GenericRpcHandler):
+    def __init__(self, prefix: str, dispatch):
+        self._prefix = prefix
+        self._dispatch = dispatch
+
+    def service(self, handler_call_details):
+        name = handler_call_details.method
+        if not name.startswith(self._prefix):
+            return None
+        method = name[len(self._prefix):]
+
+        def unary(req: dict, ctx) -> dict:
+            return self._dispatch(method, req)
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary, request_deserializer=wire.unpack,
+            response_serializer=wire.pack)
+
+
+class TikvServer:
+    """One listening tikv-server process."""
+
+    def __init__(self, node: Node, max_workers: int = 8):
+        self.node = node
+        self.service = KvService(node)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers((
+            _GenericHandler("/tikv.Tikv/", self.service.handle),))
+        self.port = self._server.add_insecure_port(node.addr)
+        assert self.port, f"cannot bind {node.addr}"
+
+    def start(self) -> None:
+        self.node.start()
+        self._server.start()
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        self._server.stop(grace)
+        self.node.stop()
+
+    def wait(self) -> None:
+        self._server.wait_for_termination()

@@ -1,0 +1,201 @@
+"""The Tikv gRPC service handlers.
+
+Reference: src/server/service/kv.rs — the ``Tikv`` service:
+``handle_request!``-expanded unary KV RPCs (:251-410), ``coprocessor``
+(:493), raft ingress (:684,737), plus the admin surface that backs
+tikv-ctl (src/server/service/debug.rs).  Handlers are transport-agnostic
+callables dict → dict; server.py binds them to gRPC methods.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..copr.dag import DAGRequest
+from ..copr.endpoint import CopRequest, Endpoint, REQ_TYPE_DAG
+from ..copr.storage_impl import MvccScanStorage
+from ..kv.engine import SnapContext
+from ..raftstore import AdminCmd, Peer, RaftCmd
+from ..storage import Storage
+from ..storage.mvcc.reader import MvccReader
+from ..storage.txn import commands as cmds
+from ..storage.txn.actions import Mutation
+from ..storage.txn_types import encode_key
+from . import wire
+
+
+class KvService:
+    """All RPC handlers over one node's Storage + raftstore."""
+
+    def __init__(self, node):
+        self.node = node
+        self.storage: Storage = node.storage
+        self.endpoint: Endpoint = node.endpoint
+
+    # ---------------------------------------------------------- helpers
+
+    def _guard(self, fn: Callable[[dict], dict], req: dict) -> dict:
+        try:
+            return fn(req)
+        except Exception as e:      # noqa: BLE001 — errors ride the wire
+            return {"error": wire.enc_error(e)}
+
+    def handle(self, method: str, req: dict) -> dict:
+        fn = getattr(self, method, None)
+        if fn is None:
+            return {"error": {"kind": "unimplemented", "method": method}}
+        return self._guard(fn, req)
+
+    # ---------------------------------------------------------- txn KV
+
+    def KvGet(self, req: dict) -> dict:
+        v = self.storage.get(req["key"], req["version"],
+                             tuple(req.get("bypass_locks", ())))
+        return {"value": v, "not_found": v is None}
+
+    def KvBatchGet(self, req: dict) -> dict:
+        pairs = self.storage.batch_get(req["keys"], req["version"])
+        return {"pairs": [{"key": k, "value": v} for k, v in pairs]}
+
+    def KvScan(self, req: dict) -> dict:
+        pairs = self.storage.scan(req["start_key"],
+                                  req.get("end_key") or None,
+                                  req["limit"], req["version"],
+                                  req.get("reverse", False))
+        return {"pairs": [{"key": k, "value": v} for k, v in pairs]}
+
+    def KvPrewrite(self, req: dict) -> dict:
+        muts = [Mutation(m["op"], m["key"], m.get("value"))
+                for m in req["mutations"]]
+        r = self.storage.sched_txn_command(cmds.Prewrite(
+            muts, req["primary"], req["start_version"],
+            lock_ttl=req.get("lock_ttl", 3000),
+            txn_size=req.get("txn_size", 0),
+            min_commit_ts=req.get("min_commit_ts", 0),
+            is_pessimistic_lock=req.get("is_pessimistic_lock", ())))
+        return r
+
+    def KvCommit(self, req: dict) -> dict:
+        return self.storage.sched_txn_command(cmds.Commit(
+            req["keys"], req["start_version"], req["commit_version"]))
+
+    def KvBatchRollback(self, req: dict) -> dict:
+        return self.storage.sched_txn_command(cmds.Rollback(
+            req["keys"], req["start_version"]))
+
+    def KvCleanup(self, req: dict) -> dict:
+        return self.storage.sched_txn_command(cmds.Cleanup(
+            req["key"], req["start_version"], req["current_ts"]))
+
+    def KvCheckTxnStatus(self, req: dict) -> dict:
+        return self.storage.sched_txn_command(cmds.CheckTxnStatus(
+            req["primary_key"], req["lock_ts"], req["caller_start_ts"],
+            req["current_ts"]))
+
+    def KvResolveLock(self, req: dict) -> dict:
+        if req.get("keys"):
+            return self.storage.sched_txn_command(cmds.ResolveLockLite(
+                req["start_version"], req.get("commit_version", 0),
+                req["keys"]))
+        return self.storage.sched_txn_command(cmds.ResolveLock(
+            req["start_version"], req.get("commit_version", 0)))
+
+    def KvPessimisticLock(self, req: dict) -> dict:
+        return self.storage.sched_txn_command(cmds.AcquirePessimisticLock(
+            req["keys"], req["primary"], req["start_version"],
+            req["for_update_ts"], req.get("lock_ttl", 3000),
+            req.get("return_values", False)))
+
+    def KvPessimisticRollback(self, req: dict) -> dict:
+        return self.storage.sched_txn_command(cmds.PessimisticRollback(
+            req["keys"], req["start_version"], req["for_update_ts"]))
+
+    def KvTxnHeartBeat(self, req: dict) -> dict:
+        return self.storage.sched_txn_command(cmds.TxnHeartBeat(
+            req["primary_key"], req["start_version"], req["advise_ttl"]))
+
+    def KvGC(self, req: dict) -> dict:
+        return {"removed": self.node.run_gc(req["safe_point"])}
+
+    # ---------------------------------------------------------- raw KV
+
+    def RawGet(self, req: dict) -> dict:
+        v = self.storage.raw_get(req["key"])
+        return {"value": v, "not_found": v is None}
+
+    def RawBatchGet(self, req: dict) -> dict:
+        return {"pairs": [{"key": k, "value": v} for k, v in
+                          self.storage.raw_batch_get(req["keys"])]}
+
+    def RawPut(self, req: dict) -> dict:
+        self.storage.raw_put(req["key"], req["value"])
+        return {}
+
+    def RawBatchPut(self, req: dict) -> dict:
+        self.storage.raw_batch_put(
+            [(p["key"], p["value"]) for p in req["pairs"]])
+        return {}
+
+    def RawDelete(self, req: dict) -> dict:
+        self.storage.raw_delete(req["key"])
+        return {}
+
+    def RawDeleteRange(self, req: dict) -> dict:
+        self.storage.raw_delete_range(req["start_key"], req["end_key"])
+        return {}
+
+    def RawScan(self, req: dict) -> dict:
+        pairs = self.storage.raw_scan(req["start_key"],
+                                      req.get("end_key") or None,
+                                      req["limit"],
+                                      req.get("reverse", False))
+        return {"kvs": [{"key": k, "value": v} for k, v in pairs]}
+
+    # ---------------------------------------------------------- copr
+
+    def Coprocessor(self, req: dict) -> dict:
+        assert req.get("tp", REQ_TYPE_DAG) == REQ_TYPE_DAG
+        dag = wire.dec_dag(req["dag"])
+        resp = self.endpoint.handle(CopRequest(
+            REQ_TYPE_DAG, dag, req.get("force_backend")))
+        return {"rows": wire.enc_rows(resp.rows()),
+                "backend": resp.backend,
+                "elapsed_ns": resp.elapsed_ns,
+                "exec_summaries": [
+                    {"rows": s.num_produced_rows,
+                     "iters": s.num_iterations,
+                     "time_ns": s.time_processed_ns}
+                    for s in resp.result.exec_summaries]}
+
+    # ---------------------------------------------------------- raft
+
+    def Raft(self, req: dict) -> dict:
+        self.node.on_raft_message(
+            req["region_id"], wire.dec_peer(req["to_peer"]),
+            wire.dec_peer(req["from_peer"]),
+            wire.dec_raft_msg(req["msg"]))
+        return {}
+
+    def BatchRaft(self, req: dict) -> dict:
+        for m in req["msgs"]:
+            self.Raft(m)
+        return {}
+
+    # ---------------------------------------------------------- admin
+
+    def SplitRegion(self, req: dict) -> dict:
+        right = self.node.split_region(req.get("region_id", 0),
+                                       req["split_key"])
+        return {"right": wire.enc_region(right)}
+
+    def ChangePeer(self, req: dict) -> dict:
+        self.node.change_peer(req["region_id"], req["change_type"],
+                              wire.dec_peer(req["peer"]))
+        return {}
+
+    def TransferLeader(self, req: dict) -> dict:
+        self.node.transfer_leader(req["region_id"], req["to_peer_id"])
+        return {}
+
+    def Status(self, req: dict) -> dict:
+        return self.node.status()

@@ -1,0 +1,273 @@
+"""Wire serialization for the RPC surface.
+
+Reference: the kvproto/tipb protobufs.  The RPC layer here rides real
+gRPC (HTTP/2) with msgpack-encoded message bodies — the schema mirrors
+kvproto field-for-field so a protobuf codec can replace msgpack without
+touching handlers (tracked deviation: binary wire compat with kvproto).
+Raft messages and DAG plans reuse the framework's own binary codecs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import msgpack
+
+from ..raft.messages import (
+    Entry,
+    EntryType,
+    Message,
+    MsgType,
+    Snapshot,
+    SnapshotMetadata,
+)
+from ..raftstore.metapb import Peer, Region, RegionEpoch
+from ..raftstore.peer_storage import decode_entry, encode_entry
+
+
+def pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(raw: bytes) -> Any:
+    return msgpack.unpackb(raw, raw=False)
+
+
+# -- metapb --
+
+def enc_peer(p: Peer) -> dict:
+    return {"id": p.id, "store_id": p.store_id, "learner": p.is_learner}
+
+
+def dec_peer(d: Optional[dict]) -> Optional[Peer]:
+    if d is None:
+        return None
+    return Peer(d["id"], d["store_id"], d.get("learner", False))
+
+
+def enc_region(r: Region) -> dict:
+    return {"id": r.id, "start": r.start_key, "end": r.end_key,
+            "conf_ver": r.epoch.conf_ver, "version": r.epoch.version,
+            "peers": [enc_peer(p) for p in r.peers]}
+
+
+def dec_region(d: dict) -> Region:
+    return Region(d["id"], d["start"], d["end"],
+                  RegionEpoch(d["conf_ver"], d["version"]),
+                  tuple(dec_peer(p) for p in d["peers"]))
+
+
+# -- raft messages (eraftpb analog) --
+
+def enc_raft_msg(m: Message) -> dict:
+    out = {"t": m.msg_type.value, "to": m.to, "frm": m.frm,
+           "term": m.term, "lt": m.log_term, "i": m.index,
+           "c": m.commit, "rej": m.reject, "hint": m.reject_hint,
+           "e": [encode_entry(e) for e in m.entries]}
+    if m.snapshot is not None:
+        meta = m.snapshot.metadata
+        out["snap"] = {"i": meta.index, "t": meta.term,
+                       "v": list(meta.voters), "l": list(meta.learners),
+                       "d": m.snapshot.data}
+    return out
+
+
+def dec_raft_msg(d: dict) -> Message:
+    snap = None
+    if "snap" in d:
+        s = d["snap"]
+        snap = Snapshot(SnapshotMetadata(s["i"], s["t"], tuple(s["v"]),
+                                         tuple(s["l"])), s["d"])
+    return Message(MsgType(d["t"]), to=d["to"], frm=d["frm"],
+                   term=d["term"], log_term=d["lt"], index=d["i"],
+                   entries=tuple(decode_entry(e) for e in d["e"]),
+                   commit=d["c"], reject=d["rej"], reject_hint=d["hint"],
+                   snapshot=snap)
+
+
+# -- errors (kvrpcpb errorpb analog: stable identities over the wire) --
+
+def enc_error(e: Exception) -> dict:
+    from ..raftstore.metapb import EpochNotMatch, NotLeaderError
+    from ..storage.mvcc.errors import (
+        AlreadyExist, Committed, KeyIsLocked, TxnLockNotFound, WriteConflict,
+    )
+    if isinstance(e, KeyIsLocked):
+        lk = e.lock
+        return {"kind": "key_is_locked", "key": e.key,
+                "lock": {"primary": lk.primary, "start_ts": lk.start_ts,
+                         "ttl": lk.ttl,
+                         "min_commit_ts": lk.min_commit_ts}}
+    if isinstance(e, WriteConflict):
+        return {"kind": "write_conflict", "key": e.key,
+                "start_ts": e.start_ts,
+                "conflict_start_ts": e.conflict_start_ts,
+                "conflict_commit_ts": e.conflict_commit_ts,
+                "reason": e.reason}
+    if isinstance(e, TxnLockNotFound):
+        return {"kind": "txn_lock_not_found", "key": e.key,
+                "start_ts": e.start_ts}
+    if isinstance(e, Committed):
+        return {"kind": "committed", "key": e.key,
+                "start_ts": e.start_ts, "commit_ts": e.commit_ts}
+    if isinstance(e, AlreadyExist):
+        return {"kind": "already_exist", "key": e.key}
+    if isinstance(e, NotLeaderError):
+        return {"kind": "not_leader", "region_id": e.region_id,
+                "leader": enc_peer(e.leader) if e.leader else None}
+    if isinstance(e, EpochNotMatch):
+        return {"kind": "epoch_not_match",
+                "current": enc_region(e.current)}
+    return {"kind": "other", "message": str(e)}
+
+
+class RemoteError(Exception):
+    """Client-side surfacing of a wire error dict."""
+
+    def __init__(self, err: dict):
+        super().__init__(f"{err.get('kind')}: {err}")
+        self.err = err
+
+    @property
+    def kind(self) -> str:
+        return self.err.get("kind", "other")
+
+
+# -- coprocessor DAG plans (tipb analog) --
+
+def enc_field_type(ft) -> dict:
+    return {"tp": int(ft.tp), "flag": int(ft.flag), "flen": ft.flen,
+            "decimal": ft.decimal, "collation": ft.collation,
+            "elems": list(ft.elems)}
+
+
+def dec_field_type(d: dict):
+    from ..datatype.eval_type import FieldType, FieldTypeFlag, FieldTypeTp
+    return FieldType(FieldTypeTp(d["tp"]), FieldTypeFlag(d["flag"]),
+                     d["flen"], d["decimal"], d["collation"],
+                     tuple(d["elems"]))
+
+
+def enc_expr(e) -> dict:
+    if e.kind == "const":
+        return {"k": "c", "v": e.value,
+                "et": e.eval_type.value if e.eval_type else None}
+    if e.kind == "column":
+        return {"k": "col", "i": e.col_idx,
+                "et": e.eval_type.value if e.eval_type else None}
+    return {"k": "f", "sig": e.sig,
+            "ch": [enc_expr(c) for c in e.children]}
+
+
+def dec_expr(d: dict):
+    from ..datatype import EvalType
+    from ..expr import Expr
+    et = EvalType(d["et"]) if d.get("et") else None
+    if d["k"] == "c":
+        return Expr(kind="const", value=d["v"], eval_type=et)
+    if d["k"] == "col":
+        return Expr(kind="column", col_idx=d["i"], eval_type=et)
+    return Expr.call(d["sig"], *(dec_expr(c) for c in d["ch"]))
+
+
+def enc_dag(dag) -> dict:
+    from ..copr.dag import (
+        AggregationDesc, IndexScanDesc, LimitDesc, ProjectionDesc,
+        SelectionDesc, TableScanDesc, TopNDesc,
+    )
+    execs = []
+    for ex in dag.executors:
+        if isinstance(ex, TableScanDesc):
+            execs.append({"k": "tscan", "table_id": ex.table_id,
+                          "desc": ex.desc,
+                          "cols": [{"id": c.col_id,
+                                    "ft": enc_field_type(c.field_type),
+                                    "pk": c.is_pk_handle}
+                                   for c in ex.columns]})
+        elif isinstance(ex, IndexScanDesc):
+            execs.append({"k": "iscan", "table_id": ex.table_id,
+                          "index_id": ex.index_id, "desc": ex.desc,
+                          "unique": ex.unique,
+                          "cols": [{"id": c.col_id,
+                                    "ft": enc_field_type(c.field_type),
+                                    "pk": c.is_pk_handle}
+                                   for c in ex.columns]})
+        elif isinstance(ex, SelectionDesc):
+            execs.append({"k": "sel",
+                          "conds": [enc_expr(e) for e in ex.conditions]})
+        elif isinstance(ex, ProjectionDesc):
+            execs.append({"k": "proj",
+                          "exprs": [enc_expr(e) for e in ex.exprs]})
+        elif isinstance(ex, AggregationDesc):
+            execs.append({"k": "agg", "streamed": ex.streamed,
+                          "group_by": [enc_expr(e) for e in ex.group_by],
+                          "aggs": [{"kind": a.kind,
+                                    "arg": enc_expr(a.arg)
+                                    if a.arg is not None else None}
+                                   for a in ex.aggs]})
+        elif isinstance(ex, TopNDesc):
+            execs.append({"k": "topn", "limit": ex.limit,
+                          "order_by": [{"e": enc_expr(e), "desc": d}
+                                       for e, d in ex.order_by]})
+        elif isinstance(ex, LimitDesc):
+            execs.append({"k": "limit", "limit": ex.limit})
+        else:   # pragma: no cover
+            raise ValueError(ex)
+    return {"execs": execs,
+            "ranges": [{"s": r.start, "e": r.end} for r in dag.ranges],
+            "start_ts": dag.start_ts,
+            "output_offsets": list(dag.output_offsets)
+            if dag.output_offsets is not None else None,
+            "encode_type": dag.encode_type}
+
+
+def dec_dag(d: dict):
+    from ..copr.dag import (
+        AggExprDesc, AggregationDesc, ColumnInfo, DAGRequest, IndexScanDesc,
+        LimitDesc, ProjectionDesc, SelectionDesc, TableScanDesc, TopNDesc,
+    )
+    from ..executors.ranges import KeyRange
+    execs = []
+    for ex in d["execs"]:
+        k = ex["k"]
+        if k in ("tscan", "iscan"):
+            cols = tuple(ColumnInfo(c["id"], dec_field_type(c["ft"]),
+                                    c["pk"]) for c in ex["cols"])
+            if k == "tscan":
+                execs.append(TableScanDesc(ex["table_id"], cols,
+                                           ex["desc"]))
+            else:
+                execs.append(IndexScanDesc(ex["table_id"], ex["index_id"],
+                                           cols, ex["desc"], ex["unique"]))
+        elif k == "sel":
+            execs.append(SelectionDesc(
+                tuple(dec_expr(e) for e in ex["conds"])))
+        elif k == "proj":
+            execs.append(ProjectionDesc(
+                tuple(dec_expr(e) for e in ex["exprs"])))
+        elif k == "agg":
+            execs.append(AggregationDesc(
+                tuple(dec_expr(e) for e in ex["group_by"]),
+                tuple(AggExprDesc(a["kind"],
+                                  dec_expr(a["arg"])
+                                  if a["arg"] is not None else None)
+                      for a in ex["aggs"]),
+                ex["streamed"]))
+        elif k == "topn":
+            execs.append(TopNDesc(
+                tuple((dec_expr(o["e"]), o["desc"])
+                      for o in ex["order_by"]), ex["limit"]))
+        elif k == "limit":
+            execs.append(LimitDesc(ex["limit"]))
+    return DAGRequest(
+        executors=tuple(execs),
+        ranges=tuple(KeyRange(r["s"], r["e"]) for r in d["ranges"]),
+        start_ts=d["start_ts"],
+        output_offsets=tuple(d["output_offsets"])
+        if d["output_offsets"] is not None else None,
+        encode_type=d["encode_type"])
+
+
+def enc_rows(rows) -> list:
+    """Result rows → wire (floats/ints/bytes/None pass through msgpack)."""
+    return [list(r) for r in rows]
