@@ -210,6 +210,94 @@ def test_log_compaction_and_snapshot_catch_up():
         assert c.get_on_store(lagger, b"k%d" % i) == b"v%d" % i
 
 
+def test_compact_log_then_restart():
+    """Regression (ADVICE r1 #1): compact_log must rewrite raft_state's
+    truncated marker in the same write batch, or a restart after
+    compaction sees trunc_idx below deleted log entries and corrupts the
+    log arithmetic."""
+    from tikv_tpu.raftstore import AdminCmd, RaftCmd
+    c = make_cluster(3)
+    for i in range(8):
+        c.must_put(b"k%d" % i, b"v%d" % i)
+    lead_sid = c.leader_store(1)
+    lead_peer = c.leader_peer(1)
+    cmd = RaftCmd(1, lead_peer.region.epoch, admin=AdminCmd(
+        "compact_log", compact_index=lead_peer.node.commit))
+    box = {}
+    lead_peer.propose(cmd, lambda r: box.__setitem__("r", r))
+    c._drive_until(lambda: "r" in box)
+    assert lead_peer.node.storage.first_index() > 1
+    # every store restarts over its engine; recovered log must be
+    # contiguous with the persisted truncated marker
+    for sid in list(c.stores):
+        c.stop_store(sid)
+    for sid in (1, 2, 3):
+        c.restart_store(sid)
+        peer = c.stores[sid].region_peer(1)
+        ms = peer.node.storage
+        if ms.entries:
+            assert ms.entries[0].index == ms.snapshot.metadata.index + 1
+    c.tick_all(40)
+    assert c.leader_store(1) is not None
+    c.must_put(b"after", b"x")
+    assert c.must_get(b"after") == b"x"
+    for i in range(8):
+        assert c.must_get(b"k%d" % i) == b"v%d" % i
+
+
+def test_snapshot_catch_up_then_restart():
+    """Regression (ADVICE r1 #2): applying a region snapshot must delete
+    stale persisted raft log entries below the snapshot index, or the
+    follower's next restart asserts 'appending compacted entries'."""
+    from tikv_tpu.raftstore import AdminCmd, RaftCmd
+    c = make_cluster(3)
+    # the future lagger first persists a few live log entries
+    c.must_put(b"k0", b"v0")
+    c.must_put(b"k1", b"v1")
+    lagger = next(sid for sid in c.stores if sid != c.leader_store(1))
+
+    def filt(frm, to, rid, msg):
+        return to != lagger and frm != lagger
+    c.transport.filters.append(filt)
+    for i in range(2, 8):
+        c.must_put(b"k%d" % i, b"v%d" % i)
+    lead_peer = c.leader_peer(1)
+    cmd = RaftCmd(1, lead_peer.region.epoch, admin=AdminCmd(
+        "compact_log", compact_index=lead_peer.node.commit))
+    box = {}
+    lead_peer.propose(cmd, lambda r: box.__setitem__("r", r))
+    c._drive_until(lambda: "r" in box)
+    c.transport.filters.clear()
+    c.tick_all(8)       # lagger caught up via snapshot
+    assert c.get_on_store(lagger, b"k7") == b"v7"
+    c.stop_store(lagger)
+    c.restart_store(lagger)     # raised AssertionError before the fix
+    c.tick_all(6)
+    for i in range(8):
+        assert c.get_on_store(lagger, b"k%d" % i) == b"v%d" % i
+    c.must_put(b"k9", b"v9")
+    c.tick_all(2)
+    assert c.get_on_store(lagger, b"k9") == b"v9"
+
+
+def test_uninitialized_shell_peer_cannot_campaign():
+    """Regression (ADVICE r1 #3): a shell peer created on first message
+    must not treat itself as a voter; otherwise it self-elects in a
+    single-voter group once leader contact lapses, inflating terms."""
+    from tikv_tpu.raft.messages import Message, MsgType
+    c = make_cluster(3)
+    store = c.stores[1]
+    store.on_raft_message(
+        99, Peer(991, 1), Peer(992, 2),
+        Message(MsgType.HEARTBEAT, to=991, frm=992, term=5))
+    shell = store.peers[99]
+    assert shell.region.peers == ()         # not a voter of anything
+    for _ in range(100):
+        shell.tick()
+    assert not shell.is_leader()
+    assert shell.node.term == 5             # no self-election term bumps
+
+
 def test_transfer_leader():
     c = make_cluster(3)
     c.must_put(b"k", b"v")

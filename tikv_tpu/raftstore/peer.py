@@ -20,7 +20,13 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ..engine.traits import KvEngine
-from ..raft.messages import ConfChange, ConfChangeType, EntryType, Message
+from ..raft.messages import (
+    ConfChange,
+    ConfChangeType,
+    EntryType,
+    HardState,
+    Message,
+)
 from ..raft.raw_node import LEADER, NotLeader, RawNode
 from .cmd import AdminCmd, RaftCmd, WriteOp
 from .metapb import (
@@ -324,6 +330,18 @@ class RaftPeer:
         if index > self.node.storage.snapshot.metadata.index:
             self.node.storage.compact(index)
             self.peer_storage.compact_log(wb, index)
+            # Rewrite raft_state with the POST-compact truncated marker in
+            # the same batch: handle_ready persisted it with the marker
+            # captured before this apply, and a crash between the two
+            # writes would leave trunc_idx pointing below log entries that
+            # this batch just deleted — an unrecoverable, non-contiguous
+            # log on restart (reference: fsm/apply.rs exec_compact_log
+            # updates RaftTruncatedState atomically with the deletion).
+            meta = self.node.storage.snapshot.metadata
+            self.peer_storage.persist(
+                wb, [],
+                HardState(self.node.term, self.node.vote, self.node.commit),
+                truncated=(meta.index, meta.term))
         return {}
 
     # ------------------------------------------------------------- misc

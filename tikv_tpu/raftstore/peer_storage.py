@@ -239,6 +239,15 @@ class PeerStorage:
         buf = snap.data
         region_raw, off = _unpack_bytes(buf, 0)
         region = decode_region(region_raw)
+        # Clear ALL persisted raft log entries for the region: a lagging
+        # follower caught up by snapshot may hold stale entries below the
+        # snapshot index, which restart-replay would then try to append
+        # under the new (higher) compaction marker and assert.  Entries
+        # after the snapshot are re-persisted by subsequent readies.
+        # (reference: peer_storage.rs clear_meta deletes the raft log
+        # range when applying a snapshot)
+        wb.delete_range_cf(CF_RAFT, raft_log_key(region.id, 0),
+                           raft_log_key(region.id, 2**64 - 1))
         lower, upper = region_data_bounds(region)
         for cf in DATA_CFS:
             wb.delete_range_cf(cf, lower, upper)

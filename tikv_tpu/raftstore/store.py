@@ -144,7 +144,13 @@ class RaftStore:
             # initializes it (maybe_create_peer)
             if msg.msg_type in (MsgType.APPEND, MsgType.HEARTBEAT,
                                 MsgType.SNAPSHOT):
-                region = Region(region_id, peers=(to_peer,))
+                # Empty peer list: the shell must NOT see itself as a
+                # voter, else once leader contact lapses it self-elects
+                # in a single-voter group and inflates terms (reference:
+                # store/fsm/store.rs maybe_create_peer replicates with an
+                # empty peer list; the leader snapshot installs the real
+                # membership).  to_peer rides peer_cache/meta for routing.
+                region = Region(region_id, peers=())
                 peer = self._add_peer(region, to_peer)
             else:
                 return
