@@ -45,11 +45,20 @@ class Proposal:
     index: int
     term: int
     cb: Callable            # cb(result | Exception)
+    is_read: bool = False   # read barrier: snapshot served at apply time
 
 
 class RegionSnapshot:
     """Engine snapshot clamped to one region, with the data-key prefix
-    applied transparently (reference: raftstore RegionSnapshot)."""
+    applied transparently (reference: raftstore RegionSnapshot).
+
+    ``data_index`` stamps the last applied *data-mutating* entry index —
+    the snapshot's data version for columnar/copr caches (read barriers
+    and leader noops do not bump it, so repeated reads share a version).
+    """
+
+    data_index: Optional[int] = None
+    apply_index: Optional[int] = None
 
     def __init__(self, snap, region: Region):
         self._snap = snap
@@ -133,6 +142,9 @@ class RaftPeer:
         ms.snapshot_provider = self._make_snapshot
         self.node = RawNode(peer_meta.id, ms, **raft_cfg)
         self.node.applied = max(self.node.applied, applied)
+        # last applied entry that mutated data; restart conservatively
+        # re-stamps at applied (one-time cache invalidation per restart)
+        self.data_index = self.node.applied
         self.proposals: list[Proposal] = []
         self.pending_destroy = False
         # sender metas seen on incoming messages — lets an uninitialized
@@ -194,8 +206,12 @@ class RaftPeer:
             if isinstance(_result, Exception):
                 cb(_result)
             else:
-                cb(RegionSnapshot(self.engine.snapshot(), self.region))
-        self.proposals.append(Proposal(index, self.node.term, on_applied))
+                snap = RegionSnapshot(self.engine.snapshot(), self.region)
+                snap.data_index = self.data_index
+                snap.apply_index = index
+                cb(snap)
+        self.proposals.append(Proposal(index, self.node.term, on_applied,
+                                       is_read=True))
         return index
 
     # ------------------------------------------------------------- ready
@@ -209,11 +225,25 @@ class RaftPeer:
             wb = self.engine.write_batch()
             if rd.snapshot is not None:
                 region = self.peer_storage.apply_snapshot(wb, rd.snapshot)
+                # a snapshot replaces all region data: stamp the data
+                # version so columnar/copr caches can never serve
+                # pre-snapshot entries
+                self.data_index = max(self.data_index,
+                                      rd.snapshot.metadata.index)
                 self.store.on_region_changed(self, region)
             meta = self.node.storage.snapshot.metadata
             self.peer_storage.persist(wb, rd.entries, rd.hard_state,
                                       truncated=(meta.index, meta.term))
             for entry in rd.committed_entries:
+                if not entry.data and not wb.is_empty() and \
+                        self._pending_read_at(entry.index, entry.term):
+                    # flush the applied prefix so the read barrier's
+                    # engine snapshot includes every earlier entry of
+                    # this same ready batch (apply state rides along so
+                    # a crash here never re-applies admin commands)
+                    self.peer_storage.persist_apply(wb, entry.index - 1)
+                    self.engine.write(wb)
+                    wb = self.engine.write_batch()
                 self._apply_entry(wb, entry)
             if rd.committed_entries:
                 self.peer_storage.persist_apply(
@@ -225,6 +255,12 @@ class RaftPeer:
         return out
 
     # ------------------------------------------------------------- apply
+
+    def _pending_read_at(self, index: int, term: int) -> bool:
+        for p in self.proposals:
+            if p.index >= index:
+                return p.index == index and p.term == term and p.is_read
+        return False
 
     def _take_proposal(self, index: int, term: int) -> Optional[Proposal]:
         while self.proposals and self.proposals[0].index <= index:
@@ -255,6 +291,12 @@ class RaftPeer:
             if cmd.admin is not None:
                 result = self._exec_admin(wb, cmd.admin)
             else:
+                # only actual KV mutations bump the data version —
+                # admin commands (compact_log, change_peer) leave table
+                # data untouched and splits bump epoch.version, so the
+                # columnar cache key (which includes both) stays exact
+                # without spurious invalidation on log GC
+                self.data_index = entry.index
                 result = self._exec_write(wb, cmd)
         if prop is not None:
             prop.cb(result)

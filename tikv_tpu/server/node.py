@@ -20,7 +20,9 @@ from typing import Optional
 import grpc
 
 from ..engine.memory import MemoryEngine
+from ..copr.dag import TableScanDesc
 from ..copr.endpoint import Endpoint
+from ..copr.region_cache import RegionColumnarCache
 from ..copr.storage_impl import MvccScanStorage
 from ..kv.engine import SnapContext
 from ..raftstore import (
@@ -93,7 +95,8 @@ class Node:
     def __init__(self, addr: str, pd: PdClient,
                  engine: Optional[MemoryEngine] = None,
                  store_id: Optional[int] = None,
-                 device_runner=None, tick_interval: float = 0.01):
+                 device_runner=None, device_row_threshold: int = 262144,
+                 tick_interval: float = 0.01):
         self.addr = addr
         self.pd = pd
         self.engine = engine if engine is not None else MemoryEngine()
@@ -111,8 +114,10 @@ class Node:
         self.raft_store.observers = [self._report_region]
         self.raft_kv = RaftKv(self.raft_store, driver=self._wait_driver)
         self.storage = Storage(engine=self.raft_kv)
+        self.copr_cache = RegionColumnarCache()
         self.endpoint = Endpoint(self._copr_snapshot,
-                                 device_runner=device_runner)
+                                 device_runner=device_runner,
+                                 device_row_threshold=device_row_threshold)
 
     # ---------------------------------------------------------- lifecycle
 
@@ -200,10 +205,21 @@ class Node:
 
     def _copr_snapshot(self, req):
         """Coprocessor feed: MVCC over a region snapshot routed by the
-        request's first key range (endpoint.rs snapshot acquisition)."""
+        request's first key range (endpoint.rs snapshot acquisition).
+
+        TableScan plans go through the per-region columnar cache so both
+        the host vectorized path and the device backend see dense tiles
+        with stable identity across requests (copr/region_cache.py);
+        everything else falls back to the row-at-a-time MVCC adapter.
+        """
         start = req.dag.ranges[0].start if req.dag.ranges else b""
         key_hint = encode_first(start)
         snap = self.raft_kv.snapshot(SnapContext(key_hint=key_hint))
+        execs = req.dag.executors
+        if execs and isinstance(execs[0], TableScanDesc):
+            ent = self.copr_cache.get(snap, req.dag)
+            if ent is not None:
+                return ent
         return MvccScanStorage(MvccReader(snap), req.dag.start_ts)
 
     # ---------------------------------------------------------- admin ops
