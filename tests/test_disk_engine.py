@@ -1,0 +1,197 @@
+"""Durable engine: WAL replay, checkpoints, torn-write recovery, and
+full-server kill -9 restart.
+
+Reference shapes: engine_rocks persistence behind the engine_traits seam
+(components/engine_rocks/src/engine.rs), raft-log durability
+(engine_traits/src/raft_engine.rs:84), and the restart-resume contract of
+store/peer_storage.rs (SURVEY.md §5.4: raft log + local states replayed
+on start).
+"""
+
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from tikv_tpu.engine.disk import DiskEngine
+from tikv_tpu.engine.traits import CF_DEFAULT, CF_RAFT, CF_WRITE
+
+
+@pytest.fixture
+def path(tmp_path):
+    return str(tmp_path / "db")
+
+
+def test_reopen_recovers_wal(path):
+    e = DiskEngine(path)
+    wb = e.write_batch()
+    for i in range(100):
+        wb.put_cf(CF_DEFAULT, b"k%03d" % i, b"v%d" % i)
+    wb.put_cf(CF_WRITE, b"w", b"1")
+    wb.put_cf(CF_RAFT, b"r", b"2")
+    e.write(wb)
+    wb2 = e.write_batch()
+    wb2.delete_cf(CF_DEFAULT, b"k050")
+    wb2.delete_range_cf(CF_DEFAULT, b"k090", b"k095")
+    e.write(wb2)
+    # no close(): simulates abrupt process death after OS-level flush
+    e2 = DiskEngine(path)
+    assert e2.get_value_cf(CF_DEFAULT, b"k000") == b"v0"
+    assert e2.get_value_cf(CF_DEFAULT, b"k050") is None
+    assert e2.get_value_cf(CF_DEFAULT, b"k092") is None
+    assert e2.get_value_cf(CF_DEFAULT, b"k095") == b"v95"
+    assert e2.get_value_cf(CF_WRITE, b"w") == b"1"
+    assert e2.get_value_cf(CF_RAFT, b"r") == b"2"
+
+
+def test_torn_wal_tail_recovers_prefix(path):
+    e = DiskEngine(path)
+    for i in range(10):
+        e.put_cf(CF_DEFAULT, b"k%d" % i, b"v%d" % i)
+    wal = e._wal_path(e._gen)
+    size = os.path.getsize(wal)
+    with open(wal, "r+b") as f:        # torn write: last record half-gone
+        f.truncate(size - 7)
+    e2 = DiskEngine(path)
+    for i in range(9):
+        assert e2.get_value_cf(CF_DEFAULT, b"k%d" % i) == b"v%d" % i
+    assert e2.get_value_cf(CF_DEFAULT, b"k9") is None
+    # engine stays writable after truncation; new writes land after
+    # the repaired tail and survive another reopen
+    e2.put_cf(CF_DEFAULT, b"k9", b"again")
+    e3 = DiskEngine(path)
+    assert e3.get_value_cf(CF_DEFAULT, b"k9") == b"again"
+
+
+def test_corrupt_crc_stops_replay(path):
+    e = DiskEngine(path)
+    e.put_cf(CF_DEFAULT, b"a", b"1")
+    e.put_cf(CF_DEFAULT, b"b", b"2")
+    wal = e._wal_path(e._gen)
+    with open(wal, "r+b") as f:        # flip a payload byte of record 2
+        data = f.read()
+        f.seek(len(data) - 1)
+        f.write(bytes([data[-1] ^ 0xFF]))
+    e2 = DiskEngine(path)
+    assert e2.get_value_cf(CF_DEFAULT, b"a") == b"1"
+    assert e2.get_value_cf(CF_DEFAULT, b"b") is None
+
+
+def test_checkpoint_rolls_wal(path):
+    e = DiskEngine(path, checkpoint_bytes=1024)
+    for i in range(200):
+        e.put_cf(CF_DEFAULT, b"key%04d" % i, b"x" * 32)
+    assert e._gen >= 1                  # size-triggered checkpoints fired
+    files = os.listdir(path)
+    assert any(f.startswith("ckpt-") for f in files)
+    assert len([f for f in files if f.startswith("wal-")]) == 1
+    e2 = DiskEngine(path)
+    for i in range(200):
+        assert e2.get_value_cf(CF_DEFAULT, b"key%04d" % i) == b"x" * 32
+
+
+def test_explicit_flush_checkpoint(path):
+    e = DiskEngine(path)
+    e.put_cf(CF_DEFAULT, b"k", b"v")
+    gen0 = e._gen
+    e.flush()
+    assert e._gen == gen0 + 1
+    assert os.path.getsize(e._wal_path(e._gen)) == 0
+    e2 = DiskEngine(path)
+    assert e2.get_value_cf(CF_DEFAULT, b"k") == b"v"
+
+
+def test_snapshot_isolation_on_disk_engine(path):
+    e = DiskEngine(path)
+    e.put_cf(CF_DEFAULT, b"k", b"v1")
+    snap = e.snapshot()
+    e.put_cf(CF_DEFAULT, b"k", b"v2")
+    assert snap.get_value_cf(CF_DEFAULT, b"k") == b"v1"
+    assert e.get_value_cf(CF_DEFAULT, b"k") == b"v2"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_listening(port: int, proc, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"server died: rc={proc.returncode}")
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.2).close()
+            return
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError("server never listened")
+
+
+def test_kill9_restart_data_intact(tmp_path):
+    """The VERDICT r1 #2 'done' criterion: kill -9 a real server process,
+    restart it over the same data dir, and the data is intact (raft
+    state, MVCC records, store identity)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+    data_dir = str(tmp_path / "store1")
+    pd_port, kv_port = _free_port(), _free_port()
+    procs = []
+    try:
+        pd = subprocess.Popen(
+            [sys.executable, "-m", "tikv_tpu.server", "pd",
+             "--addr", f"127.0.0.1:{pd_port}"], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        procs.append(pd)
+        _wait_listening(pd_port, pd)
+
+        def start_tikv():
+            p = subprocess.Popen(
+                [sys.executable, "-m", "tikv_tpu.server", "tikv",
+                 "--addr", f"127.0.0.1:{kv_port}",
+                 "--pd", f"127.0.0.1:{pd_port}",
+                 "--data-dir", data_dir], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            procs.append(p)
+            _wait_listening(kv_port, p)
+            return p
+
+        kv = start_tikv()
+        from tikv_tpu.server import TxnClient
+        c = TxnClient(f"127.0.0.1:{pd_port}")
+        for i in range(20):
+            c.put(b"crash-%02d" % i, b"v%d" % i)
+        store_id_before = c.pd.stores()[0].id
+
+        os.kill(kv.pid, signal.SIGKILL)     # no shutdown hooks at all
+        kv.wait(timeout=10)
+        kv2 = start_tikv()
+        # fresh client (leader cache invalid after restart)
+        c2 = TxnClient(f"127.0.0.1:{pd_port}")
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                assert c2.get(b"crash-00") == b"v0"
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.25)
+        for i in range(20):
+            assert c2.get(b"crash-%02d" % i) == b"v%d" % i
+        # same durable store identity, and still writable
+        assert c2.pd.stores()[0].id == store_id_before
+        c2.put(b"after-crash", b"yes")
+        assert c2.get(b"after-crash") == b"yes"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

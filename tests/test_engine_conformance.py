@@ -14,13 +14,17 @@ from tikv_tpu.engine import (
     MemoryEngine,
     PanicEngine,
 )
+from tikv_tpu.engine.disk import DiskEngine
 
-ENGINES = [MemoryEngine]
 
-
-@pytest.fixture(params=ENGINES)
-def engine(request):
-    return request.param()
+@pytest.fixture(params=["memory", "disk"])
+def engine(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryEngine()
+    else:
+        e = DiskEngine(str(tmp_path / "db"))
+        yield e
+        e.close()
 
 
 def test_point_ops(engine):

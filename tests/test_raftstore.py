@@ -280,6 +280,23 @@ def test_snapshot_catch_up_then_restart():
     assert c.get_on_store(lagger, b"k9") == b"v9"
 
 
+def test_restart_with_many_log_entries():
+    """Regression: load_peers matched any CF_RAFT key ending in b'm' as a
+    region state, but raft_log_key ends with the entry index whose low
+    byte can be 0x6d ('m', index 109...) — restart then crashed decoding
+    a log entry as a region."""
+    c = make_cluster(3)
+    for i in range(120):                # log indexes pass 109
+        c.must_put(b"k%03d" % i, b"v")
+    for sid in list(c.stores):
+        c.stop_store(sid)
+    for sid in (1, 2, 3):
+        c.restart_store(sid)            # crashed before the fix
+    c.tick_all(40)
+    assert c.leader_store(1) is not None
+    assert c.must_get(b"k119") == b"v"
+
+
 def test_uninitialized_shell_peer_cannot_campaign():
     """Regression (ADVICE r1 #3): a shell peer created on first message
     must not treat itself as a voter; otherwise it self-elects in a

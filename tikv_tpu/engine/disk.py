@@ -1,0 +1,269 @@
+"""Durable on-disk engine: WAL + in-memory working set + checkpoints.
+
+Reference roles: components/engine_rocks/src/engine.rs (RocksEngine — the
+persistent KvEngine behind the trait seam, engine_traits/src/engine.rs:13)
+and the raft-log durability contract of engine_traits/src/raft_engine.rs:84.
+The design is RocksDB's memtable+WAL shape with the SST levels collapsed
+to a single full-state checkpoint file (LSM-lite):
+
+- every committed WriteBatch appends one CRC-framed record to the WAL
+  before mutating the in-memory state — crash recovery replays the WAL
+  over the last checkpoint and stops at the first torn/corrupt record;
+- when the WAL exceeds ``checkpoint_bytes`` the engine writes a complete
+  per-CF sorted dump to ``ckpt-<gen+1>.tmp``, fsyncs, atomically renames
+  to ``ckpt-<gen+1>``, starts ``wal-<gen+1>`` and removes older files;
+- reads (point/iterator/snapshot) are identical to MemoryEngine — the
+  working set lives in sorted copy-on-write arrays, so the hot read path
+  (MVCC scans feeding the columnar/TPU pipeline) never touches disk.
+
+Durability level: ``sync=False`` (default) flushes to the OS page cache
+on every write — state survives process kill (SIGKILL) but not machine
+power loss; ``sync=True`` fsyncs every batch like raftstore's sync-log.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Optional
+
+from .memory import MemoryEngine, MemoryWriteBatch
+from .traits import ALL_CFS
+
+_CKPT_MAGIC = b"TKV1CKPT"
+_CKPT_FOOTER = b"CKPTDONE"
+_OP_PUT, _OP_DEL, _OP_DELR = 0, 1, 2
+
+
+def _pack_op(op: tuple, cf_index: dict) -> bytes:
+    kind = op[0]
+    if kind == "put":
+        _, cf, k, v = op
+        return struct.pack(">BBI", _OP_PUT, cf_index[cf], len(k)) + k + \
+            struct.pack(">I", len(v)) + v
+    if kind == "del":
+        _, cf, k = op
+        return struct.pack(">BBI", _OP_DEL, cf_index[cf], len(k)) + k
+    _, cf, s, e = op
+    return struct.pack(">BBI", _OP_DELR, cf_index[cf], len(s)) + s + \
+        struct.pack(">I", len(e)) + e
+
+
+def _unpack_ops(payload: bytes, cfs: tuple) -> list[tuple]:
+    ops = []
+    off = 0
+    n = len(payload)
+    while off < n:
+        kind, cfi, klen = struct.unpack_from(">BBI", payload, off)
+        off += 6
+        k = payload[off:off + klen]
+        off += klen
+        cf = cfs[cfi]
+        if kind == _OP_PUT:
+            (vlen,) = struct.unpack_from(">I", payload, off)
+            off += 4
+            v = payload[off:off + vlen]
+            off += vlen
+            ops.append(("put", cf, k, v))
+        elif kind == _OP_DEL:
+            ops.append(("del", cf, k))
+        else:
+            (elen,) = struct.unpack_from(">I", payload, off)
+            off += 4
+            e = payload[off:off + elen]
+            off += elen
+            ops.append(("delr", cf, k, e))
+    return ops
+
+
+class DiskEngine(MemoryEngine):
+    """KvEngine with WAL + checkpoint durability (see module docstring)."""
+
+    def __init__(self, path: str, cfs=ALL_CFS, sync: bool = False,
+                 checkpoint_bytes: int = 16 << 20):
+        super().__init__(cfs)
+        self.path = path
+        self._cf_names = tuple(cfs)
+        self._cf_index = {cf: i for i, cf in enumerate(self._cf_names)}
+        self._sync = sync
+        self._checkpoint_bytes = checkpoint_bytes
+        os.makedirs(path, exist_ok=True)
+        self._gen = 0
+        self._wal = None
+        self._wal_bytes = 0
+        with self._mu:
+            self._recover()
+
+    # ------------------------------------------------------------ recovery
+
+    def _ckpt_path(self, gen: int) -> str:
+        return os.path.join(self.path, f"ckpt-{gen:012d}")
+
+    def _wal_path(self, gen: int) -> str:
+        return os.path.join(self.path, f"wal-{gen:012d}")
+
+    def _recover(self) -> None:
+        gens = []
+        for name in os.listdir(self.path):
+            if name.startswith("ckpt-") and not name.endswith(".tmp"):
+                try:
+                    gens.append(int(name[5:]))
+                except ValueError:
+                    continue
+        for gen in sorted(gens, reverse=True):
+            if self._load_checkpoint(self._ckpt_path(gen)):
+                self._gen = gen
+                break
+        self._replay_wal(self._wal_path(self._gen))
+        self._open_wal(self._wal_path(self._gen), append=True)
+        # sweep files a crash mid-checkpoint may have left behind
+        for name in os.listdir(self.path):
+            full = os.path.join(self.path, name)
+            stale = name.endswith(".tmp")
+            for prefix in ("ckpt-", "wal-"):
+                if name.startswith(prefix) and not name.endswith(".tmp"):
+                    try:
+                        stale = int(name[len(prefix):]) < self._gen
+                    except ValueError:
+                        pass
+            if stale:
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
+
+    def _load_checkpoint(self, path: str) -> bool:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return False
+        if not (data.startswith(_CKPT_MAGIC) and
+                data.endswith(_CKPT_FOOTER)):
+            return False        # incomplete/corrupt checkpoint: skip
+        body = data[len(_CKPT_MAGIC):-len(_CKPT_FOOTER)]
+        off = 0
+        (n_cfs,) = struct.unpack_from(">B", body, off)
+        off += 1
+        for _ in range(n_cfs):
+            cfi, count = struct.unpack_from(">BQ", body, off)
+            off += 9
+            cf = self._cf_names[cfi]
+            data_cf = self._cfs[cf]
+            keys, vals = [], []
+            for _ in range(count):
+                (klen,) = struct.unpack_from(">I", body, off)
+                off += 4
+                keys.append(body[off:off + klen])
+                off += klen
+                (vlen,) = struct.unpack_from(">I", body, off)
+                off += 4
+                vals.append(body[off:off + vlen])
+                off += vlen
+            data_cf.keys = keys
+            data_cf.vals = vals
+        return True
+
+    def _replay_wal(self, path: str) -> None:
+        try:
+            f = open(path, "rb")
+        except OSError:
+            return
+        with f:
+            good = 0
+            while True:
+                hdr = f.read(8)
+                if len(hdr) < 8:
+                    break
+                length, crc = struct.unpack(">II", hdr)
+                payload = f.read(length)
+                if len(payload) < length or \
+                        (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                    break       # torn/corrupt tail: recovery stops here
+                batch = MemoryWriteBatch()
+                batch._ops = _unpack_ops(payload, self._cf_names)
+                self._write_locked(batch)
+                good = f.tell()
+        # drop the torn tail so later appends don't interleave with it
+        if os.path.exists(path) and good < os.path.getsize(path):
+            with open(path, "r+b") as f:
+                f.truncate(good)
+
+    def _open_wal(self, path: str, append: bool) -> None:
+        self._wal = open(path, "ab" if append else "wb")
+        self._wal_bytes = self._wal.tell()
+
+    # ------------------------------------------------------------ writes
+
+    def write(self, batch: MemoryWriteBatch) -> None:
+        if batch.is_empty():
+            return
+        with self._mu:
+            payload = b"".join(_pack_op(op, self._cf_index)
+                               for op in batch._ops)
+            self._wal.write(struct.pack(
+                ">II", len(payload), zlib.crc32(payload) & 0xFFFFFFFF))
+            self._wal.write(payload)
+            self._wal.flush()
+            if self._sync:
+                os.fsync(self._wal.fileno())
+            self._wal_bytes += 8 + len(payload)
+            self._write_locked(batch)
+            if self._wal_bytes >= self._checkpoint_bytes:
+                self._checkpoint_locked()
+
+    def put_cf(self, cf: str, key: bytes, value: bytes) -> None:
+        wb = MemoryWriteBatch()
+        wb.put_cf(cf, key, value)
+        self.write(wb)
+
+    def delete_cf(self, cf: str, key: bytes) -> None:
+        wb = MemoryWriteBatch()
+        wb.delete_cf(cf, key)
+        self.write(wb)
+
+    # ------------------------------------------------------------ checkpoint
+
+    def flush(self) -> None:
+        """Force a checkpoint (engine_traits MiscExt flush analog)."""
+        with self._mu:
+            self._checkpoint_locked()
+
+    def _checkpoint_locked(self) -> None:
+        new_gen = self._gen + 1
+        tmp = self._ckpt_path(new_gen) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_CKPT_MAGIC)
+            f.write(struct.pack(">B", len(self._cf_names)))
+            for cfi, cf in enumerate(self._cf_names):
+                data = self._cfs[cf]
+                f.write(struct.pack(">BQ", cfi, len(data.keys)))
+                for k, v in zip(data.keys, data.vals):
+                    f.write(struct.pack(">I", len(k)))
+                    f.write(k)
+                    f.write(struct.pack(">I", len(v)))
+                    f.write(v)
+            f.write(_CKPT_FOOTER)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, self._ckpt_path(new_gen))
+        old_wal, old_gen = self._wal, self._gen
+        self._gen = new_gen
+        self._open_wal(self._wal_path(new_gen), append=False)
+        if old_wal is not None:
+            old_wal.close()
+        for p in (self._ckpt_path(old_gen), self._wal_path(old_gen)):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._mu:
+            if self._wal is not None:
+                self._wal.flush()
+                if self._sync:
+                    os.fsync(self._wal.fileno())
+                self._wal.close()
+                self._wal = None

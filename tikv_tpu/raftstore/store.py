@@ -65,9 +65,14 @@ class RaftStore:
             CF_RAFT, REGION_PREFIX,
             REGION_PREFIX[:-1] + bytes([REGION_PREFIX[-1] + 1]))
         regions = []
+        state_key_len = len(REGION_PREFIX) + 8 + 1
         ok = it.seek_to_first()
         while ok:
-            if it.key().endswith(b"m"):
+            k = it.key()
+            # exact region_state_key shape: prefix + region_id(8) + "m".
+            # A suffix check alone is wrong — raft_log_key ends with the
+            # entry index whose low byte can be 0x6d ("m", e.g. index 109)
+            if len(k) == state_key_len and k.endswith(b"m"):
                 regions.append(decode_region(it.value()))
             ok = it.next()
         for region in regions:

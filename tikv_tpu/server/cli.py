@@ -32,6 +32,9 @@ def main(argv=None) -> int:
     kv_p = sub.add_parser("tikv", help="run a tikv store server")
     kv_p.add_argument("--addr", default="127.0.0.1:20160")
     kv_p.add_argument("--pd", required=True)
+    kv_p.add_argument("--data-dir", default=None,
+                      help="durable storage directory (WAL + checkpoints); "
+                           "omit for an in-memory store")
     kv_p.add_argument("--with-device", action="store_true",
                       help="register the TPU device runner on the "
                            "coprocessor endpoint")
@@ -80,7 +83,7 @@ def main(argv=None) -> int:
             from ..device import DeviceRunner
             device_runner = DeviceRunner()
         node = Node(args.addr, RemotePdClient(args.pd),
-                    device_runner=device_runner)
+                    data_dir=args.data_dir, device_runner=device_runner)
         server = TikvServer(node)
         server.start()
         print(f"tikv store {node.store_id} listening on {args.addr}",

@@ -20,6 +20,7 @@ from typing import Optional
 import grpc
 
 from ..engine.memory import MemoryEngine
+from ..engine.traits import CF_RAFT
 from ..copr.dag import TableScanDesc
 from ..copr.endpoint import Endpoint
 from ..copr.region_cache import RegionColumnarCache
@@ -91,22 +92,51 @@ class GrpcTransport(Transport):
         return chan
 
 
+# Reference: components/keys STORE_IDENT_KEY (0x01 0x01) — the store's
+# durable identity, read before talking to PD so a restarted store keeps
+# its id (src/server/node.rs check_store / bootstrap_store).
+STORE_IDENT_KEY = b"\x01ident"
+
+
 class Node:
     def __init__(self, addr: str, pd: PdClient,
                  engine: Optional[MemoryEngine] = None,
                  store_id: Optional[int] = None,
+                 data_dir: Optional[str] = None,
                  device_runner=None, device_row_threshold: int = 262144,
                  tick_interval: float = 0.01):
         self.addr = addr
         self.pd = pd
-        self.engine = engine if engine is not None else MemoryEngine()
+        if engine is not None and data_dir is not None:
+            raise ValueError("pass engine= or data_dir=, not both")
+        if engine is not None:
+            self.engine = engine
+        elif data_dir is not None:
+            from ..engine.disk import DiskEngine
+            self.engine = DiskEngine(data_dir)
+        else:
+            self.engine = MemoryEngine()
         self.lock = threading.RLock()
         self._tick_interval = tick_interval
         self._wake = threading.Condition(self.lock)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+        import struct as _struct
+        ident = self.engine.get_value_cf(CF_RAFT, STORE_IDENT_KEY)
+        if ident is not None:
+            persisted = _struct.unpack(">Q", ident)[0]
+            if store_id is not None and store_id != persisted:
+                # reference: src/server/node.rs check_store — a store id
+                # clashing with the durable ident is a config error, not
+                # something to paper over
+                raise ValueError(
+                    f"store_id {store_id} != persisted ident {persisted}")
+            store_id = persisted
         self.store_id = store_id if store_id is not None else pd.alloc_id()
+        if ident is None:
+            self.engine.put_cf(CF_RAFT, STORE_IDENT_KEY,
+                               _struct.pack(">Q", self.store_id))
         pd.put_store(StoreMeta(self.store_id, addr))
         self.transport = GrpcTransport(pd)
         self.raft_store = RaftStore(self.store_id, self.engine,
