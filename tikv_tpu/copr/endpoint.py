@@ -89,6 +89,9 @@ class Endpoint:
             return req.force_backend
         if self._device_runner is None or not self._device_runner.supports(req.dag):
             return "host"
+        profit = getattr(self._device_runner, "profitable", None)
+        if profit is not None and not profit(req.dag):
+            return "host"
         est = getattr(storage, "estimated_rows", None)
         n = est() if callable(est) else None
         if n is not None and n >= self._device_row_threshold:

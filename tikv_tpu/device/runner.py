@@ -44,6 +44,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..copr.dag import (
     AggregationDesc,
     DAGRequest,
+    IndexScanDesc,
     LimitDesc,
     SelectionDesc,
     TableScanDesc,
@@ -168,6 +169,20 @@ class DeviceRunner:
     def supports(self, dag: DAGRequest) -> bool:
         return self._analyze(dag) is not None
 
+    def profitable(self, dag: DAGRequest) -> bool:
+        """Should auto-routing pick the device for this plan?
+
+        Aggregations and TopN reduce on device (tiny D2H readback) and
+        measure far above the host path; selection-only plans materialize
+        their full output through the host anyway, so the device pass
+        only adds transfer cost — measured slower than the vectorized
+        host path on 10M rows (bench config 2).  force_backend="device"
+        still runs them for parity testing.
+        """
+        plan = self._analyze(dag)
+        return plan is not None and plan.kind in ("simple_agg", "hash_agg",
+                                                  "topn")
+
     def _analyze(self, dag: DAGRequest) -> Optional[_Plan]:
         key = dag.plan_key()
         if key in self._plan_cache:
@@ -178,9 +193,19 @@ class DeviceRunner:
 
     def _analyze_uncached(self, dag: DAGRequest) -> Optional[_Plan]:
         execs = dag.executors
-        if not execs or not isinstance(execs[0], TableScanDesc):
+        # IndexScan heads are device-eligible too: a covering index scan
+        # produces columnar (indexed cols, handle) tiles exactly like a
+        # table scan (BASELINE config 5 — TopN via IndexScan; reference:
+        # index_scan_executor.rs feeds the same BatchExecutor pipeline)
+        if not execs or not isinstance(execs[0],
+                                       (TableScanDesc, IndexScanDesc)):
             return None
         scan = execs[0]
+        if isinstance(scan, IndexScanDesc):
+            n_idx = len(scan.columns) - (
+                1 if scan.columns and scan.columns[-1].is_pk_handle else 0)
+            if n_idx != 1:
+                return None     # multi-column index → host row path
         scan_ets = [c.field_type.eval_type for c in scan.columns]
 
         sel_rpns: list[RpnExpression] = []
@@ -270,8 +295,13 @@ class DeviceRunner:
     def _scan_batch(self, dag: DAGRequest, plan: _Plan, storage) -> ColumnBatch:
         if hasattr(storage, "scan_columns"):
             return storage.scan_columns(plan.scan, dag.ranges)
-        from ..executors.scan import BatchTableScanExecutor
-        ex = BatchTableScanExecutor(storage, plan.scan, dag.ranges)
+        from ..executors.scan import (
+            BatchIndexScanExecutor,
+            BatchTableScanExecutor,
+        )
+        cls = BatchIndexScanExecutor if isinstance(plan.scan, IndexScanDesc) \
+            else BatchTableScanExecutor
+        ex = cls(storage, plan.scan, dag.ranges)
         chunks = []
         while True:
             r = ex.next_batch(1024)

@@ -17,13 +17,14 @@ row-codec path.
 
 from __future__ import annotations
 
+import struct
 from typing import Optional, Sequence
 
 import numpy as np
 
-from ..codec.keys import _RECORD_SEP, _TABLE_PREFIX  # type: ignore
+from ..codec.keys import _RECORD_SEP, _TABLE_PREFIX, index_key_prefix  # type: ignore
 from ..codec.number import decode_i64, encode_i64
-from ..copr.dag import TableScanDesc
+from ..copr.dag import IndexScanDesc, TableScanDesc
 from ..datatype import Column, ColumnBatch, EvalType, FieldType
 from .interface import BatchExecuteResult, TimedExecutor
 from .ranges import KeyRange
@@ -132,9 +133,11 @@ class ColumnarTable:
     def count_rows(self, ranges: Sequence[KeyRange]) -> int:
         return sum(j - i for i, j in self._range_slices(ranges))
 
-    def scan_columns(self, desc: TableScanDesc,
+    def scan_columns(self, desc,
                      ranges: Sequence[KeyRange]) -> ColumnBatch:
         """Vectorized range scan → ColumnBatch in ``desc.columns`` order."""
+        if isinstance(desc, IndexScanDesc):
+            return self._scan_index_columns(desc, ranges)
         slices = self._range_slices(ranges)
         if desc.desc:
             slices = [(i, j) for i, j in reversed(slices)]
@@ -172,6 +175,88 @@ class ColumnarTable:
             v, m = gather(col.values, col.validity)
             out_cols.append(Column(col.eval_type, v, m))
         return ColumnBatch([c.field_type for c in desc.columns], out_cols)
+
+    def _index_sorted(self, col_id: int):
+        """Memoized (value, handle)-sorted view of one indexed column:
+        → (svals, svalid, shandles, n_nulls).  MySQL NULLs sort first."""
+        cache = getattr(self, "_index_order_cache", None)
+        if cache is None:
+            cache = self._index_order_cache = {}
+        got = cache.get(col_id)
+        if got is None:
+            col = self.columns[col_id]
+            nulls = ~col.validity
+            order = np.lexsort((self.handles, col.values, nulls * -1))
+            got = (col.values[order], col.validity[order],
+                   self.handles[order], int(nulls.sum()))
+            cache[col_id] = got
+        return got
+
+    def _index_bound(self, key: bytes, prefix: bytes, svals, shandles,
+                     n_nulls: int) -> int:
+        """Encoded index key → offset into the sorted index view.
+
+        Index keys are ``prefix + mc_datum(value) [+ mc_datum(handle)]``;
+        rows at or after the returned offset have encoded keys >= ``key``.
+        """
+        from ..codec.mc_datum import decode_mc_datum
+        n = len(svals)
+        if key <= prefix:
+            return 0
+        if not key.startswith(prefix):
+            return 0 if key < prefix else n
+        try:
+            v, off = decode_mc_datum(key, len(prefix))
+        except (ValueError, IndexError, struct.error):
+            return n        # e.g. the 0xff… full-range sentinel: past all
+        if v is None:       # NULL datum: the NULLs-first block
+            i0, i1 = 0, n_nulls
+        else:
+            i0 = n_nulls + int(np.searchsorted(svals[n_nulls:], v, "left"))
+            i1 = n_nulls + int(np.searchsorted(svals[n_nulls:], v, "right"))
+        if off < len(key):  # handle datum tie-break within the value run
+            try:
+                h, _ = decode_mc_datum(key, off)
+            except (ValueError, IndexError, struct.error):
+                return i1   # junk after the value datum: past the run
+            return i0 + int(np.searchsorted(shandles[i0:i1], h, "left"))
+        return i0
+
+    def _scan_index_columns(self, desc: IndexScanDesc,
+                            ranges: Sequence[KeyRange]) -> ColumnBatch:
+        """Covering-index scan: indexed column + handle in index order,
+        range- and direction-aware (reference: index_scan_executor.rs).
+        """
+        infos = desc.columns
+        want_handle = bool(infos) and infos[-1].is_pk_handle
+        idx_infos = infos[:-1] if want_handle else infos
+        if len(idx_infos) != 1:
+            raise ValueError("columnar index scan supports single-column "
+                             "indexes; use the row-decode path")
+        info = idx_infos[0]
+        col = self.columns[info.col_id]
+        svals, svalid, shandles, n_nulls = self._index_sorted(info.col_id)
+        prefix = index_key_prefix(self.table.table_id, desc.index_id)
+        slices = []
+        for r in ranges:
+            i = self._index_bound(r.start, prefix, svals, shandles, n_nulls)
+            j = self._index_bound(r.end, prefix, svals, shandles, n_nulls)
+            if i < j:
+                slices.append((i, j))
+        if desc.desc:
+            slices = [(i, j) for i, j in reversed(slices)]
+
+        def gather(a: np.ndarray) -> np.ndarray:
+            parts = [a[i:j][::-1] if desc.desc else a[i:j]
+                     for i, j in slices]
+            return np.concatenate(parts) if parts else a[:0]
+
+        out_cols = [Column(col.eval_type, gather(svals), gather(svalid))]
+        if want_handle:
+            gh = gather(shandles)
+            out_cols.append(Column(EvalType.INT, gh,
+                                   np.ones(len(gh), dtype=np.bool_)))
+        return ColumnBatch([c.field_type for c in infos], out_cols)
 
     # -- row-codec materialization (parity tests only) -----------------------
 

@@ -61,7 +61,12 @@ def build_executors(dag: DAGRequest, storage: ScanStorage) -> BatchExecutor:
         else:
             ex = BatchTableScanExecutor(storage, head, dag.ranges)
     elif isinstance(head, IndexScanDesc):
-        ex = BatchIndexScanExecutor(storage, head, dag.ranges)
+        if hasattr(storage, "scan_columns"):
+            # columnar snapshots serve covering-index scans directly
+            from .columnar import BatchColumnarTableScanExecutor
+            ex = BatchColumnarTableScanExecutor(storage, head, dag.ranges)
+        else:
+            ex = BatchIndexScanExecutor(storage, head, dag.ranges)
     else:
         raise ValueError(f"pipeline must start with a scan, got {head}")
     for d in descs[1:]:
