@@ -315,6 +315,77 @@ def test_uninitialized_shell_peer_cannot_campaign():
     assert shell.node.term == 5             # no self-election term bumps
 
 
+def test_lease_read_no_raft_round_trip():
+    """VERDICT r1 #4: a stable leader serves reads from its lease with
+    NO log barrier — the raft log must not grow."""
+    c = make_cluster(3)
+    c.must_put(b"k", b"v")
+    c.tick_all(4)               # heartbeat acks establish the lease
+    lead = c.leader_store(1)
+    kv = c.kvs[lead]
+    peer = c.stores[lead].region_peer(1)
+    assert peer.node.in_lease()
+    last_index = peer.node.last_index()
+    before = kv.lease_reads
+    snap = kv.snapshot(SnapContext(region_id=1))
+    assert kv.lease_reads == before + 1
+    assert peer.node.last_index() == last_index     # no barrier entry
+    from tikv_tpu.engine.traits import CF_DEFAULT
+    from tikv_tpu.raftstore.peer_storage import data_key
+    assert snap.get_value_cf(CF_DEFAULT, b"k") == b"v"
+    # a follower never serves lease reads
+    follower = next(s for s in c.stores if s != lead)
+    assert c.stores[follower].region_peer(1).local_read() is None
+
+
+def test_stale_lease_after_partition_safety():
+    """Lease safety: at no tick may a partitioned old leader's lease
+    overlap a new leader's existence (stale lease reads would then miss
+    the new leader's committed writes)."""
+    c = make_cluster(3)
+    c.must_put(b"k", b"v1")
+    c.tick_all(4)
+    old_lead = c.leader_store(1)
+    others = [sid for sid in c.stores if sid != old_lead]
+    old_peer = c.stores[old_lead].region_peer(1)
+    assert old_peer.node.in_lease()
+
+    def filt(frm, to, rid, msg):
+        return not ((frm == old_lead and to in others) or
+                    (frm in others and to == old_lead))
+    c.transport.filters.append(filt)
+    overlap = []
+    for _ in range(60):
+        c.tick_all(1)
+        old_lease = old_peer.local_read() is not None
+        new_leader = any(
+            c.stores[sid].region_peer(1).is_leader() for sid in others)
+        if old_lease and new_leader:
+            overlap.append(True)
+    assert not overlap, "stale lease overlapped a new leader"
+    new_lead = c.leader_store(1)
+    assert new_lead in others
+    c.must_put(b"k", b"v2")     # committed on the majority side
+    assert old_peer.local_read() is None    # old lease long dead
+    c.transport.filters.clear()
+    c.tick_all(6)
+    assert c.must_get(b"k") == b"v2"
+
+
+def test_lease_revoked_during_leader_transfer():
+    c = make_cluster(3)
+    c.must_put(b"k", b"v")
+    c.tick_all(4)
+    lead = c.leader_store(1)
+    peer = c.stores[lead].region_peer(1)
+    assert peer.node.in_lease()
+    target = next(s for s in c.stores if s != lead)
+    target_peer_id = c.stores[target].region_peer(1).meta.id
+    peer.node._lead_transferee = target_peer_id     # transfer in flight
+    assert not peer.node.in_lease()
+    assert peer.local_read() is None
+
+
 def test_transfer_leader():
     c = make_cluster(3)
     c.must_put(b"k", b"v")

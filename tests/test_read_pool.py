@@ -1,0 +1,70 @@
+"""Read pool QoS: concurrency cap, busy rejection, priority bypass.
+
+Reference: src/read_pool.rs running-task watermarks + ServerIsBusy.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tikv_tpu.server.read_pool import ReadPool, ServerIsBusy
+
+
+def test_concurrency_cap():
+    pool = ReadPool(max_concurrency=2, max_pending=100)
+    running = []
+    peak = []
+    mu = threading.Lock()
+
+    def task():
+        with mu:
+            running.append(1)
+            peak.append(len(running))
+        time.sleep(0.02)
+        with mu:
+            running.pop()
+        return "ok"
+
+    threads = [threading.Thread(target=lambda: pool.run(task))
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert max(peak) <= 2
+    assert pool.served == 8
+
+
+def test_busy_rejection_and_priority_bypass():
+    pool = ReadPool(max_concurrency=1, max_pending=2)
+    release = threading.Event()
+    started = threading.Event()
+
+    def slow():
+        started.set()
+        release.wait(5)
+        return "slow"
+
+    t = threading.Thread(target=lambda: pool.run(slow))
+    t.start()
+    started.wait(5)
+    # one more fills the pending watermark…
+    t2 = threading.Thread(target=lambda: pool.run(lambda: "q"))
+    t2.start()
+    time.sleep(0.05)
+    # …so the next normal read is rejected
+    with pytest.raises(ServerIsBusy):
+        pool.run(lambda: "rejected")
+    assert pool.rejected == 1
+    # but a high-priority point read is still admitted (queues for a slot)
+    box = {}
+    t3 = threading.Thread(
+        target=lambda: box.__setitem__(
+            "r", pool.run(lambda: "point", priority="high")))
+    t3.start()
+    release.set()
+    t.join(5)
+    t2.join(5)
+    t3.join(5)
+    assert box["r"] == "point"

@@ -217,6 +217,23 @@ def test_split_and_routing_over_network(cluster):
     assert c.get(b"srv-zz") == b"3"
 
 
+def test_lease_reads_and_read_pool_over_network(cluster):
+    """Server tier: repeated gets ride the leader lease (no log barrier
+    per read) and flow through the read pool."""
+    c = cluster["client"]
+    c.put(b"lease-k", b"lv")
+    import time
+    time.sleep(0.3)             # heartbeat acks establish leases
+    before = {s.node.store_id: s.node.raft_kv.lease_reads
+              for s in cluster["servers"]}
+    for _ in range(10):
+        assert c.get(b"lease-k") == b"lv"
+    lease_gain = sum(s.node.raft_kv.lease_reads -
+                     before[s.node.store_id] for s in cluster["servers"])
+    assert lease_gain >= 8, lease_gain
+    assert sum(s.node.read_pool.served for s in cluster["servers"]) > 0
+
+
 def test_store_status(cluster):
     c = cluster["client"]
     st = c.status(cluster["servers"][0].node.store_id)

@@ -101,3 +101,7 @@ class Message:
     reject: bool = False
     reject_hint: int = 0        # follower's last index, speeds backtracking
     snapshot: Optional[Snapshot] = None
+    # lease context: leaders stamp heartbeats with their send tick; the
+    # response echoes it so the lease window is measured from SEND time
+    # (reference: raftstore leader lease, store/peer.rs maybe_renew_lease)
+    ctx: int = 0

@@ -107,6 +107,11 @@ class RawNode:
         self._lead_transferee = 0
         self._prev_hs = HardState(self.term, self.vote, self.commit)
         self._prev_soft = (self.leader_id, self.state)
+        # leader lease (store/worker/read.rs ReadDelegate semantics, in
+        # tick units): heartbeats carry the send tick; acks prove a
+        # quorum heard from us within the lease window
+        self._tick_count = 0
+        self._lease_ack: dict[int, int] = {}
 
     # ------------------------------------------------------------- helpers
 
@@ -167,6 +172,7 @@ class RawNode:
         self.state = LEADER
         self.leader_id = self.id
         self._lead_transferee = 0
+        self._lease_ack = {}
         last = self.last_index()
         self.progress = {
             nid: Progress(match=0, next=last + 1)
@@ -182,6 +188,7 @@ class RawNode:
     # ------------------------------------------------------------- ticking
 
     def tick(self) -> None:
+        self._tick_count += 1
         self._elapsed += 1
         if self.state == LEADER:
             if self._elapsed >= self._heartbeat_tick:
@@ -192,6 +199,30 @@ class RawNode:
                     self.id in self.voters:
                 self._reset_timeout()
                 self.campaign()
+
+    def in_lease(self) -> bool:
+        """Leader lease check for local (no-consensus) reads.
+
+        Safe iff (a) pre-vote is on — a follower with live leader contact
+        rejects pre-votes until its election timer (≥ election_tick
+        ticks) expires, so no rival can be elected while a quorum acked
+        our heartbeats within the last election_tick-2 ticks (measured
+        from heartbeat SEND tick; 2 ticks of margin absorb cross-node
+        tick skew the way the reference subtracts clock drift from
+        max_lease); and (b) no leader transfer is in flight (the target
+        campaigns immediately via TIMEOUT_NOW).
+        """
+        if self.state != LEADER or not self._pre_vote or \
+                self._lead_transferee:
+            return False
+        window = self._election_tick - 2
+        if window <= 0:
+            return False
+        floor = self._tick_count - window
+        live = sum(1 for nid in self.voters
+                   if nid == self.id or
+                   self._lease_ack.get(nid, -1) >= floor)
+        return live >= self._quorum()
 
     def campaign(self, force: bool = False) -> None:
         if self._pre_vote and not force:
@@ -324,7 +355,8 @@ class RawNode:
             if nid == self.id:
                 continue
             self._send(Message(MsgType.HEARTBEAT, to=nid, term=self.term,
-                               commit=min(pr.match, self.commit)))
+                               commit=min(pr.match, self.commit),
+                               ctx=self._tick_count))
 
     def _maybe_commit(self) -> bool:
         matches = sorted((pr.match for nid, pr in self.progress.items()
@@ -469,7 +501,8 @@ class RawNode:
         if m.commit > self.commit:
             self.commit = min(m.commit, self.last_index())
         self._send(Message(MsgType.HEARTBEAT_RESPONSE, to=m.frm,
-                           term=self.term, index=self.last_index()))
+                           term=self.term, index=self.last_index(),
+                           ctx=m.ctx))
 
     def _handle_snapshot(self, m: Message) -> None:
         self.leader_id = m.frm
@@ -532,6 +565,9 @@ class RawNode:
         pr = self.progress.get(m.frm)
         if pr is None:
             return
+        if m.ctx:
+            prev = self._lease_ack.get(m.frm, 0)
+            self._lease_ack[m.frm] = max(prev, m.ctx)
         pr.paused = False
         if pr.match < self.last_index():
             self._send_append(m.frm)

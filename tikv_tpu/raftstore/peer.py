@@ -196,6 +196,23 @@ class RaftPeer:
         self.proposals.append(Proposal(index, self.node.term, cb))
         return index
 
+    def local_read(self) -> Optional[RegionSnapshot]:
+        """Lease-based local read: serve an engine snapshot with NO raft
+        round-trip when the leader lease is valid and this leader has
+        applied into its own term (reference: store/worker/read.rs
+        LocalReader + ReadDelegate — applied_term == term guarantees all
+        writes acked by previous leaders are in the applied state; writes
+        acked by THIS leader were applied before their ack fired)."""
+        node = self.node
+        if not self.is_leader() or not node.in_lease():
+            return None
+        if node.storage.term(node.applied) != node.term:
+            return None     # fresh leader: noop not applied yet
+        snap = RegionSnapshot(self.engine.snapshot(), self.region)
+        snap.data_index = self.data_index
+        snap.apply_index = node.applied
+        return snap
+
     def propose_read(self, cb: Callable) -> int:
         """Read barrier through the log (see module docstring)."""
         if not self.is_leader():

@@ -20,9 +20,15 @@ from .store import RaftStore
 
 class RaftKv:
     def __init__(self, store: RaftStore,
-                 driver: Optional[Callable[[Callable[[], bool]], None]] = None):
+                 driver: Optional[Callable[[Callable[[], bool]], None]] = None,
+                 lock=None):
         self.store = store
         self._driver = driver if driver is not None else self._local_drive
+        # serializes lease reads against the apply loop so the engine
+        # snapshot and its data_index stamp are taken atomically
+        self._lock = lock
+        self.lease_reads = 0
+        self.barrier_reads = 0
 
     def _local_drive(self, done: Callable[[], bool]) -> None:
         for _ in range(10000):
@@ -43,6 +49,16 @@ class RaftKv:
 
     def snapshot(self, ctx: SnapContext):
         peer = self._route(ctx)
+        # lease fast path (LocalReader): no proposal, no log barrier
+        if self._lock is not None:
+            with self._lock:
+                snap = peer.local_read()
+        else:
+            snap = peer.local_read()
+        if snap is not None:
+            self.lease_reads += 1
+            return snap
+        self.barrier_reads += 1
         box: dict = {}
         peer.propose_read(lambda r: box.__setitem__("result", r))
         self._wait(box)

@@ -142,8 +142,11 @@ class Node:
         self.raft_store = RaftStore(self.store_id, self.engine,
                                     self.transport)
         self.raft_store.observers = [self._report_region]
-        self.raft_kv = RaftKv(self.raft_store, driver=self._wait_driver)
+        self.raft_kv = RaftKv(self.raft_store, driver=self._wait_driver,
+                              lock=self.lock)
         self.storage = Storage(engine=self.raft_kv)
+        from .read_pool import ReadPool
+        self.read_pool = ReadPool()
         self.copr_cache = RegionColumnarCache()
         self.endpoint = Endpoint(self._copr_snapshot,
                                  device_runner=device_runner,

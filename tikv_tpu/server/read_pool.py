@@ -1,0 +1,55 @@
+"""Read pool — admission control + concurrency cap for read requests.
+
+Reference: src/read_pool.rs (unified yatp read pool with priority and
+running-task watermarks, :28-90) and the ServerIsBusy rejection the
+scheduler/read path returns under overload.  gRPC already supplies the
+worker threads, so the pool's job here is QoS: cap how many reads run
+at once (so scans/coprocessor requests cannot starve the write path's
+lock acquisition) and reject instead of queueing unboundedly once the
+pending watermark trips — the reference's running-threshold behavior.
+
+Priorities: ``high`` (point reads) bypasses the pending watermark the
+way the reference's priority scheduling keeps small reads flowing while
+big scans queue.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ServerIsBusy(Exception):
+    def __init__(self, reason: str = "read pool saturated"):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class ReadPool:
+    def __init__(self, max_concurrency: int = 8, max_pending: int = 64):
+        self._slots = threading.Semaphore(max_concurrency)
+        self._mu = threading.Lock()
+        self._max_pending = max_pending
+        self._pending = 0
+        self.served = 0
+        self.rejected = 0
+
+    def run(self, fn, priority: str = "normal"):
+        """Execute ``fn`` under the pool's concurrency cap.
+
+        Raises ServerIsBusy when the pending watermark is exceeded
+        (normal priority only — high-priority point reads always admit).
+        """
+        with self._mu:
+            if priority != "high" and self._pending >= self._max_pending:
+                self.rejected += 1
+                raise ServerIsBusy(
+                    f"{self._pending} reads pending (max {self._max_pending})")
+            self._pending += 1
+        try:
+            with self._slots:
+                with self._mu:
+                    self.served += 1
+                return fn()
+        finally:
+            with self._mu:
+                self._pending -= 1

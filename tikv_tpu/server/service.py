@@ -24,6 +24,16 @@ from ..storage.txn_types import encode_key
 from . import wire
 
 
+# read RPCs dispatched through the read pool (src/read_pool.rs: both
+# Storage reads and coprocessor share the unified pool); point reads get
+# high priority so scans can't starve them
+_READ_METHODS = {
+    "KvGet": "high", "KvBatchGet": "high", "KvScan": "normal",
+    "RawGet": "high", "RawBatchGet": "high", "RawScan": "normal",
+    "Coprocessor": "normal",
+}
+
+
 class KvService:
     """All RPC handlers over one node's Storage + raftstore."""
 
@@ -31,6 +41,7 @@ class KvService:
         self.node = node
         self.storage: Storage = node.storage
         self.endpoint: Endpoint = node.endpoint
+        self.read_pool = node.read_pool
 
     # ---------------------------------------------------------- helpers
 
@@ -44,6 +55,10 @@ class KvService:
         fn = getattr(self, method, None)
         if fn is None:
             return {"error": {"kind": "unimplemented", "method": method}}
+        prio = _READ_METHODS.get(method)
+        if prio is not None:
+            return self._guard(
+                lambda r: self.read_pool.run(lambda: fn(r), prio), req)
         return self._guard(fn, req)
 
     # ---------------------------------------------------------- txn KV

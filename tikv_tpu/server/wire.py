@@ -63,7 +63,7 @@ def enc_raft_msg(m: Message) -> dict:
     out = {"t": m.msg_type.value, "to": m.to, "frm": m.frm,
            "term": m.term, "lt": m.log_term, "i": m.index,
            "c": m.commit, "rej": m.reject, "hint": m.reject_hint,
-           "e": [encode_entry(e) for e in m.entries]}
+           "ctx": m.ctx, "e": [encode_entry(e) for e in m.entries]}
     if m.snapshot is not None:
         meta = m.snapshot.metadata
         out["snap"] = {"i": meta.index, "t": meta.term,
@@ -82,7 +82,7 @@ def dec_raft_msg(d: dict) -> Message:
                    term=d["term"], log_term=d["lt"], index=d["i"],
                    entries=tuple(decode_entry(e) for e in d["e"]),
                    commit=d["c"], reject=d["rej"], reject_hint=d["hint"],
-                   snapshot=snap)
+                   ctx=d.get("ctx", 0), snapshot=snap)
 
 
 # -- errors (kvrpcpb errorpb analog: stable identities over the wire) --
@@ -118,6 +118,9 @@ def enc_error(e: Exception) -> dict:
     if isinstance(e, EpochNotMatch):
         return {"kind": "epoch_not_match",
                 "current": enc_region(e.current)}
+    from .read_pool import ServerIsBusy
+    if isinstance(e, ServerIsBusy):
+        return {"kind": "server_is_busy", "reason": e.reason}
     return {"kind": "other", "message": str(e)}
 
 
