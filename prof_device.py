@@ -1,0 +1,55 @@
+"""Round-3 profiling: where does config-4 time go? (throwaway)"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bench import build_table, _dag_hash_agg, _dag_simple_agg
+from tikv_tpu.device import DeviceRunner
+
+N = 100 * (1 << 20)
+runner = DeviceRunner()
+table, snap = build_table(N, 1024)
+dag = _dag_hash_agg(table)
+
+# warm: compile + feed cache
+t0 = time.perf_counter()
+r = runner.handle_request(dag, snap)
+print("cold e2e:", time.perf_counter() - t0)
+
+for i in range(3):
+    t0 = time.perf_counter()
+    r = runner.handle_request(dag, snap)
+    print("warm e2e:", time.perf_counter() - t0)
+
+# dispatch overhead: trivial jit roundtrip
+f = jax.jit(lambda x: x + 1)
+x = jnp.zeros((8,), jnp.int32)
+f(x).block_until_ready()
+for i in range(3):
+    t0 = time.perf_counter()
+    f(x).block_until_ready()
+    print("trivial jit roundtrip:", time.perf_counter() - t0)
+
+# async dispatch cost (no readback)
+t0 = time.perf_counter()
+ys = [f(x) for _ in range(12)]
+print("12 async dispatches (enqueue):", time.perf_counter() - t0)
+ys[-1].block_until_ready()
+print("12 async dispatches (complete):", time.perf_counter() - t0)
+
+# device-resident compute: time the 12 chunk kernel calls directly
+plan = runner._analyze(dag)
+meta_key = (dag.plan_key(), dag.ranges)
+meta = runner._request_meta(snap, meta_key)
+print("meta keys:", meta.keys())
+
+# big matmul sanity: what's achievable
+a = jnp.ones((1 << 16, 128), jnp.int8)
+b = jnp.ones((128, 1152), jnp.int8)
+g = jax.jit(lambda a, b: jax.lax.dot_general(
+    a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32))
+g(a, b).block_until_ready()
+t0 = time.perf_counter()
+g(a, b).block_until_ready()
+print("onehot-shaped matmul (65536x128x1152 int8):", time.perf_counter() - t0)

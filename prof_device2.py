@@ -1,0 +1,74 @@
+"""Round-3 profiling pt2: separate compute from readback RTT. (throwaway)"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bench import build_table, _dag_hash_agg
+from tikv_tpu.device import DeviceRunner
+
+N = 100 * (1 << 20)
+runner = DeviceRunner()
+table, snap = build_table(N, 1024)
+dag = _dag_hash_agg(table)
+r = runner.handle_request(dag, snap)   # warm compile + feed cache
+
+# Reproduce the inner loop manually to time compute vs readback.
+plan = runner._analyze(dag)
+meta = runner._request_meta(snap, (dag.plan_key(), dag.ranges))
+base, span, arg_nbytes = meta["hash_bounds"]
+dtypes = meta["dtypes"]
+n = snap.num_rows if hasattr(snap, "num_rows") else len(snap.handles)
+print("rows:", n)
+
+from tikv_tpu.device.kernels import build_layouts, matmul_supported
+from tikv_tpu.datatype import EvalType
+capacity = max(1024, 1 << (span - 1).bit_length())
+slots = capacity + 2
+arg_is_real = [rr is not None and rr.ret_type is EvalType.REAL
+               for rr in plan.agg_rpns]
+layouts, p8, pf = build_layouts(plan.specs, arg_is_real, arg_nbytes)
+carry0 = runner._put_carry((
+    (np.zeros((p8, slots), np.int64),
+     np.zeros((max(pf, 1), slots), np.float64),
+     np.zeros((), np.int64)),
+    []))
+key = ("hashmm", dag.plan_key(), tuple(dtypes), capacity,
+       arg_nbytes, runner._chunk_size_for(n))
+kern = runner._kernel_cache[key]
+base_arr = jnp.asarray(base, jnp.int64)
+
+feed_key = (tuple(plan.scan.columns[ci].col_id for ci in plan.used_cols),
+            tuple(dtypes), dag.ranges, runner._chunk_size_for(n))
+chunks = list(runner._chunks(lambda: None, n, snap, feed_key))
+print("n chunks:", len(chunks))
+
+# compute only: enqueue all, block on last carry leaf
+for trial in range(3):
+    carry = carry0
+    t0 = time.perf_counter()
+    for _, flat in chunks:
+        carry = kern(carry, base_arr, *flat)
+    (S8, Sf, ovf), _ = carry
+    S8.block_until_ready()
+    print("12-chunk compute+1sync:", time.perf_counter() - t0)
+
+# readback only (carry already materialized)
+t0 = time.perf_counter()
+out = runner._readback(carry)
+print("runner._readback:", time.perf_counter() - t0)
+
+t0 = time.perf_counter()
+got = jax.device_get(((S8, Sf, ovf), _))
+print("single device_get of carry:", time.perf_counter() - t0)
+
+# amortized per-chunk compute: 5 passes over all chunks
+carry = carry0
+t0 = time.perf_counter()
+for it in range(5):
+    for _, flat in chunks:
+        carry = kern(carry, base_arr, *flat)
+carry[0][0].block_until_ready()
+dt = time.perf_counter() - t0
+print("5x12-chunk compute+1sync:", dt, "-> per-pass:", dt / 5)

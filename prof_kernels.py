@@ -1,0 +1,154 @@
+"""Round-3 kernel variant shootout, carry-chained (defeats result memoization).
+
+Times L chained calls (carry folds) + one sync; per-call = (total-RTT)/L.
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+print("devices:", jax.devices())
+
+N = 1 << 23            # one chunk
+G = 1024
+SLOTS = G + 2
+GPAD = ((SLOTS + 127) // 128) * 128     # 1152
+RTT = 0.107
+
+rng = np.random.default_rng(0)
+idx = jnp.asarray(rng.integers(0, G, N).astype(np.int32))
+v = jnp.asarray(rng.integers(-1000, 1000, N).astype(np.int32))
+mask = jnp.asarray(np.ones(N, np.bool_))
+
+def timeit(name, fn, carry0, iters=12):
+    c = fn(carry0, idx, v, mask)
+    jax.block_until_ready(c)
+    t0 = time.perf_counter()
+    c = carry0
+    for _ in range(iters):
+        c = fn(c, idx, v, mask)
+    jax.block_until_ready(c)
+    dt = time.perf_counter() - t0
+    per = max(dt - RTT, 1e-9) / iters
+    print(f"{name:48s} {per*1e3:8.2f} ms/chunk -> {N/per/1e6:7.0f} M rows/s")
+    return per
+
+def planes_int8(v, mask):
+    biased = (v.astype(jnp.int32) + (1 << 15)).astype(jnp.uint32)
+    b0 = ((biased) & 0xFF).astype(jnp.int32) - 128
+    b1 = ((biased >> 8) & 0xFF).astype(jnp.int32) - 128
+    return jnp.stack([mask.astype(jnp.int8), mask.astype(jnp.int8),
+                      jnp.where(mask, b0, 0).astype(jnp.int8),
+                      jnp.where(mask, b1, 0).astype(jnp.int8)])
+
+# ---- int8 one-hot matmul, int64 carry per block (current prod) ----
+def make_int8(block, accum32=False):
+    nblk = N // block
+    iota = jnp.arange(GPAD, dtype=jnp.int32)
+    def f(c, idx, v, mask):
+        L8 = planes_int8(v, mask)
+        idx_b = idx.reshape(nblk, block)
+        l8_b = L8.reshape(4, nblk, block).transpose(1, 0, 2)
+        def body(cc, xs):
+            i_b, l8 = xs
+            onehot = (i_b[:, None] == iota[None, :]).astype(jnp.int8)
+            prod = lax.dot_general(l8, onehot, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+            return cc + (prod if accum32 else prod.astype(jnp.int64)), None
+        cc, _ = lax.scan(body, jnp.zeros_like(c), (idx_b, l8_b))
+        return c + cc.astype(c.dtype)
+    return jax.jit(f)
+
+for blk in (1 << 13, 1 << 14, 1 << 16):
+    timeit(f"int8 matmul i64-blockwiden block={blk}",
+           make_int8(blk), jnp.zeros((4, GPAD), jnp.int64))
+for blk in (1 << 13, 1 << 14, 1 << 16):
+    timeit(f"int8 matmul i32-chunkaccum block={blk}",
+           make_int8(blk, accum32=True), jnp.zeros((4, GPAD), jnp.int32))
+
+# ---- one whole-chunk matmul (XLA K-tiling) ----
+def whole(c, idx, v, mask):
+    iota = jnp.arange(GPAD, dtype=jnp.int32)
+    L8 = planes_int8(v, mask)
+    onehot = (idx[:, None] == iota[None, :]).astype(jnp.int8)
+    return c + lax.dot_general(L8, onehot, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+timeit("int8 ONE matmul whole chunk i32", jax.jit(whole),
+       jnp.zeros((4, GPAD), jnp.int32))
+
+# ---- f32 path ----
+def make_f32(block):
+    nblk = N // block
+    iota = jnp.arange(GPAD, dtype=jnp.int32)
+    def f(c, idx, v, mask):
+        vf = jnp.where(mask, v, 0).astype(jnp.float32)
+        Lf = jnp.stack([mask.astype(jnp.float32), vf])
+        idx_b = idx.reshape(nblk, block)
+        lf_b = Lf.reshape(2, nblk, block).transpose(1, 0, 2)
+        def body(cc, xs):
+            i_b, lf = xs
+            onehot = (i_b[:, None] == iota[None, :]).astype(jnp.float32)
+            prod = lax.dot_general(lf, onehot, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+            return cc + prod.astype(jnp.float64), None
+        cc, _ = lax.scan(body, jnp.zeros((2, GPAD), jnp.float64),
+                         (idx_b, lf_b))
+        return c + cc
+    return jax.jit(f)
+for blk in (1 << 12, 1 << 13):
+    timeit(f"f32 matmul f64-blockwiden block={blk}",
+           make_f32(blk), jnp.zeros((2, GPAD), jnp.float64))
+
+# ---- bf16 one-hot, int value bytes as bf16 planes ----
+def make_bf16(block):
+    nblk = N // block
+    iota = jnp.arange(GPAD, dtype=jnp.int32)
+    def f(c, idx, v, mask):
+        biased = (v.astype(jnp.int32) + (1 << 15)).astype(jnp.uint32)
+        b0 = ((biased) & 0xFF).astype(jnp.int32) - 128
+        b1 = ((biased >> 8) & 0xFF).astype(jnp.int32) - 128
+        L = jnp.stack([mask.astype(jnp.bfloat16),
+                       jnp.where(mask, b0, 0).astype(jnp.bfloat16),
+                       jnp.where(mask, b1, 0).astype(jnp.bfloat16)])
+        idx_b = idx.reshape(nblk, block)
+        l_b = L.reshape(3, nblk, block).transpose(1, 0, 2)
+        def body(cc, xs):
+            i_b, l = xs
+            onehot = (i_b[:, None] == iota[None, :]).astype(jnp.bfloat16)
+            prod = lax.dot_general(l, onehot, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+            return cc + prod.astype(jnp.float64), None
+        cc, _ = lax.scan(body, jnp.zeros((3, GPAD), jnp.float64),
+                         (idx_b, l_b))
+        return c + cc
+    return jax.jit(f)
+for blk in (1 << 13, 1 << 14):
+    timeit(f"bf16 matmul f64-blockwiden block={blk}",
+           make_bf16(blk), jnp.zeros((3, GPAD), jnp.float64))
+
+# ---- components ----
+def onehot_only(c, idx, v, mask):
+    iota = jnp.arange(GPAD, dtype=jnp.int32)
+    nblk = N // (1 << 14)
+    idx_b = idx.reshape(nblk, 1 << 14)
+    def body(cc, i_b):
+        onehot = (i_b[:, None] == iota[None, :]).astype(jnp.int8)
+        return cc + onehot.sum(axis=0, dtype=jnp.int32), None
+    cc, _ = lax.scan(body, jnp.zeros((GPAD,), jnp.int32), idx_b)
+    return c + cc
+timeit("onehot gen + rowsum only", jax.jit(onehot_only),
+       jnp.zeros((GPAD,), jnp.int32))
+
+def bw(c, idx, v, mask):
+    return c + jnp.where(mask, v, 0).sum(dtype=jnp.int64) + \
+        idx.astype(jnp.int64).sum()
+timeit("elementwise pass (bandwidth floor)", jax.jit(bw),
+       jnp.zeros((), jnp.int64))
+
+def srt(c, idx, v, mask):
+    o = jnp.argsort(idx + c.astype(jnp.int32))
+    return c + o[0].astype(jnp.int64)
+timeit("argsort (sort-path lower bound)", jax.jit(srt),
+       jnp.zeros((), jnp.int64))

@@ -1,0 +1,186 @@
+"""Round-3: pallas two-level groupby layout variants."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_enable_x64", True)
+
+N = 1 << 23
+C = 1024
+HI, LO = 32, 32
+P = 4
+BLK = 1 << 15
+SUB = BLK // 128           # 256
+NBLK = N // BLK            # 256
+SUPER = 64
+NSUP = NBLK // SUPER
+
+rng = np.random.default_rng(0)
+idx_np = rng.integers(0, C, N).astype(np.int32)
+v_np = rng.integers(-1000, 1000, N).astype(np.int32)
+idx = jnp.asarray(idx_np)
+v = jnp.asarray(v_np)
+mask = jnp.asarray(np.ones(N, np.bool_))
+
+def timeit(name, fn, carry0, iters=12, rtt=0.107):
+    c = fn(carry0, jnp.asarray(0, jnp.int32), idx, v, mask)
+    jax.block_until_ready(c)
+    t0 = time.perf_counter()
+    cc = carry0
+    for i in range(iters):
+        cc = fn(cc, jnp.asarray(i + 1, jnp.int32), idx, v, mask)
+    jax.block_until_ready(cc)
+    per = max(time.perf_counter() - t0 - rtt, 1e-9) / iters
+    print(f"{name:44s} {per*1e3:8.2f} ms/chunk -> {N/per/1e6:7.0f} M rows/s")
+    return c
+
+def check(c, iters=1):
+    S = np.asarray(c)           # (HI, P*LO)
+    cnt = np.zeros(HI * LO, np.int64); sm = np.zeros(HI * LO, np.int64)
+    for h in range(HI):
+        for l in range(LO):
+            slot = h * LO + l
+            ok = S[h, 1 * LO + l]
+            cnt[slot] = S[h, 0 * LO + l]
+            sm[slot] = (S[h, 2 * LO + l] + 128 * ok) + \
+                256 * (S[h, 3 * LO + l] + 128 * ok) - (1 << 15) * ok
+    want_cnt = np.bincount(idx_np, minlength=HI * LO).astype(np.int64)
+    want_sm = np.zeros(HI * LO, np.int64)
+    np.add.at(want_sm, idx_np, v_np.astype(np.int64))
+    print("   count exact:", np.array_equal(cnt[:C], want_cnt[:C] * iters),
+          " sum exact:", np.array_equal(sm[:C], want_sm[:C] * iters))
+
+def body_2d(idxb, vb, mb):
+    """idxb/vb (BLK,) i32, mb (BLK,) bool -> (HI, P*LO) i32 partial."""
+    hi = idxb // LO
+    lo = idxb - hi * LO
+    icol = lax.broadcasted_iota(jnp.int32, (BLK, HI), 1)
+    A = (hi[:, None] == icol).astype(jnp.int8)
+    lcol = lax.broadcasted_iota(jnp.int32, (BLK, LO), 1)
+    Blo = lo[:, None] == lcol
+    m8 = mb.astype(jnp.int8)
+    biased = (vb + (1 << 15)).astype(jnp.uint32)
+    b0 = (((biased) & 0xFF).astype(jnp.int32) - 128).astype(jnp.int8)
+    b1 = (((biased >> 8) & 0xFF).astype(jnp.int32) - 128).astype(jnp.int8)
+    zero = jnp.zeros((BLK, LO), jnp.int8)
+    W = jnp.concatenate([
+        jnp.where(Blo, m8[:, None], zero),
+        jnp.where(Blo, m8[:, None], zero),
+        jnp.where(Blo, jnp.where(mb, b0, 0)[:, None], zero),
+        jnp.where(Blo, jnp.where(mb, b1, 0)[:, None], zero)], axis=1)
+    return lax.dot_general(A, W, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.int32)
+
+# ---- variant A: (1, SUB, 128) blocks, reshape to (BLK,) in kernel ----
+def kernel_a(idx_ref, v_ref, mask_ref, out_ref, acc):
+    s = pl.program_id(1)
+    @pl.when(s == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+    idxb = idx_ref[0].reshape(BLK)
+    vb = v_ref[0].reshape(BLK)
+    mb = mask_ref[0].reshape(BLK)
+    acc[:] += body_2d(idxb, vb, mb)
+    @pl.when(s == SUPER - 1)
+    def _():
+        out_ref[0] = acc[:]
+
+def run_a(c, salt, idx, v, mask):
+    v = v + salt
+    i3 = idx.reshape(NBLK, SUB, 128)
+    v3 = v.reshape(NBLK, SUB, 128)
+    m3 = mask.reshape(NBLK, SUB, 128)
+    parts = pl.pallas_call(
+        kernel_a,
+        grid=(NSUP, SUPER),
+        in_specs=[pl.BlockSpec((1, SUB, 128), lambda i, s: (i * SUPER + s, 0, 0),
+                               memory_space=pltpu.VMEM)] * 3,
+        out_specs=pl.BlockSpec((1, HI, P * LO), lambda i, s: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((NSUP, HI, P * LO), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((HI, P * LO), jnp.int32)],
+    )(i3, v3, m3)
+    return c + parts.sum(axis=0, dtype=jnp.int64)
+
+c0 = jnp.zeros((HI, P * LO), jnp.int64)
+try:
+    c = timeit("A: reshape(BLK,) 2D onehots", jax.jit(run_a), c0)
+    check(c)
+except Exception as e:
+    print("A FAILED:", type(e).__name__, str(e)[:300])
+
+# ---- variant B: keep (SUB,128) tiles, 3D one-hot, 2-dim contraction ----
+def kernel_b(idx_ref, v_ref, mask_ref, out_ref, acc):
+    s = pl.program_id(1)
+    @pl.when(s == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+    idxb = idx_ref[0]          # (SUB, 128)
+    vb = v_ref[0]
+    mb = mask_ref[0]
+    hi = idxb // LO
+    lo = idxb - hi * LO
+    icol = lax.broadcasted_iota(jnp.int32, (SUB, 128, HI), 2)
+    A = (hi[:, :, None] == icol).astype(jnp.int8)
+    lcol = lax.broadcasted_iota(jnp.int32, (SUB, 128, LO), 2)
+    Blo = lo[:, :, None] == lcol
+    m8 = mb.astype(jnp.int8)
+    biased = (vb + (1 << 15)).astype(jnp.uint32)
+    b0 = (((biased) & 0xFF).astype(jnp.int32) - 128).astype(jnp.int8)
+    b1 = (((biased >> 8) & 0xFF).astype(jnp.int32) - 128).astype(jnp.int8)
+    zero = jnp.zeros((SUB, 128, LO), jnp.int8)
+    W = jnp.concatenate([
+        jnp.where(Blo, m8[:, :, None], zero),
+        jnp.where(Blo, m8[:, :, None], zero),
+        jnp.where(Blo, jnp.where(mb, b0, 0)[:, :, None], zero),
+        jnp.where(Blo, jnp.where(mb, b1, 0)[:, :, None], zero)], axis=2)
+    acc[:] += lax.dot_general(A, W, (((0, 1), (0, 1)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    @pl.when(s == SUPER - 1)
+    def _():
+        out_ref[0] = acc[:]
+
+def run_b(c, salt, idx, v, mask):
+    v = v + salt
+    i3 = idx.reshape(NBLK, SUB, 128)
+    v3 = v.reshape(NBLK, SUB, 128)
+    m3 = mask.reshape(NBLK, SUB, 128)
+    parts = pl.pallas_call(
+        kernel_b,
+        grid=(NSUP, SUPER),
+        in_specs=[pl.BlockSpec((1, SUB, 128), lambda i, s: (i * SUPER + s, 0, 0),
+                               memory_space=pltpu.VMEM)] * 3,
+        out_specs=pl.BlockSpec((1, HI, P * LO), lambda i, s: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((NSUP, HI, P * LO), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((HI, P * LO), jnp.int32)],
+    )(i3, v3, m3)
+    return c + parts.sum(axis=0, dtype=jnp.int64)
+
+try:
+    c = timeit("B: 3D onehot 2-dim contraction", jax.jit(run_b), c0)
+    check(c)
+except Exception as e:
+    print("B FAILED:", type(e).__name__, str(e)[:300])
+
+# ---- variant C: XLA two-level (no pallas) for comparison ----
+def run_c(c, salt, idx, v, mask):
+    v = v + salt
+    nblk = N // BLK
+    def step(cc, xs):
+        i_b, v_b, m_b = xs
+        return cc + body_2d(i_b, v_b, m_b).astype(jnp.int64), None
+    cc, _ = lax.scan(step, jnp.zeros((HI, P * LO), jnp.int64),
+                     (idx.reshape(nblk, BLK), v.reshape(nblk, BLK),
+                      mask.reshape(nblk, BLK)))
+    return c + cc
+
+try:
+    c = timeit("C: XLA two-level scan", jax.jit(run_c), c0)
+    check(c)
+except Exception as e:
+    print("C FAILED:", type(e).__name__, str(e)[:300])

@@ -1,0 +1,163 @@
+"""Profile production hash megakernel + topn variants. (throwaway)"""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+from bench import build_table, _dag_hash_agg
+from tikv_tpu.device import DeviceRunner
+
+N = 100 * (1 << 20)
+runner = DeviceRunner()
+table, snap = build_table(N, 1024)
+dag = _dag_hash_agg(table)
+r = runner.handle_request(dag, snap)
+print("kernel keys:", [k[0] for k in runner._kernel_cache])
+
+meta = runner._request_meta(snap, (dag.plan_key(), dag.ranges))
+base, span, arg_nbytes = meta["hash_bounds"]
+dtypes = meta["dtypes"]
+plan = runner._analyze(dag)
+feed_key = (tuple(plan.scan.columns[ci].col_id for ci in plan.used_cols),
+            tuple(dtypes), dag.ranges)
+feed = runner._feed_cache[snap][feed_key]
+print("n_pad", feed["n_pad"], "null_flags", feed["null_flags"],
+      "flat", len(feed["flat"]))
+
+(key,) = [k for k in runner._kernel_cache if k[0] == "hash2l"]
+kern = runner._kernel_cache[key]
+print("chunk:", key[4] if len(key) > 4 else key)
+
+from tikv_tpu.device.kernels import build_layouts, twolevel_dims
+from tikv_tpu.datatype import EvalType
+arg_is_real = [rr is not None and rr.ret_type is EvalType.REAL
+               for rr in plan.agg_rpns]
+layouts, p8, pf = build_layouts(plan.specs, arg_is_real, arg_nbytes)
+capacity = 1024
+slots = capacity + 2
+LO, HI = twolevel_dims(slots, p8, pf)
+print("p8", p8, "pf", pf, "LO", LO, "HI", HI)
+
+def carry0():
+    return runner._put_carry((
+        (np.zeros((HI, p8 * LO), np.int64),
+         np.zeros((HI, max(pf, 1) * LO), np.float64),
+         np.zeros((), np.int64)), []))
+
+def slope(fn, c0_fn, args_fn, n_lo=3, n_hi=12, label=""):
+    c = c0_fn()
+    c = fn(c, *args_fn(0))
+    jax.block_until_ready(c)
+    def run(iters, salt0):
+        c = c0_fn()
+        t0 = time.perf_counter()
+        for i in range(iters):
+            c = fn(c, *args_fn(salt0 + i))
+        leaves = jax.tree.leaves(c)
+        for x in leaves:
+            try: x.copy_to_host_async()
+            except Exception: pass
+        [np.asarray(x) for x in leaves]
+        return time.perf_counter() - t0
+    t_lo = run(n_lo, 100)
+    t_hi = run(n_hi, 200)
+    per = (t_hi - t_lo) / (n_hi - n_lo)
+    print(f"{label:40s} {per*1e3:8.2f} ms/pass({N/1e6:.0f}M rows) "
+          f"lo={t_lo:.3f}s hi={t_hi:.3f}s")
+    return per
+
+# production kernel; salt via n scalar? n must stay == N; salt via base...
+# base must stay == real base for correctness; perturb by re-putting one
+# flat array? expensive. Instead vary base by 0..k (keys shift slots but
+# kernel runs the same work; overflow counted but we ignore result).
+nn = jnp.asarray(N, jnp.int64)
+slope(kern, carry0,
+      lambda s: (nn, jnp.asarray(base - (s % 7), jnp.int64)) + feed["flat"],
+      label="production hash2l megakernel")
+
+# variant: same feed, leaner body: i32 slot + i32 rowmask iota
+flat = feed["flat"]
+kcol, vcol = flat[0], flat[1]
+n_pad = feed["n_pad"]
+
+def make_lean(block):
+    nblk = n_pad // block
+    def f(c, n_scalar, aux, k, v):
+        S8c, ovfc = c
+        ks = k.reshape(nblk, block)
+        vs = v.reshape(nblk, block)
+        steps = jnp.arange(nblk, dtype=jnp.int32)
+        iota = jnp.arange(block, dtype=jnp.int32)
+        n32 = n_scalar.astype(jnp.int32)
+        aux32 = aux.astype(jnp.int32)
+        hi_iota = lax.broadcasted_iota(jnp.int32, (block, HI), 1)
+        lo_iota = lax.broadcasted_iota(jnp.int32, (block, LO), 1)
+        def step(cc, xs):
+            s8, ovf = cc
+            s_i, kb, vb = xs
+            row_mask = (s_i * block + iota) < n32
+            idx = kb - aux32
+            in_range = (idx >= 0) & (idx < capacity)
+            idx = jnp.where(row_mask & in_range, idx, capacity + 1)
+            ovf = ovf + jnp.sum(row_mask & ~in_range, dtype=jnp.int32)
+            hi = idx // LO
+            lo = idx - hi * LO
+            A8 = (hi[:, None] == hi_iota).astype(jnp.int8)
+            OL = lo[:, None] == lo_iota
+            m8 = row_mask.astype(jnp.int8)
+            biased = (vb + (1 << 15)).astype(jnp.uint32)
+            b0 = (((biased) & 0xFF).astype(jnp.int32) - 128).astype(jnp.int8)
+            b1 = (((biased >> 8) & 0xFF).astype(jnp.int32) - 128).astype(jnp.int8)
+            zero = jnp.zeros((block, LO), jnp.int8)
+            W8 = jnp.concatenate([
+                jnp.where(OL, m8[:, None], zero),
+                jnp.where(OL, m8[:, None], zero),
+                jnp.where(OL, jnp.where(row_mask, b0, 0)[:, None], zero),
+                jnp.where(OL, jnp.where(row_mask, b1, 0)[:, None], zero)],
+                axis=1)
+            prod = lax.dot_general(A8, W8, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+            return (s8 + prod.astype(jnp.int64), ovf), None
+        cc, _ = lax.scan(step, (S8c, ovfc), (steps, ks, vs))
+        return cc
+    return jax.jit(f)
+
+for blk in (1 << 15, 1 << 16, 1 << 17):
+    lean = make_lean(blk)
+    slope(lean,
+          lambda: (jnp.zeros((HI, 4 * LO), jnp.int64),
+                   jnp.zeros((), jnp.int32)),
+          lambda s: (nn, jnp.asarray(base - (s % 7), jnp.int64), kcol, vcol),
+          label=f"lean i32 body block={blk}")
+
+# ---- topn variants over the feed's value col (f32) ----
+vf = feed["flat"][1].astype(jnp.float32)   # value col as f32 on device
+vd = feed["flat"][1].astype(jnp.float64)
+
+def topn_single_f64(c, salt, v):
+    kv, ki = lax.top_k(v + salt.astype(jnp.float64), 1000)
+    return (c[0] + kv[:8].sum(), c[1] + ki[:8].astype(jnp.int64).sum())
+def topn_single_f32(c, salt, v):
+    kv, ki = lax.top_k(v + salt.astype(jnp.float32), 1000)
+    return (c[0] + kv[:8].sum().astype(jnp.float64),
+            c[1] + ki[:8].astype(jnp.int64).sum())
+def topn_sortable_i32(c, salt, v):
+    f = v + salt.astype(jnp.float32)
+    i = jax.lax.bitcast_convert_type(f, jnp.int32)
+    i = jnp.where(i < 0, jnp.bitwise_not(i), i | jnp.int32(-2147483648))
+    kv, ki = lax.top_k(i, 1000)
+    return (c[0] + kv[:8].astype(jnp.float64).sum(),
+            c[1] + ki[:8].astype(jnp.int64).sum())
+
+slope(jax.jit(topn_single_f64),
+      lambda: (jnp.zeros((), jnp.float64), jnp.zeros((), jnp.int64)),
+      lambda s: (jnp.asarray(s, jnp.int32), vd),
+      label="topn single top_k f64 100M")
+slope(jax.jit(topn_single_f32),
+      lambda: (jnp.zeros((), jnp.float64), jnp.zeros((), jnp.int64)),
+      lambda s: (jnp.asarray(s, jnp.int32), vf),
+      label="topn single top_k f32 100M")
+slope(jax.jit(topn_sortable_i32),
+      lambda: (jnp.zeros((), jnp.float64), jnp.zeros((), jnp.int64)),
+      lambda s: (jnp.asarray(s, jnp.int32), vf),
+      label="topn single top_k sortable-i32 100M")

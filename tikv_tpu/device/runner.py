@@ -3,34 +3,41 @@
 This is the north-star slice (SURVEY.md §7, BASELINE.md): the CPU
 ``BatchExecutor`` hot loop (tidb_query_executors/src/runner.rs:641 —
 scan → selection → aggregation per 1024-row batch) becomes ONE fused XLA
-computation per plan over million-row chunks:
+computation per plan over the whole HBM-resident feed:
 
 - rows are sharded over the ("range", "tile") mesh (parallel/mesh.py) —
   TiKV's region/bucket sharding mapped to mesh axes;
-- RpnExpression evaluation (expr/eval.py) traces into the same jit as the
-  filter mask and the aggregate kernels, so XLA fuses selection into the
-  aggregation's HBM pass;
-- group-by COUNT/SUM runs on the MXU as one-hot matmuls with exact int8
-  byte-split arithmetic (device/kernels.py) — XLA's scatter lowering on
-  TPU is ~10× slower on the same shapes;
-- aggregation state is a device-resident *carry* folded across row chunks;
-  psum-mergeable fields (count/sum/nonnull — TiKV's partial aggregate
-  states, tidb_query_aggr) merge with ``lax.psum`` over both mesh axes,
-  order-fields (min/max/first-pos) stay per-shard and reduce on host;
-- ONE packed device→host transfer ends the request (through a tunneled
-  TPU every D2H sync costs ~0.1s; per-chunk readbacks are ruinous).
+- the feed is a set of flat padded column arrays cached in HBM across
+  requests (the region-cache-engine analog); row-validity for non-NULL
+  columns and the ragged tail is synthesized on device from an iota
+  compare, so it never crosses PCIe or burns HBM;
+- each request is ONE dispatch: a ``lax.scan`` over row blocks folds the
+  aggregation carry on device (RpnExpression evaluation, the filter
+  mask, and the aggregate kernels all trace into the same jit, so XLA
+  fuses selection into the aggregation's HBM pass);
+- group-by COUNT/SUM runs on the MXU as a *factorized* one-hot matmul
+  (slot = hi·LO+lo, kernels.twolevel_partial) with exact int8 byte-split
+  arithmetic — ~8× the straight one-hot matmul, which itself is ~10×
+  XLA's scatter lowering on TPU;
+- cross-shard merging happens ONCE after the scan (psum for the
+  count/sum/nonnull fields — TiKV's psum-mergeable partial aggregate
+  states, tidb_query_aggr; per-shard stacks reduced on host for
+  min/max/first);
+- the result returns in ONE packed uint8 buffer with the D2H transfer
+  started asynchronously (through a tunneled TPU every blocking sync
+  costs ~0.1s; r2's per-array readback spent 3+ RTTs per request).
 
 On a 1-device mesh kernels compile as plain jit (no shard_map, no
 NamedSharding transfers — both measurably degrade the tunneled session's
-dispatch path).  Host decode never appears on this path: the scan feed is
-a columnar snapshot (executors/columnar.py), cached in HBM across requests
-(the region-cache-engine analog).  Small requests stay on the host numpy
-path (copr/endpoint.py routing) so p99 latency never pays device dispatch.
+dispatch path). Host decode never appears on this path: the scan feed is
+a columnar snapshot (executors/columnar.py). Small requests stay on the
+host numpy path (copr/endpoint.py routing) so p99 latency never pays
+device dispatch.
 """
 
 from __future__ import annotations
 
-import functools
+import math
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -60,13 +67,17 @@ from ..ops.agg import (
     finalize_hash,
     finalize_simple,
     hash_agg_tile,
-    merge_hash_states,
-    merge_simple_states,
     simple_agg_tile,
 )
 from ..parallel import ROW_AXES, make_mesh, num_shards, row_sharding
 
 _BIG = np.iinfo(np.int64).max
+
+# scan-block granularity per kernel kind (rows per lax.scan step; the
+# feed pads to a multiple of _FEED_UNIT per shard so any of these divide)
+_FEED_BLOCK = 1 << 15
+_CHUNK_AGG = 1 << 20
+_CHUNK_TOPN = 1 << 23
 
 
 class _FallbackToHost(Exception):
@@ -134,7 +145,7 @@ class DeviceRunner:
     alternate execution backend (coprocessor_plugin_api/src/lib.rs:5-43).
     """
 
-    def __init__(self, mesh=None, chunk_rows: int = 1 << 23,
+    def __init__(self, mesh=None, chunk_rows: Optional[int] = None,
                  max_hash_capacity: int = 1 << 20,
                  max_topn_limit: int = 1 << 14):
         # int64 accumulators are required for exact SUM/COUNT over 1e8
@@ -143,7 +154,6 @@ class DeviceRunner:
         # importing the package has no process-global side effect.)
         jax.config.update("jax_enable_x64", True)
         self._mesh = mesh if mesh is not None else make_mesh()
-        self._chunk_rows = chunk_rows
         self._max_hash_capacity = max_hash_capacity
         self._max_topn_limit = max_topn_limit
         self._row_sharding = row_sharding(self._mesh)
@@ -153,6 +163,16 @@ class DeviceRunner:
         # measurably degrade the tunneled-TPU session's dispatch path, and
         # a 1-device mesh gains nothing from them.
         self._single = num_shards(self._mesh) == 1
+        # scan-block granularity (rows per shard per lax.scan step); the
+        # chunk_rows override shrinks it so tests drive multi-step scans
+        # on tiny fixtures
+        S = num_shards(self._mesh)
+        if chunk_rows is None:
+            self._block_local = _FEED_BLOCK
+            self._chunk_override = False
+        else:
+            self._block_local = max(8, ((max(chunk_rows, 8) // S) // 8) * 8)
+            self._chunk_override = True
         self._plan_cache: dict = {}
         self._kernel_cache: dict = {}
         # HBM-resident feed cache — the TPU-native analog of TiKV's
@@ -312,21 +332,74 @@ class DeviceRunner:
         return ColumnBatch.concat(chunks) if chunks \
             else ColumnBatch.empty(plan.scan.schema)
 
-    # --------------------------------------------------------------- kernels
+    # ------------------------------------------------------------- feed (v2)
 
-    def _chunk_size_for(self, n: int) -> int:
-        from .kernels import BLOCK_ROWS
-        S = num_shards(self._mesh)
-        unit = S * 8
-        if n >= self._chunk_rows:
-            # a chunk must split evenly across shards (device_put over the
-            # row axis) and each shard's slice must divide into full scan
-            # blocks, or matmul_groupby degrades to tiny gcd-sized blocks
-            if self._chunk_rows >= S * BLOCK_ROWS:
-                unit = S * BLOCK_ROWS
-            return ((self._chunk_rows + unit - 1) // unit) * unit
-        target = max(unit, _next_pow2(max(n, 1)))
-        return ((target + unit - 1) // unit) * unit
+    def _nshards(self) -> int:
+        return 1 if self._single else num_shards(self._mesh)
+
+    def _feed_unit(self) -> int:
+        return self._nshards() * self._block_local
+
+    def _pad_rows(self, n: int) -> int:
+        unit = self._feed_unit()
+        return max(unit, ((n + unit - 1) // unit) * unit)
+
+    def _pick_chunk(self, n_pad: int, desired: int) -> int:
+        """Largest scan-block size ≤ desired that divides the padded feed
+        and splits evenly over shards."""
+        unit = self._feed_unit()
+        if self._chunk_override:
+            desired = unit
+        desired = max(unit, (desired // unit) * unit)
+        return math.gcd(n_pad, desired)
+
+    def _build_flat(self, host_cols, n: int) -> dict:
+        """→ {"flat": device arrays, "null_flags": per-col bool, "n_pad"}.
+
+        One flat padded array per column value; a validity array only for
+        columns that actually contain NULLs — all-valid columns reuse the
+        on-device row mask (synthesized from iota < n), saving the HBM
+        footprint and H2D bandwidth of an all-true mask.
+        """
+        n_pad = self._pad_rows(n)
+        flat, flags = [], []
+
+        def put_padded(arr, dtype):
+            if self._single:
+                d = jnp.asarray(arr)
+                if n_pad > n:
+                    d = jnp.concatenate(
+                        [d, jnp.zeros(n_pad - n, dtype=d.dtype)])
+                return d
+            p = np.zeros(n_pad, dtype=dtype)
+            p[:n] = arr
+            return jax.device_put(p, self._row_sharding)
+
+        for v, ok in host_cols:
+            flat.append(put_padded(v, v.dtype))
+            has_nulls = not bool(ok.all())
+            flags.append(has_nulls)
+            if has_nulls:
+                flat.append(put_padded(ok, np.bool_))
+        return {"flat": tuple(flat), "null_flags": tuple(flags),
+                "n_pad": n_pad}
+
+    def _get_feed(self, storage, feed_key, host_cols, n: int) -> dict:
+        cache = None
+        if storage is not None and feed_key is not None and \
+                hasattr(storage, "scan_columns"):
+            try:
+                cache = self._feed_cache.setdefault(storage, {})
+            except TypeError:       # not weak-referenceable
+                cache = None
+        if cache is not None and feed_key in cache:
+            return cache[feed_key]
+        feed = self._build_flat(host_cols(), n)
+        if cache is not None:
+            cache[feed_key] = feed
+        return feed
+
+    # --------------------------------------------------------------- kernels
 
     def _shard_kernel(self, cache_key, build):
         kern = self._kernel_cache.get(cache_key)
@@ -352,27 +425,14 @@ class DeviceRunner:
     def _psum(self, x):
         return x if self._single else lax.psum(x, ROW_AXES)
 
-    def _put(self, arr):
-        return jnp.asarray(arr) if self._single \
-            else jax.device_put(arr, self._row_sharding)
-
-    def _wrap(self, body, n_row_args, out_specs):
-        """jit the kernel body; on a multi-device mesh, as shard_map with
-        rows split over both axes and one replicated scalar arg."""
-        if self._single:
-            return jax.jit(body)
-        return jax.jit(jax.shard_map(
-            body, mesh=self._mesh,
-            in_specs=(P(),) + (P(ROW_AXES),) * n_row_args,
-            out_specs=out_specs))
-
     # -- cross-shard merges --
     #
     # The TPU runtime here lowers only Sum all-reduce (observed: the axon
     # AOT compiler rejects pmin/pmax), so the dominant state fields
-    # (count/sum/nonnull — every config in BASELINE.md) merge with psum on
-    # ICI, while order-sensitive fields (min/max/first-pos) come back
-    # per-shard — a (n_shards, slots) stack, KBs — and reduce on host.
+    # (count/sum/nonnull — every config in BASELINE.md) merge with one
+    # post-scan psum on ICI, while order-sensitive fields (min/max/
+    # first-pos) come back per-shard — a (n_shards, slots) stack, KBs —
+    # and reduce on host.
 
     @staticmethod
     def _merge_stacked(specs, summed_states, stacked_states) -> list:
@@ -394,11 +454,6 @@ class DeviceRunner:
                     d["pos"] = np.min(pos, axis=0)
             out.append(d)
         return out
-
-    # Kernels are *carry-style*: the aggregation state lives on device and
-    # each chunk call folds new rows in; a single packed device→host
-    # transfer at the end returns the final state.  (Per-chunk readbacks
-    # are ruinous through a tunneled TPU: each D2H sync costs ~0.1s.)
 
     def _canon_state(self, s: dict) -> dict:
         """Cast state leaves to carry dtypes (int64 / float64)."""
@@ -427,11 +482,11 @@ class DeviceRunner:
         return d
 
     def _split_new_state(self, s: dict):
-        """→ (summed fields psum-merged, per-shard stacked fields [1, ...])."""
+        """→ (summed fields, per-shard stacked fields shaped [1, ...])."""
         summed, stacked = {}, {}
         for k, v in s.items():
             if k in ("count", "sum", "nonnull"):
-                summed[k] = self._psum(v)
+                summed[k] = v
             else:
                 stacked[k] = v[None] if getattr(v, "ndim", 0) else \
                     jnp.reshape(v, (1,))
@@ -444,23 +499,82 @@ class DeviceRunner:
         return (jax.tree.map(lambda _: P(), summedlike),
                 jax.tree.map(lambda _: P(ROW_AXES), stackedlike))
 
-    def _wrap_carry(self, body, carry_example, n_row_args):
-        """jit a carry-style kernel body(carry, scalar, *rows) -> carry."""
+    # -- the single-dispatch scan wrapper --
+    #
+    # Every request is ONE jit call: body(carry, aux, base, *cols, row_mask)
+    # folds one scan block; lax.scan drives it across the whole feed; the
+    # finalize hook (cross-shard psum of the summed subtree) runs once
+    # after the scan.  r2 dispatched one jit per 2^23-row chunk — enqueues
+    # are cheap but the per-chunk carries defeated XLA's scheduling and
+    # every extra sync through the tunnel costs ~0.1s.
+
+    def _mega(self, body, finalize, null_flags, n_pad: int, chunk: int,
+              emits: bool = False):
+        S = self._nshards()
+        n_local_total = n_pad // S
+        chunk_local = chunk // S
+        nblk = n_pad // chunk
+
+        def local_fn(carry, n_scalar, aux, *flat):
+            if not self._single:
+                # the replicated summed subtree becomes device-varying as
+                # soon as local rows fold in; the scan carry type must be
+                # varying from step 0
+                summed0, stacked0 = carry
+                carry = (jax.tree.map(lambda x: lax.pvary(x, ROW_AXES),
+                                      summed0), stacked0)
+            base0 = self._shard_index() * n_local_total
+            xs = tuple(a.reshape(nblk, chunk_local) for a in flat)
+            steps = jnp.arange(nblk, dtype=jnp.int64)
+            # the ragged-tail mask comes from an iota compare (int32 when
+            # rows fit — int64 is pair-emulated on TPU), so it costs no
+            # HBM reads
+            idt = jnp.int32 if n_pad <= np.iinfo(np.int32).max else jnp.int64
+            iota = jnp.arange(chunk_local, dtype=idt)
+
+            def step(c, x):
+                s_i = x[0]
+                cols = x[1:]
+                base = base0 + s_i * chunk_local
+                row_mask = (base.astype(idt) + iota) < n_scalar.astype(idt)
+                args = []
+                fi = 0
+                for has_nulls in null_flags:
+                    v = cols[fi]
+                    fi += 1
+                    if has_nulls:
+                        m = cols[fi]
+                        fi += 1
+                    else:
+                        m = row_mask
+                    args.append(v)
+                    args.append(m)
+                out = body(c, aux, base, *args, row_mask)
+                if emits:
+                    return out
+                return out, None
+
+            carry, ys = lax.scan(step, carry, (steps,) + xs)
+            carry = finalize(carry)
+            return (carry, ys) if emits else carry
+
+        return local_fn
+
+    def _wrap_mega(self, local_fn, carry_example, n_flat: int,
+                   ys_specs=None):
         if self._single:
-            return jax.jit(body)
+            return jax.jit(local_fn)
         cs = self._carry_specs(carry_example)
+        out_specs = (cs, ys_specs) if ys_specs is not None else cs
         return jax.jit(jax.shard_map(
-            body, mesh=self._mesh,
-            in_specs=(cs, P()) + (P(ROW_AXES),) * n_row_args,
-            out_specs=cs))
+            local_fn, mesh=self._mesh,
+            in_specs=(cs, P(), P()) + (P(ROW_AXES),) * n_flat,
+            out_specs=out_specs))
 
     # -- carry initialization (host → device once per request) --
 
-    def _nshards(self) -> int:
-        return 1 if self._single else num_shards(self._mesh)
-
     def _put_carry(self, carry):
-        """Place an (summed, stacked) carry pytree built from numpy."""
+        """Place a (summed, stacked) carry pytree built from numpy."""
         if self._single:
             return jax.tree.map(jnp.asarray, carry)
         summed, stacked = carry
@@ -505,12 +619,19 @@ class DeviceRunner:
             stacked.append(st)
         return summed, stacked
 
-    # -- kernel builders --
+    def _finalize_psum_summed(self):
+        """Post-scan cross-shard merge: psum every summed leaf."""
+        def fin(carry):
+            summed, stacked = carry
+            return jax.tree.map(self._psum, summed), stacked
+        return fin
 
-    def _build_simple_kernel(self, plan: _Plan, n_cols: int):
+    # -- kernel bodies --
+
+    def _build_simple_body(self, plan: _Plan, n_cols: int):
         specs = plan.specs
 
-        def body(carry, chunk_base, *flat):
+        def body(carry, aux, base, *flat):
             summed_c, stacked_c = carry
             row_mask = flat[-1]
             pairs = [(flat[2 * i], flat[2 * i + 1]) for i in range(n_cols)]
@@ -525,14 +646,13 @@ class DeviceRunner:
                     cols.append((v, ok & mask))
             n_valid = jnp.sum(mask, dtype="int64")
             states = simple_agg_tile(jnp, specs, cols, n_valid_rows=n_valid)
-            offset = chunk_base + self._shard_index() * n_local
             out_sm, out_st = [], []
             for spec, s, cs, cst in zip(specs, states, summed_c, stacked_c):
                 s = self._canon_state(s)
                 if spec.kind == "first":
                     # globalize positions; host picks the cross-shard argmin
                     s["pos"] = jnp.where(s["pos"] == _BIG, _BIG,
-                                         s["pos"] + offset)
+                                         s["pos"] + base)
                 sm, st = self._split_new_state(s)
                 out_sm.append(self._merge_summed(cs, sm))
                 out_st.append(self._merge_stacked_dict(cst, st)
@@ -541,11 +661,11 @@ class DeviceRunner:
 
         return body
 
-    def _build_hash_scatter_kernel(self, plan: _Plan, n_cols: int,
-                                   capacity: int):
+    def _build_hash_scatter_body(self, plan: _Plan, n_cols: int,
+                                 capacity: int):
         specs = plan.specs
 
-        def body(carry, base, *flat):
+        def body(carry, aux, base, *flat):
             (summed_c, present_c, overflow_c), stacked_c = carry
             row_mask = flat[-1]
             pairs = [(flat[2 * i], flat[2 * i + 1]) for i in range(n_cols)]
@@ -558,11 +678,10 @@ class DeviceRunner:
                     cols.append((jnp.zeros((n_local,), jnp.int32), mask))
                 else:
                     cols.append(eval_rpn(r, pairs, n_local, jnp))
-            st = hash_agg_tile(jnp, specs, key_pair, cols, capacity, base,
+            st = hash_agg_tile(jnp, specs, key_pair, cols, capacity, aux,
                                row_mask=mask)
-            present = present_c + self._psum(st["present"].astype(jnp.int64))
-            overflow = overflow_c + \
-                self._psum(st["overflow"].astype(jnp.int64))
+            present = present_c + st["present"].astype(jnp.int64)
+            overflow = overflow_c + st["overflow"].astype(jnp.int64)
             out_sm, out_st = [], []
             for spec, s, cs, cst in zip(specs, st["states"], summed_c,
                                         stacked_c):
@@ -574,12 +693,13 @@ class DeviceRunner:
 
         return body
 
-    def _build_hash_matmul_kernel(self, plan: _Plan, n_cols: int,
-                                  capacity: int, layouts):
-        from .kernels import make_planes, matmul_groupby, slot_index
+    def _build_hash_twolevel_body(self, plan: _Plan, n_cols: int,
+                                  capacity: int, layouts, LO: int, HI: int,
+                                  pf: int):
+        from .kernels import make_planes, slot_index, twolevel_partial
         specs = plan.specs
 
-        def body(carry, base, *flat):
+        def body(carry, aux, base, *flat):
             (S8_c, Sf_c, ovf_c), _unused = carry
             row_mask = flat[-1]
             pairs = [(flat[2 * i], flat[2 * i + 1]) for i in range(n_cols)]
@@ -592,92 +712,132 @@ class DeviceRunner:
                     cols.append((jnp.zeros((n_local,), jnp.int32), mask))
                 else:
                     cols.append(eval_rpn(r, pairs, n_local, jnp))
-            idx, overflow = slot_index(key_pair, capacity, base, mask)
+            idx, overflow = slot_index(key_pair, capacity, aux, mask)
             L8, Lf = make_planes(layouts, specs, cols, mask)
-            S8, Sf = matmul_groupby(
-                idx, L8, Lf, capacity + 2,
-                vary_axes=() if self._single else ROW_AXES)
-            S8_c = S8_c + self._psum(S8)
-            if Sf is not None:
-                Sf_c = Sf_c + self._psum(Sf)
-            ovf_c = ovf_c + self._psum(overflow.astype(jnp.int64))
+            S2_8, S2_f = twolevel_partial(idx, L8, Lf, LO, HI)
+            S8_c = S8_c + S2_8.astype(jnp.int64)
+            if S2_f is not None:
+                Sf_c = Sf_c + S2_f.astype(jnp.float64)
+            ovf_c = ovf_c + overflow.astype(jnp.int64)
             return (S8_c, Sf_c, ovf_c), _unused
 
         return body
 
-    def _build_mask_kernel(self, plan: _Plan, n_cols: int):
-        def fn(*flat):
+    def _build_mask_body(self, plan: _Plan, n_cols: int):
+        def body(carry, aux, base, *flat):
             row_mask = flat[-1]
             pairs = [(flat[2 * i], flat[2 * i + 1]) for i in range(n_cols)]
-            return self._eval_masked(plan, pairs, row_mask.shape[0], row_mask)
-        return jax.jit(fn)
+            return carry, self._eval_masked(plan, pairs,
+                                            row_mask.shape[0], row_mask)
+        return body
 
-    def _build_topn_kernel(self, plan: _Plan, n_cols: int, k: int):
+    def _topn_sort_key(self, plan: _Plan, v, ok, mask):
+        """Map the order expression to one descending-top_k sort key.
+
+        ``top_k(key2)`` must rank: real rows in requested order, then
+        NULL rows per MySQL (first for ASC, last for DESC), then
+        masked-out rows never. Keys stay in the narrowest exact dtype —
+        f32 for REAL (the device column resolution), int32 for int32 INT
+        (top_k on pair-emulated int64/f64 measures 1.5-4× slower) — and
+        any boundary ambiguity is repaired by the exact host refine over
+        the candidate set.
+        """
         desc = plan.order_desc
-
-        def shard_fn(chunk_base, *flat):
-            row_mask = flat[-1]
-            pairs = [(flat[2 * i], flat[2 * i + 1]) for i in range(n_cols)]
-            n_local = row_mask.shape[0]
-            mask = self._eval_masked(plan, pairs, n_local, row_mask)
-            v, ok = eval_rpn(plan.order_rpn, pairs, n_local, jnp)
+        if v.dtype == jnp.float32:
+            key2 = v if desc else -v
+            null_key = jnp.float32(-3e38) if desc else jnp.float32(np.inf)
+            excl = jnp.float32(-np.inf)
+        elif v.dtype == jnp.int32:
+            lo = np.iinfo(np.int32)
+            vv = jnp.maximum(v, lo.min + 2)
+            key2 = vv if desc else -vv
+            null_key = jnp.int32(lo.min + 1) if desc else jnp.int32(lo.max)
+            excl = jnp.int32(lo.min)
+        else:
             keyf = jnp.asarray(v, jnp.float64)
-            keyf = jnp.where(ok, keyf, _NULL_KEY)           # NULL below all
-            excluded = _EXCLUDED_DESC if desc else _EXCLUDED_ASC
-            keyf = jnp.where(mask, keyf, excluded)
-            kk = min(k, n_local)
-            if desc:
-                topv, idx = lax.top_k(keyf, kk)
+            key2 = keyf if desc else -keyf
+            null_key = jnp.float64(_NULL_KEY) if desc \
+                else jnp.float64(-_NULL_KEY)
+            excl = jnp.float64(_EXCLUDED_DESC)
+        key2 = jnp.where(ok, key2, null_key)
+        return jnp.where(mask, key2, excl)
+
+    def _build_topn_kernel(self, plan: _Plan, n_cols: int, k: int,
+                           null_flags, n_pad: int, n_flat: int):
+        """Whole-feed two-stage top-k — ONE dispatch, no scan.
+
+        ``lax.top_k`` over one flat 100M-row array costs 340-530ms on v5e
+        and degrades further inside lax.scan; batched over segment rows it
+        runs ~3× faster. Stage 1 takes the per-segment top k over a
+        (nseg, seglen) view (any global top-k row is in its segment's
+        top k), stage 2 reduces the nseg·k candidates to k.
+        """
+        S = self._nshards()
+        n_local = n_pad // S
+        seglen = math.gcd(n_local, 1 << 17)
+        nseg = n_local // seglen
+        kk = min(k, seglen)
+
+        idt = jnp.int32 if n_pad <= np.iinfo(np.int32).max else jnp.int64
+
+        def local_fn(n_scalar, *flat):
+            if self._single:
+                base0 = idt(0)
             else:
-                topv, idx = lax.top_k(-keyf, kk)
-            offset = chunk_base + self._shard_index() * n_local
-            gidx = idx.astype(jnp.int64) + offset
-            return gidx, mask[idx], ok[idx]
+                base0 = (self._shard_index() * n_local).astype(idt)
+            iota = jnp.arange(n_local, dtype=idt)
+            row_mask = (base0 + iota) < n_scalar.astype(idt)
+            args = []
+            fi = 0
+            for has_nulls in null_flags:
+                vv = flat[fi]
+                fi += 1
+                if has_nulls:
+                    m = flat[fi]
+                    fi += 1
+                else:
+                    m = row_mask
+                args.append((vv, m))
+            mask = self._eval_masked(plan, args, n_local, row_mask)
+            v, ok = eval_rpn(plan.order_rpn, args, n_local, jnp)
+            v = jnp.broadcast_to(v, (n_local,))
+            ok = jnp.broadcast_to(ok & mask, (n_local,))
+            key2 = self._topn_sort_key(plan, v, ok, mask)
+            kv1, ki1 = lax.top_k(key2.reshape(nseg, seglen), kk)
+            seg_base = (jnp.arange(nseg, dtype=idt) * seglen)[:, None]
+            gidx1 = (base0 + seg_base + ki1.astype(idt)).astype(jnp.int64)
+            _, sel = lax.top_k(kv1.reshape(-1), min(k, nseg * kk))
+            gidx = gidx1.reshape(-1)[sel]
+            m1 = jnp.take_along_axis(mask.reshape(nseg, seglen), ki1, axis=1)
+            o1 = jnp.take_along_axis(ok.reshape(nseg, seglen), ki1, axis=1)
+            return gidx, m1.reshape(-1)[sel], o1.reshape(-1)[sel]
 
-        return self._wrap(shard_fn, 2 * n_cols + 1, P(ROW_AXES))
+        if self._single:
+            return jax.jit(local_fn)
+        return jax.jit(jax.shard_map(
+            local_fn, mesh=self._mesh,
+            in_specs=(P(),) + (P(ROW_AXES),) * n_flat,
+            out_specs=(P(ROW_AXES),) * 3))
 
-    # -- packed device→host readback (one sync for the whole request) --
-
-    @staticmethod
-    @jax.jit
-    def _pack_jit(ints, flts, bools):
-        i = jnp.concatenate([x.ravel() for x in ints]) if ints \
-            else jnp.zeros(0, jnp.int64)
-        f = jnp.concatenate([x.ravel() for x in flts]) if flts \
-            else jnp.zeros(0, jnp.float64)
-        b = jnp.concatenate([x.ravel().astype(jnp.uint8) for x in bools]) \
-            if bools else jnp.zeros(0, jnp.uint8)
-        return i, f, b
+    # -- packed device→host readback (one transfer, one sync) --
 
     def _readback(self, tree):
-        """Transfer an arbitrary device pytree in (at most) three packed
-        arrays; returns the same pytree as numpy."""
+        """Fetch a device pytree with every D2H transfer in flight at once.
+
+        ``copy_to_host_async`` is issued for every leaf before the first
+        blocking fetch, so the whole tree lands in ~one sync round-trip
+        (through a tunneled TPU a cold blocking fetch costs ~0.1s;
+        r2's sequential per-array fetches paid that 3+ times per
+        request). Returns the same pytree as numpy.
+        """
         leaves, treedef = jax.tree.flatten(tree)
-        ints = tuple(x for x in leaves
-                     if x.dtype.kind in "iu" and x.dtype != jnp.uint8)
-        flts = tuple(x for x in leaves if x.dtype.kind == "f")
-        bools = tuple(x for x in leaves
-                      if x.dtype.kind == "b" or x.dtype == jnp.uint8)
-        i, f, b = DeviceRunner._pack_jit(ints, flts, bools)
-        i_np, f_np, b_np = np.asarray(i), np.asarray(f), np.asarray(b)
-        io = fo = bo = 0
-        out = []
         for x in leaves:
-            size = int(np.prod(x.shape, dtype=np.int64))
-            if x.dtype.kind == "f":
-                out.append(f_np[fo:fo + size].reshape(x.shape)
-                           .astype(np.dtype(str(x.dtype)), copy=False))
-                fo += size
-            elif x.dtype.kind == "b" or x.dtype == jnp.uint8:
-                arr = b_np[bo:bo + size].reshape(x.shape)
-                out.append(arr.astype(np.bool_) if x.dtype.kind == "b"
-                           else arr)
-                bo += size
-            else:
-                out.append(i_np[io:io + size].reshape(x.shape)
-                           .astype(np.dtype(str(x.dtype)), copy=False))
-                io += size
-        return jax.tree.unflatten(treedef, out)
+            try:
+                x.copy_to_host_async()
+            except Exception:       # pragma: no cover - CPU arrays
+                pass
+        return jax.tree.unflatten(treedef,
+                                  [np.asarray(x) for x in leaves])
 
     # ------------------------------------------------------------ dispatch
 
@@ -719,20 +879,19 @@ class DeviceRunner:
 
         feed_key = (tuple(plan.scan.columns[ci].col_id
                           for ci in plan.used_cols),
-                    tuple(dtypes), dag.ranges, self._chunk_size_for(n))
-        feed = (storage, feed_key)
+                    tuple(dtypes), dag.ranges)
+        feed = self._get_feed(storage, feed_key, host_cols, n)
         try:
             if plan.kind == "simple_agg":
-                result = self._run_simple(dag, plan, host_cols, dtypes, n, feed)
+                result = self._run_simple(dag, plan, dtypes, n, feed)
             elif plan.kind == "hash_agg":
-                result = self._run_hash(dag, plan, host_cols, dtypes, n, feed,
-                                        meta)
+                result = self._run_hash(dag, plan, host_cols, dtypes, n,
+                                        feed, meta)
             elif plan.kind == "topn":
-                result = self._run_topn(dag, plan, host_cols, dtypes, n, batch,
-                                        feed)
+                result = self._run_topn(dag, plan, host_cols, dtypes, n,
+                                        batch, feed)
             else:   # scan_sel
-                result = self._run_scan_sel(dag, plan, host_cols, dtypes, n,
-                                            batch, feed)
+                result = self._run_scan_sel(dag, plan, dtypes, n, batch, feed)
         except _FallbackToHost:
             from ..executors.runner import BatchExecutorsRunner
             return BatchExecutorsRunner(dag, storage).handle_request()
@@ -755,72 +914,29 @@ class DeviceRunner:
             return {}
         return per_storage.setdefault(("meta", meta_key), {})
 
-    # -- chunk feed --
-
-    def _chunks(self, host_cols, n: int, storage=None, feed_key=None):
-        """Yield (chunk_base, padded device arrays flat list) per chunk.
-
-        When ``storage`` is an immutable columnar snapshot, the device
-        arrays are cached in HBM across requests (region-cache analog).
-        """
-        cache = None
-        if storage is not None and feed_key is not None and \
-                hasattr(storage, "scan_columns"):
-            try:
-                cache = self._feed_cache.setdefault(storage, {})
-            except TypeError:       # not weak-referenceable
-                cache = None
-        if cache is not None and feed_key in cache:
-            yield from cache[feed_key]
-            return
-        built = []
-        for item in self._chunks_uncached(host_cols(), n):
-            built.append(item)
-            yield item
-        if cache is not None:
-            cache[feed_key] = built
-
-    def _chunks_uncached(self, host_cols, n: int):
-        chunk = self._chunk_size_for(n)
-        for start in range(0, n, chunk):
-            stop = min(start + chunk, n)
-            m = stop - start
-            flat = []
-            for v, ok in host_cols:
-                if m == chunk:
-                    vv, mm = v[start:stop], ok[start:stop]
-                else:
-                    vv = np.zeros(chunk, dtype=v.dtype)
-                    vv[:m] = v[start:stop]
-                    mm = np.zeros(chunk, dtype=np.bool_)
-                    mm[:m] = ok[start:stop]
-                flat.append(self._put(vv))
-                flat.append(self._put(mm))
-            if m == chunk:
-                row_mask = np.ones(chunk, dtype=np.bool_)
-            else:
-                row_mask = np.zeros(chunk, dtype=np.bool_)
-                row_mask[:m] = True
-            flat.append(self._put(row_mask))
-            yield start, flat
-
     def _result(self, dag, schema, columns) -> "SelectResult":
         from ..executors.runner import SelectResult
         return SelectResult(ColumnBatch(schema, columns), [])
 
+    def _kern_key(self, kind, dag, feed, chunk, *extra):
+        return (kind, dag.plan_key(), feed["null_flags"], feed["n_pad"],
+                chunk) + extra
+
     # -- simple agg --
 
-    def _run_simple(self, dag, plan, host_cols, dtypes, n, feed):
+    def _run_simple(self, dag, plan, dtypes, n, feed):
         carry = self._put_carry(self._init_agg_carry(plan, None))
-        key = ("simple", dag.plan_key(), tuple(dtypes),
-               self._chunk_size_for(n))
+        chunk = self._pick_chunk(feed["n_pad"], _CHUNK_AGG)
         n_cols = len(plan.used_cols)
+        key = self._kern_key("simple", dag, feed, chunk, tuple(dtypes))
         kern = self._shard_kernel(
-            key, lambda: self._wrap_carry(
-                self._build_simple_kernel(plan, n_cols),
-                carry, 2 * n_cols + 1))
-        for base, flat in self._chunks(host_cols, n, *feed):
-            carry = kern(carry, jnp.asarray(base, jnp.int64), *flat)
+            key, lambda: self._wrap_mega(
+                self._mega(self._build_simple_body(plan, n_cols),
+                           self._finalize_psum_summed(),
+                           feed["null_flags"], feed["n_pad"], chunk),
+                carry, len(feed["flat"])))
+        carry = kern(carry, jnp.asarray(n, jnp.int64),
+                     jnp.asarray(0, jnp.int64), *feed["flat"])
         summed, stacked = self._readback(carry)
         merged = self._merge_stacked(plan.specs, summed, stacked)
         finals = finalize_simple(plan.specs, merged)
@@ -836,8 +952,14 @@ class DeviceRunner:
     # -- hash agg --
 
     def _run_hash(self, dag, plan, host_cols, dtypes, n, feed, meta):
-        from .kernels import build_layouts, matmul_supported, \
-            states_from_matmul
+        from .kernels import (
+            build_layouts,
+            matmul_supported,
+            states_from_matmul,
+            twolevel_dims,
+            twolevel_lo,
+            twolevel_unpack,
+        )
         if "hash_bounds" in meta:
             base, span, arg_nbytes = meta["hash_bounds"]
         else:
@@ -859,50 +981,70 @@ class DeviceRunner:
             raise _FallbackToHost(f"hash key span {span}")
         capacity = max(1024, _next_pow2(span))
         slots = capacity + 2
-        use_matmul = matmul_supported(plan.specs)
-        base_arr = jnp.asarray(base, jnp.int64)
-
-        if use_matmul:
-            arg_is_real = [r is not None and r.ret_type is EvalType.REAL
-                           for r in plan.agg_rpns]
+        arg_is_real = [r is not None and r.ret_type is EvalType.REAL
+                       for r in plan.agg_rpns]
+        # a bare reference to a NOT NULL column has validity ≡ row mask —
+        # alias its plane to the mask plane instead of duplicating it
+        # through the matmul (cuts config-4's W operand 4→3 planes)
+        arg_ok_is_mask = []
+        for r in plan.agg_rpns:
+            flag = False
+            if r is not None and len(r.nodes) == 1 and \
+                    isinstance(r.nodes[0], RpnColumnRef):
+                ci = r.nodes[0].col_idx
+                flag = not feed["null_flags"][ci]
+            arg_ok_is_mask.append(flag)
+        layouts = p8 = pf = None
+        if matmul_supported(plan.specs):
             layouts, p8, pf = build_layouts(plan.specs, arg_is_real,
-                                            arg_nbytes)
+                                            arg_nbytes, arg_ok_is_mask)
+        base_arr = jnp.asarray(base, jnp.int64)
+        n_arr = jnp.asarray(n, jnp.int64)
+        n_cols = len(plan.used_cols)
+
+        if layouts is not None and twolevel_lo(p8, pf) is not None:
+            LO, HI = twolevel_dims(slots, p8, pf)
+            chunk = self._pick_chunk(feed["n_pad"], self._feed_unit())
             carry = self._put_carry((
-                (np.zeros((p8, slots), np.int64),
-                 np.zeros((max(pf, 1), slots), np.float64),
+                (np.zeros((HI, p8 * LO), np.int64),
+                 np.zeros((HI, max(pf, 1) * LO), np.float64),
                  np.zeros((), np.int64)),
                 []))
-            key = ("hashmm", dag.plan_key(), tuple(dtypes), capacity,
-                   arg_nbytes, self._chunk_size_for(n))
-            n_cols = len(plan.used_cols)
+            key = self._kern_key("hash2l", dag, feed, chunk, tuple(dtypes),
+                                 capacity, arg_nbytes,
+                                 tuple(arg_ok_is_mask))
             kern = self._shard_kernel(
-                key, lambda: self._wrap_carry(
-                    self._build_hash_matmul_kernel(
-                        plan, n_cols, capacity, layouts),
-                    carry, 2 * n_cols + 1))
-            for _, flat in self._chunks(host_cols, n, *feed):
-                carry = kern(carry, base_arr, *flat)
-            (S8, Sf, ovf), _ = self._readback(carry)
+                key, lambda: self._wrap_mega(
+                    self._mega(self._build_hash_twolevel_body(
+                        plan, n_cols, capacity, layouts, LO, HI, pf),
+                        self._finalize_psum_summed(),
+                        feed["null_flags"], feed["n_pad"], chunk),
+                    carry, len(feed["flat"])))
+            carry = kern(carry, n_arr, base_arr, *feed["flat"])
+            (S8p, Sfp, ovf), _ = self._readback(carry)
             assert int(ovf) == 0, "hash agg key range overflow"
+            S8 = twolevel_unpack(S8p, p8, LO, slots, xp=np)
+            Sf = twolevel_unpack(Sfp, pf, LO, slots, xp=np) if pf else None
             present, states = states_from_matmul(layouts, plan.specs, S8,
-                                                 Sf if pf else None, xp=np)
+                                                 Sf, xp=np)
             merged = {"present": present, "overflow": False,
                       "states": states}
         else:
+            chunk = self._pick_chunk(feed["n_pad"], _CHUNK_AGG)
             sm_init, st_init = self._init_agg_carry(plan, slots)
             carry = self._put_carry((
                 (sm_init, np.zeros(slots, np.int64), np.zeros((), np.int64)),
                 st_init))
-            key = ("hash", dag.plan_key(), tuple(dtypes), capacity,
-                   self._chunk_size_for(n))
-            n_cols = len(plan.used_cols)
+            key = self._kern_key("hashsc", dag, feed, chunk, tuple(dtypes),
+                                 capacity)
             kern = self._shard_kernel(
-                key, lambda: self._wrap_carry(
-                    self._build_hash_scatter_kernel(
+                key, lambda: self._wrap_mega(
+                    self._mega(self._build_hash_scatter_body(
                         plan, n_cols, capacity),
-                    carry, 2 * n_cols + 1))
-            for _, flat in self._chunks(host_cols, n, *feed):
-                carry = kern(carry, base_arr, *flat)
+                        self._finalize_psum_summed(),
+                        feed["null_flags"], feed["n_pad"], chunk),
+                    carry, len(feed["flat"])))
+            carry = kern(carry, n_arr, base_arr, *feed["flat"])
             (summed, present_counts, ovf), stacked = self._readback(carry)
             assert int(ovf) == 0, "hash agg key range overflow"
             merged = {
@@ -951,36 +1093,40 @@ class DeviceRunner:
 
     # -- selection (mask on device, compact on host) --
 
-    def _run_scan_sel(self, dag, plan, host_cols, dtypes, n, batch, feed):
-        key = ("mask", dag.plan_key(), tuple(dtypes), self._chunk_size_for(n))
+    def _run_scan_sel(self, dag, plan, dtypes, n, batch, feed):
+        chunk = self._pick_chunk(feed["n_pad"], _CHUNK_AGG)
+        S = self._nshards()
+        key = self._kern_key("mask", dag, feed, chunk, tuple(dtypes))
         kern = self._shard_kernel(
-            key, lambda: self._build_mask_kernel(plan, len(plan.used_cols)))
-        masks = []
-        for base, flat in self._chunks(host_cols, n, *feed):
-            masks.append((base, kern(*flat)))
-        parts = self._readback(tuple(m for _, m in masks))
-        full = np.zeros(n, dtype=np.bool_)
-        for (base, _), m in zip(masks, parts):
-            stop = min(base + len(m), n)
-            full[base:stop] = m[:stop - base]
+            key, lambda: self._wrap_mega(
+                self._mega(self._build_mask_body(plan, len(plan.used_cols)),
+                           lambda c: c, feed["null_flags"], feed["n_pad"],
+                           chunk, emits=True),
+                ((), ()), len(feed["flat"]),
+                ys_specs=P(None, ROW_AXES)))
+        _, ys = kern(((), ()), jnp.asarray(n, jnp.int64),
+                     jnp.asarray(0, jnp.int64), *feed["flat"])
+        ys = self._readback(ys)
+        nblk = feed["n_pad"] // chunk
+        full = ys.reshape(nblk, S, chunk // S).transpose(1, 0, 2) \
+            .reshape(feed["n_pad"])[:n]
         out = batch.filter(full)
         return self._result(dag, out.schema, out.columns)
 
     # -- top-n --
 
     def _run_topn(self, dag, plan, host_cols, dtypes, n, batch, feed):
-        k = min(plan.limit, max(1, n))
-        key = ("topn", dag.plan_key(), tuple(dtypes), k,
-               self._chunk_size_for(n))
+        k = plan.limit
+        key = self._kern_key("topn", dag, feed, 0, tuple(dtypes), k)
         kern = self._shard_kernel(
-            key, lambda: self._build_topn_kernel(plan, len(plan.used_cols), k))
-        outs = []
-        for base, flat in self._chunks(host_cols, n, *feed):
-            outs.append(kern(jnp.asarray(base, jnp.int64), *flat))
-        parts = self._readback(tuple(outs))
-        gidx = np.concatenate([p[0] for p in parts])
-        mask = np.concatenate([p[1] for p in parts])
-        ok = np.concatenate([p[2] for p in parts])
+            key, lambda: self._build_topn_kernel(
+                plan, len(plan.used_cols), k, feed["null_flags"],
+                feed["n_pad"], len(feed["flat"])))
+        ys = kern(jnp.asarray(n, jnp.int64), *feed["flat"])
+        gidx_s, mask_s, ok_s = self._readback(ys)
+        gidx = gidx_s.reshape(-1)
+        mask = mask_s.reshape(-1)
+        ok = ok_s.reshape(-1)
         sel = mask & (gidx < n)
         gidx, ok = gidx[sel], ok[sel]
         # exact host ordering over <= k * n_chunks * n_shards candidates:
