@@ -195,3 +195,20 @@ def test_kill9_restart_data_intact(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def test_corrupt_newest_checkpoint_raises(path):
+    """ADVICE r2: a non-.tmp checkpoint is post-fsync-renamed, so a
+    corrupt newest generation is data loss — recovery must refuse to
+    silently fall back to an older generation (whose WAL is gone)."""
+    import pytest
+    from tikv_tpu.engine.disk import CorruptionError
+    e = DiskEngine(path, checkpoint_bytes=256)
+    for i in range(40):
+        e.put_cf(CF_DEFAULT, b"key%04d" % i, b"x" * 32)
+    assert e._gen >= 1
+    ck = e._ckpt_path(e._gen)
+    data = open(ck, "rb").read()
+    open(ck, "wb").write(data[:-4])     # chop the footer
+    with pytest.raises(CorruptionError):
+        DiskEngine(path)

@@ -293,3 +293,42 @@ def test_deterministic_replay():
                 [(nid, n.term, n.commit) for nid, n in
                  sorted(net.nodes.items())])
     assert run() == run()
+
+
+# ------------------------------------------------------------- lease (r3)
+
+
+def test_lease_needs_recorded_acks():
+    """ADVICE r2: a leader with zero heartbeat acks (fresh election, tick
+    counter near zero so floor <= 0) must NOT satisfy the lease check off
+    absent voters."""
+    net = make_net(3)
+    net.elect(1)
+    lead = net.nodes[1]
+    lead._lease_ack.clear()                 # simulate a TIMEOUT_NOW winner
+    assert lead._tick_count - (lead._election_tick - 2) <= 0
+    assert not lead.in_lease()
+
+
+def test_lease_wallclock_stall_revokes(monkeypatch):
+    """ADVICE r2 (medium): with a real tick_interval configured, a stalled
+    tick loop must see its lease expire in monotonic time even though the
+    tick-count window still looks fresh."""
+    import tikv_tpu.raft.raw_node as rn
+    fake = [1000.0]
+    monkeypatch.setattr(rn.time, "monotonic", lambda: fake[0])
+    net = make_net(3)
+    for n in net.nodes.values():
+        n._tick_interval = 0.01             # 10ms ticks; window = 8 ticks
+    net.elect(1)
+    net.tick_all(2)                         # heartbeat + acks
+    lead = net.nodes[1]
+    assert lead.state == LEADER
+    assert lead.in_lease()
+    # tick loop stalls: wall clock advances past the lease window with no
+    # new heartbeats acked
+    fake[0] += 1.0
+    assert not lead.in_lease()
+    # heartbeats resume -> acks carry a fresh mono stamp -> lease returns
+    net.tick_all(2)
+    assert lead.in_lease()

@@ -275,3 +275,64 @@ def test_region_meta_consistent_across_stores(cluster):
         assert len(view_set) == 1, f"region {rid} diverged: {view_set}"
         peers, _cv, _v = next(iter(view_set))
         assert len(peers) == 3, f"region {rid} missing peers: {peers}"
+
+
+def test_region_cache_build_does_not_block_other_hits():
+    """ADVICE r2: a slow columnar build for one region must not hold the
+    global cache lock — concurrent hits for other regions proceed."""
+    import threading
+    import time as _time
+    import tikv_tpu.copr.region_cache as rc
+
+    real_build = rc.build_region_columnar
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def slow_build(snap, table_id, cols, read_ts):
+        if getattr(snap, "_slow", False):
+            entered.set()
+            assert gate.wait(5.0)
+        return real_build(snap, table_id, cols, read_ts)
+
+    cache = rc.RegionColumnarCache()
+
+    class FakeRegion:
+        def __init__(self, rid):
+            self.id = rid
+            self.epoch = type("E", (), {"version": 1})()
+
+    def make_snap(rid, slow):
+        from tikv_tpu.engine.memory import MemoryEngine
+        eng = MemoryEngine()
+        snap = eng.snapshot()
+        snap.region = FakeRegion(rid)
+        snap.data_index = 7
+        snap._slow = slow
+        return snap
+
+    from tikv_tpu.testing.fixture import Table, TableColumn
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.datatype import FieldType
+    table = Table(5, (TableColumn("id", 1, FieldType.long(not_null=True),
+                                  is_pk_handle=True),
+                      TableColumn("v", 2, FieldType.long())))
+    dag = DagSelect.from_table(table, ["id", "v"]).build()
+
+    orig = rc.build_region_columnar
+    rc.build_region_columnar = slow_build
+    try:
+        t = threading.Thread(
+            target=lambda: cache.get(make_snap(1, True), dag), daemon=True)
+        t.start()
+        assert entered.wait(5.0)
+        # while region 1 builds, region 2 requests must complete
+        t0 = _time.perf_counter()
+        ent2 = cache.get(make_snap(2, False), dag)
+        elapsed = _time.perf_counter() - t0
+        assert ent2 is not None
+        assert elapsed < 1.0, "unrelated request blocked behind a build"
+        gate.set()
+        t.join(5.0)
+        assert not t.is_alive()
+    finally:
+        rc.build_region_columnar = orig

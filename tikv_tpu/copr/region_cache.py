@@ -32,6 +32,7 @@ decode and H2D transfer.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Optional, Sequence
 
@@ -160,11 +161,14 @@ class RegionColumnarCache:
     """
 
     def __init__(self, capacity: int = 8):
-        import threading
         self._entries: "OrderedDict[tuple, MvccColumnarSnapshot]" = \
             OrderedDict()
         self._capacity = capacity
         self._lock = threading.Lock()
+        # key -> threading.Event for an in-flight build; waiters block on
+        # the event instead of the global lock, so a slow full-region
+        # MVCC build never serializes unrelated cache hits (ADVICE r2)
+        self._building: dict = {}
         self.hits = 0
         self.misses = 0
 
@@ -181,28 +185,46 @@ class RegionColumnarCache:
         key = (region.id, region.epoch.version, data_index, scan.table_id,
                tuple((c.col_id, c.is_pk_handle, c.field_type.tp)
                      for c in scan.columns))
-        with self._lock:
-            ent = None
-            for k in (key, key + (dag.start_ts,)):
-                got = self._entries.get(k)
-                if got is not None and got.valid_for(dag.start_ts):
-                    self._entries.move_to_end(k)
-                    self.hits += 1
-                    ent = got
+        while True:
+            wait_ev = None
+            with self._lock:
+                ent = None
+                for k in (key, key + (dag.start_ts,)):
+                    got = self._entries.get(k)
+                    if got is not None and got.valid_for(dag.start_ts):
+                        self._entries.move_to_end(k)
+                        self.hits += 1
+                        ent = got
+                        break
+                if ent is not None:
                     break
-            if ent is None:
-                self.misses += 1
+                wait_ev = self._building.get(key)
+                if wait_ev is None:
+                    # we build; others for the same key wait on the event
+                    self._building[key] = threading.Event()
+                    self.misses += 1
+            if wait_ev is not None:
+                wait_ev.wait()
+                continue        # re-check: the builder's entry may serve us
+            try:
                 tbl, safe_ts, locks = build_region_columnar(
                     snap, scan.table_id, scan.columns, dag.start_ts)
                 ent = MvccColumnarSnapshot(tbl, dag.start_ts, safe_ts,
                                            locks)
-                # a build at read_ts below safe_ts sees an OLD version
-                # set — park it under an exact-ts key so it never
-                # shadows the latest entry
-                slot = key if dag.start_ts >= safe_ts \
-                    else key + (dag.start_ts,)
-                self._entries[slot] = ent
-                while len(self._entries) > self._capacity:
-                    self._entries.popitem(last=False)
+                with self._lock:
+                    # a build at read_ts below safe_ts sees an OLD version
+                    # set — park it under an exact-ts key so it never
+                    # shadows the latest entry
+                    slot = key if dag.start_ts >= safe_ts \
+                        else key + (dag.start_ts,)
+                    self._entries[slot] = ent
+                    while len(self._entries) > self._capacity:
+                        self._entries.popitem(last=False)
+                break
+            finally:
+                with self._lock:
+                    ev = self._building.pop(key, None)
+                if ev is not None:
+                    ev.set()
         ent.check_locks(dag.ranges, dag.start_ts)
         return ent

@@ -36,6 +36,11 @@ _CKPT_FOOTER = b"CKPTDONE"
 _OP_PUT, _OP_DEL, _OP_DELR = 0, 1, 2
 
 
+class CorruptionError(RuntimeError):
+    """An on-disk artifact that should be intact is not (fsynced
+    checkpoint failed validation).  Recovery must not proceed silently."""
+
+
 def _pack_op(op: tuple, cf_index: dict) -> bytes:
     kind = op[0]
     if kind == "put":
@@ -111,10 +116,19 @@ class DiskEngine(MemoryEngine):
                     gens.append(int(name[5:]))
                 except ValueError:
                     continue
-        for gen in sorted(gens, reverse=True):
-            if self._load_checkpoint(self._ckpt_path(gen)):
-                self._gen = gen
-                break
+        if gens:
+            gen = max(gens)
+            # A non-.tmp checkpoint is only ever produced by an atomic
+            # rename after fsync, so a newest-generation file that fails
+            # validation is real corruption.  Falling back to an older
+            # generation would silently drop every write since it — that
+            # generation's WAL was deleted when this checkpoint was cut
+            # (ADVICE r2).
+            if not self._load_checkpoint(self._ckpt_path(gen)):
+                raise CorruptionError(
+                    f"newest checkpoint {self._ckpt_path(gen)} is corrupt; "
+                    "refusing to silently recover from an older generation")
+            self._gen = gen
         self._replay_wal(self._wal_path(self._gen))
         self._open_wal(self._wal_path(self._gen), append=True)
         # sweep files a crash mid-checkpoint may have left behind

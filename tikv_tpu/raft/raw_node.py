@@ -20,6 +20,7 @@ tests replay identically.
 from __future__ import annotations
 
 import random
+import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -74,12 +75,17 @@ class Ready:
 class RawNode:
     def __init__(self, node_id: int, storage: MemoryRaftStorage,
                  election_tick: int = 10, heartbeat_tick: int = 2,
-                 pre_vote: bool = True, seed: int = 0):
+                 pre_vote: bool = True, seed: int = 0,
+                 tick_interval: Optional[float] = None):
         self.id = node_id
         self.storage = storage
         self._election_tick = election_tick
         self._heartbeat_tick = heartbeat_tick
         self._pre_vote = pre_vote
+        # wall-clock seconds per tick, when the driver ticks on real time
+        # (server/node.py).  None = manually-driven ticks (in-process
+        # tests); the lease then rests on tick counts alone.
+        self._tick_interval = tick_interval
         self._rng = random.Random((seed << 16) ^ node_id)
 
         hs, voters, learners = storage.initial_state()
@@ -109,9 +115,17 @@ class RawNode:
         self._prev_soft = (self.leader_id, self.state)
         # leader lease (store/worker/read.rs ReadDelegate semantics, in
         # tick units): heartbeats carry the send tick; acks prove a
-        # quorum heard from us within the lease window
+        # quorum heard from us within the lease window.  The reference
+        # measures the lease in monotonic time (ReadDelegate
+        # maybe_renew_lease); tick counts alone break when the tick loop
+        # stalls (fsync pause, GC) while followers keep wall-clock time —
+        # so each heartbeat's send is also stamped with time.monotonic()
+        # and in_lease() cross-checks wall-clock age when tick_interval
+        # is known.
         self._tick_count = 0
         self._lease_ack: dict[int, int] = {}
+        self._hb_send_mono: dict[int, float] = {}   # send tick -> mono
+        self._lease_ack_mono: dict[int, float] = {}  # nid -> mono of ack'd hb
 
     # ------------------------------------------------------------- helpers
 
@@ -173,6 +187,8 @@ class RawNode:
         self.leader_id = self.id
         self._lead_transferee = 0
         self._lease_ack = {}
+        self._hb_send_mono = {}
+        self._lease_ack_mono = {}
         last = self.last_index()
         self.progress = {
             nid: Progress(match=0, next=last + 1)
@@ -219,9 +235,26 @@ class RawNode:
         if window <= 0:
             return False
         floor = self._tick_count - window
+        # Only voters with a recorded ack count: a freshly-(re)started
+        # leader has floor <= 0 and must not treat silent voters as live
+        # (ADVICE r2: TIMEOUT_NOW transferee could serve lease reads with
+        # zero acks).
+        now = time.monotonic() if self._tick_interval is not None else None
+        max_age = None if now is None else window * self._tick_interval
+
+        def ack_live(nid: int) -> bool:
+            if nid not in self._lease_ack or self._lease_ack[nid] < floor:
+                return False
+            if max_age is None:
+                return True
+            # wall-clock cross-check: if the tick loop stalled, tick
+            # counts freeze while followers' election timers keep running
+            # in real time — the ack must also be recent in mono time
+            mono = self._lease_ack_mono.get(nid)
+            return mono is not None and (now - mono) <= max_age
+
         live = sum(1 for nid in self.voters
-                   if nid == self.id or
-                   self._lease_ack.get(nid, -1) >= floor)
+                   if nid == self.id or ack_live(nid))
         return live >= self._quorum()
 
     def campaign(self, force: bool = False) -> None:
@@ -351,6 +384,12 @@ class RawNode:
                            snapshot=snap))
 
     def _broadcast_heartbeat(self) -> None:
+        if self._tick_interval is not None:
+            self._hb_send_mono[self._tick_count] = time.monotonic()
+            if len(self._hb_send_mono) > 4 * self._election_tick:
+                horizon = self._tick_count - 2 * self._election_tick
+                for t in [t for t in self._hb_send_mono if t < horizon]:
+                    del self._hb_send_mono[t]
         for nid, pr in self.progress.items():
             if nid == self.id:
                 continue
@@ -566,8 +605,12 @@ class RawNode:
         if pr is None:
             return
         if m.ctx:
-            prev = self._lease_ack.get(m.frm, 0)
-            self._lease_ack[m.frm] = max(prev, m.ctx)
+            prev = self._lease_ack.get(m.frm)
+            if prev is None or m.ctx > prev:
+                self._lease_ack[m.frm] = m.ctx
+                mono = self._hb_send_mono.get(m.ctx)
+                if mono is not None:
+                    self._lease_ack_mono[m.frm] = mono
         pr.paused = False
         if pr.match < self.last_index():
             self._send_append(m.frm)

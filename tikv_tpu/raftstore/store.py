@@ -47,14 +47,15 @@ class RaftStore:
     def __init__(self, store_id: int, engine: KvEngine,
                  transport: Transport, election_tick: int = 10,
                  heartbeat_tick: int = 2, pre_vote: bool = True,
-                 seed: int = 0):
+                 seed: int = 0, tick_interval: float | None = None):
         self.store_id = store_id
         self.engine = engine
         self.transport = transport
         self.peers: dict[int, RaftPeer] = {}
         self._raft_cfg = dict(election_tick=election_tick,
                               heartbeat_tick=heartbeat_tick,
-                              pre_vote=pre_vote, seed=seed)
+                              pre_vote=pre_vote, seed=seed,
+                              tick_interval=tick_interval)
         self._campaign_on_create: set[int] = set()
 
     # ------------------------------------------------------------- lifecycle

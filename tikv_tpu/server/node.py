@@ -140,7 +140,8 @@ class Node:
         pd.put_store(StoreMeta(self.store_id, addr))
         self.transport = GrpcTransport(pd)
         self.raft_store = RaftStore(self.store_id, self.engine,
-                                    self.transport)
+                                    self.transport,
+                                    tick_interval=tick_interval)
         self.raft_store.observers = [self._report_region]
         self.raft_kv = RaftKv(self.raft_store, driver=self._wait_driver,
                               lock=self.lock)
