@@ -1,0 +1,130 @@
+"""Pallas fused hash-agg prototype vs production. (throwaway)"""
+import functools
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_enable_x64", True)
+rng = np.random.default_rng(7)
+
+N = 100 * (1 << 20)
+k_np = rng.integers(0, 1024, N).astype(np.int32)
+v_np = rng.integers(-1000, 1000, N).astype(np.int32)
+kcol = jnp.asarray(k_np)
+vcol = jnp.asarray(v_np)
+np.asarray(kcol[:1])
+
+capacity = 1024
+slots = capacity + 2          # + null + scrap
+LO, HI = 32, 40               # LO*HI = 1280 >= 1026
+P8 = 3                        # mask, b0, b1
+W = P8 * LO                   # 96
+
+def fetch(out):
+    leaves = jax.tree.leaves(out)
+    for x in leaves:
+        try: x.copy_to_host_async()
+        except Exception: pass
+    return [np.asarray(x) for x in leaves]
+
+def bench(fn, label, n=5):
+    fetch(fn())
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        r = fetch(fn())
+        ts.append(time.perf_counter() - t0)
+    print(f"{label:52s} p50 {np.median(ts)*1e3:8.2f} ms  min {min(ts)*1e3:8.2f}")
+    return r
+
+# ---------------- V1: 1D blocks ----------------
+def make_v1(B):
+    nblk = N // B
+
+    def kernel(sref, k_ref, v_ref, out_ref, alo, ahi):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            alo[:] = jnp.zeros_like(alo)
+            ahi[:] = jnp.zeros_like(ahi)
+
+        n_rows = sref[0]
+        base = sref[1]
+        kb = k_ref[:]
+        vb = v_ref[:]
+        row0 = i * B
+        iota = lax.broadcasted_iota(jnp.int32, (B, 1), 0)[:, 0]
+        row_mask = (row0 + iota) < n_rows
+        idx = kb - base
+        in_range = (idx >= 0) & (idx < capacity)
+        idx = jnp.where(row_mask & in_range, idx, capacity + 1)
+        hi_ = idx // LO
+        lo_ = idx - hi_ * LO
+        hi_iota = lax.broadcasted_iota(jnp.int32, (B, HI), 1)
+        lo_iota = lax.broadcasted_iota(jnp.int32, (B, LO), 1)
+        one = jnp.ones((), jnp.int32)
+        zero_s = jnp.zeros((), jnp.int32)
+        A8 = jnp.where(hi_[:, None] == hi_iota, one, zero_s).astype(jnp.int8)
+        OL = lo_[:, None] == lo_iota
+        m32 = jnp.where(row_mask, one, zero_s)
+        biased = vb + (1 << 15)          # int32, in [0, 65536)
+        b0 = (biased & 0xFF) - 128
+        b1 = ((biased >> 8) & 0xFF) - 128
+        zero = jnp.zeros((B, LO), jnp.int32)
+        W8 = jnp.concatenate([
+            jnp.where(OL, m32[:, None], zero),
+            jnp.where(OL, (b0 * m32)[:, None], zero),
+            jnp.where(OL, (b1 * m32)[:, None], zero)], axis=1).astype(jnp.int8)
+        prod = lax.dot_general(A8, W8, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+        alo[:] += prod & 0xFFFF
+        ahi[:] += prod >> 16
+
+        @pl.when(i == nblk - 1)
+        def _():
+            out_ref[0] = alo[:]
+            out_ref[1] = ahi[:]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((B,), lambda i, s: (i,)),
+            pl.BlockSpec((B,), lambda i, s: (i,)),
+        ],
+        out_specs=pl.BlockSpec((2, HI, W), lambda i, s: (0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((HI, W), jnp.int32),
+                        pltpu.VMEM((HI, W), jnp.int32)],
+    )
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((2, HI, W), jnp.int32),
+        grid_spec=grid_spec,
+    )
+    scal = jnp.asarray([N, 0], jnp.int32)
+    return jax.jit(lambda: call(scal, kcol, vcol))
+
+for B in (1 << 14, 1 << 15, 1 << 16):
+    try:
+        f = make_v1(B)
+        r = bench(f, f"pallas v1 1D block={B}")
+    except Exception as e:
+        print(f"pallas v1 B={B} FAILED: {type(e).__name__}: {str(e)[:200]}")
+
+# correctness check vs numpy
+f = make_v1(1 << 15)
+(out,) = fetch(f())
+S = out[0].astype(np.int64) + (out[1].astype(np.int64) << 16)
+S = S.reshape(HI, P8, LO).transpose(1, 0, 2).reshape(P8, HI * LO)[:, :slots]
+cnt = np.bincount(k_np, minlength=slots)
+sv = np.zeros(slots, np.int64)
+np.add.at(sv, k_np, v_np)
+got_cnt = S[0]
+got_sum = (S[1].astype(np.int64) + (S[2].astype(np.int64) << 8)
+           + S[0] * (128 + (128 << 8) - (1 << 15)))
+print("count ok:", np.array_equal(got_cnt[:1024], cnt[:1024]),
+      " sum ok:", np.array_equal(got_sum[:1024], sv[:1024]))

@@ -1,0 +1,132 @@
+"""Full Pallas fused hash-agg on 100M rows. (throwaway)"""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_enable_x64", True)
+rng = np.random.default_rng(7)
+
+N = 100 * (1 << 20)
+k_np = rng.integers(0, 1024, N).astype(np.int32)
+v_np = rng.integers(-1000, 1000, N).astype(np.int32)
+kcol = jnp.asarray(k_np)
+vcol = jnp.asarray(v_np)
+np.asarray(kcol[:1])
+
+capacity = 1024
+slots = capacity + 2
+LO, HI = 32, 40
+P8 = 3
+W = P8 * LO
+i32 = jnp.int32
+
+def fetch(out):
+    leaves = jax.tree.leaves(out)
+    for x in leaves:
+        try: x.copy_to_host_async()
+        except Exception: pass
+    return [np.asarray(x) for x in leaves]
+
+def bench(fn, label, n=5):
+    fetch(fn())
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        r = fetch(fn())
+        ts.append(time.perf_counter() - t0)
+    print(f"{label:52s} p50 {np.median(ts)*1e3:8.2f} ms  min {min(ts)*1e3:8.2f}")
+    return r
+
+def make(B, vmem):
+    nblk = N // B
+
+    def kernel(sref, k_ref, v_ref, out_ref, alo, ahi):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            alo[:] = jnp.zeros_like(alo)
+            ahi[:] = jnp.zeros_like(ahi)
+
+        n_rows = sref[0]
+        base = sref[1]
+        kb = k_ref[:]
+        vb = v_ref[:]
+        row0 = i * i32(B)
+        riota = lax.broadcasted_iota(jnp.int32, (B, 1), 0)[:, 0]
+        row_mask = (row0 + riota) < n_rows
+        idx = kb - base
+        in_range = (idx >= i32(0)) & (idx < i32(capacity))
+        idx = jnp.where(row_mask & in_range, idx, i32(capacity + 1))
+        hi_ = idx // i32(LO)
+        lo_ = idx - hi_ * i32(LO)
+        hi_iota = lax.broadcasted_iota(jnp.int32, (B, HI), 1)
+        lo_iota = lax.broadcasted_iota(jnp.int32, (B, LO), 1)
+        A8 = jnp.where(hi_[:, None] == hi_iota, i32(1), i32(0)).astype(jnp.int8)
+        OL = lo_[:, None] == lo_iota
+        m32 = jnp.where(row_mask, i32(1), i32(0))
+        biased = vb + i32(1 << 15)
+        b0 = ((biased & i32(0xFF)) - i32(128)) * m32
+        b1 = (((biased >> 8) & i32(0xFF)) - i32(128)) * m32
+        zero = jnp.zeros((B, LO), jnp.int32)
+        W8 = jnp.concatenate([
+            jnp.where(OL, m32[:, None], zero),
+            jnp.where(OL, b0[:, None], zero),
+            jnp.where(OL, b1[:, None], zero)], axis=1).astype(jnp.int8)
+        prod = lax.dot_general(A8, W8, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+        alo[:] += prod & i32(0xFFFF)
+        ahi[:] += prod >> 16
+
+        @pl.when(i == nblk - 1)
+        def _():
+            out_ref[0] = alo[:]
+            out_ref[1] = ahi[:]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((B,), lambda i, s: (i,)),
+            pl.BlockSpec((B,), lambda i, s: (i,)),
+        ],
+        out_specs=pl.BlockSpec((2, HI, W), lambda i, s: (0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((HI, W), jnp.int32),
+                        pltpu.VMEM((HI, W), jnp.int32)],
+    )
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((2, HI, W), jnp.int32),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=vmem),
+    )
+    scal = jnp.asarray([N, 0], jnp.int32)
+    def run():
+        with jax.enable_x64(False):
+            return call(scal, kcol, vcol)
+    return run
+
+out = None
+for B, vm in ((4096, 32 << 20), (8192, 32 << 20), (16384, 64 << 20),
+              (32768, 100 << 20)):
+    try:
+        f = make(B, vm)
+        r = bench(f, f"pallas fused block={B}")
+        if B == 8192:
+            out = r[0]
+    except Exception as e:
+        print(f"pallas B={B} FAILED: {type(e).__name__}: {str(e)[:150]}")
+
+if out is not None:
+    S = out[0].astype(np.int64) + (out[1].astype(np.int64) << 16)
+    S = S.reshape(HI, P8, LO).transpose(1, 0, 2).reshape(P8, HI * LO)[:, :slots]
+    cnt = np.bincount(k_np, minlength=slots)
+    sv = np.zeros(slots, np.int64)
+    np.add.at(sv, k_np, v_np)
+    got_cnt = S[0]
+    got_sum = (S[1] + (S[2] << 8) + S[0] * (128 + (128 << 8) - (1 << 16 >> 1)))
+    print("count ok:", np.array_equal(got_cnt[:1024], cnt[:1024]),
+          " sum ok:", np.array_equal(got_sum[:1024], sv[:1024]))

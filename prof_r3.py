@@ -1,0 +1,155 @@
+"""Round-3 profiling: where does config-4's 203ms go? (throwaway)"""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+from bench import build_table, _dag_hash_agg
+from tikv_tpu.device import DeviceRunner
+from tikv_tpu.datatype import EvalType
+
+N = 100 * (1 << 20)
+runner = DeviceRunner()
+table, snap = build_table(N, 1024)
+dag = _dag_hash_agg(table)
+
+# end-to-end request timing (matches bench)
+r = runner.handle_request(dag, snap)
+ts = []
+for _ in range(6):
+    t0 = time.perf_counter()
+    runner.handle_request(dag, snap)
+    ts.append(time.perf_counter() - t0)
+print(f"e2e request p50 {np.median(ts)*1e3:.1f} ms  min {min(ts)*1e3:.1f}")
+
+meta = runner._request_meta(snap, (dag.plan_key(), dag.ranges))
+base, span, arg_nbytes = meta["hash_bounds"]
+plan = runner._analyze(dag)
+feed_key = (tuple(plan.scan.columns[ci].col_id for ci in plan.used_cols),
+            tuple(meta["dtypes"]), dag.ranges)
+feed = runner._feed_cache[snap][feed_key]
+(key,) = [k for k in runner._kernel_cache if k[0] == "hash2l"]
+kern = runner._kernel_cache[key]
+chunk = key[4]
+print("chunk", chunk, "dtypes", meta["dtypes"], "arg_nbytes", arg_nbytes)
+
+from tikv_tpu.device.kernels import build_layouts, twolevel_dims
+arg_is_real = [rr is not None and rr.ret_type is EvalType.REAL
+               for rr in plan.agg_rpns]
+# match production: ok aliases mask for NOT NULL bare col ref
+layouts, p8, pf = build_layouts(plan.specs, arg_is_real, arg_nbytes,
+                                [False, True])
+capacity = 1024
+slots = capacity + 2
+LO, HI = twolevel_dims(slots, p8, pf)
+print("p8", p8, "pf", pf, "LO", LO, "HI", HI)
+
+def carry0():
+    return runner._put_carry((
+        (np.zeros((HI, p8 * LO), np.int64),
+         np.zeros((HI, max(pf, 1) * LO), np.float64),
+         np.zeros((), np.int64)), []))
+
+def slope(fn, c0_fn, args_fn, n_lo=2, n_hi=10, label=""):
+    c = c0_fn()
+    c = fn(c, *args_fn(0))
+    jax.block_until_ready(c)
+    def run(iters, salt0):
+        c = c0_fn()
+        t0 = time.perf_counter()
+        for i in range(iters):
+            c = fn(c, *args_fn(salt0 + i))
+        jax.block_until_ready(c)
+        return time.perf_counter() - t0
+    t_lo = run(n_lo, 100)
+    t_hi = run(n_hi, 200)
+    per = (t_hi - t_lo) / (n_hi - n_lo)
+    fixed = t_lo - n_lo * per
+    print(f"{label:44s} {per*1e3:8.2f} ms/pass  fixed~{fixed*1e3:6.1f} ms")
+    return per
+
+nn = jnp.asarray(N, jnp.int64)
+slope(kern, carry0,
+      lambda s: (nn, jnp.asarray(base - (s % 7), jnp.int64)) + feed["flat"],
+      label="production hash2l megakernel")
+
+# --- lean variants over the same 2 int32 columns ---
+flat = feed["flat"]
+kcol, vcol = flat[0], flat[1]
+n_pad = feed["n_pad"]
+
+def make_lean(block, planes=3, use_scan=True):
+    nblk = n_pad // block
+    def f(c, n_scalar, aux, k, v):
+        S8c, ovfc = c
+        ks = k.reshape(nblk, block)
+        vs = v.reshape(nblk, block)
+        steps = jnp.arange(nblk, dtype=jnp.int32)
+        iota = jnp.arange(block, dtype=jnp.int32)
+        n32 = n_scalar.astype(jnp.int32)
+        aux32 = aux.astype(jnp.int32)
+        hi_iota = lax.broadcasted_iota(jnp.int32, (block, HI), 1)
+        lo_iota = lax.broadcasted_iota(jnp.int32, (block, LO), 1)
+        def step(cc, xs):
+            s8, ovf = cc
+            s_i, kb, vb = xs
+            row_mask = (s_i * block + iota) < n32
+            idx = kb - aux32
+            in_range = (idx >= 0) & (idx < capacity)
+            idx = jnp.where(row_mask & in_range, idx, capacity + 1)
+            ovf = ovf + jnp.sum(row_mask & ~in_range, dtype=jnp.int32)
+            hi = idx // LO
+            lo = idx - hi * LO
+            A8 = (hi[:, None] == hi_iota).astype(jnp.int8)
+            OL = lo[:, None] == lo_iota
+            m8 = row_mask.astype(jnp.int8)
+            biased = (vb + (1 << 15)).astype(jnp.uint32)
+            b0 = (((biased) & 0xFF).astype(jnp.int32) - 128).astype(jnp.int8)
+            b1 = (((biased >> 8) & 0xFF).astype(jnp.int32) - 128).astype(jnp.int8)
+            zero = jnp.zeros((block, LO), jnp.int8)
+            W8 = jnp.concatenate([
+                jnp.where(OL, m8[:, None], zero),
+                jnp.where(OL, jnp.where(row_mask, b0, 0)[:, None], zero),
+                jnp.where(OL, jnp.where(row_mask, b1, 0)[:, None], zero)],
+                axis=1)
+            prod = lax.dot_general(A8, W8, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+            return (s8 + prod.astype(jnp.int64), ovf), None
+        cc, _ = lax.scan(step, (S8c, ovfc), (steps, ks, vs))
+        return cc
+    return jax.jit(f)
+
+def lean_c0():
+    return (jnp.zeros((HI, 3 * LO), jnp.int64), jnp.zeros((), jnp.int32))
+
+for blk in (1 << 15, 1 << 16, 1 << 18, 1 << 20):
+    lean = make_lean(blk)
+    slope(lean, lean_c0,
+          lambda s: (nn, jnp.asarray(base - (s % 7), jnp.int64), kcol, vcol),
+          label=f"lean i32 3-plane block={blk}")
+
+# --- how fast is a pure HBM pass (sum both cols)? ---
+def pure_sum(c, k, v):
+    return (c[0] + k.astype(jnp.int64).sum(), c[1] + v.astype(jnp.int64).sum())
+slope(jax.jit(pure_sum), lambda: (jnp.zeros((), jnp.int64),) * 2,
+      lambda s: (kcol, vcol), label="pure 2-col int32 sum (HBM roofline)")
+
+# --- segment-sum alternative: jnp.zeros(...).at[idx].add ---
+def make_scatter(block):
+    nblk = n_pad // block
+    def f(c, aux, k, v):
+        ks = k.reshape(nblk, block)
+        vs = v.reshape(nblk, block)
+        aux32 = aux.astype(jnp.int32)
+        def step(cc, xs):
+            kb, vb = xs
+            idx = jnp.clip(kb - aux32, 0, capacity + 1)
+            upd = jnp.stack([jnp.ones_like(vb), vb], 1)
+            return cc.at[idx].add(upd.astype(jnp.int32)), None
+        cc, _ = lax.scan(step, c, (ks, vs))
+        return cc
+    return jax.jit(f)
+slope(make_scatter(1 << 20),
+      lambda: jnp.zeros((capacity + 2, 2), jnp.int32),
+      lambda s: (jnp.asarray(base - (s % 7), jnp.int64), kcol, vcol),
+      label="scatter .at[].add block=2^20")

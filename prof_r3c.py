@@ -1,0 +1,82 @@
+"""What is the ~90ms fixed per-execution cost? (throwaway)"""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+rng = np.random.default_rng(7)
+
+def timeit(fn, label, iters=8):
+    fn()  # warm
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    print(f"{label:52s} p50 {np.median(ts)*1e3:8.2f} ms  min {min(ts)*1e3:8.2f}")
+
+# pure sum over varying feed sizes (device-resident)
+for nbits in (20, 24, 26, 27):
+    n = 1 << nbits
+    a = jnp.asarray(rng.integers(-1000, 1000, n).astype(np.int32))
+    jax.block_until_ready(a)
+    f = jax.jit(lambda x: x.astype(jnp.int64).sum())
+    timeit(lambda: jax.block_until_ready(f(a)),
+           f"sum over 2^{nbits} int32 ({4*n/1e6:.0f} MB), launch+sync")
+
+# same 2^27 feed, program reads only first 8 elems
+n = 1 << 27
+big = jnp.asarray(rng.integers(-1000, 1000, n).astype(np.int32))
+jax.block_until_ready(big)
+g = jax.jit(lambda x: x[:8].astype(jnp.int64).sum())
+timeit(lambda: jax.block_until_ready(g(big)),
+       "slice-8 of 2^27 buffer, launch+sync")
+
+# dynamic-slice whole-array sum but as 2 half programs? n/a
+
+# scan-over-blocks sum (like mega kernel structure), 2^27
+def scan_sum(x):
+    xs = x.reshape(1 << 11, 1 << 16)
+    def step(c, b):
+        return c + b.astype(jnp.int64).sum(), None
+    c, _ = lax.scan(step, jnp.zeros((), jnp.int64), xs)
+    return c
+h = jax.jit(scan_sum)
+timeit(lambda: jax.block_until_ready(h(big)), "scan-sum 2048 steps over 2^27")
+
+# two back-to-back executions, one sync
+timeit(lambda: jax.block_until_ready((f2(big), f2(big))) if False else None
+       if False else None, "noop")
+
+f2 = jax.jit(lambda x, s: x.astype(jnp.int64).sum() + s)
+s0 = jnp.zeros((), jnp.int64)
+jax.block_until_ready(f2(big, s0))
+def chain(k):
+    c = s0
+    t0 = time.perf_counter()
+    for _ in range(k):
+        c = f2(big, c)
+    jax.block_until_ready(c)
+    return time.perf_counter() - t0
+chain(1)
+t1 = np.median([chain(1) for _ in range(6)])
+t4 = np.median([chain(4) for _ in range(6)])
+print(f"chain x1 {t1*1e3:.2f} ms   x4 {t4*1e3:.2f} ms   marginal {(t4-t1)/3*1e3:.2f}")
+
+# does donation help?
+f3 = jax.jit(lambda x, s: (x, x.astype(jnp.int64).sum() + s), donate_argnums=(0,))
+xd = jnp.asarray(rng.integers(-1000, 1000, n).astype(np.int32))
+jax.block_until_ready(xd)
+def chain_donate(k):
+    global xd
+    c = s0
+    t0 = time.perf_counter()
+    for _ in range(k):
+        xd, c = f3(xd, c)
+    jax.block_until_ready(c)
+    return time.perf_counter() - t0
+chain_donate(1)
+td1 = np.median([chain_donate(1) for _ in range(6)])
+td4 = np.median([chain_donate(4) for _ in range(6)])
+print(f"donated chain x1 {td1*1e3:.2f} ms   x4 {td4*1e3:.2f} ms   marginal {(td4-td1)/3*1e3:.2f}")

@@ -1,0 +1,75 @@
+"""Stage-level timing of the production hash-agg request. (throwaway)"""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+from bench import build_table, _dag_hash_agg
+from tikv_tpu.device import DeviceRunner
+from tikv_tpu.datatype import EvalType
+
+N = 100 * (1 << 20)
+runner = DeviceRunner()
+table, snap = build_table(N, 1024)
+dag = _dag_hash_agg(table)
+r = runner.handle_request(dag, snap)   # warm: compile + feed cache
+
+plan = runner._analyze(dag)
+meta = runner._request_meta(snap, (dag.plan_key(), dag.ranges))
+base, span, arg_nbytes = meta["hash_bounds"]
+dtypes = meta["dtypes"]
+feed_key = (tuple(plan.scan.columns[ci].col_id for ci in plan.used_cols),
+            tuple(dtypes), dag.ranges)
+feed = runner._feed_cache[snap][feed_key]
+(kkey,) = [k for k in runner._kernel_cache if k[0] == "hash2l"]
+kern = runner._kernel_cache[kkey]
+
+from tikv_tpu.device.kernels import (build_layouts, twolevel_dims,
+                                     twolevel_unpack, states_from_matmul)
+arg_is_real = [rr is not None and rr.ret_type is EvalType.REAL
+               for rr in plan.agg_rpns]
+layouts, p8, pf = build_layouts(plan.specs, arg_is_real, arg_nbytes,
+                                [False, True])
+capacity = 1024
+slots = capacity + 2
+LO, HI = twolevel_dims(slots, p8, pf)
+
+def stage_run():
+    t = {}
+    t0 = time.perf_counter()
+    carry = runner._put_carry((
+        (np.zeros((HI, p8 * LO), np.int64),
+         np.zeros((HI, max(pf, 1) * LO), np.float64),
+         np.zeros((), np.int64)), []))
+    t["carry_put"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    n_arr = jnp.asarray(N, jnp.int64)
+    base_arr = jnp.asarray(base, jnp.int64)
+    t["scalar_put"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = kern(carry, n_arr, base_arr, *feed["flat"])
+    t["enqueue"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    (S8p, Sfp, ovf), _ = runner._readback(out)
+    t["readback"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    S8 = twolevel_unpack(S8p, p8, LO, slots, xp=np)
+    Sf = twolevel_unpack(Sfp, pf, LO, slots, xp=np) if pf else None
+    present, states = states_from_matmul(layouts, plan.specs, S8, Sf, xp=np)
+    t["unpack"] = time.perf_counter() - t0
+    return t
+
+for i in range(6):
+    t = stage_run()
+    print("  ".join(f"{k}={v*1e3:7.2f}ms" for k, v in t.items()))
+
+# and full handle_request for comparison
+ts = []
+for _ in range(5):
+    t0 = time.perf_counter()
+    runner.handle_request(dag, snap)
+    ts.append(time.perf_counter() - t0)
+print(f"full handle_request p50 {np.median(ts)*1e3:.1f} ms")

@@ -1,0 +1,70 @@
+"""Does is_ready() let us dodge the blocking-fetch poll quantum? (throwaway)"""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+rng = np.random.default_rng(7)
+
+N = 100 * (1 << 20)
+kcol = jnp.asarray(rng.integers(0, 1024, N).astype(np.int32))
+vcol = jnp.asarray(rng.integers(-1000, 1000, N).astype(np.int32))
+np.asarray(kcol[:1]); np.asarray(vcol[:1])  # force through
+
+# a kernel with ~50ms of real work: 40 passes of 2-col sum
+def work(k, v, s):
+    def step(c, i):
+        return c + k.astype(jnp.int64).sum() + v.astype(jnp.int64).sum() + i, None
+    c, _ = lax.scan(step, s, jnp.arange(40, dtype=jnp.int64))
+    return c
+f = jax.jit(work)
+s0 = jnp.zeros((), jnp.int64)
+_ = np.asarray(f(kcol, vcol, s0))  # compile+run
+
+def run_block():
+    t0 = time.perf_counter()
+    out = f(kcol, vcol, s0)
+    r = np.asarray(out)
+    return time.perf_counter() - t0
+
+def run_spin(sleep_s):
+    t0 = time.perf_counter()
+    out = f(kcol, vcol, s0)
+    polls = 0
+    while not out.is_ready():
+        polls += 1
+        if sleep_s:
+            time.sleep(sleep_s)
+    t_ready = time.perf_counter() - t0
+    r = np.asarray(out)
+    return time.perf_counter() - t0, t_ready, polls
+
+print("blocking fetch:", [f"{run_block()*1e3:.1f}" for _ in range(5)])
+for sl in (0, 0.001, 0.004):
+    res = [run_spin(sl) for _ in range(5)]
+    print(f"spin sleep={sl}: total",
+          [f"{a*1e3:.1f}" for a, b, p in res],
+          "ready_at", [f"{b*1e3:.1f}" for a, b, p in res],
+          "polls", [p for a, b, p in res])
+
+# same kernel but one fresh tiny H2D per call
+def run_fresh_scalar():
+    t0 = time.perf_counter()
+    s = jnp.asarray(np.int64(0))
+    out = f(kcol, vcol, s)
+    r = np.asarray(out)
+    return time.perf_counter() - t0
+
+print("fresh-scalar fetch:", [f"{run_fresh_scalar()*1e3:.1f}" for _ in range(5)])
+
+# fresh small carry via device_put (like _put_carry)
+g = jax.jit(lambda k, v, c: c + k.astype(jnp.int64).sum() + v.astype(jnp.int64).sum())
+_ = np.asarray(g(kcol, vcol, jnp.zeros((40, 96), jnp.int64)))
+def run_fresh_carry():
+    t0 = time.perf_counter()
+    c = jax.device_put(np.zeros((40, 96), np.int64))
+    out = f(kcol, vcol, s0) + g(kcol, vcol, c)[0, 0]
+    r = np.asarray(out)
+    return time.perf_counter() - t0
+print("fresh-carry fetch:", [f"{run_fresh_carry()*1e3:.1f}" for _ in range(5)])

@@ -1,0 +1,56 @@
+"""Ground truth: chained production kernel passes + one fetch. (throwaway)"""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+from bench import build_table, _dag_hash_agg
+from tikv_tpu.device import DeviceRunner
+from tikv_tpu.datatype import EvalType
+
+N = 100 * (1 << 20)
+runner = DeviceRunner()
+table, snap = build_table(N, 1024)
+dag = _dag_hash_agg(table)
+r = runner.handle_request(dag, snap)
+
+plan = runner._analyze(dag)
+meta = runner._request_meta(snap, (dag.plan_key(), dag.ranges))
+base, span, arg_nbytes = meta["hash_bounds"]
+feed_key = (tuple(plan.scan.columns[ci].col_id for ci in plan.used_cols),
+            tuple(meta["dtypes"]), dag.ranges)
+feed = runner._feed_cache[snap][feed_key]
+(kkey,) = [k for k in runner._kernel_cache if k[0] == "hash2l"]
+kern = runner._kernel_cache[kkey]
+
+from tikv_tpu.device.kernels import twolevel_dims, build_layouts
+arg_is_real = [rr is not None and rr.ret_type is EvalType.REAL
+               for rr in plan.agg_rpns]
+layouts, p8, pf = build_layouts(plan.specs, arg_is_real, arg_nbytes,
+                                [False, True])
+LO, HI = twolevel_dims(1026, p8, pf)
+n_arr = jnp.asarray(N, jnp.int64)
+base_arr = jnp.asarray(base, jnp.int64)
+
+def carry0():
+    return runner._put_carry((
+        (np.zeros((HI, p8 * LO), np.int64),
+         np.zeros((HI, max(pf, 1) * LO), np.float64),
+         np.zeros((), np.int64)), []))
+
+def chained(k):
+    c = carry0()
+    # force carry onto device first
+    jax.tree.map(lambda x: np.asarray(x) if hasattr(x, 'shape') else x,
+                 jax.tree.leaves(c)[:1])
+    t0 = time.perf_counter()
+    for _ in range(k):
+        c = kern(c, n_arr, base_arr, *feed["flat"])
+    leaves = jax.tree.leaves(c)
+    for x in leaves:
+        try: x.copy_to_host_async()
+        except Exception: pass
+    _ = [np.asarray(x) for x in leaves]
+    return time.perf_counter() - t0
+
+for k in (1, 1, 3, 3, 6, 6):
+    print(f"chain x{k}: {chained(k)*1e3:8.1f} ms")

@@ -1,0 +1,90 @@
+"""Isolate the ~100ms fetch penalty: output kind vs scan structure. (throwaway)"""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+rng = np.random.default_rng(7)
+N = 100 * (1 << 20)
+kcol = jnp.asarray(rng.integers(0, 1024, N).astype(np.int32))
+vcol = jnp.asarray(rng.integers(-1000, 1000, N).astype(np.int32))
+np.asarray(kcol[:1])
+
+def fetch_all(out):
+    leaves = jax.tree.leaves(out)
+    for x in leaves:
+        try: x.copy_to_host_async()
+        except Exception: pass
+    return [np.asarray(x) for x in leaves]
+
+def bench(f, args, label, n=4):
+    fetch_all(f(*args))
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fetch_all(f(*args))
+        ts.append(time.perf_counter() - t0)
+    print(f"{label:56s} p50 {np.median(ts)*1e3:8.1f} ms")
+
+# ~47ms of work, scalar int64 out (baseline: no penalty expected)
+def w_scalar(k, v):
+    def step(c, i):
+        return c + k.astype(jnp.int64).sum() + v.astype(jnp.int64).sum() + i, None
+    c, _ = lax.scan(step, jnp.zeros((), jnp.int64), jnp.arange(40, dtype=jnp.int64))
+    return c
+bench(jax.jit(w_scalar), (kcol, vcol), "40-pass sum -> int64 scalar")
+
+# same work, (40,96) int64 matrix out
+def w_mat(k, v):
+    def step(c, i):
+        s = k.astype(jnp.int64).sum() + v.astype(jnp.int64).sum()
+        return c + s, None
+    c, _ = lax.scan(step, jnp.zeros((40, 96), jnp.int64),
+                    jnp.arange(40, dtype=jnp.int64))
+    return c
+bench(jax.jit(w_mat), (kcol, vcol), "40-pass sum -> (40,96) int64")
+
+# same work, tuple((40,96) i64, (40,32) f64, () i64)
+def w_tup(k, v):
+    def step(c, i):
+        a, b, s = c
+        t = k.astype(jnp.int64).sum() + v.astype(jnp.int64).sum()
+        return (a + t, b + t.astype(jnp.float64), s + t), None
+    c, _ = lax.scan(step, (jnp.zeros((40, 96), jnp.int64),
+                           jnp.zeros((40, 32), jnp.float64),
+                           jnp.zeros((), jnp.int64)),
+                    jnp.arange(40, dtype=jnp.int64))
+    return c
+bench(jax.jit(w_tup), (kcol, vcol), "40-pass sum -> (i64 mat, f64 mat, i64)")
+
+# scan over feed as xs (3200 blocks), int64 scalar out
+def w_xs(k, v):
+    ks = k.reshape(3200, 32768)
+    vs = v.reshape(3200, 32768)
+    def step(c, x):
+        kb, vb = x
+        return c + kb.astype(jnp.int64).sum() + vb.astype(jnp.int64).sum(), None
+    c, _ = lax.scan(step, jnp.zeros((), jnp.int64), (ks, vs))
+    return c
+bench(jax.jit(w_xs), (kcol, vcol), "scan-xs 3200 blocks -> int64 scalar")
+
+# scan over feed as xs, 40x less work per block but 3200 steps: ~1.3ms total
+def w_xs_1(k, v):
+    ks = k.reshape(3200, 32768)
+    vs = v.reshape(3200, 32768)
+    def step(c, x):
+        kb, vb = x
+        return c + kb.astype(jnp.int64).sum() + vb.astype(jnp.int64).sum(), None
+    c, _ = lax.scan(step, jnp.zeros((), jnp.int64), (ks, vs))
+    return c
+# one pass only (same as above); also int32 carry variant
+def w_xs_i32(k, v):
+    ks = k.reshape(3200, 32768)
+    vs = v.reshape(3200, 32768)
+    def step(c, x):
+        kb, vb = x
+        return c + kb.sum() + vb.sum(), None
+    c, _ = lax.scan(step, jnp.zeros((), jnp.int32), (ks, vs))
+    return c
+bench(jax.jit(w_xs_i32), (kcol, vcol), "scan-xs 3200 blocks -> int32 scalar")

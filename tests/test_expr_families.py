@@ -1,0 +1,322 @@
+"""Round-3 expression families: string, like/regexp, time, decimal,
+cross-type compare/control.
+
+Reference test model: tidb_query_expr impl_string.rs / impl_like.rs /
+impl_time.rs inline truth tables.
+"""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.datatype import EvalType
+from tikv_tpu.datatype.time import pack_datetime
+from tikv_tpu.expr import Expr, build_rpn, eval_rpn
+
+I, R, B = EvalType.INT, EvalType.REAL, EvalType.BYTES
+T, D, DEC = EvalType.DATETIME, EvalType.DURATION, EvalType.DECIMAL
+
+
+def ev(tree, cols, n):
+    return eval_rpn(build_rpn(tree), cols, n, np)
+
+
+def bcol(vals):
+    validity = np.array([v is not None for v in vals])
+    values = np.empty(len(vals), dtype=object)
+    for i, v in enumerate(vals):
+        values[i] = v if v is not None else b""
+    return values, validity
+
+
+def icol(vals):
+    validity = np.array([v is not None for v in vals])
+    values = np.array([0 if v is None else v for v in vals],
+                      dtype=np.int64)
+    return values, validity
+
+
+def tcol(vals):
+    validity = np.array([v is not None for v in vals])
+    values = np.array([0 if v is None else v for v in vals],
+                      dtype=np.uint64)
+    return values, validity
+
+
+def as_list(pair):
+    v, ok = pair
+    out = []
+    for i in range(len(v)):
+        if not ok[i]:
+            out.append(None)
+        else:
+            x = v[i]
+            out.append(x.item() if isinstance(x, np.generic) else x)
+    return out
+
+
+def call(sig, *args):
+    return Expr.call(sig, *args)
+
+
+def c(i, ty):
+    return Expr.column(i, ty)
+
+
+# ------------------------------------------------------------------ string
+
+
+def test_string_basics():
+    s = bcol([b"hello", b"", None, b"Ab"])
+    assert as_list(ev(call("Length", c(0, B)), [s], 4)) == [5, 0, None, 2]
+    assert as_list(ev(call("UpperUtf8", c(0, B)), [s], 4)) == \
+        [b"HELLO", b"", None, b"AB"]
+    assert as_list(ev(call("Reverse", c(0, B)), [s], 4)) == \
+        [b"olleh", b"", None, b"bA"]
+    assert as_list(ev(call("Ascii", c(0, B)), [s], 4)) == \
+        [104, 0, None, 65]
+
+
+def test_concat_and_ws():
+    a = bcol([b"a", None, b"x"])
+    b = bcol([b"b", b"c", None])
+    assert as_list(ev(call("Concat", c(0, B), c(1, B)), [a, b], 3)) == \
+        [b"ab", None, None]
+    # ConcatWs skips NULL args, NULL separator -> NULL
+    sep = bcol([b",", b",", None])
+    got = as_list(ev(call("ConcatWs", c(2, B), c(0, B), c(1, B)),
+                     [a, b, sep], 3))
+    assert got == [b"a,b", b"c", None]
+
+
+def test_substring_semantics():
+    s = bcol([b"Quadratically"])
+    assert as_list(ev(call("Substring2Args", c(0, B),
+                           Expr.const(5, I)), [s], 1)) == [b"ratically"]
+    assert as_list(ev(call("Substring2Args", c(0, B),
+                           Expr.const(-3, I)), [s], 1)) == [b"lly"]
+    assert as_list(ev(call("Substring3Args", c(0, B), Expr.const(5, I),
+                           Expr.const(6, I)), [s], 1)) == [b"ratica"]
+    assert as_list(ev(call("Substring2Args", c(0, B),
+                           Expr.const(0, I)), [s], 1)) == [b""]
+
+
+def test_locate_instr_strcmp():
+    s = bcol([b"foobarbar"])
+    assert as_list(ev(call("Locate2Args", Expr.const(b"bar", B),
+                           c(0, B)), [s], 1)) == [4]
+    assert as_list(ev(call("Locate3Args", Expr.const(b"bar", B), c(0, B),
+                           Expr.const(5, I)), [s], 1)) == [7]
+    assert as_list(ev(call("Instr", c(0, B), Expr.const(b"bar", B)),
+                     [s], 1)) == [4]
+    a, b = bcol([b"a", b"b", b"a"]), bcol([b"b", b"a", b"a"])
+    assert as_list(ev(call("Strcmp", c(0, B), c(1, B)), [a, b], 3)) == \
+        [-1, 1, 0]
+
+
+def test_pad_trim_repeat():
+    s = bcol([b"hi"])
+    assert as_list(ev(call("Lpad", c(0, B), Expr.const(5, I),
+                           Expr.const(b"?!", B)), [s], 1)) == [b"?!?hi"]
+    assert as_list(ev(call("Rpad", c(0, B), Expr.const(1, I),
+                           Expr.const(b"?", B)), [s], 1)) == [b"h"]
+    # empty pad with target > len -> NULL (impl_string.rs lpad)
+    assert as_list(ev(call("Lpad", c(0, B), Expr.const(5, I),
+                           Expr.const(b"", B)), [s], 1)) == [None]
+    t = bcol([b"  x  ", b"xxbarxx"])
+    assert as_list(ev(call("Trim1Arg", c(0, B)), [t], 2)) == \
+        [b"x", b"xxbarxx"]
+    assert as_list(ev(call("Trim2Args", c(0, B), Expr.const(b"xx", B)),
+                     [t], 2)) == [b"  x  ", b"bar"]
+    assert as_list(ev(call("Repeat", c(0, B), Expr.const(2, I)),
+                     [bcol([b"ab"])], 1)) == [b"abab"]
+
+
+def test_hash_hex_base64():
+    s = bcol([b"abc"])
+    assert as_list(ev(call("Md5", c(0, B)), [s], 1)) == \
+        [b"900150983cd24fb0d6963f7d28e17f72"]
+    assert as_list(ev(call("Sha1", c(0, B)), [s], 1)) == \
+        [b"a9993e364706816aba3e25717850c26c9cd0d89d"]
+    assert as_list(ev(call("HexStrArg", c(0, B)), [s], 1)) == [b"616263"]
+    assert as_list(ev(call("UnHex", Expr.const(b"616263", B)),
+                     [], 1)) == [b"abc"]
+    assert as_list(ev(call("UnHex", Expr.const(b"zz", B)), [], 1)) == [None]
+    assert as_list(ev(call("ToBase64", c(0, B)), [s], 1)) == [b"YWJj"]
+    assert as_list(ev(call("FromBase64", Expr.const(b"YWJj", B)),
+                     [], 1)) == [b"abc"]
+
+
+def test_find_in_set_elt_substring_index():
+    assert as_list(ev(call("FindInSet", Expr.const(b"b", B),
+                           Expr.const(b"a,b,c", B)), [], 1)) == [2]
+    assert as_list(ev(call("FindInSet", Expr.const(b"d", B),
+                           Expr.const(b"a,b,c", B)), [], 1)) == [0]
+    assert as_list(ev(call("Elt", Expr.const(2, I), Expr.const(b"x", B),
+                           Expr.const(b"y", B)), [], 1)) == [b"y"]
+    assert as_list(ev(call("Elt", Expr.const(9, I), Expr.const(b"x", B),
+                           Expr.const(b"y", B)), [], 1)) == [None]
+    assert as_list(ev(call("SubstringIndex", Expr.const(b"a.b.c", B),
+                           Expr.const(b".", B), Expr.const(2, I)),
+                     [], 1)) == [b"a.b"]
+    assert as_list(ev(call("SubstringIndex", Expr.const(b"a.b.c", B),
+                           Expr.const(b".", B), Expr.const(-1, I)),
+                     [], 1)) == [b"c"]
+
+
+# ------------------------------------------------------------------- like
+
+
+def test_like_pattern():
+    s = bcol([b"David!", b"David", b"Dave", None])
+    pat = Expr.const(b"David_", B)
+    esc = Expr.const(92, I)
+    got = as_list(ev(call("LikeSig", c(0, B), pat, esc), [s], 4))
+    assert got == [1, 0, 0, None]
+    pat2 = Expr.const(b"%D%v%", B)
+    got2 = as_list(ev(call("LikeSig", c(0, B), pat2, esc), [s], 4))
+    assert got2 == [1, 1, 1, None]
+    # escaped % is literal
+    s2 = bcol([b"50%", b"50x"])
+    pat3 = Expr.const(b"50\\%", B)
+    assert as_list(ev(call("LikeSig", c(0, B), pat3, esc), [s2], 2)) == \
+        [1, 0]
+
+
+def test_regexp():
+    s = bcol([b"new york", b"NEW YORK", None])
+    assert as_list(ev(call("RegexpLikeSig", c(0, B),
+                           Expr.const(b"^new", B)), [s], 3)) == [1, 0, None]
+    assert as_list(ev(call("RegexpLikeSig", c(0, B),
+                           Expr.const(b"^new", B), Expr.const(b"i", B)),
+                     [s], 3)) == [1, 1, None]
+    assert as_list(ev(call("RegexpInStrSig", Expr.const(b"abcabc", B),
+                           Expr.const(b"b", B), Expr.const(3, I),
+                           Expr.const(1, I)), [], 1)) == [5]
+    assert as_list(ev(call("RegexpSubstrSig", Expr.const(b"abc def", B),
+                           Expr.const(b"[a-z]+", B), Expr.const(1, I),
+                           Expr.const(2, I)), [], 1)) == [b"def"]
+    assert as_list(ev(call("RegexpReplaceSig", Expr.const(b"a1b2", B),
+                           Expr.const(b"[0-9]", B), Expr.const(b"#", B)),
+                     [], 1)) == [b"a#b#"]
+
+
+# ------------------------------------------------------------------- time
+
+
+def test_time_extraction():
+    t = tcol([int(pack_datetime(2024, 2, 29, 13, 45, 7, 123456)), None])
+    assert as_list(ev(call("Year", c(0, T)), [t], 2)) == [2024, None]
+    assert as_list(ev(call("Month", c(0, T)), [t], 2)) == [2, None]
+    assert as_list(ev(call("DayOfMonth", c(0, T)), [t], 2)) == [29, None]
+    assert as_list(ev(call("MicroSecond", c(0, T)), [t], 2)) == \
+        [123456, None]
+    assert as_list(ev(call("Quarter", c(0, T)), [t], 2)) == [1, None]
+
+
+def test_time_calendar():
+    # 2024-02-29 was a Thursday
+    t = tcol([int(pack_datetime(2024, 2, 29))])
+    assert as_list(ev(call("DayOfWeek", c(0, T)), [t], 1)) == [5]
+    assert as_list(ev(call("WeekDay", c(0, T)), [t], 1)) == [3]
+    assert as_list(ev(call("DayOfYear", c(0, T)), [t], 1)) == [60]
+    assert as_list(ev(call("WeekOfYear", c(0, T)), [t], 1)) == [9]
+    # MySQL TO_DAYS('1970-01-01') = 719528
+    t2 = tcol([int(pack_datetime(1970, 1, 1))])
+    assert as_list(ev(call("ToDays", c(0, T)), [t2], 1)) == [719528]
+    # zero date -> NULL
+    t0 = tcol([int(pack_datetime(0, 0, 0))])
+    assert as_list(ev(call("DayOfWeek", c(0, T)), [t0], 1)) == [None]
+
+
+def test_time_lastday_datediff_fromdays():
+    t = tcol([int(pack_datetime(2024, 2, 3)),
+              int(pack_datetime(2023, 2, 3))])
+    got = as_list(ev(call("LastDay", c(0, T)), [t], 2))
+    assert got == [int(pack_datetime(2024, 2, 29)),
+                   int(pack_datetime(2023, 2, 28))]
+    a = tcol([int(pack_datetime(2007, 12, 31, 23, 59, 59))])
+    b = tcol([int(pack_datetime(2007, 12, 30))])
+    assert as_list(ev(call("DateDiff", c(0, T), c(1, T)), [a, b], 1)) == [1]
+    assert as_list(ev(call("FromDays", Expr.const(730669, I)),
+                     [], 1)) == [int(pack_datetime(2000, 7, 3))]
+
+
+def test_duration_and_periods():
+    ns = 1_000_000_000
+    d = (np.array([(11 * 3600 + 30 * 60 + 49) * ns,
+                   -(1 * 3600 + 2 * 60 + 3) * ns], dtype=np.int64),
+         np.array([True, True]))
+    assert as_list(ev(call("Hour", c(0, D)), [d], 2)) == [11, 1]
+    assert as_list(ev(call("Minute", c(0, D)), [d], 2)) == [30, 2]
+    assert as_list(ev(call("Second", c(0, D)), [d], 2)) == [49, 3]
+    assert as_list(ev(call("TimeToSec", c(0, D)), [d], 2)) == \
+        [41449, -3723]
+    assert as_list(ev(call("PeriodAdd", Expr.const(200801, I),
+                           Expr.const(2, I)), [], 1)) == [200803]
+    assert as_list(ev(call("PeriodDiff", Expr.const(200802, I),
+                           Expr.const(200703, I)), [], 1)) == [11]
+
+
+def test_month_day_names_and_format():
+    t = tcol([int(pack_datetime(2009, 10, 4, 22, 23, 0))])
+    assert as_list(ev(call("MonthName", c(0, T)), [t], 1)) == [b"October"]
+    assert as_list(ev(call("DayName", c(0, T)), [t], 1)) == [b"Sunday"]
+    got = as_list(ev(call("DateFormatSig", c(0, T),
+                          Expr.const(b"%W %M %Y %H:%i:%s", B)), [t], 1))
+    assert got == [b"Sunday October 2009 22:23:00"]
+
+
+# --------------------------------------------------- cross-type families
+
+
+def test_string_compare_and_control():
+    a = bcol([b"abc", b"b", None])
+    b = bcol([b"abd", b"b", b"x"])
+    assert as_list(ev(call("LtString", c(0, B), c(1, B)), [a, b], 3)) == \
+        [1, 0, None]
+    assert as_list(ev(call("EqString", c(0, B), c(1, B)), [a, b], 3)) == \
+        [0, 1, None]
+    assert as_list(ev(call("NullEqString", c(0, B), c(1, B)),
+                     [a, b], 3)) == [0, 1, 0]
+    assert as_list(ev(call("IfNullString", c(0, B), c(1, B)),
+                     [a, b], 3)) == [b"abc", b"b", b"x"]
+    assert as_list(ev(call("StringIsNull", c(0, B)), [a], 3)) == [0, 0, 1]
+    assert as_list(ev(call("InString", c(0, B), Expr.const(b"abc", B),
+                           Expr.const(b"zz", B)), [a], 3)) == [1, 0, None]
+    assert as_list(ev(call("GreatestString", c(0, B), c(1, B)),
+                     [a, b], 3)) == [b"abd", b"b", None]
+
+
+def test_decimal_family():
+    # scaled int64 at scale 2: 1.23 -> 123
+    a = (np.array([123, -50, 0], np.int64), np.array([True, True, False]))
+    b = (np.array([77, -50, 10], np.int64), np.array([True, True, True]))
+    assert as_list(ev(call("PlusDecimal", c(0, DEC), c(1, DEC)),
+                     [a, b], 3)) == [200, -100, None]
+    assert as_list(ev(call("GtDecimal", c(0, DEC), c(1, DEC)),
+                     [a, b], 3)) == [1, 0, None]
+    assert as_list(ev(call("AbsDecimal", c(0, DEC)), [a], 3)) == \
+        [123, 50, None]
+    assert as_list(ev(call("DecimalIsNull", c(0, DEC)), [a], 3)) == \
+        [0, 0, 1]
+
+
+def test_time_compare():
+    t1 = tcol([int(pack_datetime(2024, 1, 1))])
+    t2 = tcol([int(pack_datetime(2023, 12, 31))])
+    assert as_list(ev(call("GtTime", c(0, T), c(1, T)), [t1, t2], 1)) == [1]
+
+
+def test_cast_string_numeric():
+    s = bcol([b"42", b"-7", b"3.5x", b"abc", b""])
+    assert as_list(ev(call("CastStringAsInt", c(0, B)), [s], 5)) == \
+        [42, -7, 3, 0, 0]
+    got = as_list(ev(call("CastStringAsReal", c(0, B)), [s], 5))
+    assert got == [42.0, -7.0, 3.5, 0.0, 0.0]
+    assert as_list(ev(call("CastIntAsString", Expr.const(-5, I)),
+                     [], 1)) == [b"-5"]
+
+
+def test_registry_size():
+    from tikv_tpu.expr.functions import FUNCTIONS
+    assert len(FUNCTIONS) >= 250, len(FUNCTIONS)

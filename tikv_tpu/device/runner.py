@@ -168,13 +168,19 @@ class DeviceRunner:
         # on tiny fixtures
         S = num_shards(self._mesh)
         if chunk_rows is None:
-            self._block_local = _FEED_BLOCK
+            # single-device feeds pad to the Pallas block so the fused
+            # hash kernel (pallas_hash.BLOCK rows/grid step) divides the
+            # feed exactly; the XLA scan paths gcd down from this
+            from .pallas_hash import BLOCK as _PL_BLOCK
+            self._block_local = _PL_BLOCK if self._single else _FEED_BLOCK
             self._chunk_override = False
         else:
             self._block_local = max(8, ((max(chunk_rows, 8) // S) // 8) * 8)
             self._chunk_override = True
         self._plan_cache: dict = {}
         self._kernel_cache: dict = {}
+        from collections import OrderedDict
+        self._scalar_cache: "OrderedDict" = OrderedDict()
         # HBM-resident feed cache — the TPU-native analog of TiKV's
         # in-memory region cache engine (components/
         # region_cache_memory_engine: RangeCacheMemoryEngine layered over
@@ -407,6 +413,34 @@ class DeviceRunner:
             kern = build()
             self._kernel_cache[cache_key] = kern
         return kern
+
+    def _cached_scalar(self, v, dtype):
+        """Device-resident scalar, uploaded once per value.  A fresh H2D
+        per request adds ~30ms to the next fetch through the tunnel.
+        LRU-bounded: row counts vary per snapshot, so unbounded caching
+        would leak one device buffer per distinct n on a live server."""
+        key = (int(v), str(dtype))
+        cache = self._scalar_cache
+        arr = cache.get(key)
+        if arr is None:
+            arr = jnp.asarray(v, dtype)
+            cache[key] = arr
+            while len(cache) > 256:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(key)
+        return arr
+
+    def _cached_carry(self, cache_key, build):
+        """Device-resident initial carry, uploaded once per kernel key.
+        Kernels never donate their inputs, so the same zero/identity
+        buffers are safe to reuse across requests."""
+        key = ("carry0",) + cache_key
+        carry = self._kernel_cache.get(key)
+        if carry is None:
+            carry = self._put_carry(build())
+            self._kernel_cache[key] = carry
+        return carry
 
     def _eval_masked(self, plan: _Plan, pairs, n_local, row_mask):
         mask = row_mask
@@ -845,11 +879,6 @@ class DeviceRunner:
         plan = self._analyze(dag)
         if plan is None:
             raise RuntimeError("plan not supported by device backend")
-        batch = self._scan_batch(dag, plan, storage)
-        n = batch.num_rows
-        if n == 0:
-            from ..executors.runner import BatchExecutorsRunner
-            return BatchExecutorsRunner(dag, storage).handle_request()
 
         # keyed on the full plan: hash_bounds/arg_nbytes depend on the
         # key/arg expressions, not just on which columns are shipped
@@ -858,9 +887,32 @@ class DeviceRunner:
 
         memo: dict = {}
 
+        def get_batch():
+            """Host ColumnBatch for this scan (built at most once; the
+            warm agg path never needs it — the feed is HBM-resident and
+            the row count is memoized)."""
+            if "batch" not in memo:
+                memo["batch"] = self._scan_batch(dag, plan, storage)
+            return memo["batch"]
+
+        if "n_rows" in meta:
+            n = meta["n_rows"]
+        else:
+            n = get_batch().num_rows
+            meta["n_rows"] = n
+        if n == 0:
+            from ..executors.runner import BatchExecutorsRunner
+            return BatchExecutorsRunner(dag, storage).handle_request()
+
         def host_cols():
-            """Device-dtype numpy column pairs (built at most once)."""
-            if "cols" not in memo:
+            """Device-dtype numpy column pairs.
+
+            Cached for the snapshot's lifetime (in ``meta``, same policy
+            as the device feed): the astype alone costs ~2s per 100M-row
+            REAL column, and the TopN candidate refine reads these on
+            every request."""
+            if "host_cols" not in meta:
+                batch = get_batch()
                 cols, dts = [], []
                 for ci in plan.used_cols:
                     col = batch.columns[ci]
@@ -869,9 +921,9 @@ class DeviceRunner:
                         col.values.astype(dt, copy=False)),
                         np.ascontiguousarray(col.validity)))
                     dts.append(str(dt))
-                memo["cols"] = cols
+                meta["host_cols"] = cols
                 meta.setdefault("dtypes", tuple(dts))
-            return memo["cols"]
+            return meta["host_cols"]
 
         if "dtypes" not in meta:
             host_cols()
@@ -889,9 +941,10 @@ class DeviceRunner:
                                         feed, meta)
             elif plan.kind == "topn":
                 result = self._run_topn(dag, plan, host_cols, dtypes, n,
-                                        batch, feed)
+                                        get_batch, feed)
             else:   # scan_sel
-                result = self._run_scan_sel(dag, plan, dtypes, n, batch, feed)
+                result = self._run_scan_sel(dag, plan, dtypes, n, get_batch,
+                                            feed)
         except _FallbackToHost:
             from ..executors.runner import BatchExecutorsRunner
             return BatchExecutorsRunner(dag, storage).handle_request()
@@ -925,18 +978,19 @@ class DeviceRunner:
     # -- simple agg --
 
     def _run_simple(self, dag, plan, dtypes, n, feed):
-        carry = self._put_carry(self._init_agg_carry(plan, None))
         chunk = self._pick_chunk(feed["n_pad"], _CHUNK_AGG)
         n_cols = len(plan.used_cols)
         key = self._kern_key("simple", dag, feed, chunk, tuple(dtypes))
+        carry = self._cached_carry(key,
+                                   lambda: self._init_agg_carry(plan, None))
         kern = self._shard_kernel(
             key, lambda: self._wrap_mega(
                 self._mega(self._build_simple_body(plan, n_cols),
                            self._finalize_psum_summed(),
                            feed["null_flags"], feed["n_pad"], chunk),
                 carry, len(feed["flat"])))
-        carry = kern(carry, jnp.asarray(n, jnp.int64),
-                     jnp.asarray(0, jnp.int64), *feed["flat"])
+        carry = kern(carry, self._cached_scalar(n, jnp.int64),
+                     self._cached_scalar(0, jnp.int64), *feed["flat"])
         summed, stacked = self._readback(carry)
         merged = self._merge_stacked(plan.specs, summed, stacked)
         finals = finalize_simple(plan.specs, merged)
@@ -974,6 +1028,7 @@ class DeviceRunner:
                 base, span = 0, 1
             arg_nbytes = self._arg_nbytes(plan, host_cols(), n)
             meta["hash_bounds"] = (base, span, arg_nbytes)
+            meta.setdefault("n_rows", n)
         if span > self._max_hash_capacity:
             # group cardinality exceeds the device direct-index capacity —
             # reference splits fast vs slow hash agg the same way
@@ -998,21 +1053,28 @@ class DeviceRunner:
         if matmul_supported(plan.specs):
             layouts, p8, pf = build_layouts(plan.specs, arg_is_real,
                                             arg_nbytes, arg_ok_is_mask)
-        base_arr = jnp.asarray(base, jnp.int64)
-        n_arr = jnp.asarray(n, jnp.int64)
+        base_arr = self._cached_scalar(base, jnp.int64)
+        n_arr = self._cached_scalar(n, jnp.int64)
         n_cols = len(plan.used_cols)
 
-        if layouts is not None and twolevel_lo(p8, pf) is not None:
+        merged = None
+        if layouts is not None:
+            merged = self._try_pallas_hash(dag, plan, feed, dtypes, n,
+                                           base, capacity, layouts, p8, pf,
+                                           arg_nbytes, arg_ok_is_mask)
+        if merged is not None:
+            pass
+        elif layouts is not None and twolevel_lo(p8, pf) is not None:
             LO, HI = twolevel_dims(slots, p8, pf)
             chunk = self._pick_chunk(feed["n_pad"], self._feed_unit())
-            carry = self._put_carry((
+            key = self._kern_key("hash2l", dag, feed, chunk, tuple(dtypes),
+                                 capacity, arg_nbytes,
+                                 tuple(arg_ok_is_mask))
+            carry = self._cached_carry(key, lambda: (
                 (np.zeros((HI, p8 * LO), np.int64),
                  np.zeros((HI, max(pf, 1) * LO), np.float64),
                  np.zeros((), np.int64)),
                 []))
-            key = self._kern_key("hash2l", dag, feed, chunk, tuple(dtypes),
-                                 capacity, arg_nbytes,
-                                 tuple(arg_ok_is_mask))
             kern = self._shard_kernel(
                 key, lambda: self._wrap_mega(
                     self._mega(self._build_hash_twolevel_body(
@@ -1031,12 +1093,15 @@ class DeviceRunner:
                       "states": states}
         else:
             chunk = self._pick_chunk(feed["n_pad"], _CHUNK_AGG)
-            sm_init, st_init = self._init_agg_carry(plan, slots)
-            carry = self._put_carry((
-                (sm_init, np.zeros(slots, np.int64), np.zeros((), np.int64)),
-                st_init))
             key = self._kern_key("hashsc", dag, feed, chunk, tuple(dtypes),
                                  capacity)
+
+            def build_scatter_carry():
+                sm_init, st_init = self._init_agg_carry(plan, slots)
+                return ((sm_init, np.zeros(slots, np.int64),
+                         np.zeros((), np.int64)), st_init)
+
+            carry = self._cached_carry(key, build_scatter_carry)
             kern = self._shard_kernel(
                 key, lambda: self._wrap_mega(
                     self._mega(self._build_hash_scatter_body(
@@ -1064,6 +1129,58 @@ class DeviceRunner:
         schema.append(FieldType.long())
         cols.append(Column.from_list(EvalType.INT, keys))
         return self._result(dag, schema, cols)
+
+    def _try_pallas_hash(self, dag, plan, feed, dtypes, n, base, capacity,
+                         layouts, p8, pf, arg_nbytes, arg_ok_is_mask):
+        """Fused Pallas fast path for the direct-index hash agg.
+
+        Returns the merged-states dict (same shape the XLA paths
+        produce) or None when the plan/feed/platform is outside the
+        kernel's envelope — the caller then runs the XLA two-level path.
+        A build or compile failure is cached so the fallback is taken
+        once per plan, not per request.
+        """
+        from . import pallas_hash
+        from .kernels import states_from_matmul, twolevel_unpack
+        dev0 = self._mesh.devices.flat[0]
+        if dev0.platform == "cpu":
+            return None     # Mosaic kernels need real TPU lowering
+        if not pallas_hash.supported(plan, feed, dtypes, pf, capacity,
+                                     self._single):
+            return None
+        slots = capacity + 2
+        key = ("hashpl", dag.plan_key(), feed["n_pad"], tuple(dtypes),
+               capacity, arg_nbytes, tuple(arg_ok_is_mask))
+        entry = self._kernel_cache.get(key)
+        if entry is False:
+            return None
+        if entry is None:
+            try:
+                run, LO, HI = pallas_hash.build(
+                    plan, layouts, p8, capacity, feed["n_pad"],
+                    len(plan.used_cols))
+                # compile + validate now so Mosaic rejections fall back
+                packed = np.asarray(run(n, base, feed["flat"]))
+            except Exception as e:
+                # cached so the fallback is decided once per plan — but
+                # never silently: a swallowed genuine bug here would
+                # disguise itself as the slower XLA path
+                import logging
+                logging.getLogger(__name__).warning(
+                    "pallas hash kernel disabled for plan %r: %s: %s",
+                    key[1], type(e).__name__, e)
+                self._kernel_cache[key] = False
+                return None
+            entry = (run, LO)
+            self._kernel_cache[key] = entry
+        else:
+            run, LO = entry
+            packed = np.asarray(run(n, base, feed["flat"]))
+        S = pallas_hash.unpack_to_int64(packed)
+        S8 = twolevel_unpack(S, p8, LO, slots, xp=np)
+        present, states = states_from_matmul(layouts, plan.specs, S8,
+                                             None, xp=np)
+        return {"present": present, "overflow": False, "states": states}
 
     def _arg_nbytes(self, plan: _Plan, host_cols, n: int) -> tuple:
         """Byte-plane count per aggregate arg for the MXU int path.
@@ -1093,7 +1210,7 @@ class DeviceRunner:
 
     # -- selection (mask on device, compact on host) --
 
-    def _run_scan_sel(self, dag, plan, dtypes, n, batch, feed):
+    def _run_scan_sel(self, dag, plan, dtypes, n, get_batch, feed):
         chunk = self._pick_chunk(feed["n_pad"], _CHUNK_AGG)
         S = self._nshards()
         key = self._kern_key("mask", dag, feed, chunk, tuple(dtypes))
@@ -1104,25 +1221,25 @@ class DeviceRunner:
                            chunk, emits=True),
                 ((), ()), len(feed["flat"]),
                 ys_specs=P(None, ROW_AXES)))
-        _, ys = kern(((), ()), jnp.asarray(n, jnp.int64),
-                     jnp.asarray(0, jnp.int64), *feed["flat"])
+        _, ys = kern(((), ()), self._cached_scalar(n, jnp.int64),
+                     self._cached_scalar(0, jnp.int64), *feed["flat"])
         ys = self._readback(ys)
         nblk = feed["n_pad"] // chunk
         full = ys.reshape(nblk, S, chunk // S).transpose(1, 0, 2) \
             .reshape(feed["n_pad"])[:n]
-        out = batch.filter(full)
+        out = get_batch().filter(full)
         return self._result(dag, out.schema, out.columns)
 
     # -- top-n --
 
-    def _run_topn(self, dag, plan, host_cols, dtypes, n, batch, feed):
+    def _run_topn(self, dag, plan, host_cols, dtypes, n, get_batch, feed):
         k = plan.limit
         key = self._kern_key("topn", dag, feed, 0, tuple(dtypes), k)
         kern = self._shard_kernel(
             key, lambda: self._build_topn_kernel(
                 plan, len(plan.used_cols), k, feed["null_flags"],
                 feed["n_pad"], len(feed["flat"])))
-        ys = kern(jnp.asarray(n, jnp.int64), *feed["flat"])
+        ys = kern(self._cached_scalar(n, jnp.int64), *feed["flat"])
         gidx_s, mask_s, ok_s = self._readback(ys)
         gidx = gidx_s.reshape(-1)
         mask = mask_s.reshape(-1)
@@ -1151,5 +1268,5 @@ class DeviceRunner:
             keyf = np.where(ok, vals, -np.inf)      # NULL smallest
             order = np.lexsort((gidx, -keyf if plan.order_desc else keyf))
         take = gidx[order[:plan.limit]]
-        out = batch.take(take)
+        out = get_batch().take(take)
         return self._result(dag, out.schema, out.columns)

@@ -133,6 +133,16 @@ class ColumnarTable:
     def count_rows(self, ranges: Sequence[KeyRange]) -> int:
         return sum(j - i for i, j in self._range_slices(ranges))
 
+    def _ones(self, n: int) -> np.ndarray:
+        """Cached all-true validity, grown monotonically and sliced —
+        pk-handle columns are NOT NULL by construction and a fresh
+        100M-row bool array per scan costs ~50ms."""
+        ones = getattr(self, "_ones_validity", None)
+        if ones is None or len(ones) < n:
+            ones = np.ones(max(n, len(self.handles)), dtype=np.bool_)
+            self._ones_validity = ones
+        return ones[:n]
+
     def scan_columns(self, desc,
                      ranges: Sequence[KeyRange]) -> ColumnBatch:
         """Vectorized range scan → ColumnBatch in ``desc.columns`` order."""
@@ -161,8 +171,7 @@ class ColumnarTable:
         out_cols = []
         for info in desc.columns:
             if info.is_pk_handle:
-                v, m = gather(self.handles,
-                              np.ones(len(self.handles), dtype=np.bool_))
+                v, m = gather(self.handles, self._ones(len(self.handles)))
                 out_cols.append(Column(EvalType.INT, v, m))
                 continue
             col = self.columns.get(info.col_id)
@@ -249,13 +258,16 @@ class ColumnarTable:
         def gather(a: np.ndarray) -> np.ndarray:
             parts = [a[i:j][::-1] if desc.desc else a[i:j]
                      for i, j in slices]
-            return np.concatenate(parts) if parts else a[:0]
+            if not parts:
+                return a[:0]
+            # single-slice scans (the common full/point-range case) stay
+            # zero-copy views of the memoized sorted arrays
+            return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
         out_cols = [Column(col.eval_type, gather(svals), gather(svalid))]
         if want_handle:
             gh = gather(shandles)
-            out_cols.append(Column(EvalType.INT, gh,
-                                   np.ones(len(gh), dtype=np.bool_)))
+            out_cols.append(Column(EvalType.INT, gh, self._ones(len(gh))))
         return ColumnBatch([c.field_type for c in infos], out_cols)
 
     # -- row-codec materialization (parity tests only) -----------------------

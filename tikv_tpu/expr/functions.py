@@ -385,6 +385,85 @@ def _register_cast():
         dt = "int32" if xp.__name__.startswith("jax") else "int64"
         return rounded.astype(dt), am
 
+    import numpy as _np
+
+    _I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+    @rpn_fn("CastStringAsInt", 1, I, (EvalType.BYTES,))
+    def cast_string_int(xp, a):
+        # MySQL parses the longest numeric prefix (empty/invalid -> 0)
+        # and clamps out-of-range values to the int64 bounds (with a
+        # truncation warning in MySQL; silently here).
+        def go(s):
+            s = s.strip()
+            i, n = 0, len(s)
+            if i < n and s[i:i + 1] in (b"+", b"-"):
+                i += 1
+            j = i
+            while j < n and 0x30 <= s[j] <= 0x39:
+                j += 1
+            try:
+                v = int(s[:j])
+            except ValueError:
+                return 0
+            return min(max(v, _I64_MIN), _I64_MAX)
+        (av, am) = a
+        out = _np.frompyfunc(go, 1, 1)(_np.asarray(av, dtype=object))
+        return _np.asarray(out, dtype=object).astype(_np.int64), am
+
+    @rpn_fn("CastStringAsReal", 1, R, (EvalType.BYTES,))
+    def cast_string_real(xp, a):
+        def go(s):
+            s = s.strip()
+            j, n = 0, len(s)
+            if j < n and s[j:j + 1] in (b"+", b"-"):
+                j += 1
+            digits = 0
+            seen_dot = False
+            while j < n:
+                c = s[j:j + 1]
+                if c.isdigit():
+                    digits += 1
+                    j += 1
+                elif c == b"." and not seen_dot:
+                    seen_dot = True
+                    j += 1
+                else:
+                    break
+            # exponent: accepted only with at least one following digit
+            # (MySQL longest-valid-prefix: b"15e" parses as 15)
+            if digits and j < n and s[j:j + 1] in (b"e", b"E"):
+                k = j + 1
+                if k < n and s[k:k + 1] in (b"+", b"-"):
+                    k += 1
+                if k < n and s[k:k + 1].isdigit():
+                    while k < n and s[k:k + 1].isdigit():
+                        k += 1
+                    j = k
+            try:
+                return float(s[:j])
+            except ValueError:
+                return 0.0
+        (av, am) = a
+        out = _np.frompyfunc(go, 1, 1)(_np.asarray(av, dtype=object))
+        return _np.asarray(out, dtype=object).astype(_np.float64), am
+
+    @rpn_fn("CastIntAsString", 1, EvalType.BYTES, (I,))
+    def cast_int_string(xp, a):
+        (av, am) = a
+        return _np.frompyfunc(lambda v: b"%d" % int(v), 1, 1)(
+            _np.asarray(av, dtype=_np.int64)), am
+
+    @rpn_fn("CastRealAsString", 1, EvalType.BYTES, (R,))
+    def cast_real_string(xp, a):
+        (av, am) = a
+        return _np.frompyfunc(lambda v: repr(float(v)).encode(), 1, 1)(
+            _np.asarray(av, dtype=_np.float64)), am
+
+    @rpn_fn("CastStringAsString", 1, EvalType.BYTES, (EvalType.BYTES,))
+    def cast_string_string(xp, a):
+        return a
+
 
 # ---------------------------------------------------------------------------
 # Math — reference: impl_math.rs
@@ -496,3 +575,14 @@ _register_logic()
 _register_control()
 _register_cast()
 _register_math()
+
+# family modules (imported late: they need the registry decorator above)
+from . import impl_like as _impl_like      # noqa: E402
+from . import impl_string as _impl_string  # noqa: E402
+from . import impl_time as _impl_time      # noqa: E402
+from . import impl_types as _impl_types    # noqa: E402
+
+_impl_string.register()
+_impl_like.register()
+_impl_time.register()
+_impl_types.register()

@@ -1,0 +1,204 @@
+"""Compare / control / IsNull / decimal families across eval types.
+
+Reference: components/tidb_query_expr/src/impl_compare.rs (the Gt/Ge/…
+sig matrix over every eval type), impl_control.rs (If/IfNull/CaseWhen/
+Coalesce per type), impl_op.rs (*IsNull), impl_arithmetic.rs decimal
+ops.  Sig names match the reference ScalarFuncSig variants.
+
+Type representations (datatype/eval_type.py): String = object array of
+bytes (binary collation — bytewise order matches MySQL's binary
+collation); Decimal = scaled int64 (comparisons and +/- assume operands
+share a scale — the plan compiler's responsibility here, a documented
+deviation from the reference's arbitrary-precision Decimal); Time =
+packed u64 core (the bit layout is order-preserving: year in the top
+bits); Duration = i64 nanoseconds.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..datatype import EvalType
+from .functions import FUNCTIONS, RpnFnMeta, rpn_fn, _ibool
+
+I, R, B = EvalType.INT, EvalType.REAL, EvalType.BYTES
+DEC, T, D = EvalType.DECIMAL, EvalType.DATETIME, EvalType.DURATION
+
+_FAMS = (("String", B), ("Decimal", DEC), ("Time", T), ("Duration", D))
+
+
+def _cmp_vals(ty, xp, av, bv, op):
+    if ty is B:
+        a = np.asarray(av, dtype=object)
+        b = np.asarray(bv, dtype=object)
+        out = np.frompyfunc(op, 2, 1)(a, b)
+        return np.asarray(out, dtype=bool)
+    return op(av, bv)
+
+
+def register() -> None:
+    # ---- comparisons ----
+    cmps = {
+        "Gt": lambda a, b: a > b,
+        "Ge": lambda a, b: a >= b,
+        "Lt": lambda a, b: a < b,
+        "Le": lambda a, b: a <= b,
+        "Eq": lambda a, b: a == b,
+        "Ne": lambda a, b: a != b,
+    }
+    for fam, ty in _FAMS:
+        for stem, op in cmps.items():
+            @rpn_fn(stem + fam, 2, I, (ty, ty))
+            def _cmp(xp, a, b, _op=op, _ty=ty):
+                (av, am), (bv, bm) = a, b
+                return _ibool(xp, _cmp_vals(_ty, xp, av, bv, _op)), am & bm
+
+        @rpn_fn("NullEq" + fam, 2, I, (ty, ty))
+        def _null_eq(xp, a, b, _ty=ty):
+            (av, am), (bv, bm) = a, b
+            both_null = ~am & ~bm
+            eq = am & bm & _cmp_vals(_ty, xp, av, bv, lambda x, y: x == y)
+            return _ibool(xp, both_null | eq), np.ones_like(np.asarray(am))
+
+        @rpn_fn("In" + fam, None, I, (ty,))
+        def _in(xp, *pairs, _ty=ty):
+            (pv, pm) = pairs[0]
+            hit = None
+            any_null = ~np.asarray(pm)
+            for (lv, lm) in pairs[1:]:
+                h = pm & lm & _cmp_vals(_ty, xp, pv, lv,
+                                        lambda x, y: x == y)
+                hit = h if hit is None else (hit | h)
+                any_null = any_null | ~np.asarray(lm)
+            if hit is None:
+                hit = np.zeros_like(np.asarray(pm))
+            return _ibool(xp, hit), hit | ~any_null
+
+    # ---- control ----
+    for fam, ty in _FAMS:
+        @rpn_fn("If" + fam, 3, ty, (I, ty, ty))
+        def _if(xp, c, t, f, _ty=ty):
+            (cv, cm), (tv, tm), (fv, fm) = c, t, f
+            cond = cm & (cv != 0)
+            return np.where(cond, tv, fv), np.where(cond, tm, fm)
+
+        @rpn_fn("IfNull" + fam, 2, ty, (ty, ty))
+        def _if_null(xp, a, b, _ty=ty):
+            (av, am), (bv, bm) = a, b
+            return np.where(am, av, bv), am | bm
+
+        @rpn_fn("CaseWhen" + fam, None, ty, (ty,))
+        def _case_when(xp, *pairs, _ty=ty):
+            n = len(pairs)
+            has_else = n % 2 == 1
+            conds = [(pairs[i], pairs[i + 1]) for i in range(0, n - 1, 2)]
+            if has_else:
+                out_v, out_m = pairs[-1]
+            else:
+                (v0, m0) = conds[0][1]
+                out_v = np.zeros_like(np.asarray(v0))
+                out_m = np.zeros_like(np.asarray(m0))
+            for (cv, cm), (rv, rm) in reversed(conds):
+                hitc = cm & (cv != 0)
+                out_v = np.where(hitc, rv, out_v)
+                out_m = np.where(hitc, rm, out_m)
+            return out_v, out_m
+
+        @rpn_fn("Coalesce" + fam, None, ty, (ty,))
+        def _coalesce(xp, *pairs, _ty=ty):
+            out_v, out_m = pairs[-1]
+            for (v, m) in reversed(pairs[:-1]):
+                out_v = np.where(m, v, out_v)
+                out_m = m | out_m
+            return out_v, out_m
+
+    # ---- Greatest / Least (order types; String uses bytes order) ----
+    for fam, ty in (("String", B), ("Decimal", DEC), ("Time", T),
+                    ("Duration", D)):
+        @rpn_fn("Greatest" + fam, None, ty, (ty,))
+        def _greatest(xp, *pairs, _ty=ty):
+            out_v, valid = pairs[0]
+            for (v, m) in pairs[1:]:
+                if _ty is B:
+                    take = _cmp_vals(_ty, xp, v, out_v,
+                                     lambda x, y: x > y)
+                    out_v = np.where(take, v, out_v)
+                else:
+                    out_v = np.maximum(out_v, v)
+                valid = valid & m
+            return out_v, valid
+
+        @rpn_fn("Least" + fam, None, ty, (ty,))
+        def _least(xp, *pairs, _ty=ty):
+            out_v, valid = pairs[0]
+            for (v, m) in pairs[1:]:
+                if _ty is B:
+                    take = _cmp_vals(_ty, xp, v, out_v,
+                                     lambda x, y: x < y)
+                    out_v = np.where(take, v, out_v)
+                else:
+                    out_v = np.minimum(out_v, v)
+                valid = valid & m
+            return out_v, valid
+
+    # ---- IsNull / IsTrue / IsFalse (canonical reference names) ----
+    for fam, ty in (("Int", I), ("Real", R), ("String", B),
+                    ("Decimal", DEC), ("Time", T), ("Duration", D)):
+        @rpn_fn(fam + "IsNull", 1, I, (ty,))
+        def _is_null(xp, a, _ty=ty):
+            (av, am) = a
+            return _ibool(xp, ~np.asarray(am)), \
+                np.ones_like(np.asarray(am))
+
+    @rpn_fn("DecimalIsTrue", 1, I, (DEC,))
+    def dec_is_true(xp, a):
+        (av, am) = a
+        return _ibool(xp, am & (av != 0)), np.ones_like(np.asarray(am))
+
+    @rpn_fn("DecimalIsFalse", 1, I, (DEC,))
+    def dec_is_false(xp, a):
+        (av, am) = a
+        return _ibool(xp, am & (av == 0)), np.ones_like(np.asarray(am))
+
+    # ---- decimal arithmetic (scaled int64, common scale) ----
+
+    @rpn_fn("PlusDecimal", 2, DEC, (DEC, DEC))
+    def plus_dec(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        return av + bv, am & bm
+
+    @rpn_fn("MinusDecimal", 2, DEC, (DEC, DEC))
+    def minus_dec(xp, a, b):
+        (av, am), (bv, bm) = a, b
+        return av - bv, am & bm
+
+    @rpn_fn("UnaryMinusDecimal", 1, DEC, (DEC,))
+    def neg_dec(xp, a):
+        (av, am) = a
+        return -av, am
+
+    @rpn_fn("AbsDecimal", 1, DEC, (DEC,))
+    def abs_dec(xp, a):
+        (av, am) = a
+        return np.abs(av), am
+
+    @rpn_fn("CastDecimalAsDecimal", 1, DEC, (DEC,))
+    def cast_dec_dec(xp, a):
+        return a
+
+    @rpn_fn("CastDecimalAsReal", 1, R, (DEC,))
+    def cast_dec_real(xp, a):
+        # scale is column metadata the RPN layer doesn't carry; the plan
+        # compiler rescales — here scale-0 (integral decimals) converts
+        (av, am) = a
+        return np.asarray(av, np.float64), am
+
+    @rpn_fn("CastIntAsDecimal", 1, DEC, (I,))
+    def cast_int_dec(xp, a):
+        (av, am) = a
+        return np.asarray(av, np.int64), am
+
+    @rpn_fn("CastDecimalAsInt", 1, I, (DEC,))
+    def cast_dec_int(xp, a):
+        (av, am) = a
+        return np.asarray(av, np.int64), am
