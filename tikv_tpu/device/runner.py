@@ -1162,20 +1162,53 @@ class DeviceRunner:
                 # compile + validate now so Mosaic rejections fall back
                 packed = np.asarray(run(n, base, feed["flat"]))
             except Exception as e:
-                # cached so the fallback is decided once per plan — but
                 # never silently: a swallowed genuine bug here would
                 # disguise itself as the slower XLA path
                 import logging
-                logging.getLogger(__name__).warning(
-                    "pallas hash kernel disabled for plan %r: %s: %s",
-                    key[1], type(e).__name__, e)
-                self._kernel_cache[key] = False
+                # cache-disable deterministic build/lowering rejections
+                # (Mosaic/compile errors) immediately; a transient runtime
+                # failure (device OOM, tunnel hiccup) falls back without
+                # poisoning the cache — but only a few times, so a
+                # deterministic failure dressed as transient can't re-pay
+                # the build+compile cost on every request forever
+                name = type(e).__name__
+                transient = isinstance(e, (OSError, TimeoutError)) or \
+                    "RESOURCE_EXHAUSTED" in str(e) or \
+                    name in ("XlaRuntimeError", "InternalError") and \
+                    "Mosaic" not in str(e)
+                tries = self._kernel_cache.get(("hashpl_tries", key), 0) + 1
+                self._kernel_cache[("hashpl_tries", key)] = tries
+                if transient and tries < 3:
+                    logging.getLogger(__name__).warning(
+                        "pallas hash kernel transient failure for plan %r "
+                        "(attempt %d/3, falling back once): %s: %s",
+                        key[1], tries, name, e)
+                else:
+                    logging.getLogger(__name__).warning(
+                        "pallas hash kernel disabled (cached) for plan "
+                        "%r: %s: %s", key[1], name, e)
+                    self._kernel_cache[key] = False
                 return None
             entry = (run, LO)
             self._kernel_cache[key] = entry
         else:
             run, LO = entry
-            packed = np.asarray(run(n, base, feed["flat"]))
+            try:
+                packed = np.asarray(run(n, base, feed["flat"]))
+            except Exception as e:
+                # a transient runtime failure on a cached kernel must fall
+                # back to the XLA path for THIS request, same as the
+                # build-time path — not fail the coprocessor request
+                import logging
+                logging.getLogger(__name__).warning(
+                    "pallas hash kernel runtime failure for cached plan "
+                    "%r (falling back once): %s: %s",
+                    key[1], type(e).__name__, e)
+                tries = self._kernel_cache.get(("hashpl_tries", key), 0) + 1
+                self._kernel_cache[("hashpl_tries", key)] = tries
+                if tries >= 3:
+                    self._kernel_cache[key] = False
+                return None
         S = pallas_hash.unpack_to_int64(packed)
         S8 = twolevel_unpack(S, p8, LO, slots, xp=np)
         present, states = states_from_matmul(layouts, plan.specs, S8,

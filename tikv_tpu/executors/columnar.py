@@ -140,6 +140,10 @@ class ColumnarTable:
         ones = getattr(self, "_ones_validity", None)
         if ones is None or len(ones) < n:
             ones = np.ones(max(n, len(self.handles)), dtype=np.bool_)
+            # slices of this buffer are handed out as Column.validity;
+            # freeze it so an in-place mutation raises instead of
+            # corrupting every later scan's all-true mask
+            ones.flags.writeable = False
             self._ones_validity = ones
         return ones[:n]
 
@@ -198,6 +202,10 @@ class ColumnarTable:
             order = np.lexsort((self.handles, col.values, nulls * -1))
             got = (col.values[order], col.validity[order],
                    self.handles[order], int(nulls.sum()))
+            # single-slice scans hand out zero-copy views of these;
+            # freeze so downstream mutation can't corrupt the memo
+            for a in got[:3]:
+                a.flags.writeable = False
             cache[col_id] = got
         return got
 

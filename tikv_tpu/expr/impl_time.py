@@ -334,4 +334,28 @@ def register() -> None:
             out[i] = fmt_one(i, specs[i])
         ok = np.broadcast_to(np.asarray(am, bool) & np.asarray(fm, bool),
                              (n,)).copy()
+        # calendar-day specifiers need a real date: MySQL's date_format
+        # errors (→ NULL) on zero dates for %j/%W/%a/%w (impl_time.rs
+        # date_format); mask those rows instead of emitting garbage
+
+        def has_day_spec(spec: bytes) -> bool:
+            # walk %-pairs exactly as fmt_one does so '%%w' (a literal
+            # '%' then 'w') is not mistaken for the %w specifier
+            j = 0
+            while j < len(spec):
+                if spec[j:j + 1] == b"%" and j + 1 < len(spec):
+                    if spec[j + 1:j + 2] in (b"j", b"W", b"a", b"w"):
+                        return True
+                    j += 2
+                else:
+                    j += 1
+            return False
+
+        # formats are near-always a single constant: memoize per spec
+        memo: dict[bytes, bool] = {}
+        day_based = np.fromiter(
+            (memo[sp] if sp in memo else
+             memo.setdefault(sp, has_day_spec(sp)) for sp in specs),
+            dtype=bool, count=n)
+        ok &= hasd | ~day_based
         return out, ok

@@ -103,5 +103,6 @@ class Message:
     snapshot: Optional[Snapshot] = None
     # lease context: leaders stamp heartbeats with their send tick; the
     # response echoes it so the lease window is measured from SEND time
-    # (reference: raftstore leader lease, store/peer.rs maybe_renew_lease)
-    ctx: int = 0
+    # (reference: raftstore leader lease, store/peer.rs maybe_renew_lease).
+    # None = no lease context — distinct from tick 0, which is a valid ack
+    ctx: Optional[int] = None

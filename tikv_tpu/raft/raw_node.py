@@ -604,7 +604,9 @@ class RawNode:
         pr = self.progress.get(m.frm)
         if pr is None:
             return
-        if m.ctx:
+        # explicit None check: a heartbeat broadcast at tick 0 carries
+        # ctx == 0, which must still count as a lease ack
+        if m.ctx is not None:
             prev = self._lease_ack.get(m.frm)
             if prev is None or m.ctx > prev:
                 self._lease_ack[m.frm] = m.ctx

@@ -82,7 +82,7 @@ def dec_raft_msg(d: dict) -> Message:
                    term=d["term"], log_term=d["lt"], index=d["i"],
                    entries=tuple(decode_entry(e) for e in d["e"]),
                    commit=d["c"], reject=d["rej"], reject_hint=d["hint"],
-                   ctx=d.get("ctx", 0), snapshot=snap)
+                   ctx=d.get("ctx"), snapshot=snap)
 
 
 # -- errors (kvrpcpb errorpb analog: stable identities over the wire) --
