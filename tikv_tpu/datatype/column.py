@@ -59,6 +59,12 @@ class Column:
             for i, x in enumerate(items):
                 values[i] = x if x is not None else b""
         else:
+            if dtype == np.int64 and any(
+                    x is not None and x >= 1 << 63 for x in items):
+                # unsigned BIGINT domain (SET/ENUM/DATETIME payloads and
+                # unsigned handles live above 2^63): keep the container
+                # uint64 — INT columns carry signedness via FieldType
+                dtype = np.dtype(np.uint64)
             values = np.zeros(n, dtype=dtype)
             for i, x in enumerate(items):
                 if x is not None:

@@ -1191,10 +1191,14 @@ class DeviceRunner:
                 return None
             entry = (run, LO)
             self._kernel_cache[key] = entry
+            # success clears the transient strike count — three isolated
+            # hiccups over a process lifetime must not kill the fast path
+            self._kernel_cache.pop(("hashpl_tries", key), None)
         else:
             run, LO = entry
             try:
                 packed = np.asarray(run(n, base, feed["flat"]))
+                self._kernel_cache.pop(("hashpl_tries", key), None)
             except Exception as e:
                 # a transient runtime failure on a cached kernel must fall
                 # back to the XLA path for THIS request, same as the

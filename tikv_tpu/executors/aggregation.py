@@ -181,9 +181,47 @@ class _HashAggBase(TimedExecutor):
             key_cols.append((np.broadcast_to(v, (n,)),
                              np.broadcast_to(ok, (n,))))
         # batch-local dictionary encode: single int key fast path
-        if len(key_cols) == 1 and key_cols[0][0].dtype.kind in "iuf":
+        if len(key_cols) == 1 and key_cols[0][0].dtype.kind in "iu":
             v, ok = key_cols[0]
-            # NULL → sentinel via separate channel in the tuple key
+            any_null = not ok.all()
+            valid = v[ok] if any_null else v
+            if valid.size == 0:
+                inverse = np.zeros(n, dtype=np.int64)
+                local_keys = [(None,)]
+            else:
+                m = int(valid.min())
+                span = int(valid.max()) - m + 1
+                if span <= max(4 * n, 1 << 20):
+                    # dense key domain: O(n) direct-index encode — no
+                    # sort (fast_hash_aggr_executor.rs specialises the
+                    # single-int-key case the same way)
+                    idx = np.where(ok, v - m, span) if any_null \
+                        else v - m
+                    seen = np.zeros(span + (2 if any_null else 1),
+                                    np.bool_)
+                    seen[idx] = True
+                    local_of = np.cumsum(seen, dtype=np.int64) - 1
+                    inverse = local_of[idx]
+                    uniq_off = np.flatnonzero(seen[:span])
+                    # rebuild keys in v's dtype: a uint64 domain above
+                    # 2^63 overflows int64 + python-int addition
+                    uniq_vals = uniq_off.astype(v.dtype) + v.dtype.type(m)
+                    local_keys = [(x,) for x in uniq_vals.tolist()]
+                    if any_null and seen[span]:
+                        local_keys.append((None,))
+                else:
+                    # sparse domain: one sort over the valid rows only
+                    uniq, inv_valid = np.unique(valid,
+                                                return_inverse=True)
+                    local_keys = [(x,) for x in uniq.tolist()]
+                    if any_null:
+                        inverse = np.full(n, len(local_keys), np.int64)
+                        inverse[ok] = inv_valid
+                        local_keys.append((None,))
+                    else:
+                        inverse = inv_valid.astype(np.int64, copy=False)
+        elif len(key_cols) == 1 and key_cols[0][0].dtype.kind == "f":
+            v, ok = key_cols[0]
             uniq, inverse = np.unique(
                 np.stack([np.where(ok, v, 0), ok.astype(v.dtype)]),
                 axis=1, return_inverse=True)
@@ -244,14 +282,19 @@ class _HashAggBase(TimedExecutor):
         return ColumnBatch(self._schema, agg_cols + group_cols)
 
     def _next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        # one child batch per call (reference: util/aggr_executor.rs
+        # handle_next_batch) so the driver's 32→2×→max batch growth
+        # reaches the executor below — draining the child in a private
+        # loop would pin it at the initial 32-row batches forever
         if self._done:
             return BatchExecuteResult(ColumnBatch.empty(self._schema), True)
-        while True:
-            r = self._child.next_batch(scan_rows)
-            self._update(r.batch)
-            if r.is_drained:
-                self._done = True
-                return BatchExecuteResult(self._emit(), True, r.warnings)
+        r = self._child.next_batch(scan_rows)
+        self._update(r.batch)
+        if r.is_drained:
+            self._done = True
+            return BatchExecuteResult(self._emit(), True, r.warnings)
+        return BatchExecuteResult(ColumnBatch.empty(self._schema), False,
+                                  r.warnings)
 
 
 class BatchFastHashAggExecutor(_HashAggBase):
@@ -269,16 +312,17 @@ class BatchSimpleAggExecutor(_HashAggBase):
     def _next_batch(self, scan_rows: int) -> BatchExecuteResult:
         if self._done:
             return BatchExecuteResult(ColumnBatch.empty(self._schema), True)
-        while True:
-            r = self._child.next_batch(scan_rows)
-            if not self._group_keys:
-                self._group_keys.append(())
-            self._update(r.batch)
-            if r.is_drained:
-                self._done = True
-                for st in self._states:
-                    st.grow(1)
-                return BatchExecuteResult(self._emit(), True, r.warnings)
+        if not self._group_keys:
+            self._group_keys.append(())
+        r = self._child.next_batch(scan_rows)
+        self._update(r.batch)
+        if r.is_drained:
+            self._done = True
+            for st in self._states:
+                st.grow(1)
+            return BatchExecuteResult(self._emit(), True, r.warnings)
+        return BatchExecuteResult(ColumnBatch.empty(self._schema), False,
+                                  r.warnings)
 
 
 class BatchStreamAggExecutor(_HashAggBase):

@@ -119,13 +119,16 @@ class BatchTopNExecutor(TimedExecutor):
         self._cand_seq = cseq[order]
 
     def _next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        # one child batch per call so the driver's batch growth reaches
+        # the scan below (see _HashAggBase._next_batch)
         if self._done:
             return BatchExecuteResult(ColumnBatch.empty(self.schema), True)
-        while True:
-            r = self._child.next_batch(scan_rows)
-            self._fold(r.batch)
-            if r.is_drained:
-                self._done = True
-                out = self._cand if self._cand is not None \
-                    else ColumnBatch.empty(self.schema)
-                return BatchExecuteResult(out, True, r.warnings)
+        r = self._child.next_batch(scan_rows)
+        self._fold(r.batch)
+        if r.is_drained:
+            self._done = True
+            out = self._cand if self._cand is not None \
+                else ColumnBatch.empty(self.schema)
+            return BatchExecuteResult(out, True, r.warnings)
+        return BatchExecuteResult(ColumnBatch.empty(self.schema), False,
+                                  r.warnings)
