@@ -39,7 +39,7 @@ from typing import Optional, Sequence
 from ..codec import decode_record_handle, decode_row
 from ..codec.keys import table_record_range
 from ..datatype import Column
-from ..engine.traits import CF_LOCK, CF_WRITE
+from ..engine.traits import CF_DEFAULT, CF_LOCK, CF_WRITE
 from ..executors.columnar import ColumnarTable
 from ..storage.mvcc.reader import _PAST_VERSIONS, MvccReader, \
     check_lock_conflict
@@ -62,19 +62,18 @@ class _TableShim:
         self.table_id = table_id
 
 
-def build_region_columnar(snap, table_id: int, col_infos: Sequence,
-                          read_ts: int):
-    """One MVCC pass over the region ∩ table record range.
+from ..datatype import EvalType
 
-    Returns (ColumnarTable, safe_ts, blocking_locks).  Pending locks are
-    recorded, not raised — the committed version set is independent of
-    them; per-request conflict checks happen at serve time against the
-    request's own key ranges.
-    """
-    lo, hi = table_record_range(table_id)
-    lower, upper = encode_key(lo), encode_key(hi)
-    reader = MvccReader(snap)
+# native builder kind codes (fastbuild.cpp Col.kind)
+_NATIVE_KINDS = {
+    EvalType.INT: 0, EvalType.DURATION: 0,
+    EvalType.REAL: 1,
+    EvalType.BYTES: 2,
+    EvalType.DATETIME: 3, EvalType.ENUM: 3, EvalType.SET: 3,
+}
 
+
+def _scan_blocking_locks(snap, lower: bytes, upper: bytes):
     blocking_locks: list[tuple[bytes, Lock]] = []
     lit = snap.iterator_cf(CF_LOCK, lower, upper)
     ok = lit.seek_to_first()
@@ -83,7 +82,104 @@ def build_region_columnar(snap, table_id: int, col_infos: Sequence,
         if lock.lock_type in (LockType.PUT, LockType.DELETE):
             blocking_locks.append((decode_key(lit.key()), lock))
         ok = lit.next()
+    return blocking_locks
 
+
+def _build_native(snap, table_id: int, col_infos: Sequence, read_ts: int):
+    """Native one-pass build (tikv_tpu/native/fastbuild.cpp), or None
+    when the snapshot/schema is outside the native envelope."""
+    from ..native import mvcc_build_columnar
+    if mvcc_build_columnar is None:
+        return None
+    rng = getattr(snap, "range_cf", None)
+    if rng is None:
+        return None
+    ids, kinds = [], []
+    for info in col_infos:
+        if info.is_pk_handle:
+            continue
+        ft = info.field_type
+        kind = _NATIVE_KINDS.get(ft.eval_type)
+        if kind is None or info.default_value is not None:
+            return None     # DECIMAL/JSON payloads or non-NULL defaults
+        if kind == 0 and ft.is_unsigned:
+            kind = 3        # unsigned BIGINT: values live above 2^63
+        ids.append(info.col_id)
+        kinds.append(kind)
+    lo, hi = table_record_range(table_id)
+    got = rng(CF_WRITE, encode_key(lo), encode_key(hi))
+    if got is None:
+        return None
+    keys, vals, skip = got
+    try:
+        out = mvcc_build_columnar(keys, vals, read_ts, skip,
+                                  tuple(ids), tuple(kinds))
+    except ValueError:
+        # stored row payloads can hold datums outside the native
+        # envelope (DECIMAL tuples of *unrequested* columns, exotic
+        # tags): the interpreted path is the behavioral reference
+        return None
+
+    import numpy as np
+    n = out["n"]
+    handles = np.frombuffer(out["handles"], dtype=np.int64)
+    columns: dict = {}
+    np_dtypes = {0: np.int64, 1: np.float64, 3: np.uint64}
+    by_id = {}
+    for col_id, kind, payload, validity in out["cols"]:
+        valid = np.frombuffer(validity, dtype=np.bool_)
+        if kind == 2:
+            values = np.empty(n, dtype=object)
+            for i, b in enumerate(payload):
+                values[i] = b if b is not None else b""
+        else:
+            values = np.frombuffer(payload, dtype=np_dtypes[kind])
+        et = next(info.field_type.eval_type for info in col_infos
+                  if not info.is_pk_handle and info.col_id == col_id)
+        col = Column(et, values, valid)
+        columns[col_id] = col
+        by_id[col_id] = (kind, payload, col)
+    # big values (> SHORT_VALUE_MAX_LEN) live in CF_DEFAULT: patch rows
+    for row, start_ts, user_key in out["need_default"]:
+        from ..storage.txn_types import append_ts
+        v = snap.get_value_cf(CF_DEFAULT,
+                              append_ts(encode_key(user_key), start_ts))
+        assert v is not None, \
+            f"default CF missing for {user_key!r}@{start_ts}"
+        payload_row = decode_row(v)
+        for col_id, (kind, payload, col) in by_id.items():
+            pv = payload_row.get(col_id)
+            if pv is None:
+                continue
+            col.values[row] = pv
+            col.validity[row] = True
+    tbl = ColumnarTable(_TableShim(table_id), handles, columns)
+    return tbl, out["safe_ts"]
+
+
+def build_region_columnar(snap, table_id: int, col_infos: Sequence,
+                          read_ts: int):
+    """One MVCC pass over the region ∩ table record range.
+
+    Returns (ColumnarTable, safe_ts, blocking_locks).  Pending locks are
+    recorded, not raised — the committed version set is independent of
+    them; per-request conflict checks happen at serve time against the
+    request's own key ranges.
+
+    The hot loop (version resolution + key/row decode) runs in the
+    native builder when available; the interpreted loop below is the
+    behavioral reference and the fallback for exotic schemas.
+    """
+    lo, hi = table_record_range(table_id)
+    lower, upper = encode_key(lo), encode_key(hi)
+    blocking_locks = _scan_blocking_locks(snap, lower, upper)
+
+    native = _build_native(snap, table_id, col_infos, read_ts)
+    if native is not None:
+        tbl, safe_ts = native
+        return tbl, safe_ts, blocking_locks
+
+    reader = MvccReader(snap)
     handles: list[int] = []
     rows: list[dict] = []
     safe_ts = 0
@@ -108,7 +204,8 @@ def build_region_columnar(snap, table_id: int, col_infos: Sequence,
             continue
         vals = [row.get(info.col_id, info.default_value) for row in rows]
         columns[info.col_id] = Column.from_list(
-            info.field_type.eval_type, vals)
+            info.field_type.eval_type, vals,
+            unsigned=info.field_type.is_unsigned)
     tbl = ColumnarTable(_TableShim(table_id),
                         np.asarray(handles, dtype=np.int64), columns)
     return tbl, safe_ts, blocking_locks

@@ -49,8 +49,15 @@ class Column:
         )
 
     @staticmethod
-    def from_list(eval_type: EvalType, items: Sequence) -> "Column":
-        """Build from a Python list where ``None`` means NULL."""
+    def from_list(eval_type: EvalType, items: Sequence,
+                  unsigned: bool = False) -> "Column":
+        """Build from a Python list where ``None`` means NULL.
+
+        ``unsigned``: the column is declared UNSIGNED (FieldType flag) —
+        the container is uint64 regardless of which values appear, so
+        per-batch builds of the same column never mix int64/uint64
+        (np.concatenate would silently promote that mix to float64).
+        """
         n = len(items)
         validity = np.fromiter((x is not None for x in items), dtype=np.bool_, count=n)
         dtype = eval_type.np_dtype
@@ -59,11 +66,11 @@ class Column:
             for i, x in enumerate(items):
                 values[i] = x if x is not None else b""
         else:
-            if dtype == np.int64 and any(
-                    x is not None and x >= 1 << 63 for x in items):
-                # unsigned BIGINT domain (SET/ENUM/DATETIME payloads and
-                # unsigned handles live above 2^63): keep the container
-                # uint64 — INT columns carry signedness via FieldType
+            if dtype == np.int64 and (unsigned or any(
+                    x is not None and x >= 1 << 63 for x in items)):
+                # unsigned BIGINT domain lives above 2^63: keep the
+                # container uint64 — INT columns carry signedness via
+                # FieldType
                 dtype = np.dtype(np.uint64)
             values = np.zeros(n, dtype=dtype)
             for i, x in enumerate(items):

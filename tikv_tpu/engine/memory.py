@@ -103,6 +103,16 @@ class MemorySnapshot:
                     upper: Optional[bytes] = None) -> _MemIterator:
         return _MemIterator(self._cfs[cf], lower, upper)
 
+    def range_cf(self, cf: str, lower: bytes,
+                 upper: bytes) -> tuple[list, list, int]:
+        """Bulk range read → (keys, values, prefix_skip) for the native
+        columnar builder — list slices of the pinned generation, no
+        per-key iterator hops."""
+        data = self._cfs[cf]
+        i = bisect.bisect_left(data.keys, lower)
+        j = bisect.bisect_left(data.keys, upper)
+        return data.keys[i:j], data.vals[i:j], 0
+
 
 class MemoryWriteBatch:
     def __init__(self):

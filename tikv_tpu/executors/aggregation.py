@@ -191,7 +191,9 @@ class _HashAggBase(TimedExecutor):
             else:
                 m = int(valid.min())
                 span = int(valid.max()) - m + 1
-                if span <= max(4 * n, 1 << 20):
+                # O(n)-bounded: no absolute floor — early 32-row batches
+                # must not pay a span-sized table per batch
+                if span <= 4 * n:
                     # dense key domain: O(n) direct-index encode — no
                     # sort (fast_hash_aggr_executor.rs specialises the
                     # single-int-key case the same way)

@@ -54,7 +54,8 @@ class BatchTableScanExecutor(TimedExecutor):
                 else:
                     v = row.get(info.col_id, info.default_value)
                     out[c][r] = v
-        columns = [Column.from_list(info.field_type.eval_type, vals)
+        columns = [Column.from_list(info.field_type.eval_type, vals,
+                                    unsigned=info.field_type.is_unsigned)
                    for info, vals in zip(cols_info, out)]
         return BatchExecuteResult(ColumnBatch(list(self._schema), columns),
                                   is_drained=self._drained)
@@ -102,7 +103,8 @@ class BatchIndexScanExecutor(TimedExecutor):
                 else:
                     h, _ = decode_mc_datum(key, off)
                     out[-1][r] = h
-        columns = [Column.from_list(info.field_type.eval_type, vals)
+        columns = [Column.from_list(info.field_type.eval_type, vals,
+                                    unsigned=info.field_type.is_unsigned)
                    for info, vals in zip(cols_info, out)]
         return BatchExecuteResult(ColumnBatch(list(self._schema), columns),
                                   is_drained=self._drained)

@@ -1,0 +1,63 @@
+"""Native (C++) runtime components, compiled on first import.
+
+The reference's hot loops live in C++/Rust (RocksDB iterators, the row
+codec, tidb_query's decode paths); here the equivalent data-loader —
+the MVCC→columnar builder feeding both the host pipeline and the TPU
+device feed — is a CPython extension (fastbuild.cpp).
+
+The build is hermetic and optional: g++ compiles the module into
+``_build/`` keyed by source hash (one compile per source change, ~2s);
+any failure leaves ``mvcc_build_columnar = None`` and callers use the
+interpreted fallback, so the framework never hard-requires a compiler.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "fastbuild.cpp")
+
+
+def _load():
+    try:
+        with open(_SRC, "rb") as f:
+            src = f.read()
+    except OSError:
+        return None
+    digest = hashlib.sha256(src).hexdigest()[:16]
+    cache = os.path.join(_DIR, "_build")
+    so = os.path.join(cache, f"_fastbuild_{digest}.so")
+    if not os.path.exists(so):
+        os.makedirs(cache, exist_ok=True)
+        tmp = f"{so}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               f"-I{sysconfig.get_paths()['include']}", _SRC, "-o", tmp]
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode != 0:
+            import logging
+            logging.getLogger(__name__).warning(
+                "native fastbuild compile failed:\n%s",
+                r.stderr.decode(errors="replace"))
+            return None
+        os.replace(tmp, so)
+    spec = importlib.util.spec_from_file_location("_fastbuild", so)
+    if spec is None or spec.loader is None:
+        return None
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    except Exception:
+        return None
+    return mod
+
+
+_mod = _load()
+mvcc_build_columnar = getattr(_mod, "mvcc_build_columnar", None)

@@ -1,0 +1,458 @@
+/* Native MVCC -> columnar builder: the data-loader hot loop.
+ *
+ * Reference roles: the scan->batch handoff the reference gets from
+ * RocksDB's C++ iterators + tidb_query_datatype's row decode
+ * (src/coprocessor/dag/storage_impl.rs scan_next feeding
+ * LazyBatchColumnVec).  SURVEY.md §7 "Decode on the hot path" calls for
+ * host-side decode into dense columnar buffers at native speed; this
+ * module is that component: one pass over a CF_WRITE range resolving
+ * Percolator versions at read_ts and decoding row payloads straight
+ * into int64/float64 buffers the caller wraps as numpy arrays.
+ *
+ * Formats parsed here (kept in lockstep with the Python codecs):
+ *  - engine key: [prefix_skip bytes] 'x' + memcomparable(user_key)
+ *                + 8-byte big-endian ~commit_ts   (txn_types.py)
+ *  - user key:   't' + be64(table_id^sign) + "_r" + be64(handle^sign)
+ *                (codec/keys.py)
+ *  - write record: type byte 'P'/'D'/'L'/'R' + varint(start_ts)
+ *                [+ 'v' varint(len) short_value] [+ 'R']  (txn_types.py)
+ *  - row payload: msgpack map {int column_id: nil|int|float|bin|str}
+ *                (codec/row.py)
+ *
+ * Anything outside this envelope (unknown msgpack tag, malformed key)
+ * raises, and the Python caller falls back to the interpreted path.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kSignMask = 0x8000000000000000ULL;
+
+inline uint64_t be64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+  return v;
+}
+
+int read_varu64(const uint8_t* p, Py_ssize_t len, Py_ssize_t* off,
+                uint64_t* out) {
+  int shift = 0;
+  uint64_t v = 0;
+  while (*off < len) {
+    uint8_t b = p[(*off)++];
+    v |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return 0;
+    }
+    shift += 7;
+    if (shift > 63) return -1;
+  }
+  return -1;
+}
+
+/* memcomparable decode (codec/number.py decode_bytes_memcomparable) */
+int mc_decode(const uint8_t* p, Py_ssize_t len, Py_ssize_t* off,
+              std::string* out) {
+  out->clear();
+  for (;;) {
+    if (*off + 9 > len) return -1;
+    uint8_t marker = p[*off + 8];
+    int pad = 0xFF - (int)marker;
+    if (pad < 0 || pad > 8) return -1;
+    out->append(reinterpret_cast<const char*>(p) + *off, 8 - pad);
+    *off += 9;
+    if (pad != 0) return 0;
+  }
+}
+
+/* minimal msgpack value (codec/row.py envelope) */
+struct MpVal {
+  enum { NIL, INT, FLT, BIN } type;
+  int64_t i;
+  double f;
+  const uint8_t* b;
+  uint32_t blen;
+};
+
+int mp_read(const uint8_t* p, Py_ssize_t len, Py_ssize_t* off, MpVal* v) {
+  if (*off >= len) return -1;
+  uint8_t t = p[(*off)++];
+  if (t <= 0x7F) { v->type = MpVal::INT; v->i = t; return 0; }
+  if (t >= 0xE0) { v->type = MpVal::INT; v->i = (int8_t)t; return 0; }
+  auto need = [&](Py_ssize_t n) { return *off + n <= len; };
+  switch (t) {
+    case 0xC0: v->type = MpVal::NIL; return 0;
+    case 0xC2: v->type = MpVal::INT; v->i = 0; return 0;
+    case 0xC3: v->type = MpVal::INT; v->i = 1; return 0;
+    case 0xCC: if (!need(1)) return -1;
+      v->type = MpVal::INT; v->i = p[(*off)++]; return 0;
+    case 0xCD: if (!need(2)) return -1;
+      v->type = MpVal::INT; v->i = (p[*off] << 8) | p[*off + 1];
+      *off += 2; return 0;
+    case 0xCE: if (!need(4)) return -1;
+      v->type = MpVal::INT;
+      v->i = ((uint32_t)p[*off] << 24) | ((uint32_t)p[*off + 1] << 16) |
+             ((uint32_t)p[*off + 2] << 8) | p[*off + 3];
+      *off += 4; return 0;
+    case 0xCF: if (!need(8)) return -1;
+      v->type = MpVal::INT; v->i = (int64_t)be64(p + *off);
+      *off += 8; return 0;
+    case 0xD0: if (!need(1)) return -1;
+      v->type = MpVal::INT; v->i = (int8_t)p[(*off)++]; return 0;
+    case 0xD1: if (!need(2)) return -1;
+      v->type = MpVal::INT;
+      v->i = (int16_t)((p[*off] << 8) | p[*off + 1]); *off += 2; return 0;
+    case 0xD2: if (!need(4)) return -1;
+      v->type = MpVal::INT;
+      v->i = (int32_t)(((uint32_t)p[*off] << 24) |
+                       ((uint32_t)p[*off + 1] << 16) |
+                       ((uint32_t)p[*off + 2] << 8) | p[*off + 3]);
+      *off += 4; return 0;
+    case 0xD3: if (!need(8)) return -1;
+      v->type = MpVal::INT; v->i = (int64_t)be64(p + *off);
+      *off += 8; return 0;
+    case 0xCA: { if (!need(4)) return -1;
+      uint32_t u = ((uint32_t)p[*off] << 24) |
+                   ((uint32_t)p[*off + 1] << 16) |
+                   ((uint32_t)p[*off + 2] << 8) | p[*off + 3];
+      float f;
+      std::memcpy(&f, &u, 4);
+      v->type = MpVal::FLT; v->f = f; *off += 4; return 0; }
+    case 0xCB: { if (!need(8)) return -1;
+      uint64_t u = be64(p + *off);
+      std::memcpy(&v->f, &u, 8);
+      v->type = MpVal::FLT; *off += 8; return 0; }
+    case 0xC4: case 0xD9: { if (!need(1)) return -1;
+      uint32_t n = p[(*off)++];
+      if (!need(n)) return -1;
+      v->type = MpVal::BIN; v->b = p + *off; v->blen = n;
+      *off += n; return 0; }
+    case 0xC5: case 0xDA: { if (!need(2)) return -1;
+      uint32_t n = (p[*off] << 8) | p[*off + 1];
+      *off += 2;
+      if (!need(n)) return -1;
+      v->type = MpVal::BIN; v->b = p + *off; v->blen = n;
+      *off += n; return 0; }
+    case 0xC6: case 0xDB: { if (!need(4)) return -1;
+      uint32_t n = ((uint32_t)p[*off] << 24) | ((uint32_t)p[*off + 1] << 16) |
+                   ((uint32_t)p[*off + 2] << 8) | p[*off + 3];
+      *off += 4;
+      if (!need(n)) return -1;
+      v->type = MpVal::BIN; v->b = p + *off; v->blen = n;
+      *off += n; return 0; }
+    default:
+      if (t >= 0xA0 && t <= 0xBF) {  /* fixstr */
+        uint32_t n = t & 0x1F;
+        if (!need(n)) return -1;
+        v->type = MpVal::BIN; v->b = p + *off; v->blen = n;
+        *off += n; return 0;
+      }
+      return -1;
+  }
+}
+
+int mp_map_len(const uint8_t* p, Py_ssize_t len, Py_ssize_t* off,
+               uint32_t* n) {
+  if (*off >= len) return -1;
+  uint8_t t = p[(*off)++];
+  if ((t & 0xF0) == 0x80) { *n = t & 0x0F; return 0; }
+  if (t == 0xDE) {
+    if (*off + 2 > len) return -1;
+    *n = (p[*off] << 8) | p[*off + 1];
+    *off += 2;
+    return 0;
+  }
+  if (t == 0xDF) {
+    if (*off + 4 > len) return -1;
+    *n = ((uint32_t)p[*off] << 24) | ((uint32_t)p[*off + 1] << 16) |
+         ((uint32_t)p[*off + 2] << 8) | p[*off + 3];
+    *off += 4;
+    return 0;
+  }
+  return -1;
+}
+
+struct Col {
+  int64_t id;
+  int kind;  /* 0=int64 1=float64 2=bytes(object) 3=uint64 */
+  std::vector<int64_t> i64;
+  std::vector<double> f64;
+  std::vector<uint64_t> u64;
+  PyObject* objs;  /* list, for kind 2 */
+  std::vector<uint8_t> valid;
+};
+
+PyObject* fail(const char* msg) {
+  PyErr_SetString(PyExc_ValueError, msg);
+  return nullptr;
+}
+
+PyObject* mvcc_build(PyObject*, PyObject* args) {
+  PyObject *keys_o, *vals_o, *colids_o, *colkinds_o;
+  unsigned long long read_ts;
+  Py_ssize_t prefix_skip;
+  if (!PyArg_ParseTuple(args, "OOKnOO", &keys_o, &vals_o, &read_ts,
+                        &prefix_skip, &colids_o, &colkinds_o))
+    return nullptr;
+
+  PyObject* keys = PySequence_Fast(keys_o, "keys not a sequence");
+  if (!keys) return nullptr;
+  PyObject* vals = PySequence_Fast(vals_o, "values not a sequence");
+  if (!vals) { Py_DECREF(keys); return nullptr; }
+  Py_ssize_t n_in = PySequence_Fast_GET_SIZE(keys);
+  if (PySequence_Fast_GET_SIZE(vals) != n_in) {
+    Py_DECREF(keys); Py_DECREF(vals);
+    return fail("keys/values length mismatch");
+  }
+
+  std::vector<Col> cols;
+  Py_ssize_t ncols = PySequence_Size(colids_o);
+  for (Py_ssize_t c = 0; c < ncols; c++) {
+    PyObject* ido = PySequence_GetItem(colids_o, c);
+    PyObject* ko = PySequence_GetItem(colkinds_o, c);
+    Col col;
+    col.id = PyLong_AsLongLong(ido);
+    col.kind = (int)PyLong_AsLong(ko);
+    col.objs = (col.kind == 2) ? PyList_New(0) : nullptr;
+    Py_XDECREF(ido);
+    Py_XDECREF(ko);
+    cols.push_back(std::move(col));
+  }
+
+  std::vector<int64_t> handles;
+  uint64_t safe_ts = 0;
+  std::string user_key, prev_key;
+  bool resolved = false;
+  PyObject* need_default = PyList_New(0);
+
+  auto cleanup = [&]() {
+    for (auto& c : cols) Py_XDECREF(c.objs);
+    Py_XDECREF(need_default);
+    Py_DECREF(keys);
+    Py_DECREF(vals);
+  };
+
+  for (Py_ssize_t i = 0; i < n_in; i++) {
+    PyObject* ko = PySequence_Fast_GET_ITEM(keys, i);
+    PyObject* vo = PySequence_Fast_GET_ITEM(vals, i);
+    char* kp;
+    Py_ssize_t klen;
+    if (PyBytes_AsStringAndSize(ko, &kp, &klen) < 0) {
+      cleanup();
+      return nullptr;
+    }
+    const uint8_t* k = reinterpret_cast<const uint8_t*>(kp);
+    Py_ssize_t off = prefix_skip;
+    if (off >= klen || k[off] != 'x') { cleanup(); return fail("bad key mode"); }
+    off += 1;
+    if (mc_decode(k, klen - 8, &off, &user_key) < 0 || off != klen - 8) {
+      cleanup();
+      return fail("bad memcomparable key");
+    }
+    uint64_t commit_ts = ~be64(k + klen - 8);
+    if (commit_ts > safe_ts) safe_ts = commit_ts;
+    bool same = (user_key == prev_key);
+    if (!same) {
+      prev_key = user_key;
+      resolved = false;
+    }
+    if (resolved || commit_ts > read_ts) continue;
+
+    char* vp;
+    Py_ssize_t vlen;
+    if (PyBytes_AsStringAndSize(vo, &vp, &vlen) < 0) {
+      cleanup();
+      return nullptr;
+    }
+    const uint8_t* v = reinterpret_cast<const uint8_t*>(vp);
+    if (vlen < 2) { cleanup(); return fail("short write record"); }
+    char wt = (char)v[0];
+    Py_ssize_t voff = 1;
+    uint64_t start_ts;
+    if (read_varu64(v, vlen, &voff, &start_ts) < 0) {
+      cleanup();
+      return fail("bad write start_ts");
+    }
+    const uint8_t* sval = nullptr;
+    uint64_t svlen = 0;
+    while (voff < vlen) {
+      char tag = (char)v[voff++];
+      if (tag == 'v') {
+        if (read_varu64(v, vlen, &voff, &svlen) < 0 ||
+            voff + (Py_ssize_t)svlen > vlen) {
+          cleanup();
+          return fail("bad short value");
+        }
+        sval = v + voff;
+        voff += svlen;
+      } else if (tag == 'R') {
+        /* overlapped rollback marker on a committed write */
+      } else {
+        cleanup();
+        return fail("bad write tag");
+      }
+    }
+    if (wt == 'L' || wt == 'R') continue;   /* next version */
+    resolved = true;
+    if (wt == 'D') continue;                /* deleted at read_ts */
+    if (wt != 'P') { cleanup(); return fail("bad write type"); }
+
+    /* visible PUT: decode handle (user key 't'+8+'_r'+8) */
+    if (user_key.size() < 19) { cleanup(); return fail("short record key"); }
+    const uint8_t* uk = reinterpret_cast<const uint8_t*>(user_key.data());
+    int64_t handle = (int64_t)(be64(uk + 11) - kSignMask);
+    Py_ssize_t row = (Py_ssize_t)handles.size();
+    handles.push_back(handle);
+    for (auto& c : cols) {
+      c.valid.push_back(0);
+      switch (c.kind) {
+        case 0: c.i64.push_back(0); break;
+        case 1: c.f64.push_back(0.0); break;
+        case 3: c.u64.push_back(0); break;
+        case 2:
+          if (PyList_Append(c.objs, Py_None) < 0) { cleanup(); return nullptr; }
+          break;
+      }
+    }
+    if (sval == nullptr) {
+      /* big value lives in CF_DEFAULT at (key, start_ts): patched by
+       * the Python caller (rare: values > SHORT_VALUE_MAX_LEN) */
+      PyObject* t = Py_BuildValue(
+          "nKy#", row, (unsigned long long)start_ts, user_key.data(),
+          (Py_ssize_t)user_key.size());
+      if (!t || PyList_Append(need_default, t) < 0) {
+        Py_XDECREF(t);
+        cleanup();
+        return nullptr;
+      }
+      Py_DECREF(t);
+      continue;
+    }
+    /* decode msgpack row map into the column slots */
+    Py_ssize_t moff = 0;
+    uint32_t pairs;
+    if (mp_map_len(sval, (Py_ssize_t)svlen, &moff, &pairs) < 0) {
+      cleanup();
+      return fail("bad row map");
+    }
+    for (uint32_t e = 0; e < pairs; e++) {
+      MpVal cid, val;
+      if (mp_read(sval, (Py_ssize_t)svlen, &moff, &cid) < 0 ||
+          cid.type != MpVal::INT ||
+          mp_read(sval, (Py_ssize_t)svlen, &moff, &val) < 0) {
+        cleanup();
+        return fail("bad row datum");
+      }
+      for (auto& c : cols) {
+        if (c.id != cid.i) continue;
+        if (val.type == MpVal::NIL) break;
+        c.valid[row] = 1;
+        switch (c.kind) {
+          case 0:
+            if (val.type == MpVal::INT) c.i64[row] = val.i;
+            else if (val.type == MpVal::FLT) c.i64[row] = (int64_t)val.f;
+            else { cleanup(); return fail("type mismatch int col"); }
+            break;
+          case 1:
+            if (val.type == MpVal::FLT) c.f64[row] = val.f;
+            else if (val.type == MpVal::INT) c.f64[row] = (double)val.i;
+            else { cleanup(); return fail("type mismatch real col"); }
+            break;
+          case 3:
+            if (val.type == MpVal::INT) c.u64[row] = (uint64_t)val.i;
+            else { cleanup(); return fail("type mismatch u64 col"); }
+            break;
+          case 2: {
+            if (val.type != MpVal::BIN) {
+              cleanup();
+              return fail("type mismatch bytes col");
+            }
+            PyObject* b = PyBytes_FromStringAndSize(
+                reinterpret_cast<const char*>(val.b), val.blen);
+            if (!b) { cleanup(); return nullptr; }
+            /* PyList_SetItem steals b's ref even on failure */
+            if (PyList_SetItem(c.objs, row, b) < 0) {
+              cleanup();
+              return nullptr;
+            }
+            break;
+          }
+        }
+        break;
+      }
+    }
+  }
+
+  Py_ssize_t n = (Py_ssize_t)handles.size();
+  PyObject* handles_b = PyByteArray_FromStringAndSize(
+      reinterpret_cast<const char*>(handles.data()), n * 8);
+  PyObject* out_cols = PyList_New(0);
+  if (!handles_b || !out_cols) {
+    Py_XDECREF(handles_b);
+    Py_XDECREF(out_cols);
+    cleanup();
+    return nullptr;
+  }
+  for (auto& c : cols) {
+    PyObject* payload;
+    if (c.kind == 2) {
+      payload = c.objs;
+      Py_INCREF(payload);
+    } else if (c.kind == 1) {
+      payload = PyByteArray_FromStringAndSize(
+          reinterpret_cast<const char*>(c.f64.data()), n * 8);
+    } else if (c.kind == 3) {
+      payload = PyByteArray_FromStringAndSize(
+          reinterpret_cast<const char*>(c.u64.data()), n * 8);
+    } else {
+      payload = PyByteArray_FromStringAndSize(
+          reinterpret_cast<const char*>(c.i64.data()), n * 8);
+    }
+    PyObject* validity = PyByteArray_FromStringAndSize(
+        reinterpret_cast<const char*>(c.valid.data()), n);
+    PyObject* tup = (payload && validity)
+        ? Py_BuildValue("(LiOO)", (long long)c.id, c.kind, payload, validity)
+        : nullptr;
+    Py_XDECREF(payload);
+    Py_XDECREF(validity);
+    if (!tup || PyList_Append(out_cols, tup) < 0) {
+      Py_XDECREF(tup);
+      Py_DECREF(handles_b);
+      Py_DECREF(out_cols);
+      cleanup();
+      return nullptr;
+    }
+    Py_DECREF(tup);
+  }
+  PyObject* ret = Py_BuildValue("{s:O,s:n,s:K,s:O,s:O}",
+                                "handles", handles_b, "n", n,
+                                "safe_ts", (unsigned long long)safe_ts,
+                                "cols", out_cols,
+                                "need_default", need_default);
+  Py_DECREF(handles_b);
+  Py_DECREF(out_cols);
+  cleanup();  /* drops our refs; ret holds its own */
+  return ret;
+}
+
+PyMethodDef methods[] = {
+    {"mvcc_build_columnar", mvcc_build, METH_VARARGS,
+     "One-pass MVCC resolve + row decode into columnar buffers.\n"
+     "(keys, values, read_ts, prefix_skip, col_ids, col_kinds) -> dict"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef moddef = {PyModuleDef_HEAD_INIT, "_fastbuild",
+                      "native MVCC columnar builder", -1, methods,
+                      nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__fastbuild(void) { return PyModule_Create(&moddef); }

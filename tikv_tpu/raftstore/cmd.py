@@ -121,10 +121,11 @@ class RaftCmd:
                            self.epoch.version)
         if self.admin is not None:
             return head + b"A" + self.admin.to_bytes()
-        body = struct.pack(">I", len(self.ops))
-        for op in self.ops:
-            body += op.to_bytes()
-        return head + b"W" + body
+        # join, never body += op_bytes: quadratic concat turns a 20k-op
+        # batch proposal into seconds of memcpy
+        parts = [head, b"W", struct.pack(">I", len(self.ops))]
+        parts.extend(op.to_bytes() for op in self.ops)
+        return b"".join(parts)
 
     @staticmethod
     def from_bytes(buf: bytes) -> "RaftCmd":

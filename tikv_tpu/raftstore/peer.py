@@ -84,6 +84,22 @@ class RegionSnapshot:
         hi = rhi if upper is None else min(rhi, data_key(upper))
         return _PrefixStripIterator(self._snap.iterator_cf(cf, lo, hi))
 
+    def range_cf(self, cf: str, lower: bytes, upper: bytes):
+        """Bulk range read clamped to the region; keys keep the data-key
+        prefix — the extra prefix_skip tells the native builder how many
+        leading bytes to ignore instead of re-slicing every key."""
+        rng = getattr(self._snap, "range_cf", None)
+        if rng is None:
+            return None
+        from .peer_storage import region_data_bounds
+        rlo, rhi = region_data_bounds(self.region)
+        lo = max(rlo, data_key(lower))
+        hi = min(rhi, data_key(upper))
+        if lo >= hi:
+            return [], [], 0
+        keys, vals, skip = rng(cf, lo, hi)
+        return keys, vals, skip + 1
+
 
 class _PrefixStripIterator:
     """Strips the data-key prefix so layers above see user keys."""

@@ -56,6 +56,10 @@ class TxnClient:
     def __init__(self, pd_addr: str):
         self.pd = RemotePdClient(pd_addr)
         self._stores: dict[int, StoreClient] = {}
+        # client-go RegionCache analog: region routing resolved from PD
+        # once and reused until a NotLeader/EpochNotMatch invalidates it
+        # — without it every mutation in a batch pays a PD RPC
+        self._region_cache: dict[int, tuple[Region, Peer]] = {}
 
     # -- routing --
 
@@ -66,10 +70,23 @@ class TxnClient:
             self._stores[store_id] = c
         return c
 
-    def _leader_client(self, key: bytes) -> tuple[StoreClient, Region]:
+    def _lookup_region(self, key: bytes) -> tuple[Region, Peer]:
+        for region, leader in self._region_cache.values():
+            if region.contains(key):
+                return region, leader
         region, leader = self.pd.get_region_with_leader(key)
         if leader is None:
             leader = region.peers[0]
+        self._region_cache[region.id] = (region, leader)
+        return region, leader
+
+    def _invalidate_region(self, key: bytes) -> None:
+        for rid, (region, _leader) in list(self._region_cache.items()):
+            if region.contains(key):
+                del self._region_cache[rid]
+
+    def _leader_client(self, key: bytes) -> tuple[StoreClient, Region]:
+        region, leader = self._lookup_region(key)
         return self._store_client(leader.store_id), region
 
     def _call_leader(self, key: bytes, method: str, req: dict,
@@ -85,6 +102,7 @@ class TxnClient:
                 if e.kind in ("not_leader", "epoch_not_match",
                               "region_not_found"):
                     last = e
+                    self._invalidate_region(key)
                     time.sleep(0.05)
                     continue
                 raise
@@ -135,27 +153,59 @@ class TxnClient:
         assert mutations
         start_ts = self.tso()
         primary = mutations[0][1]
-        # group keys by region leader
-        groups: dict[tuple, list] = {}
-        for op, key, value in mutations:
-            client, region = self._leader_client(key)
-            groups.setdefault((client.addr, region.id), []).append(
-                (client, op, key, value))
-        # prewrite every group
-        for (addr, rid), muts in groups.items():
-            client = muts[0][0]
-            self._retryable_prewrite(client, muts, primary, start_ts)
+        # prewrite, grouped one RPC per region leader; a stale cached
+        # route (split/leader change mid-flight) re-groups and retries —
+        # re-prewriting an already-locked key with the same start_ts is
+        # idempotent (mvcc/actions prewrite lock-match rule)
+        for attempt in range(8):
+            groups: dict[tuple, list] = {}
+            for op, key, value in mutations:
+                client, region = self._leader_client(key)
+                groups.setdefault((client.addr, region.id), []).append(
+                    (client, op, key, value))
+            try:
+                for muts in groups.values():
+                    self._retryable_prewrite(muts[0][0], muts, primary,
+                                             start_ts)
+                break
+            except wire.RemoteError as e:
+                if e.kind in ("not_leader", "epoch_not_match",
+                              "region_not_found") and attempt < 7:
+                    for _op, key, _v in mutations:
+                        self._invalidate_region(key)
+                    time.sleep(0.05)
+                    continue
+                raise
         # commit primary first — the txn's durability point
         commit_ts = self.tso()
         self._call_leader(primary, "KvCommit", {
             "keys": [primary], "start_version": start_ts,
             "commit_version": commit_ts})
-        # then secondaries (safe to retry/resolve after the primary commit)
-        secondaries = [k for _, k, _v in mutations if k != primary]
-        for key in secondaries:
-            self._call_leader(key, "KvCommit", {
-                "keys": [key], "start_version": start_ts,
-                "commit_version": commit_ts})
+        # then secondaries (safe to retry/resolve after the primary
+        # commit), batched one KvCommit per region leader — the
+        # reference's client-go commits per-region, not per-key
+        by_leader: dict[tuple, tuple] = {}
+        for op, key, _v in mutations:
+            if key == primary:
+                continue
+            client, region = self._leader_client(key)
+            by_leader.setdefault((client.addr, region.id),
+                                 (client, []))[1].append(key)
+        for client, keys in by_leader.values():
+            try:
+                client.call("KvCommit", {
+                    "keys": keys, "start_version": start_ts,
+                    "commit_version": commit_ts})
+            except wire.RemoteError as e:
+                if e.kind not in ("not_leader", "epoch_not_match",
+                                  "region_not_found"):
+                    raise
+                # stale group route: fall back to per-key re-routing
+                for key in keys:
+                    self._invalidate_region(key)
+                    self._call_leader(key, "KvCommit", {
+                        "keys": [key], "start_version": start_ts,
+                        "commit_version": commit_ts})
         return commit_ts
 
     def _retryable_prewrite(self, client, muts, primary, start_ts,
