@@ -2,24 +2,40 @@
 percentiles (BASELINE.json: "coprocessor rows/sec + p99 DAGRequest
 latency, 1M→100M-row scans").
 
-Configs (BASELINE.md):
+Configs (BASELINE.md + r4 additions):
   1. table scan, 1M int64 rows, no predicate
   2. selection `v > k`, 10M rows, 10% selectivity
   3. simple aggregation SUM/COUNT/AVG, 50M rows, single group
   4. fast hash agg: GROUP BY int key (1k groups) + SUM, 100M rows
   5. TopN (ORDER BY col LIMIT 1000), 100M mixed-type rows via IndexScan
+  4s. config 4 with SPARSE keys: 1k distinct drawn from [0, 2^62)
+      (device two-pass sparse recode — VERDICT r3 #2)
+  4p. config 4 under 8-way request pipelining: aggregate rows/s with
+      overlapped dispatches (read pools overlap requests exactly this
+      way; the tunnel sync floor hides under concurrency)
+  6.  PRODUCTION PATH: gRPC → raft leader → MVCC snapshot → region
+      columnar cache (native C++ build) → executor, on a live
+      single-node server; cold = first query (cache build), warm =
+      cache hit (VERDICT r3 #1)
+
+Latency decomposition: "device_sync_floor_ms" reports the cost of ONE
+tiny dispatch+fetch through the device transport — over a tunneled TPU
+this RTT (~80-100ms) bounds p50 of any single blocking request, which
+is why the pipelined aggregate is also reported.
 
 Prints ONE JSON line: the headline metric (config 4 hash-agg rows/s, the
 north-star 8× target) plus a "configs" map with per-config rows/s and
 p50/p99 latency.  The CPU baseline for each config is the host
 vectorized columnar BatchExecutor pipeline (the serious baseline — the
-same plan on numpy), measured at a reduced size and quoted as rows/s.
+same plan on numpy, 30-45M rows/s on agg shapes), measured at a reduced
+size and quoted as rows/s.
 
 Env knobs:
   TIKV_TPU_BENCH_SCALE      scales every config's row count (default 1.0)
   TIKV_TPU_BENCH_HOST_ROWS  host-baseline row cap          (default 2**22)
   TIKV_TPU_BENCH_ITERS      timed iterations per config    (default 12)
   TIKV_TPU_BENCH_GROUPS     config-4 group cardinality     (default 1024)
+  TIKV_TPU_BENCH_PROD_ROWS  config-6 loaded row count      (default 400k)
 """
 
 from __future__ import annotations
@@ -102,13 +118,17 @@ def measure(fn, iters: int):
         float(ts.min())
 
 
-def run_config(name, n, make_dag, runner, host_rows, iters, checks=None):
+def run_config(name, n, make_dag, runner, host_rows, iters, checks=None,
+               builder=None):
     """Measure one config on its best backend + the host baseline."""
     from tikv_tpu.executors.runner import BatchExecutorsRunner
 
     groups = int(os.environ.get("TIKV_TPU_BENCH_GROUPS", 1024))
     real_v = name == "topn_index_scan"
-    table, snap = build_table(n, groups, real_v=real_v)
+    if builder is None:
+        def builder(nn, gg):
+            return build_table(nn, gg, real_v=real_v)
+    table, snap = builder(n, groups)
     dag = make_dag(table)
 
     backend = "host"
@@ -133,7 +153,7 @@ def run_config(name, n, make_dag, runner, host_rows, iters, checks=None):
     if n_host == n and backend == "host":
         host_rps = rps
     else:
-        table_h, snap_h = build_table(n_host, groups, real_v=real_v)
+        table_h, snap_h = builder(n_host, groups)
         dag_h = make_dag(table_h)
         runner_h = BatchExecutorsRunner(dag_h, snap_h)
         _ = runner_h.handle_request()
@@ -153,6 +173,122 @@ def run_config(name, n, make_dag, runner, host_rows, iters, checks=None):
         "host_rows_per_sec": round(host_rps, 1),
         "vs_baseline": round(rps / host_rps, 3),
     }
+
+
+def build_sparse_table(n: int, groups: int, seed: int = 7):
+    """Config-4 shape but keys are ``groups`` distinct values drawn
+    from [0, 2^62) — the arbitrary-int64 GROUP BY domain."""
+    table, snap = build_table(n, groups, seed=seed)
+    rng = np.random.default_rng(seed + 1)
+    doms = np.sort(rng.integers(0, 1 << 62, groups))
+    from tikv_tpu.datatype import Column
+    k = snap.columns[2]
+    snap.columns[2] = Column(k.eval_type, doms[k.values % groups],
+                             k.validity)
+    return table, snap
+
+
+def run_pipelined(runner, dag, snap, n: int, n_threads: int = 8,
+                  n_reqs: int = 16):
+    """Aggregate rows/s with overlapped requests (read-pool pattern)."""
+    import concurrent.futures as cf
+    runner.handle_request(dag, snap)            # warm
+    with cf.ThreadPoolExecutor(n_threads) as ex:
+        t0 = time.perf_counter()
+        futs = [ex.submit(runner.handle_request, dag, snap)
+                for _ in range(n_reqs)]
+        for f in futs:
+            f.result()
+        dt = time.perf_counter() - t0
+    return {"rows": n, "backend": "device", "n_inflight": n_threads,
+            "n_requests": n_reqs,
+            "rows_per_sec": round(n_reqs * n / dt, 1),
+            "total_ms": round(dt * 1e3, 1)}
+
+
+def run_production_path(device_runner, iters: int):
+    """Config 6: the full network path on a live single-node server.
+
+    gRPC → raft leader lease read → MVCC snapshot → RegionColumnarCache
+    (native C++ MVCC→columnar build) → vectorized executor → wire.
+    Cold = first query at a fresh data version (pays the columnar
+    build); warm = cache hit.  Load phase uses real 2PC transactions.
+    """
+    from tikv_tpu.raftstore.metapb import Store
+    from tikv_tpu.server import (
+        Node, PdServer, RemotePdClient, TikvServer, TxnClient,
+    )
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import encode_table_row, int_table
+
+    n = int(os.environ.get("TIKV_TPU_BENCH_PROD_ROWS", 400_000))
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    node = Node("127.0.0.1:0", RemotePdClient(pd_addr),
+                device_runner=device_runner,
+                device_row_threshold=1 << 62)   # keep copr on host path
+    srv = TikvServer(node)
+    node.addr = f"127.0.0.1:{srv.port}"
+    node.pd.put_store(Store(node.store_id, node.addr))
+    srv.start()
+    try:
+        c = TxnClient(pd_addr)
+        table = int_table(2, table_id=9900)
+        batch = 20_000
+        t0 = time.perf_counter()
+        for s in range(0, n, batch):
+            muts = [("put",) + encode_table_row(
+                table, h, {"c0": h % 1024, "c1": h % 1000})
+                for h in range(s, min(s + batch, n))]
+            c.txn_write(muts)
+        load_s = time.perf_counter() - t0
+        sel = DagSelect.from_table(table, ["id", "c0", "c1"])
+
+        def agg_dag():
+            return sel.aggregate(
+                [sel.col("c0")],
+                [("count_star", None), ("sum", sel.col("c1"))]
+            ).build(start_ts=c.tso())
+
+        t0 = time.perf_counter()
+        resp = c.coprocessor(agg_dag())
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        assert len(resp["rows"]) == 1024
+        p50, p99, _ = measure(lambda: c.coprocessor(agg_dag()),
+                              max(4, iters // 2))
+        return {
+            "rows": n,
+            "backend": "grpc+mvcc+columnar_cache",
+            "load_rows_per_sec": round(n / load_s, 1),
+            "cold_build_ms": round(cold_ms, 3),
+            "p50_ms": round(p50 * 1e3, 3),
+            "p99_ms": round(p99 * 1e3, 3),
+            "rows_per_sec": round(n / p50, 1),
+        }
+    finally:
+        srv.stop()
+        pd_server.stop()
+
+
+def device_sync_floor_ms(iters: int = 5) -> float:
+    """One tiny dispatch + blocking fetch — the transport RTT floor.
+
+    Through a tunneled TPU this is ~80-100ms and bounds ANY blocking
+    request's p50; reported so per-request latencies can be read
+    against it (the pipelined config shows the floor amortized away).
+    """
+    import jax
+
+    x = jax.device_put(np.zeros(8, np.int64))
+    f = jax.jit(lambda a: a + 1)
+    np.asarray(f(x))                            # compile
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        ts.append(time.perf_counter() - t0)
+    return round(float(np.median(ts)) * 1e3, 3)
 
 
 def main() -> None:
@@ -208,7 +344,26 @@ def main() -> None:
         "5_topn_index_scan": run_config(
             "topn_index_scan", sz(100 * (1 << 20)), _dag_topn_index,
             runner, host_rows, iters, check_topn),
+        "4s_hash_agg_sparse_keys": run_config(
+            "hash_agg_sparse", sz(100 * (1 << 20)), _dag_hash_agg,
+            runner, host_rows, iters, check_hash,
+            builder=build_sparse_table),
     }
+
+    # 4p: config-4 shape under request pipelining (aggregate throughput)
+    groups = int(os.environ.get("TIKV_TPU_BENCH_GROUPS", 1024))
+    n4 = sz(100 * (1 << 20))
+    table_p, snap_p = build_table(n4, groups)
+    configs["4p_hash_agg_pipelined"] = run_pipelined(
+        runner, _dag_hash_agg(table_p), snap_p, n4)
+    del table_p, snap_p
+    gc.collect()
+
+    # 6: the production path on a live server
+    try:
+        configs["6_production_path"] = run_production_path(runner, iters)
+    except Exception as e:      # noqa: BLE001 — bench must still report
+        configs["6_production_path"] = {"error": f"{type(e).__name__}: {e}"}
 
     headline = configs["4_hash_agg"]
     print(json.dumps({
@@ -217,12 +372,18 @@ def main() -> None:
         "unit": "rows/s",
         "vs_baseline": headline["vs_baseline"],
         "platform": f"{jax.devices()[0].platform}:{len(jax.devices())}",
+        "device_sync_floor_ms": device_sync_floor_ms(),
         "configs": configs,
     }))
     for name, c in configs.items():
-        print(f"# {name}: {c['rows']} rows {c['backend']} "
-              f"{c['rows_per_sec']:,.0f} rows/s p50={c['p50_ms']}ms "
-              f"p99={c['p99_ms']}ms vs_host={c['vs_baseline']}x",
+        if "rows_per_sec" not in c:
+            print(f"# {name}: {c}", file=sys.stderr)
+            continue
+        extra = f" p50={c['p50_ms']}ms p99={c['p99_ms']}ms" \
+            if "p50_ms" in c else ""
+        vs = f" vs_host={c['vs_baseline']}x" if "vs_baseline" in c else ""
+        print(f"# {name}: {c['rows']} rows {c.get('backend', '?')} "
+              f"{c['rows_per_sec']:,.0f} rows/s{extra}{vs}",
               file=sys.stderr)
 
 

@@ -242,3 +242,62 @@ def test_hash_agg_capacity_fallback():
     host = BatchExecutorsRunner(dag, snap).handle_request()
     dev = r.handle_request(dag, snap)
     assert_same(host, dev)
+
+
+def test_hash_agg_sparse_keys_device(runner):
+    """Sparse int64 key domains (VERDICT r3 #2): distinct keys spread
+    over [0, 2^62) must stay on device via the two-pass sparse recode
+    (device unique → searchsorted rank), matching the host pipeline."""
+    rng = np.random.default_rng(9)
+    n = 40_000
+    table = Table(7801, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("k", 2, FieldType.long()),
+        TableColumn("v", 3, FieldType.long())))
+    doms = np.unique(rng.integers(0, 1 << 62, 997))
+    k = doms[rng.integers(0, len(doms), n)]
+    kvalid = (np.arange(n) % 23) != 7          # NULL keys too
+    v = rng.integers(-1000, 1000, n).astype(np.int64)
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"k": Column(EvalType.INT, k, kvalid),
+         "v": Column(EvalType.INT, v, np.ones(n, np.bool_))})
+    sel = DagSelect.from_table(table, ["id", "k", "v"])
+    dag = sel.aggregate(
+        [sel.col("k")],
+        [("count_star", None), ("sum", sel.col("v")),
+         ("avg", sel.col("v"))]).build()
+    host, dev = run_both(runner, dag, snap)
+    assert_same(host, dev)
+    keys = [r[-1] for r in dev.rows()]
+    assert None in keys and len(keys) == len(doms) + 1
+    # warm request: the cached distinct set serves without a new dedup
+    dev2 = runner.handle_request(dag, snap)
+    assert canon(dev2.rows()) == canon(host.rows())
+
+
+def test_hash_agg_sparse_distinct_overflow_falls_back(runner):
+    """More distinct keys than the sparse budget → host fallback with
+    correct results (the r3 cliff, now at a far higher threshold)."""
+    small = DeviceRunner(chunk_rows=1 << 12, max_hash_capacity=256)
+    rng = np.random.default_rng(11)
+    n = 9_000
+    table = Table(7802, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("k", 2, FieldType.long()),
+        TableColumn("v", 3, FieldType.long())))
+    doms = np.unique(rng.integers(0, 1 << 62, 600))   # 600 > 256 budget
+    k = doms[rng.integers(0, len(doms), n)]
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"k": Column(EvalType.INT, k, np.ones(n, np.bool_)),
+         "v": Column(EvalType.INT, rng.integers(0, 50, n).astype(np.int64),
+                     np.ones(n, np.bool_))})
+    sel = DagSelect.from_table(table, ["id", "k", "v"])
+    dag = sel.aggregate([sel.col("k")], [("count_star", None),
+                                         ("sum", sel.col("v"))]).build()
+    host = BatchExecutorsRunner(dag, snap).handle_request()
+    dev = small.handle_request(dag, snap)
+    assert canon(dev.rows()) == canon(host.rows())

@@ -696,24 +696,32 @@ class DeviceRunner:
         return body
 
     def _build_hash_scatter_body(self, plan: _Plan, n_cols: int,
-                                 capacity: int):
+                                 capacity: int, sparse: bool = False):
         specs = plan.specs
+        n_pairs = n_cols + (1 if sparse else 0)
 
         def body(carry, aux, base, *flat):
             (summed_c, present_c, overflow_c), stacked_c = carry
             row_mask = flat[-1]
-            pairs = [(flat[2 * i], flat[2 * i + 1]) for i in range(n_cols)]
+            pairs = [(flat[2 * i], flat[2 * i + 1])
+                     for i in range(n_pairs)]
             n_local = row_mask.shape[0]
             mask = self._eval_masked(plan, pairs, n_local, row_mask)
-            key_pair = eval_rpn(plan.key_rpn, pairs, n_local, jnp)
             cols = []
             for r in plan.agg_rpns:
                 if r is None:
                     cols.append((jnp.zeros((n_local,), jnp.int32), mask))
                 else:
                     cols.append(eval_rpn(r, pairs, n_local, jnp))
-            st = hash_agg_tile(jnp, specs, key_pair, cols, capacity, aux,
-                               row_mask=mask)
+            if sparse:
+                # precomputed slot ids ride as the trailing column
+                key_pair = (jnp.zeros((n_local,), jnp.int32), mask)
+                tile_base = ("precomp", pairs[n_cols][0])
+            else:
+                key_pair = eval_rpn(plan.key_rpn, pairs, n_local, jnp)
+                tile_base = aux
+            st = hash_agg_tile(jnp, specs, key_pair, cols, capacity,
+                               tile_base, row_mask=mask)
             present = present_c + st["present"].astype(jnp.int64)
             overflow = overflow_c + st["overflow"].astype(jnp.int64)
             out_sm, out_st = [], []
@@ -729,24 +737,34 @@ class DeviceRunner:
 
     def _build_hash_twolevel_body(self, plan: _Plan, n_cols: int,
                                   capacity: int, layouts, LO: int, HI: int,
-                                  pf: int):
+                                  pf: int, sparse: bool = False):
         from .kernels import make_planes, slot_index, twolevel_partial
         specs = plan.specs
+        n_pairs = n_cols + (1 if sparse else 0)
 
         def body(carry, aux, base, *flat):
             (S8_c, Sf_c, ovf_c), _unused = carry
             row_mask = flat[-1]
-            pairs = [(flat[2 * i], flat[2 * i + 1]) for i in range(n_cols)]
+            pairs = [(flat[2 * i], flat[2 * i + 1])
+                     for i in range(n_pairs)]
             n_local = row_mask.shape[0]
             mask = self._eval_masked(plan, pairs, n_local, row_mask)
-            key_pair = eval_rpn(plan.key_rpn, pairs, n_local, jnp)
             cols = []
             for r in plan.agg_rpns:
                 if r is None:
                     cols.append((jnp.zeros((n_local,), jnp.int32), mask))
                 else:
                     cols.append(eval_rpn(r, pairs, n_local, jnp))
-            idx, overflow = slot_index(key_pair, capacity, aux, mask)
+            if sparse:
+                # precomputed slot ids (trailing column); only the
+                # request's selection/row mask is applied here
+                scrap = capacity + 1
+                idx = jnp.where(mask, pairs[n_cols][0].astype(jnp.int32),
+                                scrap)
+                overflow = jnp.zeros((), jnp.bool_)
+            else:
+                key_pair = eval_rpn(plan.key_rpn, pairs, n_local, jnp)
+                idx, overflow = slot_index(key_pair, capacity, aux, mask)
             L8, Lf = make_planes(layouts, specs, cols, mask)
             S2_8, S2_f = twolevel_partial(idx, L8, Lf, LO, HI)
             S8_c = S8_c + S2_8.astype(jnp.int64)
@@ -1005,6 +1023,52 @@ class DeviceRunner:
 
     # -- hash agg --
 
+    def _sparse_slots(self, plan, host_cols, n, feed, meta):
+        """Host recode of a sparse GROUP BY key into dense slot ids.
+
+        A sparse int64 key domain (user ids, hashes) cannot
+        direct-index into [0, capacity).  Ranking on device was tried
+        and measured: ``searchsorted``/gather per row lowers to
+        scalar-gather loops on TPU (~120× slower than the dense MXU
+        path).  The TPU-shaped answer is dictionary encoding OUTSIDE
+        the kernel — exactly how BYTES columns reach devices — so the
+        recode runs once per snapshot on host (np.unique's sort is the
+        C path) and the slot column is cached in HBM next to the feed;
+        warm requests then run the identical one-hot MXU kernel as the
+        dense case.  Reference analog: fast_hash_aggr_executor.rs keys
+        its specialised hashmap once per scan, not per batch.
+
+        Returns (uniq_np, nd, capacity, slot device array) or None when
+        the distinct count exceeds the sparse budget.
+        """
+        if "sparse_slots" in meta:
+            return meta["sparse_slots"]
+        kv, km = eval_rpn(plan.key_rpn, host_cols(), n, np)
+        kv = np.broadcast_to(kv, (n,))
+        km = np.broadcast_to(km, (n,))
+        valid = kv[km] if not km.all() else kv
+        got = None
+        if valid.size:
+            # keep the key dtype: casting a uint64 domain to int64 would
+            # wrap keys >= 2^63 and emit wrong group values
+            uniq, inv = np.unique(valid, return_inverse=True)
+            nd = len(uniq)
+            if nd <= self._max_hash_capacity:
+                capacity = max(1024, _next_pow2(nd))
+                idx = np.full(n, capacity, np.int32)       # NULL slot
+                if km.all():
+                    idx[:] = inv.astype(np.int32)
+                else:
+                    idx[km] = inv.astype(np.int32)
+                n_pad = feed["n_pad"]
+                padded = np.full(n_pad, capacity + 1, np.int32)  # scrap
+                padded[:n] = idx
+                dev = jnp.asarray(padded) if self._single else \
+                    jax.device_put(padded, self._row_sharding)
+                got = (uniq, nd, capacity, dev)
+        meta["sparse_slots"] = got
+        return got
+
     def _run_hash(self, dag, plan, host_cols, dtypes, n, feed, meta):
         from .kernels import (
             build_layouts,
@@ -1029,12 +1093,20 @@ class DeviceRunner:
             arg_nbytes = self._arg_nbytes(plan, host_cols(), n)
             meta["hash_bounds"] = (base, span, arg_nbytes)
             meta.setdefault("n_rows", n)
+        sparse_keys = None          # (uniq_np, slot device array)
         if span > self._max_hash_capacity:
-            # group cardinality exceeds the device direct-index capacity —
-            # reference splits fast vs slow hash agg the same way
-            # (runner.rs:293-318); the general path stays on host.
-            raise _FallbackToHost(f"hash key span {span}")
-        capacity = max(1024, _next_pow2(span))
+            # sparse key domain: direct indexing can't span it, but the
+            # DISTINCT count may still be small — dictionary-encode the
+            # key once per snapshot and feed dense slot ids (the
+            # reference's fast_hash_aggr_executor.rs handles arbitrary
+            # int keys with a hashmap, runner.rs:293-318)
+            got = self._sparse_slots(plan, host_cols, n, feed, meta)
+            if got is None:
+                raise _FallbackToHost(f"hash key span {span}")
+            uniq_np, nd, capacity, slots_dev = got
+            sparse_keys = (uniq_np, slots_dev)
+        else:
+            capacity = max(1024, _next_pow2(span))
         slots = capacity + 2
         arg_is_real = [r is not None and r.ret_type is EvalType.REAL
                        for r in plan.agg_rpns]
@@ -1053,12 +1125,19 @@ class DeviceRunner:
         if matmul_supported(plan.specs):
             layouts, p8, pf = build_layouts(plan.specs, arg_is_real,
                                             arg_nbytes, arg_ok_is_mask)
-        base_arr = self._cached_scalar(base, jnp.int64)
+        sparse = sparse_keys is not None
+        # the sparse slot column rides the sharded flat inputs like any
+        # other column (one extra all-valid pair after the scan columns)
+        kern_flat = feed["flat"] + (sparse_keys[1],) if sparse \
+            else feed["flat"]
+        kern_null_flags = feed["null_flags"] + (False,) if sparse \
+            else feed["null_flags"]
+        aux_arr = self._cached_scalar(base, jnp.int64)
         n_arr = self._cached_scalar(n, jnp.int64)
         n_cols = len(plan.used_cols)
 
         merged = None
-        if layouts is not None:
+        if layouts is not None and not sparse:
             merged = self._try_pallas_hash(dag, plan, feed, dtypes, n,
                                            base, capacity, layouts, p8, pf,
                                            arg_nbytes, arg_ok_is_mask)
@@ -1069,7 +1148,7 @@ class DeviceRunner:
             chunk = self._pick_chunk(feed["n_pad"], self._feed_unit())
             key = self._kern_key("hash2l", dag, feed, chunk, tuple(dtypes),
                                  capacity, arg_nbytes,
-                                 tuple(arg_ok_is_mask))
+                                 tuple(arg_ok_is_mask), sparse)
             carry = self._cached_carry(key, lambda: (
                 (np.zeros((HI, p8 * LO), np.int64),
                  np.zeros((HI, max(pf, 1) * LO), np.float64),
@@ -1078,11 +1157,12 @@ class DeviceRunner:
             kern = self._shard_kernel(
                 key, lambda: self._wrap_mega(
                     self._mega(self._build_hash_twolevel_body(
-                        plan, n_cols, capacity, layouts, LO, HI, pf),
+                        plan, n_cols, capacity, layouts, LO, HI, pf,
+                        sparse=sparse),
                         self._finalize_psum_summed(),
-                        feed["null_flags"], feed["n_pad"], chunk),
-                    carry, len(feed["flat"])))
-            carry = kern(carry, n_arr, base_arr, *feed["flat"])
+                        kern_null_flags, feed["n_pad"], chunk),
+                    carry, len(kern_flat)))
+            carry = kern(carry, n_arr, aux_arr, *kern_flat)
             (S8p, Sfp, ovf), _ = self._readback(carry)
             assert int(ovf) == 0, "hash agg key range overflow"
             S8 = twolevel_unpack(S8p, p8, LO, slots, xp=np)
@@ -1094,7 +1174,7 @@ class DeviceRunner:
         else:
             chunk = self._pick_chunk(feed["n_pad"], _CHUNK_AGG)
             key = self._kern_key("hashsc", dag, feed, chunk, tuple(dtypes),
-                                 capacity)
+                                 capacity, sparse)
 
             def build_scatter_carry():
                 sm_init, st_init = self._init_agg_carry(plan, slots)
@@ -1105,11 +1185,11 @@ class DeviceRunner:
             kern = self._shard_kernel(
                 key, lambda: self._wrap_mega(
                     self._mega(self._build_hash_scatter_body(
-                        plan, n_cols, capacity),
+                        plan, n_cols, capacity, sparse=sparse),
                         self._finalize_psum_summed(),
-                        feed["null_flags"], feed["n_pad"], chunk),
-                    carry, len(feed["flat"])))
-            carry = kern(carry, n_arr, base_arr, *feed["flat"])
+                        kern_null_flags, feed["n_pad"], chunk),
+                    carry, len(kern_flat)))
+            carry = kern(carry, n_arr, aux_arr, *kern_flat)
             (summed, present_counts, ovf), stacked = self._readback(carry)
             assert int(ovf) == 0, "hash agg key range overflow"
             merged = {
@@ -1117,7 +1197,9 @@ class DeviceRunner:
                 "overflow": False,
                 "states": self._merge_stacked(plan.specs, summed, stacked),
             }
-        keys, results = finalize_hash(plan.specs, merged, base, capacity)
+        keys, results = finalize_hash(
+            plan.specs, merged, base, capacity,
+            slot_keys=sparse_keys[0] if sparse else None)
 
         from ..executors.aggregation import _agg_ret_ft
         schema, cols = [], []

@@ -220,13 +220,20 @@ def hash_agg_tile(xp, specs: Sequence[AggSpec], key: tuple,
     null_slot = capacity
     scrap = capacity + 1
 
-    shifted = kv.astype("int64") - base
-    in_range = (shifted >= 0) & (shifted < capacity)
-    idx = xp.where(km & in_range, shifted, 0).astype("int32")
-    idx = xp.where(km, xp.where(in_range, idx, scrap), null_slot)
-    idx = xp.where(row_mask, idx, scrap)
-
-    overflow = xp.any(row_mask & km & ~in_range)
+    if isinstance(base, tuple):
+        # sparse recode: base = ("precomp", idx) — the slot per row was
+        # already computed (rank among distinct keys, NULLs at the NULL
+        # slot); only the request's row/selection mask is applied here
+        # (device/runner.py _run_hash sparse path)
+        idx = xp.where(row_mask, base[1].astype("int32"), scrap)
+        overflow = xp.zeros((), dtype=bool) if xp is not np else False
+    else:
+        shifted = kv.astype("int64") - base
+        in_range = (shifted >= 0) & (shifted < capacity)
+        idx = xp.where(km & in_range, shifted, 0).astype("int32")
+        idx = xp.where(km, xp.where(in_range, idx, scrap), null_slot)
+        idx = xp.where(row_mask, idx, scrap)
+        overflow = xp.any(row_mask & km & ~in_range)
     present = xp.zeros((slots,), dtype=bool)
     present = _scatter_max(xp, present, idx, row_mask)
 
@@ -298,17 +305,23 @@ def merge_hash_states(xp, specs, a: dict, b: dict) -> dict:
     }
 
 
-def finalize_hash(specs, state: dict, base: int, capacity: int):
+def finalize_hash(specs, state: dict, base: int, capacity: int,
+                  slot_keys=None):
     """Produce (group_keys, per-spec result columns) for present groups.
 
     Groups are emitted in ascending key order (deterministic), NULL group
     last — matches what the reference's tests canonicalize to.
+    ``slot_keys``: sparse recode — per-slot key values (sorted distinct
+    keys) instead of the dense ``slot + base`` arithmetic.
     Returns (keys: list[Optional[int]], results: list[list]).
     """
     present = np.asarray(state["present"])
     slots = np.nonzero(present[:capacity])[0]
     has_null = bool(present[capacity])
-    keys: list[Optional[int]] = [int(s) + base for s in slots]
+    if slot_keys is not None:
+        keys: list[Optional[int]] = [int(slot_keys[s]) for s in slots]
+    else:
+        keys = [int(s) + base for s in slots]
     all_slots = list(slots)
     if has_null:
         keys.append(None)
