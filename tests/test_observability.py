@@ -1,0 +1,199 @@
+"""Metrics registry, status server, config system, failpoints.
+
+Reference test model: status_server/mod.rs inline tests (route
+behavior), online_config tests (dispatch + rejection), fail crate
+semantics (cfg/remove/count-limited actions).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from tikv_tpu.config import ConfigController, TikvConfig
+from tikv_tpu.utils import failpoint
+from tikv_tpu.utils.metrics import Registry
+
+
+# ---------------------------------------------------------------- metrics
+
+def test_metrics_counter_gauge_histogram_exposition():
+    reg = Registry()
+    c = reg.counter("t_requests_total", "requests", labels=("method",))
+    c.labels("get").inc()
+    c.labels("get").inc(2)
+    c.labels("put").inc()
+    g = reg.gauge("t_regions", "region count")
+    g.set(5)
+    g.dec()
+    h = reg.histogram("t_latency_seconds", "latency",
+                      buckets=(0.01, 0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.expose()
+    assert 't_requests_total{method="get"} 3' in text
+    assert 't_requests_total{method="put"} 1' in text
+    assert "t_regions 4" in text
+    assert 't_latency_seconds_bucket{le="0.01"} 0' in text
+    assert 't_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 't_latency_seconds_bucket{le="1"} 2' in text
+    assert 't_latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "t_latency_seconds_count 3" in text
+    # re-registering the same name returns the same family
+    assert reg.counter("t_requests_total", "requests", ("method",)) is c
+
+
+# ---------------------------------------------------------------- config
+
+def test_config_from_dict_and_validation():
+    cfg = TikvConfig.from_dict({
+        "raftstore": {"region-split-size-mb": 32, "region_max_size_mb": 48},
+        "coprocessor": {"device-row-threshold": 1000},
+    })
+    assert cfg.raftstore.region_split_size_mb == 32
+    assert cfg.raftstore.region_max_size_mb == 48
+    assert cfg.coprocessor.device_row_threshold == 1000
+    with pytest.raises(ValueError):
+        TikvConfig.from_dict(
+            {"raftstore": {"region-split-size-mb": 500}})  # > max
+
+
+def test_online_config_dispatch_and_rejection():
+    cfg = TikvConfig()
+    ctl = ConfigController(cfg)
+    seen = {}
+    ctl.register("coprocessor", seen.update)
+    applied = ctl.update({"coprocessor.device-row-threshold": 99})
+    assert applied == {"coprocessor.device_row_threshold": 99}
+    assert cfg.coprocessor.device_row_threshold == 99
+    assert seen == {"device_row_threshold": 99}
+    # non-online field rejected, nothing applied
+    with pytest.raises(ValueError):
+        ctl.update({"server.addr": "1.2.3.4:1"})
+    # unknown field rejected
+    with pytest.raises(ValueError):
+        ctl.update({"coprocessor.nope": 1})
+    # a change that breaks validation is rejected atomically
+    with pytest.raises(ValueError):
+        ctl.update({"raftstore.region-split-size-mb": 10_000})
+    assert cfg.raftstore.region_split_size_mb == 96
+
+
+# -------------------------------------------------------------- failpoint
+
+@pytest.fixture(autouse=True)
+def _fp_teardown():
+    yield
+    failpoint.teardown()
+
+
+def test_failpoint_off_by_default_and_panic():
+    assert failpoint.fail_point("nothing/configured") is None
+    failpoint.cfg("apply::crash", "panic(boom)")
+    with pytest.raises(failpoint.FailpointPanic, match="boom"):
+        failpoint.fail_point("apply::crash")
+    failpoint.remove("apply::crash")
+    assert failpoint.fail_point("apply::crash") is None
+
+
+def test_failpoint_count_limited_and_chained():
+    failpoint.cfg("wal::torn", "2*return(short)->off")
+    r1 = failpoint.fail_point("wal::torn")
+    r2 = failpoint.fail_point("wal::torn")
+    assert r1.value == "short" and r2.value == "short"
+    assert failpoint.fail_point("wal::torn") is None   # chain fell to off
+    assert failpoint.hits("wal::torn") == 3
+
+
+def test_failpoint_sleep_and_callback():
+    import time
+    failpoint.cfg("slow::io", "sleep(30)")
+    t0 = time.perf_counter()
+    failpoint.fail_point("slow::io")
+    assert time.perf_counter() - t0 >= 0.025
+    called = []
+    failpoint.cfg_callback("custom::hook", lambda: called.append(1))
+    failpoint.fail_point("custom::hook")
+    assert called == [1]
+
+
+# ---------------------------------------------------------- status server
+
+def test_status_server_routes():
+    from tikv_tpu.pd import MockPd
+    from tikv_tpu.server.node import Node
+    from tikv_tpu.server.status_server import StatusServer
+
+    pd = MockPd()
+    node = Node("test:0", pd)
+    node.start()
+    srv = StatusServer("127.0.0.1:0", node=node,
+                       config_controller=node.config_controller)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        # /metrics: prometheus text with our instrument families
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "# TYPE tikv_grpc_msg_total counter" in body
+        # /status
+        st = json.load(urllib.request.urlopen(f"{base}/status"))
+        assert st["store_id"] == node.store_id
+        # /config GET
+        cfg = json.load(urllib.request.urlopen(f"{base}/config"))
+        assert cfg["coprocessor"]["device_row_threshold"] == 262144
+        # /config POST (online change) flows into the endpoint
+        req = urllib.request.Request(
+            f"{base}/config", method="POST",
+            data=json.dumps(
+                {"coprocessor.device-row-threshold": 1234}).encode())
+        resp = json.load(urllib.request.urlopen(req))
+        assert resp["applied"] == {"coprocessor.device_row_threshold": 1234}
+        assert node.endpoint._device_row_threshold == 1234
+        # non-online field → 400
+        req = urllib.request.Request(
+            f"{base}/config", method="POST",
+            data=json.dumps({"server.addr": "x"}).encode())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400
+        # /region/{id}
+        rid = st["regions"][0]["region"]["id"]
+        r = json.load(urllib.request.urlopen(f"{base}/region/{rid}"))
+        assert r["region"]["id"] == rid
+        # /fail_point listing + remote cfg
+        req = urllib.request.Request(
+            f"{base}/fail_point/test::remote", method="POST",
+            data=json.dumps({"actions": "return(x)"}).encode())
+        urllib.request.urlopen(req)
+        fps = json.load(urllib.request.urlopen(f"{base}/fail_point"))
+        assert fps == {"test::remote": ["return"]}
+        assert failpoint.fail_point("test::remote").value == "x"
+    finally:
+        srv.stop()
+        node.stop()
+
+
+def test_grpc_and_copr_metrics_instrumented():
+    """The RPC path increments the grpc/copr counters."""
+    from tikv_tpu.pd import MockPd
+    from tikv_tpu.server.node import Node
+    from tikv_tpu.server.service import KvService
+    from tikv_tpu.utils import metrics as m
+
+    pd = MockPd()
+    node = Node("test:0", pd)
+    node.start()
+    try:
+        svc = KvService(node)
+        before = m.GRPC_MSG_COUNTER.labels("RawPut", "ok").value \
+            if hasattr(m.GRPC_MSG_COUNTER.labels("RawPut", "ok"), "value") \
+            else m.GRPC_MSG_COUNTER.labels("RawPut", "ok").value
+        before = m.GRPC_MSG_COUNTER.labels("RawPut", "ok").value
+        svc.handle("RawPut", {"key": b"mk", "value": b"mv"})
+        assert m.GRPC_MSG_COUNTER.labels("RawPut", "ok").value == before + 1
+        pbefore = m.RAFT_PROPOSE_COUNTER.labels("write").value
+        svc.handle("RawPut", {"key": b"mk2", "value": b"mv2"})
+        assert m.RAFT_PROPOSE_COUNTER.labels("write").value > pbefore
+    finally:
+        node.stop()

@@ -1,0 +1,181 @@
+"""Configuration tree + online reconfig dispatch.
+
+Reference: src/config/mod.rs (``TikvConfig`` — one serde-TOML tree
+embedding every subsystem's config), components/online_config
+(``OnlineConfig`` derive + ``ConfigManager`` trait, lib.rs:137) and the
+``ConfigController`` that routes live changes to registered managers;
+POST /config on the status server feeds it (status_server/mod.rs:699).
+
+Python shape: dataclass tree loaded from TOML (stdlib ``tomllib``),
+validated, diffed for online updates.  Fields marked in
+``_ONLINE_FIELDS`` may change at runtime; everything else is rejected
+with the same "not an online-config field" contract the reference
+enforces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from dataclasses import dataclass, field, fields
+from typing import Callable, Optional
+
+
+@dataclass
+class ServerConfig:
+    addr: str = "127.0.0.1:20160"
+    status_addr: str = ""               # "" = status server disabled
+    grpc_concurrency: int = 8
+
+
+@dataclass
+class StorageConfig:
+    data_dir: str = ""                  # "" = in-memory engine
+    scheduler_concurrency: int = 4
+
+
+@dataclass
+class RaftstoreConfig:
+    raft_base_tick_interval_ms: int = 10
+    raft_heartbeat_ticks: int = 2
+    raft_election_timeout_ticks: int = 10
+    region_split_size_mb: int = 96      # split-check threshold
+    region_max_size_mb: int = 144
+    region_split_check_ticks: int = 10  # split check every N ticks
+    raft_log_gc_threshold: int = 1024
+    hibernate_regions: bool = False
+
+
+@dataclass
+class CoprocessorConfig:
+    device_row_threshold: int = 262144
+    region_cache_capacity: int = 8
+    # paged response budget (endpoint.rs paging)
+    response_page_rows: int = 1 << 20
+
+
+@dataclass
+class ReadPoolConfig:
+    concurrency: int = 8
+
+
+@dataclass
+class TikvConfig:
+    """The full config tree (config/mod.rs TikvConfig analog)."""
+
+    server: ServerConfig = field(default_factory=ServerConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    raftstore: RaftstoreConfig = field(default_factory=RaftstoreConfig)
+    coprocessor: CoprocessorConfig = field(
+        default_factory=CoprocessorConfig)
+    readpool: ReadPoolConfig = field(default_factory=ReadPoolConfig)
+
+    @staticmethod
+    def from_file(path: str) -> "TikvConfig":
+        import tomllib
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        return TikvConfig.from_dict(raw)
+
+    @staticmethod
+    def from_dict(raw: dict) -> "TikvConfig":
+        cfg = TikvConfig()
+        for f in fields(cfg):
+            sub = raw.get(f.name.replace("_", "-"), raw.get(f.name))
+            if sub is None:
+                continue
+            target = getattr(cfg, f.name)
+            for sf in fields(target):
+                key = sf.name.replace("_", "-")
+                if key in sub or sf.name in sub:
+                    setattr(target, sf.name, sub.get(key, sub.get(sf.name)))
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        r = self.raftstore
+        if r.raft_heartbeat_ticks >= r.raft_election_timeout_ticks:
+            raise ValueError("heartbeat ticks must be < election ticks")
+        if r.region_split_size_mb > r.region_max_size_mb:
+            raise ValueError("region-split-size must be <= region-max-size")
+        if self.readpool.concurrency < 1:
+            raise ValueError("readpool concurrency must be >= 1")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# fields changeable at runtime ("section.field" — OnlineConfig markers)
+_ONLINE_FIELDS = {
+    "raftstore.region_split_size_mb",
+    "raftstore.region_max_size_mb",
+    "raftstore.region_split_check_ticks",
+    "raftstore.raft_log_gc_threshold",
+    "raftstore.hibernate_regions",
+    "coprocessor.device_row_threshold",
+    "coprocessor.region_cache_capacity",
+    "coprocessor.response_page_rows",
+    "readpool.concurrency",
+}
+
+
+class ConfigController:
+    """Live-change router (online_config ConfigController analog).
+
+    Subsystems register a manager callback per section; ``update``
+    validates the diff against _ONLINE_FIELDS, applies it to the config
+    tree, and dispatches {changed field: value} to the section manager.
+    """
+
+    def __init__(self, cfg: TikvConfig):
+        self.cfg = cfg
+        self._managers: dict[str, Callable[[dict], None]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, section: str,
+                 manager: Callable[[dict], None]) -> None:
+        self._managers[section] = manager
+
+    def update(self, changes: dict) -> dict:
+        """changes: {"raftstore.region-split-size-mb": 64, ...} →
+        {applied field: value}.  Raises ValueError on unknown or
+        non-online fields (nothing is applied)."""
+        with self._lock:
+            parsed = []
+            for dotted, value in changes.items():
+                section, _, name = dotted.replace("-", "_").partition(".")
+                if not name:
+                    raise ValueError(f"bad config key {dotted!r}")
+                if f"{section}.{name}" not in _ONLINE_FIELDS:
+                    raise ValueError(
+                        f"{dotted!r} is not an online-config field")
+                target = getattr(self.cfg, section, None)
+                if target is None or not hasattr(target, name):
+                    raise ValueError(f"unknown config field {dotted!r}")
+                cur = getattr(target, name)
+                if cur is not None and value is not None and \
+                        not isinstance(value, type(cur)):
+                    if isinstance(cur, bool) or not (
+                            isinstance(cur, (int, float)) and
+                            isinstance(value, (int, float))):
+                        raise ValueError(
+                            f"{dotted!r}: want {type(cur).__name__}")
+                parsed.append((section, name, value))
+            # validate the tree with changes applied before committing
+            # (deep copy: replace() would share the nested sections)
+            import copy
+            trial = copy.deepcopy(self.cfg)
+            for section, name, value in parsed:
+                setattr(getattr(trial, section), name, value)
+            trial.validate()
+            applied: dict = {}
+            by_section: dict[str, dict] = {}
+            for section, name, value in parsed:
+                setattr(getattr(self.cfg, section), name, value)
+                applied[f"{section}.{name}"] = value
+                by_section.setdefault(section, {})[name] = value
+        for section, diff in by_section.items():
+            mgr = self._managers.get(section)
+            if mgr is not None:
+                mgr(diff)
+        return applied

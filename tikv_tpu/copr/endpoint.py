@@ -67,6 +67,7 @@ class Endpoint:
         self._device_row_threshold = device_row_threshold
 
     def handle(self, req: CopRequest) -> CopResponse:
+        from ..utils import metrics as m
         if req.tp != REQ_TYPE_DAG:
             raise NotImplementedError(f"request type {req.tp}")
         t0 = time.perf_counter_ns()
@@ -77,7 +78,10 @@ class Endpoint:
         else:
             from ..executors.runner import BatchExecutorsRunner
             result = BatchExecutorsRunner(req.dag, storage).handle_request()
-        return CopResponse(result, time.perf_counter_ns() - t0, backend)
+        elapsed = time.perf_counter_ns() - t0
+        m.COPR_REQ_COUNTER.labels(backend).inc()
+        m.COPR_REQ_DURATION.labels(backend).observe(elapsed / 1e9)
+        return CopResponse(result, elapsed, backend)
 
     def _pick_backend(self, req: CopRequest, storage) -> str:
         if req.force_backend in ("host", "device"):

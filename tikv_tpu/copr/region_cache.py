@@ -291,6 +291,8 @@ class RegionColumnarCache:
                     if got is not None and got.valid_for(dag.start_ts):
                         self._entries.move_to_end(k)
                         self.hits += 1
+                        from ..utils.metrics import COPR_CACHE_COUNTER
+                        COPR_CACHE_COUNTER.labels("hit").inc()
                         ent = got
                         break
                 if ent is not None:
@@ -300,6 +302,8 @@ class RegionColumnarCache:
                     # we build; others for the same key wait on the event
                     self._building[key] = threading.Event()
                     self.misses += 1
+                    from ..utils.metrics import COPR_CACHE_COUNTER
+                    COPR_CACHE_COUNTER.labels("miss").inc()
             if wait_ev is not None:
                 wait_ev.wait()
                 continue        # re-check: the builder's entry may serve us

@@ -200,6 +200,9 @@ class RaftPeer:
         if not self.is_leader():
             raise NotLeaderError(self.region.id, self.leader_peer())
         self._check_header(cmd)
+        from ..utils.metrics import RAFT_PROPOSE_COUNTER
+        RAFT_PROPOSE_COUNTER.labels(
+            cmd.admin.kind if cmd.admin is not None else "write").inc()
         if cmd.admin is not None and cmd.admin.kind == "change_peer":
             a = cmd.admin
             cc_type = {"add": ConfChangeType.ADD_NODE,
@@ -267,6 +270,9 @@ class RaftPeer:
             meta = self.node.storage.snapshot.metadata
             self.peer_storage.persist(wb, rd.entries, rd.hard_state,
                                       truncated=(meta.index, meta.term))
+            if rd.committed_entries:
+                from ..utils.metrics import RAFT_APPLY_COUNTER
+                RAFT_APPLY_COUNTER.inc(len(rd.committed_entries))
             for entry in rd.committed_entries:
                 if not entry.data and not wb.is_empty() and \
                         self._pending_read_at(entry.index, entry.term):

@@ -38,6 +38,11 @@ def main(argv=None) -> int:
     kv_p.add_argument("--with-device", action="store_true",
                       help="register the TPU device runner on the "
                            "coprocessor endpoint")
+    kv_p.add_argument("--config", default=None,
+                      help="TOML config file (config-template.toml shape)")
+    kv_p.add_argument("--status-addr", default=None,
+                      help="HTTP status server bind "
+                           "(/metrics /status /config)")
 
     ctl = sub.add_parser("ctl", help="ops CLI (tikv-ctl analog)")
     ctl.add_argument("--pd", required=True)
@@ -82,10 +87,20 @@ def main(argv=None) -> int:
         if args.with_device:
             from ..device import DeviceRunner
             device_runner = DeviceRunner()
+        config = None
+        if args.config:
+            from ..config import TikvConfig
+            config = TikvConfig.from_file(args.config)
+        if args.status_addr and config is not None:
+            config.server.status_addr = args.status_addr
         node = Node(args.addr, RemotePdClient(args.pd),
-                    data_dir=args.data_dir, device_runner=device_runner)
-        server = TikvServer(node)
+                    data_dir=args.data_dir, device_runner=device_runner,
+                    config=config)
+        server = TikvServer(node, status_addr=args.status_addr)
         server.start()
+        if server.status_server is not None:
+            print(f"status server on port {server.status_server.port}",
+                  flush=True)
         print(f"tikv store {node.store_id} listening on {args.addr}",
               flush=True)
         server.wait()

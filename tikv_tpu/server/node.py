@@ -104,7 +104,17 @@ class Node:
                  store_id: Optional[int] = None,
                  data_dir: Optional[str] = None,
                  device_runner=None, device_row_threshold: int = 262144,
-                 tick_interval: float = 0.01):
+                 tick_interval: float = 0.01, config=None):
+        from ..config import ConfigController, TikvConfig
+        if config is None:
+            config = TikvConfig()
+            config.storage.data_dir = data_dir or ""
+            config.coprocessor.device_row_threshold = device_row_threshold
+        else:
+            data_dir = config.storage.data_dir or data_dir or None
+            device_row_threshold = config.coprocessor.device_row_threshold
+        self.config = config
+        self.config_controller = ConfigController(config)
         self.addr = addr
         self.pd = pd
         if engine is not None and data_dir is not None:
@@ -148,10 +158,20 @@ class Node:
         self.storage = Storage(engine=self.raft_kv)
         from .read_pool import ReadPool
         self.read_pool = ReadPool()
-        self.copr_cache = RegionColumnarCache()
+        self.copr_cache = RegionColumnarCache(
+            capacity=config.coprocessor.region_cache_capacity)
         self.endpoint = Endpoint(self._copr_snapshot,
                                  device_runner=device_runner,
                                  device_row_threshold=device_row_threshold)
+        # online reconfig (online_config ConfigManager registrations)
+        self.config_controller.register("coprocessor", self._copr_cfg)
+
+    def _copr_cfg(self, diff: dict) -> None:
+        if "device_row_threshold" in diff:
+            self.endpoint._device_row_threshold = \
+                diff["device_row_threshold"]
+        if "region_cache_capacity" in diff:
+            self.copr_cache._capacity = diff["region_cache_capacity"]
 
     # ---------------------------------------------------------- lifecycle
 

@@ -40,7 +40,8 @@ class _GenericHandler(grpc.GenericRpcHandler):
 class TikvServer:
     """One listening tikv-server process."""
 
-    def __init__(self, node: Node, max_workers: int = 8):
+    def __init__(self, node: Node, max_workers: int = 8,
+                 status_addr: Optional[str] = None):
         self.node = node
         self.service = KvService(node)
         self._server = grpc.server(
@@ -49,12 +50,26 @@ class TikvServer:
             _GenericHandler("/tikv.Tikv/", self.service.handle),))
         self.port = self._server.add_insecure_port(node.addr)
         assert self.port, f"cannot bind {node.addr}"
+        # HTTP status server (/metrics, /config, /status —
+        # status_server/mod.rs), bound from config or the explicit arg
+        self.status_server = None
+        saddr = status_addr or getattr(node, "config", None) and \
+            node.config.server.status_addr
+        if saddr:
+            from .status_server import StatusServer
+            self.status_server = StatusServer(
+                saddr, node=node,
+                config_controller=node.config_controller)
 
     def start(self) -> None:
         self.node.start()
         self._server.start()
+        if self.status_server is not None:
+            self.status_server.start()
 
     def stop(self, grace: Optional[float] = 0.5) -> None:
+        if self.status_server is not None:
+            self.status_server.stop()
         self._server.stop(grace)
         self.node.stop()
 

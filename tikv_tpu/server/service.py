@@ -9,6 +9,7 @@ callables dict → dict; server.py binds them to gRPC methods.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 from ..copr.dag import DAGRequest
@@ -52,14 +53,22 @@ class KvService:
             return {"error": wire.enc_error(e)}
 
     def handle(self, method: str, req: dict) -> dict:
+        from ..utils import metrics as m
         fn = getattr(self, method, None)
         if fn is None:
             return {"error": {"kind": "unimplemented", "method": method}}
         prio = _READ_METHODS.get(method)
+        t0 = time.perf_counter()
         if prio is not None:
-            return self._guard(
+            resp = self._guard(
                 lambda r: self.read_pool.run(lambda: fn(r), prio), req)
-        return self._guard(fn, req)
+        else:
+            resp = self._guard(fn, req)
+        m.GRPC_MSG_DURATION.labels(method).observe(
+            time.perf_counter() - t0)
+        m.GRPC_MSG_COUNTER.labels(
+            method, "err" if resp.get("error") else "ok").inc()
+        return resp
 
     # ---------------------------------------------------------- txn KV
 

