@@ -186,9 +186,6 @@ def test_grpc_and_copr_metrics_instrumented():
     node.start()
     try:
         svc = KvService(node)
-        before = m.GRPC_MSG_COUNTER.labels("RawPut", "ok").value \
-            if hasattr(m.GRPC_MSG_COUNTER.labels("RawPut", "ok"), "value") \
-            else m.GRPC_MSG_COUNTER.labels("RawPut", "ok").value
         before = m.GRPC_MSG_COUNTER.labels("RawPut", "ok").value
         svc.handle("RawPut", {"key": b"mk", "value": b"mv"})
         assert m.GRPC_MSG_COUNTER.labels("RawPut", "ok").value == before + 1

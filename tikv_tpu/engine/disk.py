@@ -211,13 +211,25 @@ class DiskEngine(MemoryEngine):
     # ------------------------------------------------------------ writes
 
     def write(self, batch: MemoryWriteBatch) -> None:
+        from ..utils.failpoint import FailpointPanic, fail_point
+        from ..utils.metrics import ENGINE_WRITE_COUNTER
         if batch.is_empty():
             return
+        ENGINE_WRITE_COUNTER.inc()
         with self._mu:
+            fail_point("wal::before_append")
             payload = b"".join(_pack_op(op, self._cf_index)
                                for op in batch._ops)
             self._wal.write(struct.pack(
                 ">II", len(payload), zlib.crc32(payload) & 0xFFFFFFFF))
+            # a "torn" action truncates the record mid-payload, modeling
+            # power loss between the header and body hitting disk
+            torn = fail_point("wal::torn_write")
+            if torn is not None:
+                self._wal.write(payload[:max(0, len(payload) // 2)])
+                self._wal.flush()
+                os.fsync(self._wal.fileno())
+                raise FailpointPanic("wal::torn_write")
             self._wal.write(payload)
             self._wal.flush()
             if self._sync:
@@ -245,6 +257,8 @@ class DiskEngine(MemoryEngine):
             self._checkpoint_locked()
 
     def _checkpoint_locked(self) -> None:
+        from ..utils.failpoint import fail_point
+        fail_point("ckpt::before_write")
         new_gen = self._gen + 1
         tmp = self._ckpt_path(new_gen) + ".tmp"
         with open(tmp, "wb") as f:

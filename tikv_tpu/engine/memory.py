@@ -174,6 +174,8 @@ class MemoryEngine:
         return MemoryWriteBatch()
 
     def write(self, batch: MemoryWriteBatch) -> None:
+        from ..utils.metrics import ENGINE_WRITE_COUNTER
+        ENGINE_WRITE_COUNTER.inc()
         with self._mu:
             self._write_locked(batch)
 

@@ -255,11 +255,16 @@ class RaftPeer:
     def handle_ready(self) -> list[Message]:
         """Persist, apply, return messages to send.  Reference:
         handle_raft_ready_append + the apply poller, collapsed."""
+        from ..utils.failpoint import fail_point
         out: list[Message] = []
         while self.node.has_ready():
+            from ..utils.metrics import RAFT_READY_COUNTER
+            RAFT_READY_COUNTER.inc()
+            fail_point("peer::handle_ready")
             rd = self.node.ready()
             wb = self.engine.write_batch()
             if rd.snapshot is not None:
+                fail_point("snapshot::before_apply")
                 region = self.peer_storage.apply_snapshot(wb, rd.snapshot)
                 # a snapshot replaces all region data: stamp the data
                 # version so columnar/copr caches can never serve
@@ -267,9 +272,11 @@ class RaftPeer:
                 self.data_index = max(self.data_index,
                                       rd.snapshot.metadata.index)
                 self.store.on_region_changed(self, region)
+            fail_point("raftlog::before_persist")
             meta = self.node.storage.snapshot.metadata
             self.peer_storage.persist(wb, rd.entries, rd.hard_state,
                                       truncated=(meta.index, meta.term))
+            fail_point("apply::before_entries")
             if rd.committed_entries:
                 from ..utils.metrics import RAFT_APPLY_COUNTER
                 RAFT_APPLY_COUNTER.inc(len(rd.committed_entries))
@@ -287,8 +294,10 @@ class RaftPeer:
             if rd.committed_entries:
                 self.peer_storage.persist_apply(
                     wb, rd.committed_entries[-1].index)
+            fail_point("apply::before_write")
             if not wb.is_empty():
                 self.engine.write(wb)
+            fail_point("apply::after_write")
             out.extend(rd.messages)
             self.node.advance(rd)
         return out
@@ -360,9 +369,12 @@ class RaftPeer:
 
     def _exec_admin(self, wb, admin: AdminCmd,
                     cc: Optional[ConfChange] = None) -> dict:
+        from ..utils.failpoint import fail_point
         if admin.kind == "split":
+            fail_point("apply::before_split")
             return self._exec_split(wb, admin)
         if admin.kind == "change_peer":
+            fail_point("apply::before_conf_change")
             return self._exec_change_peer(wb, admin, cc)
         if admin.kind == "compact_log":
             return self._exec_compact_log(wb, admin)

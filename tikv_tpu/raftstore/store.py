@@ -57,6 +57,10 @@ class RaftStore:
                               pre_vote=pre_vote, seed=seed,
                               tick_interval=tick_interval)
         self._campaign_on_create: set[int] = set()
+        # live raftstore knobs (split/gc thresholds); Node swaps in the
+        # config-file section so online changes flow through
+        from ..config import RaftstoreConfig
+        self.config = RaftstoreConfig()
 
     # ------------------------------------------------------------- lifecycle
 

@@ -103,16 +103,19 @@ class Node:
                  engine: Optional[MemoryEngine] = None,
                  store_id: Optional[int] = None,
                  data_dir: Optional[str] = None,
-                 device_runner=None, device_row_threshold: int = 262144,
+                 device_runner=None,
+                 device_row_threshold: Optional[int] = None,
                  tick_interval: float = 0.01, config=None):
         from ..config import ConfigController, TikvConfig
         if config is None:
             config = TikvConfig()
             config.storage.data_dir = data_dir or ""
+        if device_row_threshold is not None:
+            # an explicit argument wins over the config file value
             config.coprocessor.device_row_threshold = device_row_threshold
         else:
-            data_dir = config.storage.data_dir or data_dir or None
             device_row_threshold = config.coprocessor.device_row_threshold
+        data_dir = config.storage.data_dir or data_dir or None
         self.config = config
         self.config_controller = ConfigController(config)
         self.addr = addr
@@ -149,15 +152,21 @@ class Node:
                                _struct.pack(">Q", self.store_id))
         pd.put_store(StoreMeta(self.store_id, addr))
         self.transport = GrpcTransport(pd)
-        self.raft_store = RaftStore(self.store_id, self.engine,
-                                    self.transport,
-                                    tick_interval=tick_interval)
+        self.raft_store = RaftStore(
+            self.store_id, self.engine, self.transport,
+            election_tick=config.raftstore.raft_election_timeout_ticks,
+            heartbeat_tick=config.raftstore.raft_heartbeat_ticks,
+            tick_interval=tick_interval)
+        # the store reads split/gc thresholds live (split checker, log
+        # gc) so online raftstore changes take effect without restart
+        self.raft_store.config = config.raftstore
         self.raft_store.observers = [self._report_region]
         self.raft_kv = RaftKv(self.raft_store, driver=self._wait_driver,
                               lock=self.lock)
         self.storage = Storage(engine=self.raft_kv)
         from .read_pool import ReadPool
-        self.read_pool = ReadPool()
+        self.read_pool = ReadPool(
+            max_concurrency=config.readpool.concurrency)
         self.copr_cache = RegionColumnarCache(
             capacity=config.coprocessor.region_cache_capacity)
         self.endpoint = Endpoint(self._copr_snapshot,

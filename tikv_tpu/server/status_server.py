@@ -96,6 +96,9 @@ class StatusServer:
                 except json.JSONDecodeError:
                     self._json(400, {"error": "bad json"})
                     return
+                if not isinstance(body, dict):
+                    self._json(400, {"error": "body must be a JSON object"})
+                    return
                 if path == "/config":
                     self._post_config(body)
                 elif path.startswith("/fail_point/"):
@@ -133,7 +136,9 @@ class StatusServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
         if self._thread is not None:
+            # shutdown() waits on an event only serve_forever sets —
+            # calling it before start() would hang forever
+            self._httpd.shutdown()
             self._thread.join(timeout=2)
+        self._httpd.server_close()

@@ -33,13 +33,18 @@ class TxnScheduler:
         if isinstance(cmd, ResolveLock):
             # read phase before latching (resolve_lock.rs scan → write)
             cmd.prepare(MvccReader(self._engine.snapshot(ctx)))
+        from ...utils.failpoint import fail_point
+        from ...utils.metrics import SCHED_COMMANDS
+        SCHED_COMMANDS.labels(type(cmd).__name__).inc()
         cid = self._latches.gen_cid()
         slots = self._latches.acquire(cid, cmd.write_keys())
         try:
+            fail_point("txn::before_process")
             snapshot = self._engine.snapshot(ctx)
             reader = MvccReader(snapshot)
             txn = MvccTxn(cmd.start_ts)
             result = cmd.process_write(txn, reader)
+            fail_point("txn::before_engine_write")
             if not txn.is_empty():
                 self._engine.write(ctx, WriteData.from_txn(txn))
             return result

@@ -67,10 +67,22 @@ def _parse_one(spec: str) -> _Action:
     return _Action(pct, cnt, task.strip(), arg)
 
 
+_TASKS = ("off", "return", "panic", "sleep", "delay", "pause", "print",
+          "yield")
+
+
 def cfg(name: str, actions: str) -> None:
-    """Configure a failpoint: ``cfg("apply::before", "panic")``."""
+    """Configure a failpoint: ``cfg("apply::before", "panic")``.
+
+    A bad action string is rejected HERE — surfacing it later inside an
+    instrumented production path would crash the raft/apply loop."""
     global _registry
     chain = [_parse_one(s) for s in actions.split("->") if s.strip()]
+    if not chain:
+        raise ValueError(f"empty failpoint actions {actions!r}")
+    for a in chain:
+        if a.task not in _TASKS:
+            raise ValueError(f"unknown failpoint task {a.task!r}")
     with _lock:
         if _registry is None:
             _registry = {}
@@ -142,17 +154,28 @@ def fail_point(name: str, return_hook: Optional[Callable] = None):
     chain = reg.get(name)
     if chain is None:
         return None
-    _hit_counts[name] = _hit_counts.get(name, 0) + 1
-    for action in chain:
+    selected = []
+    with _lock:
+        # fired/hits are read-modify-write: without the lock two threads
+        # could both fire a "1*" count-limited action
+        _hit_counts[name] = _hit_counts.get(name, 0) + 1
+        for action in chain:
+            if callable(action):
+                selected.append(action)
+                continue
+            if action.cnt is not None and action.fired >= action.cnt:
+                continue
+            if action.pct is not None and \
+                    random.random() * 100.0 >= action.pct:
+                continue
+            action.fired += 1
+            selected.append(action)
+            if action.task in ("off", "panic", "return"):
+                break           # chain-terminating tasks
+    for action in selected:
         if callable(action):
             action()
             continue
-        if action.cnt is not None and action.fired >= action.cnt:
-            continue
-        if action.pct is not None and \
-                random.random() * 100.0 >= action.pct:
-            continue
-        action.fired += 1
         t = action.task
         if t == "off":
             return None
