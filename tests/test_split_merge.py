@@ -1,0 +1,212 @@
+"""Size-based auto-split + coordinated region merge.
+
+Reference test model: tests/integrations/raftstore/test_split_region.rs
+and test_merge.rs over the in-process cluster; split checker from
+store/worker/split_check.rs, merge admin flow from fsm/apply.rs.
+"""
+
+import pytest
+
+from tikv_tpu.raftstore import Peer
+from tikv_tpu.raftstore.metapb import RegionMerging
+from tikv_tpu.testing.cluster import Cluster
+from tikv_tpu.utils import failpoint
+
+
+@pytest.fixture(autouse=True)
+def _fp():
+    yield
+    failpoint.teardown()
+
+
+def make_cluster(n=3, split_mb=None):
+    c = Cluster(n)
+    c.bootstrap()
+    c.start()
+    if split_mb is not None:
+        for store in c.stores.values():
+            store.config.region_split_size_mb = split_mb
+    return c
+
+
+def test_auto_split_by_size_then_merge_back_roundtrip():
+    """Writes push region 1 over the split threshold → auto-split;
+    delete + merge restores a single region; data intact and routable
+    throughout (the VERDICT r3 #5 acceptance test)."""
+    c = make_cluster(3, split_mb=4 / 1024.0)     # 4 KB threshold
+    keys = [b"k%03d" % i for i in range(64)]
+    for k in keys:
+        c.must_put(k, b"v" * 100)                # ~7 KB total
+    assert c.split_check_all() >= 1
+    c.pump()
+    c.tick_all(2)
+    regions = {p.region.id for p in c.stores[1].peers.values()}
+    assert len(regions) == 2, "size checker did not split"
+    # routing still correct across the boundary
+    for k in keys:
+        assert c.must_get(k) == b"v" * 100
+    # PD learned both regions
+    left = c.pd.get_region(keys[0])
+    right = c.pd.get_region(keys[-1])
+    assert left.id != right.id
+    assert left.end_key == right.start_key
+
+    # raise the threshold back so the checker stays quiet, then merge
+    for store in c.stores.values():
+        store.config.region_split_size_mb = 96
+    source_id = left.id if left.id != 1 else right.id
+    target_id = right.id if source_id == left.id else left.id
+    # make them leader-colocated for the fixture coordinator
+    merged = c.merge_region(source_id, target_id)
+    assert merged.start_key == b"" and merged.end_key == b""
+    c.pump()
+    c.tick_all(2)
+    for sid, store in c.stores.items():
+        assert source_id not in store.peers, f"store {sid} kept source"
+    for k in keys:
+        assert c.must_get(k) == b"v" * 100
+    # PD no longer routes to the absorbed source
+    assert c.pd.get_region(keys[0]).id == merged.id
+    assert c.pd.get_region(keys[-1]).id == merged.id
+
+
+def test_split_key_keeps_txn_versions_together():
+    """The split checker must never put two versions of one user key on
+    different sides (ts-suffix truncation in find_split_key)."""
+    c = make_cluster(1, split_mb=2 / 1024.0)
+    # many versions of few keys: naive midpoint would land mid-version
+    for ver in range(40):
+        c.must_put(b"hot-a", b"x" * 40)
+        c.must_put(b"hot-b", b"y" * 40)
+    if c.split_check_all():
+        c.pump()
+        for p in c.stores[1].peers.values():
+            r = p.region
+            for bound in (r.start_key, r.end_key):
+                if bound:
+                    # boundaries must be bare encoded keys (no ts): the
+                    # codec round-trips them cleanly
+                    from tikv_tpu.storage.txn_types import decode_key
+                    decode_key(bound)
+    assert c.must_get(b"hot-a") == b"x" * 40
+    assert c.must_get(b"hot-b") == b"y" * 40
+
+
+def test_writes_rejected_while_merging_then_rollback():
+    """PrepareMerge blocks the source's writes (ProposalInMergingMode);
+    RollbackMerge reopens it."""
+    from tikv_tpu.raftstore import AdminCmd, RaftCmd
+    c = make_cluster(1)
+    c.must_put(b"a", b"1")
+    c.must_put(b"z", b"2")
+    right = c.split_region(1, b"m")
+    c.pump()
+    c.elect_leader(right.id, 1)
+    src = c.leader_peer(1)
+    box = {}
+    src.propose(RaftCmd(1, src.region.epoch,
+                        admin=AdminCmd("prepare_merge")),
+                lambda r: box.__setitem__("r", r))
+    c._drive_until(lambda: "r" in box)
+    with pytest.raises(RegionMerging):
+        c.must_put(b"a", b"blocked")
+    # rollback, then writes flow again
+    box2 = {}
+    src.propose(RaftCmd(1, src.region.epoch,
+                        admin=AdminCmd("rollback_merge")),
+                lambda r: box2.__setitem__("r", r))
+    c._drive_until(lambda: "r" in box2)
+    c.must_put(b"a", b"after")
+    assert c.must_get(b"a") == b"after"
+
+
+def test_merge_survives_source_restart_between_prepare_and_commit():
+    """A store restart between PrepareMerge and CommitMerge must keep
+    the source write-blocked (persisted merge state) and the merge must
+    still complete."""
+    from tikv_tpu.raftstore import AdminCmd, RaftCmd
+    from tikv_tpu.raftstore.peer_storage import encode_region
+    c = make_cluster(1)
+    c.must_put(b"a", b"1")
+    c.must_put(b"z", b"2")
+    right = c.split_region(1, b"m")
+    c.pump()
+    c.elect_leader(right.id, 1)
+    src = c.leader_peer(1)
+    box = {}
+    src.propose(RaftCmd(1, src.region.epoch,
+                        admin=AdminCmd("prepare_merge")),
+                lambda r: box.__setitem__("r", r))
+    c._drive_until(lambda: "r" in box)
+    prepare_index = box["r"]["prepare_index"]
+    source_region = box["r"]["region"]
+    # crash + restart the store
+    c.restart_store(1)
+    c.pump()
+    for rid in list(c.stores[1].peers):
+        c.elect_leader(rid, 1)
+    c.pump()
+    src2 = c.stores[1].peers[1]
+    assert src2.merging == prepare_index, "merge state lost on restart"
+    with pytest.raises(RegionMerging):
+        c.must_put(b"a", b"blocked")
+    # commit on the target completes the merge
+    tgt = c.leader_peer(right.id)
+    box2 = {}
+    tgt.propose(RaftCmd(right.id, tgt.region.epoch,
+                        admin=AdminCmd("commit_merge",
+                                       merge_index=prepare_index,
+                                       extra=encode_region(source_region))),
+                lambda r: box2.__setitem__("r", r))
+    c._drive_until(lambda: "r" in box2)
+    merged = box2["r"]["region"]
+    assert merged.start_key == b"" and merged.end_key == b""
+    assert 1 not in c.stores[1].peers
+    assert c.must_get(b"a") == b"1"
+    assert c.must_get(b"z") == b"2"
+
+
+def test_merge_over_network_with_copr_routing():
+    """The gRPC path: split, load rows in both halves, merge via the
+    MergeRegion RPC, verify KV + coprocessor still serve everything."""
+    from tikv_tpu.server import (
+        Node, PdServer, RemotePdClient, TikvServer, TxnClient,
+    )
+    from tikv_tpu.raftstore.metapb import Store
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import encode_table_row, int_table
+
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    node = Node("127.0.0.1:0", RemotePdClient(pd_addr))
+    from tikv_tpu.server.server import TikvServer as TS
+    srv = TS(node)
+    node.addr = f"127.0.0.1:{srv.port}"
+    node.pd.put_store(Store(node.store_id, node.addr))
+    srv.start()
+    try:
+        c = TxnClient(pd_addr)
+        table = int_table(2, table_id=601)
+        for h in range(60):
+            k, v = encode_table_row(table, h, {"c0": h % 5, "c1": h})
+            c.put(k, v)
+        mid_key = encode_table_row(table, 30, {})[0]
+        right = c.split(mid_key)
+        import time
+        time.sleep(0.3)
+        merged = c.merge(right.id, 1) if right.id != 1 else None
+        assert merged is not None and merged.id == 1
+        time.sleep(0.3)
+        # all rows reachable; coprocessor scans the merged region
+        sel = DagSelect.from_table(table, ["id", "c0", "c1"])
+        dag = sel.aggregate([], [("count_star", None)]).build(
+            start_ts=c.tso())
+        resp = c.coprocessor(dag)
+        assert resp["rows"] == [[60]]
+        for h in (0, 29, 30, 59):
+            k, _ = encode_table_row(table, h, {})
+            assert c.get(k) is not None
+    finally:
+        srv.stop()
+        pd_server.stop()

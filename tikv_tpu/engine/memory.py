@@ -205,6 +205,18 @@ class MemoryEngine:
             data.pinned = True      # iterator sees a stable generation
             return _MemIterator(data, lower, upper)
 
+    def range_cf(self, cf: str, lower: bytes,
+                 upper: bytes) -> tuple[list, list, int]:
+        """Bulk range read → (keys, values, prefix_skip); see
+        MemorySnapshot.range_cf.  The returned slices are independent
+        copies, so no generation pin is needed — pinning here would
+        force a full copy-on-write of the CF on the next mutation."""
+        with self._mu:
+            data = self._cfs[cf]
+            i = bisect.bisect_left(data.keys, lower)
+            j = bisect.bisect_left(data.keys, upper)
+            return data.keys[i:j], data.vals[i:j], 0
+
     def put_cf(self, cf: str, key: bytes, value: bytes) -> None:
         with self._mu:
             self._put_locked(cf, key, value)

@@ -109,7 +109,9 @@ class MockPd:
         return info.leader if info else None
 
     def region_heartbeat(self, region: Region, leader: Peer) -> None:
-        """Reference: pd.rs handle_heartbeat — accept newer epochs only."""
+        """Reference: pd.rs handle_heartbeat — accept newer epochs only;
+        a newer region covering an older one's whole range evicts it
+        (how PD learns a merge: the absorbed source simply vanishes)."""
         with self._lock:
             cur = self._regions.get(region.id)
             if cur is not None:
@@ -117,6 +119,15 @@ class MockPd:
                 if (ne.version, ne.conf_ver) < (ce.version, ce.conf_ver):
                     return      # stale heartbeat
             self._regions[region.id] = _RegionInfo(region, leader)
+            for rid, info in list(self._regions.items()):
+                if rid == region.id:
+                    continue
+                o = info.region
+                covered = o.start_key >= region.start_key and (
+                    not region.end_key or
+                    (o.end_key and o.end_key <= region.end_key))
+                if covered and (o.epoch.version < region.epoch.version):
+                    del self._regions[rid]
 
     def ask_split(self, region: Region) -> tuple[int, list[int]]:
         """→ (new_region_id, new peer ids aligned with region.peers)."""

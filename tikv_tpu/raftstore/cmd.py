@@ -53,11 +53,16 @@ class WriteOp:
 
 @dataclass(frozen=True)
 class AdminCmd:
-    """Admin command.  kind: split | change_peer | compact_log.
+    """Admin command.  kind: split | change_peer | compact_log |
+    prepare_merge | commit_merge | rollback_merge.
 
     split: split_key + new_region_id + new_peer_ids
     change_peer: change_type(add|remove|add_learner) + peer
     compact_log: compact_index
+    prepare_merge: target region id rides new_region_id
+    commit_merge: extra = encoded source Region, merge_index = the
+        source's prepare-merge apply index (fsm/apply.rs merge cmds)
+    rollback_merge: merge_index = the prepare index being rolled back
     """
 
     kind: str
@@ -67,6 +72,8 @@ class AdminCmd:
     change_type: str = ""
     peer: Optional[Peer] = None
     compact_index: int = 0
+    merge_index: int = 0
+    extra: bytes = b""          # commit_merge: encoded source region
 
     def to_bytes(self) -> bytes:
         parts = [_pack_bytes(self.kind.encode()), _pack_bytes(self.split_key),
@@ -80,6 +87,9 @@ class AdminCmd:
                                      int(self.peer.is_learner)))
         else:
             parts.append(struct.pack(">B", 0))
+        # trailing fields: absent in pre-merge logs, decoder tolerates
+        parts.append(struct.pack(">Q", self.merge_index))
+        parts.append(_pack_bytes(self.extra))
         return b"".join(parts)
 
     @staticmethod
@@ -103,8 +113,15 @@ class AdminCmd:
             pid, sid, learner = struct.unpack_from(">QQB", buf, off)
             off += 17
             peer = Peer(pid, sid, bool(learner))
+        merge_index = 0
+        extra = b""
+        if off + 8 <= len(buf):     # logs from before the merge fields
+            (merge_index,) = struct.unpack_from(">Q", buf, off)
+            off += 8
+            extra, off = _unpack_bytes(buf, off)
         return AdminCmd(kind.decode(), split_key, new_region_id, tuple(ids),
-                        change_type.decode(), peer, compact_index), off
+                        change_type.decode(), peer, compact_index,
+                        merge_index, extra), off
 
 
 @dataclass(frozen=True)

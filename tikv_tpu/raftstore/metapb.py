@@ -86,3 +86,13 @@ class RegionNotFound(Exception):
     def __init__(self, region_id: int):
         super().__init__(f"region {region_id} not found")
         self.region_id = region_id
+
+
+class RegionMerging(Exception):
+    """Writes rejected while a PrepareMerge is in flight (reference:
+    raftstore Error::ProposalInMergingMode) — retryable after the merge
+    commits or rolls back."""
+
+    def __init__(self, region_id: int):
+        super().__init__(f"region {region_id} is merging")
+        self.region_id = region_id

@@ -19,7 +19,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from ..engine.traits import KvEngine
+from ..engine.traits import CF_RAFT, KvEngine
 from ..raft.messages import (
     ConfChange,
     ConfChangeType,
@@ -36,6 +36,7 @@ from .metapb import (
     Peer as PeerMeta,
     Region,
     RegionEpoch,
+    RegionMerging,
 )
 from .peer_storage import PeerStorage, data_key
 
@@ -163,6 +164,13 @@ class RaftPeer:
         self.data_index = self.node.applied
         self.proposals: list[Proposal] = []
         self.pending_destroy = False
+        # PrepareMerge in flight: the prepare entry's apply index, or
+        # None.  Persisted (merge_state_key) so a restarted source peer
+        # keeps rejecting writes until commit/rollback.
+        from .peer_storage import merge_state_key
+        raw = engine.get_value_cf(CF_RAFT, merge_state_key(region.id))
+        self.merging: Optional[int] = \
+            int.from_bytes(raw, "big") if raw else None
         # sender metas seen on incoming messages — lets an uninitialized
         # peer route responses before it learns the region's peer list
         # (reference: peer.rs Peer::peer_cache)
@@ -199,6 +207,12 @@ class RaftPeer:
     def propose(self, cmd: RaftCmd, cb: Callable) -> int:
         if not self.is_leader():
             raise NotLeaderError(self.region.id, self.leader_peer())
+        if self.merging is not None and (
+                cmd.admin is None or
+                cmd.admin.kind not in ("rollback_merge",)):
+            # a merging source accepts only the rollback; everything
+            # else retries after commit/rollback (ProposalInMergingMode)
+            raise RegionMerging(self.region.id)
         self._check_header(cmd)
         from ..utils.metrics import RAFT_PROPOSE_COUNTER
         RAFT_PROPOSE_COUNTER.labels(
@@ -327,7 +341,8 @@ class RaftPeer:
         if entry.entry_type is EntryType.CONF_CHANGE:
             cc = ConfChange.from_bytes(entry.data)
             cmd = RaftCmd.from_bytes(cc.context)
-            result = self._exec_admin(wb, cmd.admin, cc=cc)
+            result = self._exec_admin(wb, cmd.admin, cc=cc,
+                                      index=entry.index)
         else:
             cmd = RaftCmd.from_bytes(entry.data)
             try:
@@ -337,7 +352,8 @@ class RaftPeer:
                     prop.cb(e)
                 return
             if cmd.admin is not None:
-                result = self._exec_admin(wb, cmd.admin)
+                result = self._exec_admin(wb, cmd.admin,
+                                          index=entry.index)
             else:
                 # only actual KV mutations bump the data version —
                 # admin commands (compact_log, change_peer) leave table
@@ -368,7 +384,8 @@ class RaftPeer:
         return {}
 
     def _exec_admin(self, wb, admin: AdminCmd,
-                    cc: Optional[ConfChange] = None) -> dict:
+                    cc: Optional[ConfChange] = None,
+                    index: int = 0) -> dict:
         from ..utils.failpoint import fail_point
         if admin.kind == "split":
             fail_point("apply::before_split")
@@ -378,7 +395,91 @@ class RaftPeer:
             return self._exec_change_peer(wb, admin, cc)
         if admin.kind == "compact_log":
             return self._exec_compact_log(wb, admin)
+        if admin.kind == "prepare_merge":
+            fail_point("apply::before_prepare_merge")
+            return self._exec_prepare_merge(wb, admin, index)
+        if admin.kind == "commit_merge":
+            fail_point("apply::before_commit_merge")
+            return self._exec_commit_merge(wb, admin)
+        if admin.kind == "rollback_merge":
+            return self._exec_rollback_merge(wb, admin)
         raise ValueError(admin.kind)    # pragma: no cover
+
+    def _exec_prepare_merge(self, wb, admin: AdminCmd,
+                            index: int) -> dict:
+        """fsm/apply.rs exec_prepare_merge: epoch bump + persisted merge
+        state; the source stops accepting proposals until commit or
+        rollback."""
+        from dataclasses import replace
+        from .peer_storage import merge_state_key
+        region = self.region
+        new_region = replace(region, epoch=RegionEpoch(
+            region.epoch.conf_ver, region.epoch.version + 1))
+        self.peer_storage.persist_region(wb, new_region)
+        wb.put_cf(CF_RAFT, merge_state_key(region.id),
+                  index.to_bytes(8, "big"))
+        self.merging = index
+        self.store.on_region_changed(self, new_region)
+        return {"region": new_region, "prepare_index": index}
+
+    def _exec_rollback_merge(self, wb, admin: AdminCmd) -> dict:
+        """fsm/apply.rs exec_rollback_merge: clear the merge state and
+        bump the epoch so stale CommitMerge attempts epoch-fail."""
+        from dataclasses import replace
+        from .peer_storage import merge_state_key
+        region = self.region
+        new_region = replace(region, epoch=RegionEpoch(
+            region.epoch.conf_ver, region.epoch.version + 1))
+        self.peer_storage.persist_region(wb, new_region)
+        wb.delete_cf(CF_RAFT, merge_state_key(region.id))
+        self.merging = None
+        self.store.on_region_changed(self, new_region)
+        return {"region": new_region}
+
+    def _exec_commit_merge(self, wb, admin: AdminCmd) -> dict:
+        """fsm/apply.rs exec_commit_merge (simplified to the coordinated
+        protocol): the TARGET absorbs the adjacent source region.
+
+        Data never moves — both regions share this store's engine; only
+        the region boundary and the source's raft-local state change.
+        Safety precondition (the coordinator enforced it before
+        proposing, node.merge_region): every source peer has applied the
+        PrepareMerge, so the local source peer's data is complete up to
+        the merge point.  The reference instead ships the source log
+        tail inside CommitMerge — the coordinated wait is the
+        in-process/PD-scheduler equivalent.
+        """
+        from dataclasses import replace
+        from .peer_storage import decode_region
+        source = decode_region(admin.extra)
+        region = self.region
+        speer = self.store.peers.get(source.id)
+        if speer is not None:
+            # drain any committed-but-unapplied source entries first
+            # (messages are dropped; the group is being destroyed)
+            if speer.node.applied < admin.merge_index:
+                speer.handle_ready()
+            if speer.node.applied < admin.merge_index:
+                raise AssertionError(
+                    f"commit_merge: source {source.id} applied "
+                    f"{speer.node.applied} < prepare {admin.merge_index}")
+        # b"" as end_key means +infinity — it must never compare equal
+        # to a b"" start_key (-infinity)
+        if source.end_key and source.end_key == region.start_key:
+            new_start, new_end = source.start_key, region.end_key
+        elif region.end_key and region.end_key == source.start_key:
+            new_start, new_end = region.start_key, source.end_key
+        else:
+            raise AssertionError("commit_merge: regions not adjacent")
+        new_region = replace(
+            region, start_key=new_start, end_key=new_end,
+            epoch=RegionEpoch(
+                max(region.epoch.conf_ver, source.epoch.conf_ver),
+                max(region.epoch.version, source.epoch.version) + 1))
+        self.peer_storage.persist_region(wb, new_region)
+        self.store.destroy_peer(source.id)
+        self.store.on_region_changed(self, new_region)
+        return {"region": new_region}
 
     def _exec_split(self, wb, admin: AdminCmd) -> dict:
         """fsm/apply.rs exec_batch_split: left keeps the id, right is the

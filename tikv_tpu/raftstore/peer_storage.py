@@ -56,6 +56,13 @@ def region_state_key(region_id: int) -> bytes:
     return REGION_PREFIX + struct.pack(">Q", region_id) + b"m"
 
 
+def merge_state_key(region_id: int) -> bytes:
+    """Persisted PrepareMerge state (raft_serverpb MergeState analog):
+    value = >Q prepare-apply-index.  Lives under the region's CF_RAFT
+    prefix so peer destruction cleans it up with everything else."""
+    return REGION_PREFIX + struct.pack(">Q", region_id) + b"g"
+
+
 def data_key(key: bytes) -> bytes:
     return DATA_PREFIX + key
 

@@ -20,7 +20,7 @@ from typing import Callable, Optional
 
 from ..engine.traits import CF_RAFT, KvEngine
 from ..raft.messages import Message, MsgType
-from .cmd import RaftCmd
+from .cmd import AdminCmd, RaftCmd
 from .metapb import Peer as PeerMeta, Region, RegionNotFound
 from .peer import RaftPeer
 from .peer_storage import (
@@ -204,3 +204,96 @@ class RaftStore:
             if p.id == peer_id:
                 return p
         return None
+
+    # ------------------------------------------------------- split checker
+
+    def _scan_region(self, peer: RaftPeer):
+        """ONE bulk pass over the region's data CFs → (total_bytes,
+        sorted [(bare_key, bytes)]) — size and split-key candidates
+        from the same scan.  The reference reads RocksDB
+        table-properties instead (engine_rocks/src/properties.rs); a
+        scan is exact and cheap at this engine's scale."""
+        from ..engine.traits import CF_DEFAULT, CF_LOCK, CF_WRITE
+        from .peer_storage import region_data_bounds
+        rng = getattr(self.engine, "range_cf", None)
+        lo, hi = region_data_bounds(peer.region)
+        total = 0
+        entries: list[tuple[bytes, int]] = []
+        for cf, splittable in ((CF_WRITE, True), (CF_DEFAULT, True),
+                               (CF_LOCK, False)):
+            if rng is not None:
+                keys, vals, _skip = rng(cf, lo, hi)
+                for k, v in zip(keys, vals):
+                    sz = len(k) + len(v)
+                    total += sz
+                    if splittable:
+                        uk = k[1:]              # strip data prefix
+                        if uk[:1] == b"x" and len(uk) > 8:
+                            uk = uk[:-8]        # versions stay together
+                        entries.append((uk, sz))
+            else:   # pragma: no cover - engines without bulk range
+                it = self.engine.iterator_cf(cf, lo, hi)
+                ok = it.seek_to_first()
+                while ok:
+                    total += len(it.key()) + len(it.value())
+                    ok = it.next()
+        entries.sort()
+        return total, entries
+
+    def region_approximate_size(self, peer: RaftPeer) -> int:
+        return self._scan_region(peer)[0]
+
+    def find_split_key(self, peer: RaftPeer,
+                       entries=None) -> Optional[bytes]:
+        """The key where cumulative size crosses half the region —
+        worker/split_check.rs's half-split policy.  Versioned keys
+        (txn keyspace 'x', 8-byte ts suffix in write/default CFs) are
+        truncated to the bare encoded key so one user key's versions
+        never straddle the boundary."""
+        if entries is None:
+            entries = self._scan_region(peer)[1]
+        if len(entries) < 2:
+            return None
+        total = sum(sz for _, sz in entries)
+        acc = 0
+        region = peer.region
+        for uk, sz in entries:
+            acc += sz
+            if acc >= total // 2:
+                if uk > region.start_key and \
+                        (not region.end_key or uk < region.end_key):
+                    return uk
+                # keep walking: the midpoint key may equal start_key
+                continue
+        return None
+
+    def split_check(self, pd) -> int:
+        """One split-checker pass (store/worker/split_check.rs): leader
+        peers over ``region_split_size_mb`` propose a half-split with
+        PD-allocated ids.  One bulk scan per region serves both the
+        size estimate and the split key.  Returns splits proposed."""
+        threshold = int(self.config.region_split_size_mb * (1 << 20))
+        if threshold <= 0:
+            return 0
+        proposed = 0
+        for peer in list(self.peers.values()):
+            if not peer.is_leader() or peer.merging is not None:
+                continue
+            size, entries = self._scan_region(peer)
+            if size < threshold:
+                continue
+            split_key = self.find_split_key(peer, entries)
+            if split_key is None:
+                continue
+            new_id, new_peer_ids = pd.ask_split(peer.region)
+            cmd = RaftCmd(peer.region.id, peer.region.epoch,
+                          admin=AdminCmd(
+                              "split", split_key=split_key,
+                              new_region_id=new_id,
+                              new_peer_ids=tuple(new_peer_ids)))
+            try:
+                peer.propose(cmd, lambda r: None)
+                proposed += 1
+            except Exception:   # not leader anymore / epoch raced
+                continue
+        return proposed

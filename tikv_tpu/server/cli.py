@@ -62,6 +62,11 @@ def main(argv=None) -> int:
     ap = ctl_sub.add_parser("add-peer")
     ap.add_argument("region_id", type=int)
     ap.add_argument("store_id", type=int)
+    mg = ctl_sub.add_parser("merge")
+    mg.add_argument("source_id", type=int)
+    mg.add_argument("target_id", type=int)
+    rb = ctl_sub.add_parser("rollback-merge")
+    rb.add_argument("region_id", type=int)
     st = ctl_sub.add_parser("store-status")
     st.add_argument("store_id", type=int)
     gc = ctl_sub.add_parser("gc")
@@ -135,6 +140,14 @@ def main(argv=None) -> int:
     elif args.op == "add-peer":
         peer = c.add_peer(args.region_id, args.store_id)
         print(f"added peer {peer.id} on store {peer.store_id}")
+    elif args.op == "merge":
+        merged = c.merge(args.source_id, args.target_id)
+        print(f"merged region {args.source_id} into {merged.id}")
+    elif args.op == "rollback-merge":
+        region = c.pd.get_region_by_id(args.region_id)
+        c._call_leader_by_region(region, "RollbackMerge",
+                                 {"region_id": args.region_id})
+        print(f"rolled back merge on region {args.region_id}")
     elif args.op == "store-status":
         print(json.dumps(c.status(args.store_id), default=repr, indent=2))
     elif args.op == "gc":

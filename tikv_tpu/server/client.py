@@ -100,7 +100,7 @@ class TxnClient:
                 return client.call(method, req)
             except wire.RemoteError as e:
                 if e.kind in ("not_leader", "epoch_not_match",
-                              "region_not_found"):
+                              "region_not_found", "region_merging"):
                     last = e
                     self._invalidate_region(key)
                     time.sleep(0.05)
@@ -170,7 +170,8 @@ class TxnClient:
                 break
             except wire.RemoteError as e:
                 if e.kind in ("not_leader", "epoch_not_match",
-                              "region_not_found") and attempt < 7:
+                              "region_not_found",
+                              "region_merging") and attempt < 7:
                     for _op, key, _v in mutations:
                         self._invalidate_region(key)
                     time.sleep(0.05)
@@ -198,7 +199,7 @@ class TxnClient:
                     "commit_version": commit_ts})
             except wire.RemoteError as e:
                 if e.kind not in ("not_leader", "epoch_not_match",
-                                  "region_not_found"):
+                                  "region_not_found", "region_merging"):
                     raise
                 # stale group route: fall back to per-key re-routing
                 for key in keys:
@@ -281,6 +282,14 @@ class TxnClient:
             "region_id": region_id, "change_type": "remove",
             "peer": wire.enc_peer(peer)})
 
+    def merge(self, source_id: int, target_id: int) -> Region:
+        """Merge the source region into its adjacent target."""
+        region = self.pd.get_region_by_id(source_id)
+        self._region_cache.clear()      # boundaries are about to change
+        r = self._call_leader_by_region(region, "MergeRegion", {
+            "source_id": source_id, "target_id": target_id})
+        return wire.dec_region(r["region"])
+
     def _call_leader_by_region(self, region: Region, method: str,
                                req: dict, retries: int = 8) -> dict:
         last = None
@@ -293,7 +302,8 @@ class TxnClient:
             try:
                 return client.call(method, req)
             except wire.RemoteError as e:
-                if e.kind in ("not_leader", "epoch_not_match"):
+                if e.kind in ("not_leader", "epoch_not_match",
+                              "region_merging"):
                     last = e
                     time.sleep(0.05)
                     continue

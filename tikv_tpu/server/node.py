@@ -213,6 +213,7 @@ class Node:
     def _drive_loop(self) -> None:
         last_tick = time.monotonic()
         last_hb = 0.0
+        ticks = 0
         while not self._stop.is_set():
             did = 0
             with self.lock:
@@ -220,6 +221,13 @@ class Node:
                 if now - last_tick >= self._tick_interval:
                     last_tick = now
                     self.raft_store.tick()
+                    ticks += 1
+                    every = self.config.raftstore.region_split_check_ticks
+                    if every > 0 and ticks % every == 0:
+                        try:
+                            self.raft_store.split_check(self.pd)
+                        except Exception:
+                            pass    # PD outage: retry next interval
                 did = self.raft_store.drive()
                 self._wake.notify_all()
                 # periodic PD reporting (worker/pd.rs heartbeat loop)
@@ -325,6 +333,100 @@ class Node:
         with self.lock:
             peer = self.raft_store.region_peer(region_id)
             peer.node.transfer_leader(to_peer_id)
+
+    def region_applied(self, region_id: int) -> int:
+        """Local peer's apply index (merge coordination probe)."""
+        with self.lock:
+            return self.raft_store.region_peer(region_id).node.applied
+
+    def merge_region(self, source_id: int, target_id: int) -> Region:
+        """Coordinated region merge over the network (this node must
+        lead BOTH regions): PrepareMerge on the source, poll every
+        source-peer store's apply index over gRPC until the prepare is
+        everywhere, then CommitMerge on the target — the PD-scheduler
+        protocol from the in-process fixture, lifted onto real RPC
+        (testing/cluster.py merge_region)."""
+        import time as _time
+
+        from ..raftstore.peer_storage import encode_region
+        from .client import StoreClient
+        with self.lock:
+            src = self.raft_store.region_peer(source_id)
+            tgt = self.raft_store.region_peer(target_id)
+            if not tgt.is_leader():
+                # check BEFORE proposing PrepareMerge: discovering this
+                # after the prepare would leave the source write-dead
+                # until a rollback
+                raise NotLeaderError(target_id, tgt.leader_peer())
+            sr, tr = src.region, tgt.region
+            if sorted(p.store_id for p in sr.peers) != \
+                    sorted(p.store_id for p in tr.peers):
+                raise ValueError("merge requires colocated replicas")
+            if not ((sr.end_key and sr.end_key == tr.start_key) or
+                    (tr.end_key and tr.end_key == sr.start_key)):
+                raise ValueError("merge requires adjacent regions")
+            box: dict = {}
+            cmd = RaftCmd(source_id, sr.epoch, admin=AdminCmd(
+                "prepare_merge", new_region_id=target_id))
+            src.propose(cmd, lambda r: box.__setitem__("result", r))
+        self._wait_driver(lambda: "result" in box)
+        if isinstance(box["result"], Exception):
+            raise box["result"]
+        prepare_index = box["result"]["prepare_index"]
+        source_region = box["result"]["region"]
+
+        try:
+            deadline = _time.monotonic() + 10.0
+            pending = {p.store_id for p in source_region.peers
+                       if p.store_id != self.store_id}
+            while pending:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"merge: stores {pending} lag the prepare")
+                for sid in list(pending):
+                    addr = self.pd.get_store(sid).address
+                    try:
+                        r = StoreClient(addr).call(
+                            "RegionApplied", {"region_id": source_id})
+                        if r["applied"] >= prepare_index:
+                            pending.discard(sid)
+                    except Exception:
+                        pass
+                if pending:
+                    _time.sleep(0.02)
+
+            with self.lock:
+                box2: dict = {}
+                cmd2 = RaftCmd(target_id, tgt.region.epoch,
+                               admin=AdminCmd(
+                                   "commit_merge",
+                                   merge_index=prepare_index,
+                                   extra=encode_region(source_region)))
+                tgt.propose(cmd2, lambda r: box2.__setitem__("result", r))
+            self._wait_driver(lambda: "result" in box2)
+            if isinstance(box2["result"], Exception):
+                raise box2["result"]
+            return box2["result"]["region"]
+        except Exception:
+            # the merge cannot proceed: roll the source back so it is
+            # not left permanently write-dead (fsm RollbackMerge)
+            try:
+                self.rollback_merge(source_id)
+            except Exception:
+                pass    # operator remedy: ctl rollback-merge
+            raise
+
+    def rollback_merge(self, region_id: int) -> None:
+        """Abort an in-flight PrepareMerge (exec_rollback_merge)."""
+        with self.lock:
+            peer = self.raft_store.region_peer(region_id)
+            box: dict = {}
+            cmd = RaftCmd(region_id, peer.region.epoch, admin=AdminCmd(
+                "rollback_merge", merge_index=peer.merging or 0))
+            peer.propose(cmd, lambda r: box.__setitem__("result", r))
+        self._wait_driver(lambda: "result" in box)
+        if isinstance(box["result"], Exception):
+            raise box["result"]
 
     def run_gc(self, safe_point: int) -> int:
         """GC every leader region on this store (gc_worker role)."""

@@ -221,5 +221,17 @@ class KvService:
         self.node.transfer_leader(req["region_id"], req["to_peer_id"])
         return {}
 
+    def RegionApplied(self, req: dict) -> dict:
+        return {"applied": self.node.region_applied(req["region_id"])}
+
+    def MergeRegion(self, req: dict) -> dict:
+        merged = self.node.merge_region(req["source_id"],
+                                        req["target_id"])
+        return {"region": wire.enc_region(merged)}
+
+    def RollbackMerge(self, req: dict) -> dict:
+        self.node.rollback_merge(req["region_id"])
+        return {}
+
     def Status(self, req: dict) -> dict:
         return self.node.status()

@@ -118,6 +118,9 @@ def enc_error(e: Exception) -> dict:
     if isinstance(e, EpochNotMatch):
         return {"kind": "epoch_not_match",
                 "current": enc_region(e.current)}
+    from ..raftstore.metapb import RegionMerging
+    if isinstance(e, RegionMerging):
+        return {"kind": "region_merging", "region_id": e.region_id}
     from .read_pool import ServerIsBusy
     if isinstance(e, ServerIsBusy):
         return {"kind": "server_is_busy", "reason": e.reason}

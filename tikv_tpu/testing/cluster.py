@@ -231,6 +231,62 @@ class Cluster:
         if isinstance(box["result"], Exception):
             raise box["result"]
 
+    def merge_region(self, source_id: int, target_id: int) -> Region:
+        """PD-style coordinated merge (SURVEY §2.8.1): PrepareMerge on
+        the source, wait until EVERY source peer applied it, then
+        CommitMerge on the adjacent target.  Returns the merged region.
+        """
+        from ..raftstore.peer_storage import encode_region
+        src = self.leader_peer(source_id)
+        tgt = self.leader_peer(target_id)
+        assert src is not None and tgt is not None
+        s_stores = sorted(p.store_id for p in src.region.peers)
+        t_stores = sorted(p.store_id for p in tgt.region.peers)
+        assert s_stores == t_stores, "merge requires colocated replicas"
+        sr, tr = src.region, tgt.region
+        assert (sr.end_key and sr.end_key == tr.start_key) or \
+            (tr.end_key and tr.end_key == sr.start_key), \
+            "merge requires adjacent regions"
+        # 1. PrepareMerge on the source
+        box: dict = {}
+        cmd = RaftCmd(source_id, sr.epoch, admin=AdminCmd(
+            "prepare_merge", new_region_id=target_id))
+        src.propose(cmd, lambda r: box.__setitem__("r", r))
+        self._drive_until(lambda: "r" in box)
+        if isinstance(box["r"], Exception):
+            raise box["r"]
+        prepare_index = box["r"]["prepare_index"]
+        source_region = box["r"]["region"]
+
+        # 2. every source peer must have applied the prepare
+        def all_applied() -> bool:
+            return all(
+                store.peers[source_id].node.applied >= prepare_index
+                for store in self.stores.values()
+                if source_id in store.peers)
+        self._drive_until(all_applied)
+
+        # 3. CommitMerge on the target
+        box2: dict = {}
+        cmd2 = RaftCmd(target_id, tgt.region.epoch, admin=AdminCmd(
+            "commit_merge", merge_index=prepare_index,
+            extra=encode_region(source_region)))
+        tgt.propose(cmd2, lambda r: box2.__setitem__("r", r))
+        self._drive_until(lambda: "r" in box2)
+        if isinstance(box2["r"], Exception):
+            raise box2["r"]
+        self.pump()
+        return box2["r"]["region"]
+
+    def split_check_all(self) -> int:
+        """Run the size-based split checker on every store (the split
+        check tick, store/worker/split_check.rs)."""
+        n = 0
+        for store in self.stores.values():
+            n += store.split_check(self.pd)
+        self.pump()
+        return n
+
     def transfer_leader(self, region_id: int, to_store: int) -> None:
         peer = self.leader_peer(region_id)
         target = self.stores[to_store].region_peer(region_id)
