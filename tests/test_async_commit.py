@@ -1,0 +1,237 @@
+"""Async commit, 1PC, concurrency manager, deadlock detection.
+
+Reference test model: src/storage/txn/commands/prewrite.rs +
+check_secondary_locks.rs inline suites, concurrency_manager crate
+tests, and lock_manager/deadlock.rs detector tests.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tikv_tpu.engine.memory import MemoryEngine
+from tikv_tpu.kv.engine import LocalEngine
+from tikv_tpu.storage import Storage
+from tikv_tpu.storage.lock_manager import Deadlock, DeadlockDetector
+from tikv_tpu.storage.mvcc.errors import KeyIsLocked
+from tikv_tpu.storage.txn import commands as cmds
+from tikv_tpu.storage.txn.actions import Mutation
+
+
+def make_storage():
+    return Storage(LocalEngine(MemoryEngine()))
+
+
+# ------------------------------------------------------------ async commit
+
+def test_async_commit_min_commit_ts_exceeds_read_max_ts():
+    """A read at ts R forces any later async prewrite's min_commit_ts
+    above R — the committed-below-read anomaly is impossible."""
+    s = make_storage()
+    s.get(b"ak", 100)                       # bumps max_ts to 100
+    r = s.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"ak", b"v")], b"ak", 50,
+        use_async_commit=True, secondaries=()))
+    assert r["min_commit_ts"] > 100
+    # commit at min_commit_ts: reader at 100 must NOT see it
+    s.sched_txn_command(cmds.Commit([b"ak"], 50, r["min_commit_ts"]))
+    assert s.get(b"ak", 100) is None
+    assert s.get(b"ak", r["min_commit_ts"]) == b"v"
+
+
+def test_async_commit_lock_carries_secondaries():
+    s = make_storage()
+    r = s.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"p", b"1"), Mutation("put", b"s1", b"2"),
+         Mutation("put", b"s2", b"3")], b"p", 10,
+        use_async_commit=True, secondaries=[b"s1", b"s2"]))
+    st = s.sched_txn_command(cmds.CheckTxnStatus(b"p", 10, 0, 10**18))
+    assert st["status"] == "locked"
+    assert st["use_async_commit"] is True
+    assert sorted(st["secondaries"]) == [b"s1", b"s2"]
+    assert st["min_commit_ts"] == r["min_commit_ts"]
+
+
+def test_async_commit_resolution_via_secondary_locks():
+    """Crashed writer: a reader resolves the async txn from the primary
+    lock's secondary list — all locks present → commit at
+    max(min_commit_ts)."""
+    s = make_storage()
+    r = s.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"p", b"1"), Mutation("put", b"s1", b"2")],
+        b"p", 10, use_async_commit=True, secondaries=[b"s1"]))
+    # writer crashed. resolver path:
+    st = s.sched_txn_command(cmds.CheckTxnStatus(b"p", 10, 0, 10**18))
+    assert st["status"] == "locked" and st["use_async_commit"]
+    sec = s.sched_txn_command(cmds.CheckSecondaryLocks(st["secondaries"],
+                                                       10))
+    assert sec["status"] == "locked"
+    commit_ts = max(st["min_commit_ts"], sec["min_commit_ts"])
+    s.sched_txn_command(cmds.ResolveLockLite(10, commit_ts,
+                                             [b"p", b"s1"]))
+    assert s.get(b"p", commit_ts) == b"1"
+    assert s.get(b"s1", commit_ts) == b"2"
+
+
+def test_async_commit_resolution_rolls_back_missing_secondary():
+    """A secondary that was never prewritten (writer died mid-prewrite)
+    gets a protective rollback and the txn resolves to rolled back."""
+    s = make_storage()
+    s.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"p", b"1")], b"p", 10,
+        use_async_commit=True, secondaries=[b"s-missing"]))
+    sec = s.sched_txn_command(
+        cmds.CheckSecondaryLocks([b"s-missing"], 10))
+    assert sec["status"] == "rolled_back"
+    s.sched_txn_command(cmds.ResolveLockLite(10, 0, [b"p"]))
+    assert s.get(b"p", 10**18) is None
+    # the protective rollback blocks a late prewrite of that secondary
+    from tikv_tpu.storage.mvcc.errors import WriteConflict
+    with pytest.raises(WriteConflict):
+        s.sched_txn_command(cmds.Prewrite(
+            [Mutation("put", b"s-missing", b"late")], b"p", 10))
+
+
+def test_memory_lock_blocks_concurrent_reader_during_prewrite():
+    """The in-memory lock table closes the window between min_commit_ts
+    computation and the engine lock landing."""
+    from tikv_tpu.storage.txn_types import Lock, LockType
+    s = make_storage()
+    cm = s.concurrency_manager
+    cm.lock_keys([b"mk"], [Lock(LockType.PUT, b"mk", 10)])
+    try:
+        with pytest.raises(KeyIsLocked):
+            s.get(b"mk", 50)
+        # reads below the lock's start_ts pass
+        assert s.get(b"mk", 5) is None
+        # range reads see it too
+        with pytest.raises(KeyIsLocked):
+            s.scan(b"a", b"z", 10, 50)
+    finally:
+        cm.unlock_keys([b"mk"])
+    assert s.get(b"mk", 50) is None
+
+
+# ------------------------------------------------------------------- 1PC
+
+def test_one_pc_commits_without_lock_phase():
+    s = make_storage()
+    s.get(b"opc", 200)
+    r = s.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"opc", b"v"), Mutation("put", b"opc2", b"w")],
+        b"opc", 100, try_one_pc=True))
+    ts = r["one_pc_commit_ts"]
+    assert ts > 200
+    # no lock left behind; data visible at the 1PC ts
+    st = s.sched_txn_command(cmds.CheckTxnStatus(b"opc", 100, 0, 10**18))
+    assert st["status"] == "committed"
+    assert s.get(b"opc", ts) == b"v"
+    assert s.get(b"opc2", ts) == b"w"
+    assert s.get(b"opc", 200) is None
+
+
+# ------------------------------------------------------- deadlock detector
+
+def test_detector_finds_cycle_and_reports_chain():
+    d = DeadlockDetector()
+    assert d.detect(1, 2) is None       # 1 waits for 2
+    assert d.detect(2, 3) is None
+    cycle = d.detect(3, 1)              # closes 3 -> 1 -> 2 -> 3
+    assert cycle is not None
+    d.clean_up(1)
+    assert d.detect(3, 1) is None       # edge gone: no cycle now
+
+
+def test_pessimistic_wait_then_woken_by_commit():
+    """A conflicting AcquirePessimisticLock parks and succeeds once the
+    holder commits."""
+    s = make_storage()
+    s.sched_txn_command(cmds.AcquirePessimisticLock(
+        [b"wk"], b"wk", 10, 10))
+    got = {}
+
+    def waiter():
+        got["r"] = s.sched_txn_command(cmds.AcquirePessimisticLock(
+            [b"wk"], b"wk", 20, 20, wait_timeout_s=5.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    assert "r" not in got               # parked
+    # holder prewrites + commits; the release wakes the waiter
+    s.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"wk", b"v")], b"wk", 10,
+        is_pessimistic_lock=[True]))
+    s.sched_txn_command(cmds.Commit([b"wk"], 10, 15))
+    t.join(5.0)
+    assert not t.is_alive() and "r" in got
+
+
+def test_two_txn_deadlock_detected():
+    """T1 holds a, waits for b; T2 holds b, waits for a → one of them
+    gets Deadlock instead of hanging."""
+    s = make_storage()
+    s.sched_txn_command(cmds.AcquirePessimisticLock([b"da"], b"da", 1, 1))
+    s.sched_txn_command(cmds.AcquirePessimisticLock([b"db"], b"db", 2, 2))
+    errs = {}
+
+    def t1():
+        try:
+            s.sched_txn_command(cmds.AcquirePessimisticLock(
+                [b"db"], b"da", 1, 1, wait_timeout_s=3.0))
+            errs[1] = None
+        except Exception as e:
+            errs[1] = e
+
+    th = threading.Thread(target=t1)
+    th.start()
+    time.sleep(0.15)                    # T1 is parked waiting for T2
+    t0 = time.perf_counter()
+    with pytest.raises(Deadlock):
+        s.sched_txn_command(cmds.AcquirePessimisticLock(
+            [b"da"], b"db", 2, 2, wait_timeout_s=3.0))
+    assert time.perf_counter() - t0 < 1.0, "deadlock not detected fast"
+    # unblock T1 by rolling T2 back
+    s.sched_txn_command(cmds.PessimisticRollback([b"db"], 2, 2))
+    th.join(5.0)
+    assert not th.is_alive()
+    assert errs[1] is None, errs[1]
+
+
+def test_async_commit_over_network_with_crash_resolution():
+    """gRPC path: async-commit prewrite returns min_commit_ts; a reader
+    after a writer crash resolves via CheckSecondaryLocks and commits."""
+    from tikv_tpu.pd import MockPd
+    from tikv_tpu.server.node import Node
+    from tikv_tpu.server.service import KvService
+
+    pd = MockPd()
+    node = Node("test:0", pd)
+    node.start()
+    try:
+        svc = KvService(node)
+        ts = pd.tso()
+        r = svc.handle("KvPrewrite", {
+            "mutations": [{"op": "put", "key": b"np", "value": b"1"},
+                          {"op": "put", "key": b"ns", "value": b"2"}],
+            "primary": b"np", "start_version": ts,
+            "use_async_commit": True, "secondaries": [b"ns"]})
+        assert not r.get("error"), r
+        assert r["min_commit_ts"] > ts
+        # writer crashes; a reader resolves
+        st = svc.handle("KvCheckTxnStatus", {
+            "primary_key": b"np", "lock_ts": ts,
+            "caller_start_ts": 0, "current_ts": pd.tso()})
+        assert st["status"] == "locked" and st.get("use_async_commit")
+        sec = svc.handle("KvCheckSecondaryLocks", {
+            "keys": st["secondaries"], "start_version": ts})
+        assert sec["status"] == "locked"
+        commit_ts = max(st["min_commit_ts"], sec["min_commit_ts"])
+        svc.handle("KvResolveLock", {
+            "start_version": ts, "commit_version": commit_ts,
+            "keys": [b"np", b"ns"]})
+        g = svc.handle("KvGet", {"key": b"np", "version": pd.tso()})
+        assert g["value"] == b"1"
+    finally:
+        node.stop()

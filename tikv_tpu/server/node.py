@@ -98,6 +98,66 @@ class GrpcTransport(Transport):
 STORE_IDENT_KEY = b"\x01ident"
 
 
+class _DetectorProxy:
+    """Routes deadlock detection to the cluster's detector leader.
+
+    Reference: src/server/lock_manager/deadlock.rs — the leader of the
+    first region hosts the authoritative wait-for graph; other stores
+    forward Detect RPCs to it (client.rs).  Falls back to the local
+    graph when the leader is unreachable (local-only detection still
+    catches same-store cycles).
+    """
+
+    def __init__(self, node):
+        from ..storage.lock_manager import DeadlockDetector
+        self._node = node
+        self._local = DeadlockDetector()
+        self._clients: dict = {}        # addr -> StoreClient (channel reuse)
+
+    def _leader_addr(self):
+        pd = self._node.pd
+        try:
+            if hasattr(pd, "get_region_with_leader"):
+                _region, leader = pd.get_region_with_leader(b"")
+            else:
+                leader = pd.leader_of(pd.get_region(b"").id)
+            if leader is not None and \
+                    leader.store_id != self._node.store_id:
+                return pd.get_store(leader.store_id).address
+        except Exception:
+            pass
+        return None
+
+    def _call(self, req):
+        addr = self._leader_addr()
+        if addr is None:
+            return None
+        from .client import StoreClient
+        client = self._clients.get(addr)
+        if client is None:
+            client = self._clients[addr] = StoreClient(addr)
+        try:
+            return client.call("Detect", req, timeout=2)
+        except Exception:
+            return None
+
+    def detect(self, waiter_ts, holder_ts):
+        r = self._call({"op": "detect", "waiter_ts": waiter_ts,
+                        "holder_ts": holder_ts})
+        if r is None:
+            return self._local.detect(waiter_ts, holder_ts)
+        return tuple(r["wait_chain"]) if r["deadlock"] else None
+
+    def remove_edge(self, waiter_ts, holder_ts):
+        if self._call({"op": "remove_edge", "waiter_ts": waiter_ts,
+                       "holder_ts": holder_ts}) is None:
+            self._local.remove_edge(waiter_ts, holder_ts)
+
+    def clean_up(self, txn_ts):
+        if self._call({"op": "clean_up", "txn_ts": txn_ts}) is None:
+            self._local.clean_up(txn_ts)
+
+
 class Node:
     def __init__(self, addr: str, pd: PdClient,
                  engine: Optional[MemoryEngine] = None,
@@ -163,7 +223,10 @@ class Node:
         self.raft_store.observers = [self._report_region]
         self.raft_kv = RaftKv(self.raft_store, driver=self._wait_driver,
                               lock=self.lock)
-        self.storage = Storage(engine=self.raft_kv)
+        from ..storage.lock_manager import LockManager
+        self.storage = Storage(
+            engine=self.raft_kv,
+            lock_manager=LockManager(detector=_DetectorProxy(self)))
         from .read_pool import ReadPool
         self.read_pool = ReadPool(
             max_concurrency=config.readpool.concurrency)
@@ -285,6 +348,12 @@ class Node:
         """
         start = req.dag.ranges[0].start if req.dag.ranges else b""
         key_hint = encode_first(start)
+        # async-commit read protocol: bump max_ts, then check the
+        # in-memory lock table (conservatively over all of it — memory
+        # locks live only for the prewrite window)
+        cm = self.storage.concurrency_manager
+        cm.update_max_ts(req.dag.start_ts)
+        cm.read_range_check(None, None, req.dag.start_ts)
         snap = self.raft_kv.snapshot(SnapContext(key_hint=key_hint))
         execs = req.dag.executors
         if execs and isinstance(execs[0], TableScanDesc):

@@ -96,8 +96,15 @@ class KvService:
             lock_ttl=req.get("lock_ttl", 3000),
             txn_size=req.get("txn_size", 0),
             min_commit_ts=req.get("min_commit_ts", 0),
-            is_pessimistic_lock=req.get("is_pessimistic_lock", ())))
+            is_pessimistic_lock=req.get("is_pessimistic_lock", ()),
+            use_async_commit=req.get("use_async_commit", False),
+            secondaries=req.get("secondaries", ()),
+            try_one_pc=req.get("try_one_pc", False)))
         return r
+
+    def KvCheckSecondaryLocks(self, req: dict) -> dict:
+        return self.storage.sched_txn_command(cmds.CheckSecondaryLocks(
+            req["keys"], req["start_version"]))
 
     def KvCommit(self, req: dict) -> dict:
         return self.storage.sched_txn_command(cmds.Commit(
@@ -128,7 +135,24 @@ class KvService:
         return self.storage.sched_txn_command(cmds.AcquirePessimisticLock(
             req["keys"], req["primary"], req["start_version"],
             req["for_update_ts"], req.get("lock_ttl", 3000),
-            req.get("return_values", False)))
+            req.get("return_values", False),
+            wait_timeout_s=req.get("wait_timeout_s", 0.0)))
+
+    def Detect(self, req: dict) -> dict:
+        """Deadlock detector service (lock_manager/deadlock.rs): the
+        cluster's detector leader answers detect/clean_up for waiters on
+        other stores."""
+        det = self.storage.lock_manager.detector
+        op = req.get("op", "detect")
+        if op == "detect":
+            cycle = det.detect(req["waiter_ts"], req["holder_ts"])
+            return {"deadlock": cycle is not None,
+                    "wait_chain": list(cycle or ())}
+        if op == "remove_edge":
+            det.remove_edge(req["waiter_ts"], req["holder_ts"])
+        elif op == "clean_up":
+            det.clean_up(req["txn_ts"])
+        return {"deadlock": False, "wait_chain": []}
 
     def KvPessimisticRollback(self, req: dict) -> dict:
         return self.storage.sched_txn_command(cmds.PessimisticRollback(

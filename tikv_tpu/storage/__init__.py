@@ -22,19 +22,35 @@ RAW_PREFIX = b"r"       # raw and txn keyspaces must not overlap (ApiV2
 
 
 class Storage:
-    def __init__(self, engine: Optional[Engine] = None):
+    def __init__(self, engine: Optional[Engine] = None,
+                 lock_manager=None):
+        from .concurrency_manager import ConcurrencyManager
         self._engine = engine if engine is not None else LocalEngine()
-        self._sched = TxnScheduler(self._engine)
+        self.concurrency_manager = ConcurrencyManager()
+        self._sched = TxnScheduler(
+            self._engine, concurrency_manager=self.concurrency_manager,
+            lock_manager=lock_manager)
 
     @property
     def engine(self) -> Engine:
         return self._engine
 
+    @property
+    def lock_manager(self):
+        return self._sched.lock_manager
+
     # -- transactional reads (mod.rs:597,1166,1360) --
+    #
+    # every read bumps the concurrency manager's max_ts BEFORE checking
+    # locks, then checks the in-memory table — the two halves of the
+    # async-commit read protocol (mod.rs:626 + concurrency_manager)
 
     def get(self, key: bytes, read_ts: int,
             bypass_locks=()) -> Optional[bytes]:
         from .txn_types import encode_key
+        cm = self.concurrency_manager
+        cm.update_max_ts(read_ts)
+        cm.read_key_check(key, read_ts, bypass_locks)
         reader = MvccReader(self._engine.snapshot(
             SnapContext(read_ts=read_ts, key_hint=encode_key(key))))
         return reader.get(key, read_ts, bypass_locks)
@@ -42,8 +58,11 @@ class Storage:
     def batch_get(self, keys: Sequence[bytes], read_ts: int,
                   bypass_locks=()) -> list:
         from .txn_types import encode_key
+        cm = self.concurrency_manager
+        cm.update_max_ts(read_ts)
         out = []
         for k in keys:
+            cm.read_key_check(k, read_ts, bypass_locks)
             reader = MvccReader(self._engine.snapshot(
                 SnapContext(read_ts=read_ts, key_hint=encode_key(k))))
             out.append((k, reader.get(k, read_ts, bypass_locks)))
@@ -52,6 +71,9 @@ class Storage:
     def scan(self, start: Optional[bytes], end: Optional[bytes], limit: int,
              read_ts: int, desc: bool = False, bypass_locks=()) -> list:
         from .txn_types import encode_key
+        cm = self.concurrency_manager
+        cm.update_max_ts(read_ts)
+        cm.read_range_check(start, end, read_ts, bypass_locks)
         hint = encode_key(start) if start else b""
         reader = MvccReader(self._engine.snapshot(
             SnapContext(read_ts=read_ts, key_hint=hint)))

@@ -17,6 +17,9 @@ class MvccTxn:
     def __init__(self, start_ts: int):
         self.start_ts = start_ts
         self.modifies: list[tuple] = []     # (op, cf, key, value?)
+        # user keys whose engine lock this command removes — the waiter
+        # manager wakes parked pessimistic lockers on exactly these
+        self.released_keys: list[bytes] = []
 
     # -- locks --
 
@@ -26,6 +29,7 @@ class MvccTxn:
 
     def unlock_key(self, key: bytes) -> None:
         self.modifies.append(("del", CF_LOCK, encode_key(key), None))
+        self.released_keys.append(key)
 
     # -- write records --
 

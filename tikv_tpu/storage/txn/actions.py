@@ -52,13 +52,21 @@ def _lock_type_of(m: Mutation) -> LockType:
 def prewrite(txn: MvccTxn, reader: MvccReader, m: Mutation, primary: bytes,
              lock_ttl: int = 3000, txn_size: int = 0,
              min_commit_ts: int = 0,
-             is_pessimistic_lock: bool = False) -> None:
+             is_pessimistic_lock: bool = False,
+             use_async_commit: bool = False,
+             secondaries: tuple = (),
+             one_pc_commit_ts: int = 0) -> None:
     """Reference: actions/prewrite.rs:36.
 
     Optimistic: conflict-check against newer committed writes, then lock.
     Pessimistic (``is_pessimistic_lock``): the key must already hold this
     txn's pessimistic lock; convert it in place (no conflict check — it
     happened at acquire time).
+    Async commit (``use_async_commit``): the lock carries min_commit_ts
+    (computed from the concurrency manager's max_ts by the command) and,
+    on the primary, the secondary key list.
+    1PC (``one_pc_commit_ts``): skip the lock entirely — write the
+    commit record at that ts (prewrite.rs one_pc path).
     """
     start_ts = txn.start_ts
     lock = reader.load_lock(m.key)
@@ -93,10 +101,26 @@ def prewrite(txn: MvccTxn, reader: MvccReader, m: Mutation, primary: bytes,
     short_value = None
     if m.value is not None and len(m.value) <= SHORT_VALUE_MAX_LEN:
         short_value = m.value
+
+    if one_pc_commit_ts:
+        # 1PC: conflict checks passed; commit directly, no lock phase
+        if lock is not None:
+            txn.unlock_key(m.key)   # converted pessimistic lock
+        wt = {LockType.PUT: WriteType.PUT,
+              LockType.DELETE: WriteType.DELETE,
+              LockType.LOCK: WriteType.LOCK}[_lock_type_of(m)]
+        txn.put_write(m.key, one_pc_commit_ts,
+                      Write(wt, start_ts, short_value))
+        if m.value is not None and short_value is None:
+            txn.put_value(m.key, start_ts, m.value)
+        return
+
     new_lock = Lock(_lock_type_of(m), primary, start_ts, lock_ttl,
                     short_value,
                     for_update_ts=lock.for_update_ts if lock else 0,
-                    txn_size=txn_size, min_commit_ts=min_commit_ts)
+                    txn_size=txn_size, min_commit_ts=min_commit_ts,
+                    use_async_commit=use_async_commit,
+                    secondaries=tuple(secondaries))
     txn.put_lock(m.key, new_lock)
     if m.value is not None and short_value is None:
         txn.put_value(m.key, start_ts, m.value)
@@ -196,6 +220,11 @@ def check_txn_status(txn: MvccTxn, reader: MvccReader, primary: bytes,
     start_ts = txn.start_ts
     lock = reader.load_lock(primary)
     if lock is not None and lock.start_ts == start_ts:
+        if lock.use_async_commit:
+            # async-commit fate is decided by the secondaries, never by
+            # TTL here (check_txn_status.rs returns the lock info so the
+            # caller runs CheckSecondaryLocks)
+            return ("locked", lock.ttl)
         if ts_physical(lock.start_ts) + lock.ttl < ts_physical(current_ts):
             rollback(txn, reader, primary)
             return ("ttl_expired", 0)

@@ -36,7 +36,14 @@ class Command:
 
 @dataclass
 class Prewrite(Command):
-    """commands/prewrite.rs"""
+    """commands/prewrite.rs (incl. the async-commit and 1PC modes).
+
+    Async commit: min_commit_ts is finalized from the concurrency
+    manager's max_ts (the scheduler injects ``_cm`` and publishes the
+    memory locks around this command); the primary's lock carries the
+    secondary keys.  1PC additionally skips the lock phase, committing
+    at that same ts when the whole txn fits one region.
+    """
 
     mutations: Sequence[Mutation]
     primary: bytes
@@ -46,6 +53,10 @@ class Prewrite(Command):
     min_commit_ts: int = 0
     # per-mutation: True if the key holds this txn's pessimistic lock
     is_pessimistic_lock: Sequence[bool] = ()
+    use_async_commit: bool = False
+    secondaries: Sequence[bytes] = ()
+    try_one_pc: bool = False
+    _cm: object = field(default=None, repr=False, compare=False)
 
     def write_keys(self):
         return [m.key for m in self.mutations]
@@ -54,11 +65,29 @@ class Prewrite(Command):
         flags = self.is_pessimistic_lock or [False] * len(self.mutations)
         assert len(flags) == len(self.mutations), \
             "is_pessimistic_lock must match mutations 1:1"
+        final_min_commit = self.min_commit_ts
+        one_pc_ts = 0
+        if self.use_async_commit or self.try_one_pc:
+            assert self._cm is not None, \
+                "async commit requires the concurrency manager"
+            final_min_commit = max(self._cm.max_ts + 1,
+                                   self.start_ts + 1,
+                                   self.min_commit_ts)
+            if self.try_one_pc:
+                one_pc_ts = final_min_commit
         for m, pess in zip(self.mutations, flags):
-            actions.prewrite(txn, reader, m, self.primary, self.lock_ttl,
-                             self.txn_size, self.min_commit_ts,
-                             is_pessimistic_lock=pess)
-        return {"min_commit_ts": self.min_commit_ts}
+            actions.prewrite(
+                txn, reader, m, self.primary, self.lock_ttl,
+                self.txn_size, final_min_commit,
+                is_pessimistic_lock=pess,
+                use_async_commit=self.use_async_commit,
+                secondaries=(tuple(self.secondaries)
+                             if m.key == self.primary else ()),
+                one_pc_commit_ts=one_pc_ts)
+        return {"min_commit_ts": final_min_commit
+                if (self.use_async_commit or self.try_one_pc)
+                else self.min_commit_ts,
+                "one_pc_commit_ts": one_pc_ts}
 
 
 @dataclass
@@ -130,7 +159,53 @@ class CheckTxnStatus(Command):
         status, ts = actions.check_txn_status(
             txn, reader, self.primary, self.current_ts,
             self.caller_start_ts)
-        return {"status": status, "ts": ts}
+        out = {"status": status, "ts": ts}
+        if status == "locked":
+            lock = reader.load_lock(self.primary)
+            if lock is not None and lock.use_async_commit:
+                # the caller resolves via CheckSecondaryLocks
+                out["use_async_commit"] = True
+                out["secondaries"] = list(lock.secondaries)
+                out["min_commit_ts"] = lock.min_commit_ts
+        return out
+
+
+@dataclass
+class CheckSecondaryLocks(Command):
+    """commands/check_secondary_locks.rs — the async-commit resolution
+    probe: for each secondary, report its lock (still pending) or its
+    final state; keys with neither get a protective rollback so a late
+    prewrite cannot resurrect the txn."""
+
+    keys: Sequence[bytes]
+    start_ts: int
+
+    def write_keys(self):
+        return list(self.keys)
+
+    def process_write(self, txn, reader):
+        min_commit_ts = 0
+        for k in self.keys:
+            lock = reader.load_lock(k)
+            if lock is not None and lock.start_ts == self.start_ts:
+                if lock.lock_type is LockType.PESSIMISTIC:
+                    # an unprewritten pessimistic lock can't commit:
+                    # drop it and mark rolled back (check_secondary_locks.rs)
+                    txn.unlock_key(k)
+                    actions._put_rollback(txn, reader, k)
+                    return {"status": "rolled_back", "commit_ts": 0}
+                min_commit_ts = max(min_commit_ts, lock.min_commit_ts)
+                continue
+            status, ts, _w = reader.get_txn_commit_record(k, self.start_ts)
+            if status == "committed":
+                return {"status": "committed", "commit_ts": ts}
+            if status == "rolled_back":
+                return {"status": "rolled_back", "commit_ts": 0}
+            # no lock, no record: protective rollback
+            actions._put_rollback(txn, reader, k)
+            return {"status": "rolled_back", "commit_ts": 0}
+        return {"status": "locked", "commit_ts": 0,
+                "min_commit_ts": min_commit_ts}
 
 
 @dataclass
@@ -197,6 +272,9 @@ class AcquirePessimisticLock(Command):
     for_update_ts: int
     lock_ttl: int = 3000
     return_values: bool = False
+    # > 0: on conflict, park in the waiter manager (with deadlock
+    # detection) instead of failing — lock_manager/waiter_manager.rs
+    wait_timeout_s: float = 0.0
 
     def write_keys(self):
         return list(self.keys)

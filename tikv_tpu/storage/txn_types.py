@@ -87,7 +87,12 @@ class LockType(Enum):
 
 @dataclass
 class Lock:
-    """CF_LOCK record.  Reference: lock.rs:75 (Lock struct + to_bytes)."""
+    """CF_LOCK record.  Reference: lock.rs:75 (Lock struct + to_bytes).
+
+    ``use_async_commit`` + ``secondaries``: the primary lock of an
+    async-commit txn carries every secondary key, so any reader can
+    resolve the txn's fate from the primary alone (lock.rs async commit
+    fields; the resolution protocol is CheckSecondaryLocks)."""
 
     lock_type: LockType
     primary: bytes
@@ -97,6 +102,8 @@ class Lock:
     for_update_ts: int = 0          # pessimistic txns
     txn_size: int = 0
     min_commit_ts: int = 0
+    use_async_commit: bool = False
+    secondaries: tuple = ()
 
     def to_bytes(self) -> bytes:
         out = bytearray()
@@ -112,6 +119,12 @@ class Lock:
             out += b"v"
             out += encode_var_u64(len(self.short_value))
             out += self.short_value
+        if self.use_async_commit:
+            out += b"a"
+            out += encode_var_u64(len(self.secondaries))
+            for s in self.secondaries:
+                out += encode_var_u64(len(s))
+                out += s
         return bytes(out)
 
     @staticmethod
@@ -127,13 +140,27 @@ class Lock:
         txn_size, off = decode_var_u64(b, off)
         min_commit_ts, off = decode_var_u64(b, off)
         short_value = None
-        if off < len(b) and b[off:off + 1] == b"v":
+        use_async_commit = False
+        secondaries: list = []
+        while off < len(b):
+            tag = b[off:off + 1]
             off += 1
-            n, off = decode_var_u64(b, off)
-            short_value = b[off:off + n]
-            off += n
+            if tag == b"v":
+                n, off = decode_var_u64(b, off)
+                short_value = b[off:off + n]
+                off += n
+            elif tag == b"a":
+                use_async_commit = True
+                cnt, off = decode_var_u64(b, off)
+                for _ in range(cnt):
+                    n, off = decode_var_u64(b, off)
+                    secondaries.append(b[off:off + n])
+                    off += n
+            else:
+                raise ValueError(f"bad lock tag {tag!r}")
         return Lock(lt, primary, start_ts, ttl, short_value,
-                    for_update_ts, txn_size, min_commit_ts)
+                    for_update_ts, txn_size, min_commit_ts,
+                    use_async_commit, tuple(secondaries))
 
 
 # ---------------------------------------------------------------- Write
