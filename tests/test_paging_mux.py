@@ -1,0 +1,198 @@
+"""Coprocessor paging/streaming + batch_commands mux.
+
+Reference test model: endpoint.rs paging/streaming tests (:760-823) and
+the batch_commands demux (service/kv.rs:921, service/batch.rs).
+"""
+
+import threading
+
+import pytest
+
+from tikv_tpu.raftstore.metapb import Store
+from tikv_tpu.server import (
+    Node,
+    PdServer,
+    RemotePdClient,
+    TikvServer,
+    TxnClient,
+)
+from tikv_tpu.server.client import BatchCommandsClient
+from tikv_tpu.testing.dag import DagSelect
+from tikv_tpu.testing.fixture import encode_table_row, int_table
+
+
+@pytest.fixture(scope="module")
+def server():
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    node = Node("127.0.0.1:0", RemotePdClient(pd_addr))
+    srv = TikvServer(node)
+    node.addr = f"127.0.0.1:{srv.port}"
+    node.pd.put_store(Store(node.store_id, node.addr))
+    srv.start()
+    c = TxnClient(pd_addr)
+    table = int_table(2, table_id=801)
+    muts = [("put",) + encode_table_row(t := table, h,
+                                        {"c0": h % 10, "c1": h})
+            for h in range(500)]
+    c.txn_write(muts)
+    yield {"client": c, "table": table, "srv": srv}
+    srv.stop()
+    pd_server.stop()
+
+
+def _scan_dag(table, ts):
+    sel = DagSelect.from_table(table, ["id", "c0", "c1"])
+    return sel.build(start_ts=ts)
+
+
+def test_unary_paging_covers_all_rows_in_bounded_pages(server):
+    c, table = server["client"], server["table"]
+    dag = _scan_dag(table, c.tso())
+    pages = list(c.coprocessor_paged(dag, paging_size=120))
+    assert len(pages) >= 3      # 500 rows / (120-budget + growth slack)
+    rows = [r for p in pages for r in p["rows"]]
+    assert len(rows) == 500
+    assert sorted(r[0] for r in rows) == list(range(500))
+    # every non-final page respects the budget (batch granularity can
+    # overshoot by at most one growth step)
+    for p in pages[:-1]:
+        assert len(p["rows"]) <= 120 + 1024
+        assert not p["is_drained"]
+    assert pages[-1]["is_drained"]
+
+
+def test_paging_with_selection_bounds_result_size(server):
+    c, table = server["client"], server["table"]
+    sel = DagSelect.from_table(table, ["id", "c0", "c1"])
+    dag = sel.where(sel.col("c0").eq(3)).build(start_ts=c.tso())
+    pages = list(c.coprocessor_paged(dag, paging_size=20))
+    rows = [r for p in pages for r in p["rows"]]
+    assert len(rows) == 50
+    assert all(r[1] == 3 for r in rows)
+    assert len(pages) >= 2
+
+
+def test_coprocessor_stream_single_snapshot(server):
+    """The stream variant pins one snapshot: a write mid-stream must not
+    leak into later pages."""
+    c, table = server["client"], server["table"]
+    dag = _scan_dag(table, c.tso())
+    it = c.coprocessor_stream(dag, paging_size=150)
+    first = next(it)
+    assert not first["is_drained"]
+    # write a new row mid-stream
+    k, v = encode_table_row(table, 9000, {"c0": 1, "c1": 1})
+    c.txn_write([("put", k, v)])
+    rest = list(it)
+    rows = first["rows"] + [r for p in rest for r in p["rows"]]
+    assert len(rows) == 500                 # 9000 not visible mid-stream
+    assert sorted(r[0] for r in rows) == list(range(500))
+
+
+def test_agg_plan_pages_as_single_final_page(server):
+    c, table = server["client"], server["table"]
+    sel = DagSelect.from_table(table, ["id", "c0", "c1"])
+    dag = sel.aggregate([sel.col("c0")],
+                        [("count_star", None)]).build(start_ts=c.tso())
+    pages = list(c.coprocessor_paged(dag, paging_size=5))
+    rows = [r for p in pages for r in p["rows"]]
+    assert sum(r[0] for r in rows) >= 500
+    assert pages[-1]["is_drained"]
+
+
+def test_batch_commands_mux_serves_kv_and_copr(server):
+    c, table = server["client"], server["table"]
+    addr = server["srv"].node.addr
+    mux = BatchCommandsClient(addr)
+    try:
+        ts = c.tso()
+        k0, _ = encode_table_row(table, 0, {})
+        r = mux.call("KvGet", {"key": k0, "version": ts})
+        assert not r.get("not_found")
+        import tikv_tpu.server.wire as wire
+        r2 = mux.call("Coprocessor", {
+            "tp": 103, "dag": wire.enc_dag(_scan_dag(table, c.tso()))})
+        assert len(r2["rows"]) >= 500
+        # error demux: a bad request fails ITS call only
+        with pytest.raises(wire.RemoteError):
+            mux.call("KvCommit", {"keys": [b"nope"],
+                                  "start_version": 1,
+                                  "commit_version": 2})
+        r3 = mux.call("KvGet", {"key": k0, "version": c.tso()})
+        assert not r3.get("not_found")
+    finally:
+        mux.close()
+
+
+def test_batch_commands_mux_concurrent_callers(server):
+    addr = server["srv"].node.addr
+    table, c = server["table"], server["client"]
+    mux = BatchCommandsClient(addr)
+    try:
+        ts = c.tso()
+        out = {}
+
+        def worker(i):
+            k, _ = encode_table_row(table, i, {})
+            out[i] = mux.call("KvGet", {"key": k, "version": ts})
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert len(out) == 32
+        assert all(not r.get("not_found") for r in out.values())
+    finally:
+        mux.close()
+
+
+def test_mux_parked_lock_does_not_block_releasing_commit(server):
+    """A pessimistic-lock wait parked on the mux must not head-of-line
+    block the commit (sent on the SAME mux) that releases it."""
+    addr = server["srv"].node.addr
+    c = server["client"]
+    mux = BatchCommandsClient(addr)
+    try:        # noqa: SIM105
+        ts1, ts2 = c.tso(), c.tso()
+        mux.call("KvPessimisticLock", {
+            "keys": [b"muxlock"], "primary": b"muxlock",
+            "start_version": ts1, "for_update_ts": ts1})
+        got = {}
+
+        def waiter():
+            import tikv_tpu.server.wire as wire
+            try:
+                got["r"] = mux.call("KvPessimisticLock", {
+                    "keys": [b"muxlock"], "primary": b"muxlock",
+                    "start_version": ts2, "for_update_ts": ts2,
+                    "wait_timeout_s": 8.0}, timeout=15)
+            except wire.RemoteError as e:
+                # woken by the commit, then the conflict check saw the
+                # newer commit_ts — the client retries with a fresh
+                # for_update_ts; either way the waiter was NOT starved
+                assert e.kind == "write_conflict", e
+                got["r"] = e.kind
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.2)                 # waiter parked server-side
+        # release through the SAME mux: the parked waiter must not
+        # head-of-line block these
+        mux.call("KvPrewrite", {
+            "mutations": [{"op": "put", "key": b"muxlock",
+                           "value": b"v"}],
+            "primary": b"muxlock", "start_version": ts1,
+            "is_pessimistic_lock": [True]})
+        mux.call("KvCommit", {"keys": [b"muxlock"],
+                              "start_version": ts1,
+                              "commit_version": c.tso()})
+        t.join(12)
+        assert not t.is_alive(), "waiter starved: commit HOL-blocked"
+        assert "r" in got
+    finally:
+        mux.close()

@@ -33,12 +33,19 @@ REQ_TYPE_CHECKSUM = 105
 
 @dataclass
 class CopRequest:
-    """Reference: coppb::Request (tp + data + ranges + start_ts)."""
+    """Reference: coppb::Request (tp + data + ranges + start_ts +
+    paging_size for the paged/streaming variants)."""
 
     tp: int
     dag: DAGRequest
     # device routing hint; None = auto (estimated row count)
     force_backend: Optional[str] = None
+    # > 0: return at most ~paging_size result rows per response and a
+    # resume token (endpoint.rs:760-823); always served by the host
+    # pipeline (pages bound RESULT materialization; the scan itself is
+    # zero-copy columnar views)
+    paging_size: int = 0
+    paging_offset: int = 0
 
 
 @dataclass
@@ -49,6 +56,14 @@ class CopResponse:
 
     def rows(self):
         return self.result.rows()
+
+    @property
+    def is_drained(self) -> bool:
+        return self.result.is_drained
+
+    @property
+    def next_offset(self) -> int:
+        return self.result.next_offset
 
 
 class Endpoint:
@@ -66,6 +81,11 @@ class Endpoint:
         self._device_runner = device_runner
         self._device_row_threshold = device_row_threshold
 
+    def snapshot_for(self, req: CopRequest):
+        """Public snapshot seam for streaming handlers that drive their
+        own runner (copr_stream): same provider the unary path uses."""
+        return self._snapshot_provider(req)
+
     def handle(self, req: CopRequest) -> CopResponse:
         from ..utils import metrics as m
         if req.tp != REQ_TYPE_DAG:
@@ -73,7 +93,14 @@ class Endpoint:
         t0 = time.perf_counter_ns()
         storage = self._snapshot_provider(req)
         backend = self._pick_backend(req, storage)
-        if backend == "device":
+        if req.paging_size > 0:
+            backend = "host"    # pages are a host-pipeline contract
+            from ..executors.runner import BatchExecutorsRunner
+            result = BatchExecutorsRunner(
+                req.dag, storage,
+                scan_offset=req.paging_offset).handle_request(
+                    max_rows=req.paging_size)
+        elif backend == "device":
             result = self._device_runner.handle_request(req.dag, storage)
         else:
             from ..executors.runner import BatchExecutorsRunner

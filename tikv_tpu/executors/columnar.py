@@ -313,6 +313,17 @@ class BatchColumnarTableScanExecutor(TimedExecutor):
     def schema(self) -> list[FieldType]:
         return self._schema
 
+    # -- paging hooks (endpoint.rs streaming/paged requests) --
+
+    def skip_rows(self, n: int) -> None:
+        """Resume a paged scan at row offset ``n`` (the scan order over
+        a pinned snapshot is deterministic, so the offset is an exact
+        resume token)."""
+        self._pos = min(n, self._batch.num_rows)
+
+    def rows_consumed(self) -> int:
+        return self._pos
+
     def _next_batch(self, scan_rows: int) -> BatchExecuteResult:
         start = self._pos
         stop = min(start + scan_rows, self._batch.num_rows)

@@ -110,38 +110,75 @@ def _is_fast_key(e) -> bool:
 
 @dataclass
 class SelectResult:
-    """Decoded response: final columns + per-executor summaries."""
+    """Decoded response: final columns + per-executor summaries.
+
+    Paging (endpoint.rs:760-823): ``is_drained=False`` means more pages
+    follow; ``next_offset`` is the scan-row offset to resume from.
+    """
 
     batch: ColumnBatch
     exec_summaries: list
     warnings: list = field(default_factory=list)
+    is_drained: bool = True
+    next_offset: int = 0
 
     def rows(self):
         return self.batch.rows()
 
 
 class BatchExecutorsRunner:
-    """Drives the pipeline to completion (unary request).
+    """Drives the pipeline to completion (unary request) or one page.
 
-    Reference: runner.rs handle_request/internal_handle_request.
+    Reference: runner.rs handle_request/internal_handle_request; the
+    paged variant mirrors handle_streaming_request — stop once the page
+    budget fills, report how far the scan got so the next request
+    resumes there.
     """
 
-    def __init__(self, dag: DAGRequest, storage: ScanStorage):
+    def __init__(self, dag: DAGRequest, storage: ScanStorage,
+                 scan_offset: int = 0):
         self._dag = dag
         self._out = build_executors(dag, storage)
         self._max_batch = BATCH_MAX_SIZE_COLUMNAR \
             if hasattr(storage, "scan_columns") else BATCH_MAX_SIZE
+        if scan_offset:
+            scan = self._scan_executor()
+            if scan is None or not hasattr(scan, "skip_rows"):
+                raise ValueError("plan does not support scan_offset")
+            scan.skip_rows(scan_offset)
 
-    def handle_request(self) -> SelectResult:
+    def _scan_executor(self):
+        cur = self._out
+        while cur is not None:
+            nxt = getattr(cur, "_child", None)
+            if nxt is None:
+                return cur
+            cur = nxt
+        return None
+
+    def handle_request(self, max_rows: Optional[int] = None) -> SelectResult:
+        scan = self._scan_executor()
+        if max_rows is not None and \
+                not callable(getattr(scan, "rows_consumed", None)):
+            # a scan without a resume token cannot page: serve the full
+            # result as one drained page rather than reporting
+            # next_offset=0 forever (the client would loop on page 1)
+            max_rows = None
         batch_size = BATCH_INITIAL_SIZE
         chunks: list[ColumnBatch] = []
         warnings: list = []
+        n_rows = 0
+        drained = False
         while True:
             r = self._out.next_batch(batch_size)
             if r.batch.num_rows:
                 chunks.append(r.batch)
+                n_rows += r.batch.num_rows
             warnings.extend(r.warnings)
             if r.is_drained:
+                drained = True
+                break
+            if max_rows is not None and n_rows >= max_rows:
                 break
             if batch_size < self._max_batch:
                 batch_size = min(batch_size * BATCH_GROW_FACTOR,
@@ -154,7 +191,11 @@ class BatchExecutorsRunner:
                 [batch.schema[i] for i in self._dag.output_offsets],
                 [batch.columns[i] for i in self._dag.output_offsets])
         summaries = _collect_summaries(self._out)
-        return SelectResult(batch, summaries, warnings)
+        consumed = getattr(scan, "rows_consumed", None)
+        # rows_consumed is the scan's ABSOLUTE position (skip included)
+        next_offset = consumed() if callable(consumed) else 0
+        return SelectResult(batch, summaries, warnings,
+                            is_drained=drained, next_offset=next_offset)
 
 
 def _collect_summaries(ex) -> list[ExecSummary]:

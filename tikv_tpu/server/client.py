@@ -9,6 +9,7 @@ callers but its tests exercise via test fixtures.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -39,6 +40,94 @@ class StoreClient:
         if name.startswith("_"):
             raise AttributeError(name)
         return lambda req=None, **kw: self.call(name, req or kw)
+
+
+class BatchCommandsClient:
+    """Client side of the batch_commands mux (service/kv.rs:921 +
+    service/batch.rs): ONE bidirectional stream carries every RPC,
+    demultiplexed by request id — concurrent callers share the stream
+    instead of a connection/HTTP2-stream each."""
+
+    def __init__(self, addr: str):
+        import queue
+
+        self.addr = addr
+        self._chan = grpc.insecure_channel(addr)
+        self._q: "queue.Queue" = queue.Queue()
+        self._pending: dict = {}
+        self._mu = threading.Lock()
+        self._next_id = 0
+        self._closed = False
+        fn = self._chan.stream_stream(
+            "/tikv.Tikv/BatchCommands", request_serializer=wire.pack,
+            response_deserializer=wire.unpack)
+        self._responses = fn(self._outbound())
+        self._recv = threading.Thread(target=self._recv_loop, daemon=True)
+        self._recv.start()
+
+    def _outbound(self):
+        import queue
+        while not self._closed:
+            try:
+                first = self._q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if first is None:
+                return
+            batch = [first]
+            # drain whatever else queued: one message, many commands
+            while True:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    break
+                batch.append(nxt)
+            yield {"requests": batch}
+
+    def _recv_loop(self):
+        try:
+            for msg in self._responses:
+                for ent in msg.get("responses", ()):
+                    with self._mu:
+                        box = self._pending.pop(ent["request_id"], None)
+                    if box is not None:
+                        box["resp"] = ent["response"]
+                        box["ev"].set()
+        except Exception:
+            pass
+        with self._mu:
+            # stream died: later call()s must fail fast, not park for
+            # their full timeout against a reader that will never run
+            self._closed = True
+            pending, self._pending = self._pending, {}
+        for box in pending.values():
+            box["ev"].set()     # wake waiters with no resp
+
+    def call(self, method: str, req: dict, timeout: float = 10) -> dict:
+        with self._mu:
+            if self._closed:
+                raise RuntimeError("mux closed")
+            self._next_id += 1
+            rid = self._next_id
+            box = {"ev": threading.Event()}
+            self._pending[rid] = box
+        self._q.put({"request_id": rid, "method": method, "req": req})
+        if not box["ev"].wait(timeout):
+            with self._mu:
+                self._pending.pop(rid, None)
+            raise TimeoutError(f"mux call {method} timed out")
+        resp = box.get("resp")
+        if resp is None:
+            raise RuntimeError("mux stream closed")
+        if resp.get("error"):
+            raise wire.RemoteError(resp["error"])
+        return resp
+
+    def close(self):
+        self._closed = True
+        self._q.put(None)
 
 
 class TxnError(Exception):
@@ -246,12 +335,44 @@ class TxnClient:
     # -- coprocessor --
 
     def coprocessor(self, dag, key_hint: Optional[bytes] = None,
-                    force_backend: Optional[str] = None) -> dict:
+                    force_backend: Optional[str] = None,
+                    paging_size: int = 0, paging_offset: int = 0) -> dict:
         key = key_hint if key_hint is not None else \
             (dag.ranges[0].start if dag.ranges else b"")
         return self._call_leader(key, "Coprocessor", {
             "tp": 103, "dag": wire.enc_dag(dag),
-            "force_backend": force_backend})
+            "force_backend": force_backend,
+            "paging_size": paging_size, "paging_offset": paging_offset})
+
+    def coprocessor_paged(self, dag, paging_size: int,
+                          key_hint: Optional[bytes] = None):
+        """Iterate the unary paged protocol: yields one response dict
+        per page until the server reports is_drained."""
+        offset = 0
+        while True:
+            r = self.coprocessor(dag, key_hint=key_hint,
+                                 paging_size=paging_size,
+                                 paging_offset=offset)
+            yield r
+            if r.get("is_drained", True):
+                return
+            offset = r["next_offset"]
+
+    def coprocessor_stream(self, dag, paging_size: int = 0,
+                           key_hint: Optional[bytes] = None):
+        """Server-streamed pages over ONE snapshot (coprocessor_stream).
+        Yields response dicts."""
+        key = key_hint if key_hint is not None else \
+            (dag.ranges[0].start if dag.ranges else b"")
+        client, _region = self._leader_client(key)
+        fn = client._chan.unary_stream(
+            "/tikv.Tikv/CoprocessorStream", request_serializer=wire.pack,
+            response_deserializer=wire.unpack)
+        for msg in fn({"tp": 103, "dag": wire.enc_dag(dag),
+                       "paging_size": paging_size}, timeout=60):
+            if msg.get("error"):
+                raise wire.RemoteError(msg["error"])
+            yield msg
 
     # -- raw --
 

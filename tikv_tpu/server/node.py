@@ -349,11 +349,15 @@ class Node:
         start = req.dag.ranges[0].start if req.dag.ranges else b""
         key_hint = encode_first(start)
         # async-commit read protocol: bump max_ts, then check the
-        # in-memory lock table (conservatively over all of it — memory
-        # locks live only for the prewrite window)
+        # in-memory lock table scoped to the REQUEST's key ranges —
+        # an unrelated table's in-flight prewrite must not fail this
         cm = self.storage.concurrency_manager
         cm.update_max_ts(req.dag.start_ts)
-        cm.read_range_check(None, None, req.dag.start_ts)
+        if req.dag.ranges:
+            cm.read_ranges_check_encoded(req.dag.ranges,
+                                         req.dag.start_ts)
+        else:
+            cm.read_range_check(None, None, req.dag.start_ts)
         snap = self.raft_kv.snapshot(SnapContext(key_hint=key_hint))
         execs = req.dag.executors
         if execs and isinstance(execs[0], TableScanDesc):

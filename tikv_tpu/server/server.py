@@ -19,15 +19,38 @@ from .service import KvService
 
 
 class _GenericHandler(grpc.GenericRpcHandler):
-    def __init__(self, prefix: str, dispatch):
+    """Routes /tikv.Tikv/* to the service: unary by default, plus the
+    two streaming surfaces of the reference — coprocessor_stream
+    (service/kv.rs:632, server-streamed result pages) and
+    batch_commands (service/kv.rs:921, the bidirectional mux)."""
+
+    def __init__(self, prefix: str, dispatch, stream_dispatch=None,
+                 batch_dispatch=None):
         self._prefix = prefix
         self._dispatch = dispatch
+        self._stream_dispatch = stream_dispatch
+        self._batch_dispatch = batch_dispatch
 
     def service(self, handler_call_details):
         name = handler_call_details.method
         if not name.startswith(self._prefix):
             return None
         method = name[len(self._prefix):]
+
+        if method == "CoprocessorStream" and \
+                self._stream_dispatch is not None:
+            def stream(req: dict, ctx):
+                yield from self._stream_dispatch(req)
+            return grpc.unary_stream_rpc_method_handler(
+                stream, request_deserializer=wire.unpack,
+                response_serializer=wire.pack)
+
+        if method == "BatchCommands" and self._batch_dispatch is not None:
+            def batch(request_iterator, ctx):
+                yield from self._batch_dispatch(request_iterator)
+            return grpc.stream_stream_rpc_method_handler(
+                batch, request_deserializer=wire.unpack,
+                response_serializer=wire.pack)
 
         def unary(req: dict, ctx) -> dict:
             return self._dispatch(method, req)
@@ -47,7 +70,9 @@ class TikvServer:
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers))
         self._server.add_generic_rpc_handlers((
-            _GenericHandler("/tikv.Tikv/", self.service.handle),))
+            _GenericHandler("/tikv.Tikv/", self.service.handle,
+                            stream_dispatch=self.service.copr_stream,
+                            batch_dispatch=self.service.batch_commands),))
         self.port = self._server.add_insecure_port(node.addr)
         assert self.port, f"cannot bind {node.addr}"
         # HTTP status server (/metrics, /config, /status —

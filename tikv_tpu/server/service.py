@@ -201,19 +201,97 @@ class KvService:
 
     # ---------------------------------------------------------- copr
 
-    def Coprocessor(self, req: dict) -> dict:
-        assert req.get("tp", REQ_TYPE_DAG) == REQ_TYPE_DAG
-        dag = wire.dec_dag(req["dag"])
-        resp = self.endpoint.handle(CopRequest(
-            REQ_TYPE_DAG, dag, req.get("force_backend")))
+    def _enc_cop_resp(self, resp) -> dict:
         return {"rows": wire.enc_rows(resp.rows()),
                 "backend": resp.backend,
                 "elapsed_ns": resp.elapsed_ns,
+                "is_drained": resp.is_drained,
+                "next_offset": resp.next_offset,
                 "exec_summaries": [
                     {"rows": s.num_produced_rows,
                      "iters": s.num_iterations,
                      "time_ns": s.time_processed_ns}
                     for s in resp.result.exec_summaries]}
+
+    def Coprocessor(self, req: dict) -> dict:
+        assert req.get("tp", REQ_TYPE_DAG) == REQ_TYPE_DAG
+        dag = wire.dec_dag(req["dag"])
+        resp = self.endpoint.handle(CopRequest(
+            REQ_TYPE_DAG, dag, req.get("force_backend"),
+            paging_size=req.get("paging_size", 0),
+            paging_offset=req.get("paging_offset", 0)))
+        return self._enc_cop_resp(resp)
+
+    def copr_stream(self, req: dict):
+        """Server-streamed coprocessor pages (service/kv.rs:632
+        coprocessor_stream).  One runner instance spans the stream, so
+        every page reads the SAME pinned snapshot — unlike offset-based
+        unary paging, concurrent writes cannot shift page boundaries.
+        """
+        import time as _time
+
+        from ..copr.endpoint import CopResponse
+        from ..executors.runner import BatchExecutorsRunner
+        try:
+            dag = wire.dec_dag(req["dag"])
+            page = req.get("paging_size", 0) or \
+                self.node.config.coprocessor.response_page_rows
+            creq = CopRequest(REQ_TYPE_DAG, dag)
+            storage = self.endpoint.snapshot_for(creq)
+            runner = BatchExecutorsRunner(dag, storage)
+            while True:
+                t0 = _time.perf_counter_ns()
+                result = runner.handle_request(max_rows=page)
+                yield self._enc_cop_resp(CopResponse(
+                    result, _time.perf_counter_ns() - t0, "host"))
+                if result.is_drained:
+                    return
+        except Exception as e:      # noqa: BLE001 — errors ride the wire
+            yield {"error": wire.enc_error(e)}
+
+    def batch_commands(self, request_iterator):
+        """Bidirectional mux (service/kv.rs:921): inbound messages carry
+        (request_id, method, req) triples.  Each command dispatches to a
+        worker pool and responses stream back AS THEY COMPLETE — a
+        parked command (pessimistic-lock wait) must not head-of-line
+        block the very commit that would release it."""
+        import queue as _q
+        from concurrent.futures import ThreadPoolExecutor
+
+        done: "_q.Queue" = _q.Queue()
+        sentinel = object()
+
+        def run_one(ent):
+            resp = self.handle(ent["method"], ent.get("req") or {})
+            done.put({"request_id": ent["request_id"], "response": resp})
+
+        def feeder():
+            pool = ThreadPoolExecutor(max_workers=8)
+            try:
+                for batch in request_iterator:
+                    for ent in batch.get("requests", ()):
+                        pool.submit(run_one, ent)
+            finally:
+                pool.shutdown(wait=True)
+                done.put(sentinel)
+
+        import threading as _t
+        _t.Thread(target=feeder, daemon=True).start()
+        while True:
+            item = done.get()
+            if item is sentinel:
+                return
+            out = [item]
+            while True:     # opportunistic batching of ready responses
+                try:
+                    nxt = done.get_nowait()
+                except _q.Empty:
+                    break
+                if nxt is sentinel:
+                    yield {"responses": out}
+                    return
+                out.append(nxt)
+            yield {"responses": out}
 
     # ---------------------------------------------------------- raft
 

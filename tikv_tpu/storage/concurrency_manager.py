@@ -76,6 +76,24 @@ class ConcurrencyManager:
             if self._blocks(lk, read_ts, bypass_locks):
                 raise KeyIsLocked(k, lk)
 
+    def read_ranges_check_encoded(self, ranges, read_ts: int,
+                                  bypass_locks=()) -> None:
+        """Range check against ENCODED key ranges (coprocessor DAG
+        ranges) — only memory locks inside the request's ranges block
+        it, mirroring the engine-lock scoping of the row scanner."""
+        if not self._table:
+            return
+        from .txn_types import encode_key
+        with self._mu:
+            items = list(self._table.items())
+        for k, lk in items:
+            if not self._blocks(lk, read_ts, bypass_locks):
+                continue
+            enc = encode_key(k)
+            for r in ranges:
+                if r.start <= enc < r.end:
+                    raise KeyIsLocked(k, lk)
+
     @staticmethod
     def _blocks(lk: Lock, read_ts: int, bypass_locks) -> bool:
         from .txn_types import LockType
