@@ -1,0 +1,174 @@
+"""ANALYZE (tp=104) + CHECKSUM (tp=105).
+
+Reference test model: src/coprocessor/statistics/ histogram tests and
+checksum.rs — stats must match a numpy ground truth; checksums must be
+order-independent and replica-comparable.
+"""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.copr.analyze import (
+    AnalyzeReq,
+    ChecksumReq,
+    checksum_kv_pairs,
+    crc64,
+    histogram_from_sorted,
+)
+from tikv_tpu.copr.endpoint import Endpoint
+from tikv_tpu.datatype import Column, EvalType
+from tikv_tpu.executors.columnar import ColumnarTable
+from tikv_tpu.testing.dag import DagSelect
+from tikv_tpu.testing.fixture import int_table
+
+
+def make_store(n=5000, seed=3):
+    rng = np.random.default_rng(seed)
+    table = int_table(2, table_id=701)
+    k = rng.integers(0, 50, n).astype(np.int64)
+    v = rng.integers(-1000, 1000, n).astype(np.int64)
+    kvalid = (np.arange(n) % 11) != 4
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"c0": Column(EvalType.INT, k, kvalid),
+         "c1": Column(EvalType.INT, v, np.ones(n, np.bool_))})
+    return table, snap, k, kvalid, v
+
+
+def _scan(table):
+    return DagSelect.from_table(table, ["id", "c0", "c1"]).build()
+
+
+def test_analyze_matches_numpy_ground_truth():
+    table, snap, k, kvalid, v = make_store()
+    ep = Endpoint(lambda req: snap)
+    dag = _scan(table)
+    stats = ep.handle_analyze(AnalyzeReq(dag.executors[0], dag.ranges,
+                                         buckets=16))["columns"]
+    by_id = {s.col_id: s for s in stats}
+    s_k = by_id[2]
+    assert s_k.total == len(k)
+    assert s_k.null_count == int((~kvalid).sum())
+    assert s_k.distinct == len(np.unique(k[kvalid]))
+    # equi-depth: last bucket's cumulative count == valid rows; bounds
+    # are exact order statistics
+    assert s_k.buckets[-1][1] == int(kvalid.sum())
+    sk = np.sort(k[kvalid])
+    for ub, cum in s_k.buckets:
+        assert ub == sk[cum - 1]
+    s_v = by_id[3]
+    assert s_v.null_count == 0
+    assert s_v.distinct == len(np.unique(v))
+
+
+def test_histogram_equi_depth_shape():
+    svals = np.arange(100)
+    buckets, distinct = histogram_from_sorted(svals, 4)
+    assert distinct == 100
+    assert [c for _, c in buckets] == [25, 50, 75, 100]
+    assert [b for b, _ in buckets] == [24, 49, 74, 99]
+
+
+def test_analyze_device_parity_single_device():
+    """The device sort path must equal the host stats exactly."""
+    import jax
+
+    from tikv_tpu.device import DeviceRunner
+    from tikv_tpu.parallel import make_mesh
+    table, snap, k, kvalid, v = make_store(20_000, seed=9)
+    runner = DeviceRunner(mesh=make_mesh(jax.devices()[:1]))
+    ep_dev = Endpoint(lambda req: snap, device_runner=runner,
+                      device_row_threshold=1000)
+    ep_host = Endpoint(lambda req: snap)
+    dag = _scan(table)
+    areq = AnalyzeReq(dag.executors[0], dag.ranges, buckets=32)
+    dev = ep_dev.handle_analyze(areq)["columns"]
+    host = ep_host.handle_analyze(areq)["columns"]
+    for d, h in zip(dev, host):
+        assert (d.col_id, d.total, d.null_count, d.distinct) == \
+            (h.col_id, h.total, h.null_count, h.distinct)
+        assert d.buckets == h.buckets
+
+
+def test_crc64_known_vector_and_fold_properties():
+    # crc64-xz of "123456789" is the standard check value
+    assert crc64(b"123456789") == 0x995DC9BBDF1939FA
+    r1 = checksum_kv_pairs([b"a", b"b"], [b"1", b"2"])
+    r2 = checksum_kv_pairs([b"b", b"a"], [b"2", b"1"])
+    assert r1["checksum"] == r2["checksum"]     # order-independent
+    assert r1["total_kvs"] == 2
+    assert r1["total_bytes"] == 4
+    r3 = checksum_kv_pairs([b"a", b"b"], [b"1", b"x"])
+    assert r3["checksum"] != r1["checksum"]
+
+
+def test_native_checksum_matches_python():
+    from tikv_tpu import native
+    if native._mod is None or \
+            not hasattr(native._mod, "checksum_pairs"):
+        pytest.skip("native module not compiled")
+    keys = [b"k%d" % i for i in range(200)]
+    vals = [b"v" * (i % 17) for i in range(200)]
+    cs_n, nb_n = native._mod.checksum_pairs(keys, vals)
+    py = 0
+    for k, v in zip(keys, vals):
+        py ^= crc64(k + v)
+    assert cs_n == py
+    assert nb_n == sum(len(k) + len(v) for k, v in zip(keys, vals))
+
+
+def test_checksum_over_endpoint_replicas_agree():
+    table, snap, *_ = make_store(800, seed=5)
+    ep = Endpoint(lambda req: snap)
+    dag = _scan(table)
+    r1 = ep.handle_checksum(ChecksumReq(dag.executors[0], dag.ranges))
+    r2 = ep.handle_checksum(ChecksumReq(dag.executors[0], dag.ranges))
+    assert r1 == r2
+    assert r1["total_kvs"] == 800
+    # a different snapshot content yields a different checksum
+    table2, snap2, *_ = make_store(800, seed=6)
+    ep2 = Endpoint(lambda req: snap2)
+    dag2 = _scan(table2)
+    r3 = ep2.handle_checksum(ChecksumReq(dag2.executors[0], dag2.ranges))
+    assert r3["checksum"] != r1["checksum"]
+
+
+def test_analyze_and_checksum_over_network():
+    from tikv_tpu.pd import MockPd
+    from tikv_tpu.server.node import Node
+    from tikv_tpu.server.service import KvService
+    from tikv_tpu.server import wire
+    from tikv_tpu.testing.fixture import encode_table_row
+
+    pd = MockPd()
+    node = Node("test:0", pd)
+    node.start()
+    try:
+        svc = KvService(node)
+        table = int_table(2, table_id=702)
+        muts = [{"op": "put", "key": k, "value": v} for k, v in
+                (encode_table_row(table, h, {"c0": h % 7, "c1": h})
+                 for h in range(300))]
+        ts = pd.tso()
+        svc.handle("KvPrewrite", {"mutations": muts,
+                                  "primary": muts[0]["key"],
+                                  "start_version": ts})
+        svc.handle("KvCommit", {"keys": [m["key"] for m in muts],
+                                "start_version": ts,
+                                "commit_version": pd.tso()})
+        sel = DagSelect.from_table(table, ["id", "c0", "c1"])
+        dag = sel.build(start_ts=pd.tso())
+        r = svc.handle("Coprocessor", {"tp": 104,
+                                       "dag": wire.enc_dag(dag),
+                                       "buckets": 8})
+        assert not r.get("error"), r
+        cols = {c["col_id"]: c for c in r["columns"]}
+        assert cols[2]["distinct"] == 7
+        assert cols[2]["total"] == 300
+        assert cols[3]["buckets"][-1][1] == 300
+        r2 = svc.handle("Coprocessor", {"tp": 105,
+                                        "dag": wire.enc_dag(dag)})
+        assert not r2.get("error"), r2
+        assert r2["total_kvs"] == 300 and r2["checksum"] != 0
+    finally:
+        node.stop()

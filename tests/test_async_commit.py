@@ -235,3 +235,25 @@ def test_async_commit_over_network_with_crash_resolution():
         assert g["value"] == b"1"
     finally:
         node.stop()
+
+
+def test_copr_range_check_sees_memory_locks_in_range():
+    """Regression: the range-scoped memory-lock check compares RAW user
+    keys — an encoded-vs-raw mismatch silently disabled it (r4 review).
+    """
+    from tikv_tpu.codec.keys import table_record_key, table_record_range
+    from tikv_tpu.executors.ranges import KeyRange
+    from tikv_tpu.storage.concurrency_manager import ConcurrencyManager
+    from tikv_tpu.storage.txn_types import Lock, LockType
+
+    cm = ConcurrencyManager()
+    key = table_record_key(801, 5)
+    cm.lock_keys([key], [Lock(LockType.PUT, key, 10)])
+    lo, hi = table_record_range(801)
+    with pytest.raises(KeyIsLocked):
+        cm.read_ranges_check([KeyRange(lo, hi)], 50)
+    # a different table's range does not block
+    lo2, hi2 = table_record_range(802)
+    cm.read_ranges_check([KeyRange(lo2, hi2)], 50)
+    cm.unlock_keys([key])
+    cm.read_ranges_check([KeyRange(lo, hi)], 50)

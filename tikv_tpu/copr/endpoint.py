@@ -43,9 +43,10 @@ class CopRequest:
     # > 0: return at most ~paging_size result rows per response and a
     # resume token (endpoint.rs:760-823); always served by the host
     # pipeline (pages bound RESULT materialization; the scan itself is
-    # zero-copy columnar views)
+    # zero-copy columnar views).  resume_token = last returned handle
+    # from the previous page (stable across snapshots)
     paging_size: int = 0
-    paging_offset: int = 0
+    resume_token: object = None
 
 
 @dataclass
@@ -62,8 +63,8 @@ class CopResponse:
         return self.result.is_drained
 
     @property
-    def next_offset(self) -> int:
-        return self.result.next_offset
+    def resume_token(self):
+        return self.result.resume_token
 
 
 class Endpoint:
@@ -86,6 +87,58 @@ class Endpoint:
         own runner (copr_stream): same provider the unary path uses."""
         return self._snapshot_provider(req)
 
+    def handle_analyze(self, areq, storage=None) -> dict:
+        """tp=104 (src/coprocessor/statistics/, endpoint.rs:275-312):
+        per-column equi-depth histogram + distinct/null counts.
+
+        Device routing mirrors DAG requests: big snapshots sort on the
+        TPU (XLA sort at HBM speed), small ones on numpy.
+        """
+        from ..copr.dag import DAGRequest
+        from .analyze import analyze_columns
+        dag = DAGRequest((areq.scan,), tuple(areq.ranges),
+                         start_ts=areq.start_ts)
+        creq = CopRequest(REQ_TYPE_ANALYZE, dag)
+        if storage is None:
+            storage = self._snapshot_provider(creq)
+        runner = self._device_runner
+        est = getattr(storage, "estimated_rows", None)
+        n = est() if callable(est) else None
+        if runner is not None and n is not None and \
+                n >= self._device_row_threshold and \
+                hasattr(runner, "handle_analyze"):
+            stats = runner.handle_analyze(dag, storage, areq.buckets)
+            if stats is not None:
+                return {"columns": stats}
+        from ..executors.runner import BatchExecutorsRunner
+        result = BatchExecutorsRunner(dag, storage).handle_request()
+        return {"columns": analyze_columns(result.batch,
+                                           areq.scan.columns,
+                                           areq.buckets)}
+
+    def handle_checksum(self, creq, storage=None) -> dict:
+        """tp=105 (src/coprocessor/checksum.rs): crc64-xz XOR-folded
+        over the request range's KV pairs (native crc when compiled)."""
+        from ..copr.dag import DAGRequest
+        from .analyze import checksum_kv_pairs
+        dag = DAGRequest((creq.scan,), tuple(creq.ranges),
+                         start_ts=creq.start_ts)
+        req = CopRequest(REQ_TYPE_CHECKSUM, dag)
+        if storage is None:
+            storage = self._snapshot_provider(req)
+        if not hasattr(storage, "to_kv_pairs"):
+            raise NotImplementedError(
+                "checksum requires a table snapshot feed")
+        # checksum over the LOGICAL rows (record key + row payload)
+        # WITHIN the request's ranges: identical visible content ⇒
+        # identical checksum on every replica, independent of MVCC
+        # garbage — the consistency-check contract the admin command
+        # needs
+        pairs = storage.to_kv_pairs(tuple(creq.ranges) or None)
+        keys = [k for k, _ in pairs]
+        vals = [v for _, v in pairs]
+        return checksum_kv_pairs(keys, vals)
+
     def handle(self, req: CopRequest) -> CopResponse:
         from ..utils import metrics as m
         if req.tp != REQ_TYPE_DAG:
@@ -98,7 +151,7 @@ class Endpoint:
             from ..executors.runner import BatchExecutorsRunner
             result = BatchExecutorsRunner(
                 req.dag, storage,
-                scan_offset=req.paging_offset).handle_request(
+                resume_token=req.resume_token).handle_request(
                     max_rows=req.paging_size)
         elif backend == "device":
             result = self._device_runner.handle_request(req.dag, storage)

@@ -242,6 +242,10 @@ class MvccColumnarSnapshot:
     def scan_columns(self, desc: TableScanDesc, ranges):
         return self._tbl.scan_columns(desc, ranges)
 
+    def to_kv_pairs(self, ranges=None):
+        """Logical row pairs for the CHECKSUM admin request."""
+        return self._tbl.to_kv_pairs(ranges)
+
     def count_rows(self, ranges) -> int:
         return self._tbl.count_rows(ranges)
 

@@ -993,6 +993,61 @@ class DeviceRunner:
         return (kind, dag.plan_key(), feed["null_flags"], feed["n_pad"],
                 chunk) + extra
 
+    # -- analyze (tp=104) --
+
+    def handle_analyze(self, dag, storage, n_buckets: int):
+        """Per-column stats on device: XLA sort is the whole cost; null
+        count, distinct count, and equi-depth bucket bounds fall out of
+        the sorted column in the same jit (copr/analyze.py is the host
+        twin).  Multi-shard meshes fall back to host (a distributed
+        sort buys nothing at the admin path's rate).  Returns a list of
+        ColumnStats or None when outside the device envelope.
+        """
+        if not self._single:
+            return None
+        from ..copr.analyze import ColumnStats, histogram_from_sorted
+        scan = dag.executors[0]
+        ets = [c.field_type.eval_type for c in scan.columns]
+        if not all(et in (EvalType.INT, EvalType.REAL) or c.is_pk_handle
+                   for et, c in zip(ets, scan.columns)):
+            return None
+        batch = self._scan_batch(dag, self._analyze_plan(scan), storage)
+        n = batch.num_rows
+        out = []
+        for info, col in zip(scan.columns, batch.columns):
+            if col.values.dtype == np.dtype(object):
+                return None
+            is_int = col.values.dtype.kind in "iu"
+            key = ("analyze", n, str(col.values.dtype))
+
+            def build(is_int=is_int):
+                def sortcol(v, ok):
+                    # NULLs sort last via the dtype's +inf analog so the
+                    # valid prefix is exactly svals[:n_valid]
+                    if is_int:
+                        fill = jnp.asarray(np.iinfo(np.int64).max,
+                                           jnp.int64)
+                        filled = jnp.where(ok, v.astype(jnp.int64), fill)
+                    else:
+                        filled = jnp.where(ok, v.astype(jnp.float64),
+                                           jnp.inf)
+                    return jnp.sort(filled), jnp.sum(ok, dtype=jnp.int64)
+                return jax.jit(sortcol)
+
+            kern = self._shard_kernel(key, build)
+            svals_d, n_valid_d = kern(jnp.asarray(col.values),
+                                      jnp.asarray(col.validity))
+            svals, n_valid = self._readback((svals_d, n_valid_d))
+            n_valid = int(n_valid)
+            svals = svals[:n_valid]
+            buckets, distinct = histogram_from_sorted(svals, n_buckets)
+            out.append(ColumnStats(info.col_id, n, n - n_valid,
+                                   distinct, buckets))
+        return out
+
+    def _analyze_plan(self, scan) -> "_Plan":
+        return _Plan(scan, "scan", list(range(len(scan.columns))))
+
     # -- simple agg --
 
     def _run_simple(self, dag, plan, dtypes, n, feed):

@@ -280,11 +280,17 @@ class ColumnarTable:
 
     # -- row-codec materialization (parity tests only) -----------------------
 
-    def to_kv_pairs(self) -> list[tuple[bytes, bytes]]:
+    def to_kv_pairs(self, ranges=None) -> list[tuple[bytes, bytes]]:
         from ..codec import encode_row, table_record_key
+        if ranges is None:
+            indices = range(len(self.handles))
+        else:
+            indices = [i for lo, hi in self._range_slices(ranges)
+                       for i in range(lo, hi)]
         pairs = []
         by_id = self.columns
-        for i, h in enumerate(self.handles):
+        for i in indices:
+            h = self.handles[i]
             payload = {}
             for col_id, col in by_id.items():
                 v = col.get(i)
@@ -308,18 +314,52 @@ class BatchColumnarTableScanExecutor(TimedExecutor):
         self._batch = snapshot.scan_columns(desc, ranges)
         self._pos = 0
         self._schema = list(desc.schema)
+        self._src = (snapshot, desc, ranges)
+        self._hcache = None
 
     @property
     def schema(self) -> list[FieldType]:
         return self._schema
 
     # -- paging hooks (endpoint.rs streaming/paged requests) --
+    #
+    # Unary pages resume by the LAST RETURNED HANDLE, not a row offset:
+    # each page may see a fresh snapshot (writes land between pages),
+    # and a key-based token stays exact while an offset silently skips
+    # or duplicates rows when earlier handles appear/disappear.
 
-    def skip_rows(self, n: int) -> None:
-        """Resume a paged scan at row offset ``n`` (the scan order over
-        a pinned snapshot is deterministic, so the offset is an exact
-        resume token)."""
-        self._pos = min(n, self._batch.num_rows)
+    def _handles_for_batch(self):
+        if getattr(self, "_hcache", None) is None:
+            snap, desc, ranges = self._src
+            tbl = snap if hasattr(snap, "_range_slices") else \
+                getattr(snap, "_tbl", None)     # MvccColumnarSnapshot
+            if tbl is None or isinstance(desc, IndexScanDesc) or \
+                    desc.desc:
+                self._hcache = False        # no resume token
+            else:
+                slices = tbl._range_slices(ranges)
+                parts = [tbl.handles[i:j] for i, j in slices]
+                self._hcache = parts[0] if len(parts) == 1 else (
+                    np.concatenate(parts) if parts
+                    else tbl.handles[:0])
+        return None if self._hcache is False else self._hcache
+
+    def resume_handle(self):
+        """Token for the next page: the last consumed row's handle, or
+        None when nothing was consumed / the scan cannot resume."""
+        h = self._handles_for_batch()
+        if h is None or self._pos == 0:
+            return None
+        return int(h[self._pos - 1])
+
+    def skip_after_handle(self, token: int) -> None:
+        h = self._handles_for_batch()
+        if h is None:
+            raise ValueError("scan does not support handle resume")
+        self._pos = int(np.searchsorted(h, token, side="right"))
+
+    def supports_resume(self) -> bool:
+        return self._handles_for_batch() is not None
 
     def rows_consumed(self) -> int:
         return self._pos

@@ -113,14 +113,16 @@ class SelectResult:
     """Decoded response: final columns + per-executor summaries.
 
     Paging (endpoint.rs:760-823): ``is_drained=False`` means more pages
-    follow; ``next_offset`` is the scan-row offset to resume from.
+    follow; ``resume_token`` is the last returned row's handle — stable
+    across snapshots, unlike a row offset (concurrent writes shift
+    offsets but never reorder handles).
     """
 
     batch: ColumnBatch
     exec_summaries: list
     warnings: list = field(default_factory=list)
     is_drained: bool = True
-    next_offset: int = 0
+    resume_token: Optional[int] = None
 
     def rows(self):
         return self.batch.rows()
@@ -131,21 +133,22 @@ class BatchExecutorsRunner:
 
     Reference: runner.rs handle_request/internal_handle_request; the
     paged variant mirrors handle_streaming_request — stop once the page
-    budget fills, report how far the scan got so the next request
-    resumes there.
+    budget fills, report the key-based resume token so the next request
+    (possibly over a NEWER snapshot) continues exactly after the last
+    returned row.
     """
 
     def __init__(self, dag: DAGRequest, storage: ScanStorage,
-                 scan_offset: int = 0):
+                 resume_token: Optional[int] = None):
         self._dag = dag
         self._out = build_executors(dag, storage)
         self._max_batch = BATCH_MAX_SIZE_COLUMNAR \
             if hasattr(storage, "scan_columns") else BATCH_MAX_SIZE
-        if scan_offset:
+        if resume_token is not None:
             scan = self._scan_executor()
-            if scan is None or not hasattr(scan, "skip_rows"):
-                raise ValueError("plan does not support scan_offset")
-            scan.skip_rows(scan_offset)
+            if scan is None or not hasattr(scan, "skip_after_handle"):
+                raise ValueError("plan does not support paging resume")
+            scan.skip_after_handle(resume_token)
 
     def _scan_executor(self):
         cur = self._out
@@ -158,11 +161,12 @@ class BatchExecutorsRunner:
 
     def handle_request(self, max_rows: Optional[int] = None) -> SelectResult:
         scan = self._scan_executor()
+        supports = getattr(scan, "supports_resume", None)
         if max_rows is not None and \
-                not callable(getattr(scan, "rows_consumed", None)):
+                not (callable(supports) and supports()):
             # a scan without a resume token cannot page: serve the full
-            # result as one drained page rather than reporting
-            # next_offset=0 forever (the client would loop on page 1)
+            # result as one drained page rather than looping the client
+            # on page 1 forever
             max_rows = None
         batch_size = BATCH_INITIAL_SIZE
         chunks: list[ColumnBatch] = []
@@ -191,11 +195,10 @@ class BatchExecutorsRunner:
                 [batch.schema[i] for i in self._dag.output_offsets],
                 [batch.columns[i] for i in self._dag.output_offsets])
         summaries = _collect_summaries(self._out)
-        consumed = getattr(scan, "rows_consumed", None)
-        # rows_consumed is the scan's ABSOLUTE position (skip included)
-        next_offset = consumed() if callable(consumed) else 0
+        token_fn = getattr(scan, "resume_handle", None)
+        token = token_fn() if callable(token_fn) else None
         return SelectResult(batch, summaries, warnings,
-                            is_drained=drained, next_offset=next_offset)
+                            is_drained=drained, resume_token=token)
 
 
 def _collect_summaries(ex) -> list[ExecSummary]:

@@ -336,27 +336,42 @@ class TxnClient:
 
     def coprocessor(self, dag, key_hint: Optional[bytes] = None,
                     force_backend: Optional[str] = None,
-                    paging_size: int = 0, paging_offset: int = 0) -> dict:
+                    paging_size: int = 0, resume_token=None) -> dict:
         key = key_hint if key_hint is not None else \
             (dag.ranges[0].start if dag.ranges else b"")
         return self._call_leader(key, "Coprocessor", {
             "tp": 103, "dag": wire.enc_dag(dag),
             "force_backend": force_backend,
-            "paging_size": paging_size, "paging_offset": paging_offset})
+            "paging_size": paging_size, "resume_token": resume_token})
 
     def coprocessor_paged(self, dag, paging_size: int,
                           key_hint: Optional[bytes] = None):
         """Iterate the unary paged protocol: yields one response dict
         per page until the server reports is_drained."""
-        offset = 0
+        token = None
         while True:
             r = self.coprocessor(dag, key_hint=key_hint,
                                  paging_size=paging_size,
-                                 paging_offset=offset)
+                                 resume_token=token)
             yield r
             if r.get("is_drained", True):
                 return
-            offset = r["next_offset"]
+            token = r["resume_token"]
+
+    def analyze(self, dag, buckets: int = 64,
+                key_hint: Optional[bytes] = None) -> dict:
+        """ANALYZE (tp=104): per-column histogram/distinct/null stats."""
+        key = key_hint if key_hint is not None else \
+            (dag.ranges[0].start if dag.ranges else b"")
+        return self._call_leader(key, "Coprocessor", {
+            "tp": 104, "dag": wire.enc_dag(dag), "buckets": buckets})
+
+    def checksum(self, dag, key_hint: Optional[bytes] = None) -> dict:
+        """CHECKSUM (tp=105): crc64 over the range's logical rows."""
+        key = key_hint if key_hint is not None else \
+            (dag.ranges[0].start if dag.ranges else b"")
+        return self._call_leader(key, "Coprocessor", {
+            "tp": 105, "dag": wire.enc_dag(dag)})
 
     def coprocessor_stream(self, dag, paging_size: int = 0,
                            key_hint: Optional[bytes] = None):

@@ -354,8 +354,7 @@ class Node:
         cm = self.storage.concurrency_manager
         cm.update_max_ts(req.dag.start_ts)
         if req.dag.ranges:
-            cm.read_ranges_check_encoded(req.dag.ranges,
-                                         req.dag.start_ts)
+            cm.read_ranges_check(req.dag.ranges, req.dag.start_ts)
         else:
             cm.read_range_check(None, None, req.dag.start_ts)
         snap = self.raft_kv.snapshot(SnapContext(key_hint=key_hint))

@@ -206,7 +206,7 @@ class KvService:
                 "backend": resp.backend,
                 "elapsed_ns": resp.elapsed_ns,
                 "is_drained": resp.is_drained,
-                "next_offset": resp.next_offset,
+                "resume_token": resp.resume_token,
                 "exec_summaries": [
                     {"rows": s.num_produced_rows,
                      "iters": s.num_iterations,
@@ -214,12 +214,29 @@ class KvService:
                     for s in resp.result.exec_summaries]}
 
     def Coprocessor(self, req: dict) -> dict:
-        assert req.get("tp", REQ_TYPE_DAG) == REQ_TYPE_DAG
+        tp = req.get("tp", REQ_TYPE_DAG)
+        if tp == 104:       # ANALYZE (endpoint.rs:275-312)
+            from ..copr.analyze import AnalyzeReq
+            dag = wire.dec_dag(req["dag"])
+            stats = self.endpoint.handle_analyze(AnalyzeReq(
+                dag.executors[0], dag.ranges,
+                req.get("buckets", 64), dag.start_ts))
+            return {"columns": [
+                {"col_id": s.col_id, "total": s.total,
+                 "null_count": s.null_count, "distinct": s.distinct,
+                 "buckets": [[b, c] for b, c in s.buckets]}
+                for s in stats["columns"]]}
+        if tp == 105:       # CHECKSUM (checksum.rs)
+            from ..copr.analyze import ChecksumReq
+            dag = wire.dec_dag(req["dag"])
+            return self.endpoint.handle_checksum(ChecksumReq(
+                dag.executors[0], dag.ranges, dag.start_ts))
+        assert tp == REQ_TYPE_DAG, tp
         dag = wire.dec_dag(req["dag"])
         resp = self.endpoint.handle(CopRequest(
             REQ_TYPE_DAG, dag, req.get("force_backend"),
             paging_size=req.get("paging_size", 0),
-            paging_offset=req.get("paging_offset", 0)))
+            resume_token=req.get("resume_token")))
         return self._enc_cop_resp(resp)
 
     def copr_stream(self, req: dict):
@@ -256,26 +273,44 @@ class KvService:
         parked command (pessimistic-lock wait) must not head-of-line
         block the very commit that would release it."""
         import queue as _q
-        from concurrent.futures import ThreadPoolExecutor
+        import threading as _t
 
         done: "_q.Queue" = _q.Queue()
         sentinel = object()
+        outstanding = [0]
+        drained = _t.Event()
+        mu = _t.Lock()
 
         def run_one(ent):
-            resp = self.handle(ent["method"], ent.get("req") or {})
-            done.put({"request_id": ent["request_id"], "response": resp})
+            try:
+                resp = self.handle(ent["method"], ent.get("req") or {})
+                done.put({"request_id": ent["request_id"],
+                          "response": resp})
+            finally:
+                with mu:
+                    outstanding[0] -= 1
+                    last = outstanding[0] == 0 and drained.is_set()
+                if last:
+                    done.put(sentinel)
 
         def feeder():
-            pool = ThreadPoolExecutor(max_workers=8)
+            # one thread per in-flight command, NOT a bounded pool: N
+            # parked pessimistic-lock waits must never occupy every
+            # worker and queue the releasing commit behind themselves
             try:
                 for batch in request_iterator:
                     for ent in batch.get("requests", ()):
-                        pool.submit(run_one, ent)
+                        with mu:
+                            outstanding[0] += 1
+                        _t.Thread(target=run_one, args=(ent,),
+                                  daemon=True).start()
             finally:
-                pool.shutdown(wait=True)
-                done.put(sentinel)
+                with mu:
+                    drained.set()
+                    idle = outstanding[0] == 0
+                if idle:
+                    done.put(sentinel)
 
-        import threading as _t
         _t.Thread(target=feeder, daemon=True).start()
         while True:
             item = done.get()

@@ -76,22 +76,23 @@ class ConcurrencyManager:
             if self._blocks(lk, read_ts, bypass_locks):
                 raise KeyIsLocked(k, lk)
 
-    def read_ranges_check_encoded(self, ranges, read_ts: int,
-                                  bypass_locks=()) -> None:
-        """Range check against ENCODED key ranges (coprocessor DAG
-        ranges) — only memory locks inside the request's ranges block
-        it, mirroring the engine-lock scoping of the row scanner."""
+    def read_ranges_check(self, ranges, read_ts: int,
+                          bypass_locks=()) -> None:
+        """Range check against coprocessor DAG key ranges — only memory
+        locks inside the request's ranges block it, mirroring the
+        engine-lock scoping of the row scanner.  Both the lock table
+        and DAG ranges are RAW user keys (table record keys), compared
+        directly — the same comparison MvccColumnarSnapshot.check_locks
+        uses for engine locks."""
         if not self._table:
             return
-        from .txn_types import encode_key
         with self._mu:
             items = list(self._table.items())
         for k, lk in items:
             if not self._blocks(lk, read_ts, bypass_locks):
                 continue
-            enc = encode_key(k)
             for r in ranges:
-                if r.start <= enc < r.end:
+                if r.start <= k < r.end:
                     raise KeyIsLocked(k, lk)
 
     @staticmethod
