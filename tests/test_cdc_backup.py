@@ -1,0 +1,268 @@
+"""Observer framework, resolved-ts, CDC, backup/restore (§2.6 stack).
+
+Reference test model: components/cdc + resolved_ts + backup inline
+suites — apply-event capture, watermark semantics (no event at or below
+a published resolved_ts), backup→restore roundtrip.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from tikv_tpu.backup import (
+    backup_region,
+    create_storage,
+    read_backup_file,
+    restore_rows,
+)
+from tikv_tpu.cdc import CdcObserver, ResolvedTsObserver
+from tikv_tpu.raftstore.observer import CoprocessorHost, Observer
+from tikv_tpu.testing.cluster import Cluster
+
+
+def make_cluster(n=1):
+    c = Cluster(n)
+    c.bootstrap()
+    c.start()
+    return c
+
+
+# ------------------------------------------------------------ observers
+
+def test_observer_host_sees_apply_events_in_order():
+    c = make_cluster()
+    seen = []
+
+    class Spy(Observer):
+        def on_apply_write(self, region_id, index, ops):
+            seen.append((region_id, index,
+                         [(o.op, o.cf, o.key) for o in ops]))
+
+    c.stores[1].coprocessor_host.register(Spy())
+    c.must_put(b"oa", b"1")
+    c.must_put(b"ob", b"2")
+    assert len(seen) >= 2
+    indices = [i for _rid, i, _ops in seen]
+    assert indices == sorted(indices), "apply events out of order"
+    keys = [k for _r, _i, ops in seen for _o, _cf, k in ops]
+    assert b"oa" in keys and b"ob" in keys
+
+
+def test_observer_role_change_fires():
+    c = make_cluster(3)
+    roles = []
+
+    class Spy(Observer):
+        def on_role_change(self, region_id, is_leader):
+            roles.append((region_id, is_leader))
+
+    for sid in c.stores:
+        c.stores[sid].coprocessor_host.register(Spy())
+    leader = c.leader_store(1)
+    to = [s for s in c.stores if s != leader][0]
+    c.transfer_leader(1, to)
+    c.pump()
+    c.tick_all(3)
+    assert (1, True) in roles
+    assert (1, False) in roles
+
+
+# ----------------------------------------------------------- resolved-ts
+
+def test_resolved_ts_blocked_by_pending_lock_then_advances():
+    """A pending prewrite pins the watermark below its start_ts; the
+    commit releases it (resolver.rs contract)."""
+    from tikv_tpu.storage import Storage
+    from tikv_tpu.storage.txn import commands as cmds
+    from tikv_tpu.storage.txn.actions import Mutation
+    from tikv_tpu.kv.engine import SnapContext, WriteData
+
+    c = make_cluster()
+    rts = ResolvedTsObserver()
+    c.stores[1].coprocessor_host.register(rts)
+    storage = Storage(engine=c.kvs[1])
+
+    storage.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"rk", b"v")], b"rk", 100))
+    c.pump()
+    r = rts.resolver(1)
+    assert r.min_lock_ts() == 100
+    assert r.advance(1000) == 99        # pinned below the lock
+    storage.sched_txn_command(cmds.Commit([b"rk"], 100, 101))
+    c.pump()
+    assert r.min_lock_ts() is None
+    assert r.advance(1000) == 1000      # free to advance
+    # monotonic: a stale advance can't move it backwards
+    assert r.advance(500) == 1000
+
+
+# ------------------------------------------------------------------- CDC
+
+def test_cdc_delegate_joins_prewrite_value_with_commit():
+    from tikv_tpu.storage import Storage
+    from tikv_tpu.storage.txn import commands as cmds
+    from tikv_tpu.storage.txn.actions import Mutation
+
+    c = make_cluster()
+    cdc = CdcObserver()
+    c.stores[1].coprocessor_host.register(cdc)
+    storage = Storage(engine=c.kvs[1])
+    events = []
+    cdc.subscribe(1, events.append)
+
+    storage.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"ck", b"cv")], b"ck", 10))
+    c.pump()
+    assert events == []                 # prewrite alone emits nothing
+    storage.sched_txn_command(cmds.Commit([b"ck"], 10, 11))
+    c.pump()
+    assert len(events) == 1
+    e = events[0]
+    assert (e.key, e.op, e.commit_ts, e.start_ts, e.value) == \
+        (b"ck", "put", 11, 10, b"cv")
+    # big value rides CF_DEFAULT; the event must still carry it
+    big = b"B" * 400
+    storage.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", b"cbig", big)], b"cbig", 20))
+    storage.sched_txn_command(cmds.Commit([b"cbig"], 20, 21))
+    c.pump()
+    assert events[-1].value == big
+    # delete event
+    storage.sched_txn_command(cmds.Prewrite(
+        [Mutation("delete", b"ck", None)], b"ck", 30))
+    storage.sched_txn_command(cmds.Commit([b"ck"], 30, 31))
+    c.pump()
+    assert events[-1].op == "delete" and events[-1].key == b"ck"
+
+
+def test_cdc_stream_over_network_with_resolved_ts():
+    """gRPC CDC: initial scan + live events + resolved-ts heartbeats;
+    no event may arrive with commit_ts <= an already-seen resolved_ts."""
+    from tikv_tpu.raftstore.metapb import Store
+    from tikv_tpu.server import (
+        Node, PdServer, RemotePdClient, TikvServer, TxnClient,
+    )
+
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    node = Node("127.0.0.1:0", RemotePdClient(pd_addr))
+    srv = TikvServer(node)
+    node.addr = f"127.0.0.1:{srv.port}"
+    node.pd.put_store(Store(node.store_id, node.addr))
+    srv.start()
+    try:
+        c = TxnClient(pd_addr)
+        c.put(b"pre-1", b"a")           # pre-existing row
+        got: "queue.Queue" = queue.Queue()
+
+        def consume():
+            try:
+                for msg in c.cdc_stream(1):
+                    got.put(msg)
+            except Exception:   # noqa: BLE001 — server teardown cancels
+                pass
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        first = got.get(timeout=5)      # initial scan
+        assert any(bytes(e["key"]) == b"pre-1"
+                   for e in first["events"])
+        c.put(b"live-1", b"b")          # live event
+        deadline = time.time() + 5
+        live = None
+        max_resolved = 0
+        while time.time() < deadline:
+            try:
+                msg = got.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            for e in msg["events"]:
+                assert e["commit_ts"] > max_resolved, \
+                    "event at/below a published resolved_ts"
+                if bytes(e["key"]) == b"live-1":
+                    live = e
+            max_resolved = max(max_resolved, msg["resolved_ts"])
+            if live is not None and max_resolved > live["commit_ts"]:
+                break
+        assert live is not None and live["value"] == b"b"
+        assert max_resolved > live["commit_ts"], \
+            "resolved_ts never advanced past the event"
+    finally:
+        srv.stop()
+        pd_server.stop()
+
+
+# -------------------------------------------------------- backup/restore
+
+def test_backup_file_roundtrip_and_corruption_detect(tmp_path):
+    c = make_cluster()
+    from tikv_tpu.storage import Storage
+    from tikv_tpu.storage.txn import commands as cmds
+    from tikv_tpu.storage.txn.actions import Mutation
+    storage = Storage(engine=c.kvs[1])
+    for i in range(20):
+        storage.sched_txn_command(cmds.Prewrite(
+            [Mutation("put", b"bk%02d" % i, b"v%d" % i)],
+            b"bk%02d" % i, 10 + i))
+        storage.sched_txn_command(cmds.Commit(
+            [b"bk%02d" % i], 10 + i, 11 + i))
+    c.pump()
+    url = f"local://{tmp_path}/bk"
+    from tikv_tpu.kv.engine import SnapContext
+    snap = c.kvs[1].snapshot(SnapContext(region_id=1))
+    meta = backup_region(snap, 1, 10**18, url)
+    assert meta["rows"] == 20
+    parsed = read_backup_file(url, meta["name"])
+    assert len(parsed["rows"]) == 20
+    # corrupt one byte → crc detects
+    st = create_storage(url)
+    blob = bytearray(st.read(meta["name"]))
+    blob[-3] ^= 0xFF
+    st.write(meta["name"], bytes(blob))
+    with pytest.raises(ValueError, match="crc"):
+        read_backup_file(url, meta["name"])
+
+
+def test_backup_restore_over_network(tmp_path):
+    """Full loop: write → Backup RPC → wipe into a fresh cluster →
+    restore → data identical."""
+    from tikv_tpu.raftstore.metapb import Store
+    from tikv_tpu.server import (
+        Node, PdServer, RemotePdClient, TikvServer, TxnClient,
+    )
+
+    def start_one():
+        pd_server = PdServer("127.0.0.1:0")
+        pd_server.start()
+        pd_addr = f"127.0.0.1:{pd_server.port}"
+        node = Node("127.0.0.1:0", RemotePdClient(pd_addr))
+        srv = TikvServer(node)
+        node.addr = f"127.0.0.1:{srv.port}"
+        node.pd.put_store(Store(node.store_id, node.addr))
+        srv.start()
+        return pd_server, srv, TxnClient(pd_addr)
+
+    url = f"local://{tmp_path}/net"
+    pd1, srv1, c1 = start_one()
+    try:
+        for i in range(30):
+            c1.put(b"nb%02d" % i, b"val%d" % i)
+        resps = c1.backup(url)
+        assert sum(r["meta"]["rows"] for r in resps) == 30
+    finally:
+        srv1.stop()
+        pd1.stop()
+
+    pd2, srv2, c2 = start_one()
+    try:
+        assert c2.get(b"nb00") is None          # fresh cluster
+        restored = c2.restore(url)
+        assert restored == 30
+        for i in range(30):
+            assert c2.get(b"nb%02d" % i) == b"val%d" % i
+    finally:
+        srv2.stop()
+        pd2.stop()

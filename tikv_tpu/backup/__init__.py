@@ -1,0 +1,154 @@
+"""Backup / restore + external storage + SST import (§2.6).
+
+Reference: components/backup/ (scan region snapshots at backup_ts →
+SST writers → external storage; endpoint.rs + writer.rs),
+components/external_storage/ + components/cloud/ (the ``ExternalStorage``
+trait over local/S3/GCS/Azure backends), and components/sst_importer/ +
+src/import/ (download + ingest files back into the cluster).
+
+File format: one file per region — header + msgpack rows of
+(user_key, value, commit_ts, start_ts) at the backup snapshot, plus a
+crc64 of the payload so restores detect corruption.  The ingest path
+replays rows as raft-replicated writes at a FRESH commit ts (rewrite
+semantics, the same contract the reference's download+rewrite step
+implements for timestamps).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Optional
+from urllib.parse import urlparse
+
+import msgpack
+
+_MAGIC = b"TKVBK1\n"
+
+
+# ------------------------------------------------------- external storage
+
+class ExternalStorage:
+    """Write/read named blobs (external_storage/src/lib.rs trait)."""
+
+    def write(self, name: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read(self, name: str) -> bytes:
+        raise NotImplementedError
+
+    def list(self) -> list:
+        raise NotImplementedError
+
+
+class LocalStorage(ExternalStorage):
+    """local:// backend (external_storage local.rs): atomic writes via
+    tmp + rename, the same durability contract cloud backends give."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def write(self, name: str, data: bytes) -> None:
+        path = os.path.join(self.root, name)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def read(self, name: str) -> bytes:
+        with open(os.path.join(self.root, name), "rb") as f:
+            return f.read()
+
+    def list(self) -> list:
+        return sorted(os.listdir(self.root))
+
+
+class NoopStorage(ExternalStorage):
+    """noop:// — discard writes (reference ships the same for tests)."""
+
+    def write(self, name: str, data: bytes) -> None:
+        pass
+
+    def read(self, name: str) -> bytes:
+        raise FileNotFoundError(name)
+
+    def list(self) -> list:
+        return []
+
+
+def create_storage(url: str) -> ExternalStorage:
+    """URL → backend (external_storage create_storage): local://path,
+    noop://.  Cloud schemes (s3/gcs/azure) need credentials + egress
+    this environment doesn't have; they would slot in here."""
+    p = urlparse(url)
+    if p.scheme in ("local", "file"):
+        return LocalStorage(p.netloc + p.path)
+    if p.scheme == "noop":
+        return NoopStorage()
+    raise ValueError(f"unsupported storage scheme {p.scheme!r}")
+
+
+# ---------------------------------------------------------------- backup
+
+def backup_region(snapshot, region_id: int, backup_ts: int,
+                  storage_url: str) -> dict:
+    """Scan one region's committed rows at backup_ts into a backup file
+    (backup/src/endpoint.rs scan → writer.rs).  Returns file metadata.
+    """
+    from ..copr.analyze import crc64
+    from ..storage.mvcc.reader import MvccReader
+    reader = MvccReader(snapshot)
+    rows = []
+    for key, value in reader.scan(None, None, 1 << 30, backup_ts):
+        found = reader.seek_write(key, backup_ts)
+        commit_ts, w = found if found else (0, None)
+        rows.append((key, value, commit_ts,
+                     w.start_ts if w is not None else 0))
+    payload = msgpack.packb(rows, use_bin_type=True)
+    crc = crc64(payload)
+    blob = _MAGIC + struct.pack(">QQI", backup_ts, crc,
+                                len(rows)) + payload
+    name = f"backup_r{region_id}_{backup_ts}.bak"
+    create_storage(storage_url).write(name, blob)
+    return {"name": name, "rows": len(rows), "bytes": len(blob),
+            "crc64": crc}
+
+
+def read_backup_file(storage_url: str, name: str) -> dict:
+    """Parse + verify one backup file → {"backup_ts", "rows": [...]}.
+
+    Raises ValueError on magic/crc mismatch (torn or corrupt upload).
+    """
+    from ..copr.analyze import crc64
+    blob = create_storage(storage_url).read(name)
+    if not blob.startswith(_MAGIC):
+        raise ValueError(f"{name}: bad backup magic")
+    off = len(_MAGIC)
+    backup_ts, crc, n = struct.unpack_from(">QQI", blob, off)
+    payload = blob[off + 20:]
+    if crc64(payload) != crc:
+        raise ValueError(f"{name}: backup payload crc mismatch")
+    rows = msgpack.unpackb(payload, raw=False)
+    if len(rows) != n:
+        raise ValueError(f"{name}: row count mismatch")
+    return {"backup_ts": backup_ts, "rows": rows}
+
+
+# ----------------------------------------------------------------- import
+
+def restore_rows(client, rows, batch: int = 2000) -> int:
+    """Ingest backup rows through the cluster's transactional write
+    path (sst_importer's download+rewrite+ingest collapsed onto the txn
+    API: every row lands raft-replicated on every replica with a fresh
+    commit ts).  ``client`` is a TxnClient."""
+    total = 0
+    for s in range(0, len(rows), batch):
+        muts = [("put", bytes(k), bytes(v))
+                for k, v, _commit, _start in rows[s:s + batch]]
+        if muts:
+            client.txn_write(muts)
+            total += len(muts)
+    return total

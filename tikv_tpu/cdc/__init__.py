@@ -1,0 +1,7 @@
+"""Change data capture + resolved-ts components (§2.6)."""
+
+from .resolved_ts import ResolvedTsObserver, Resolver
+from .delegate import CdcDelegate, CdcObserver, ChangeEvent
+
+__all__ = ["Resolver", "ResolvedTsObserver", "CdcObserver",
+           "CdcDelegate", "ChangeEvent"]

@@ -60,6 +60,21 @@ class ReadPoolConfig:
 
 
 @dataclass
+class SecurityConfig:
+    """[security]: TLS for every gRPC channel (components/security).
+    The ONE definition — server/security.py builds its manager from
+    this same dataclass."""
+
+    ca_path: str = ""
+    cert_path: str = ""
+    key_path: str = ""
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.ca_path or self.cert_path)
+
+
+@dataclass
 class TikvConfig:
     """The full config tree (config/mod.rs TikvConfig analog)."""
 
@@ -69,6 +84,7 @@ class TikvConfig:
     coprocessor: CoprocessorConfig = field(
         default_factory=CoprocessorConfig)
     readpool: ReadPoolConfig = field(default_factory=ReadPoolConfig)
+    security: SecurityConfig = field(default_factory=SecurityConfig)
 
     @staticmethod
     def from_file(path: str) -> "TikvConfig":

@@ -1022,24 +1022,40 @@ class DeviceRunner:
 
             def build(is_int=is_int):
                 def sortcol(v, ok):
-                    # NULLs sort last via the dtype's +inf analog so the
-                    # valid prefix is exactly svals[:n_valid]
+                    # NULLs sort last so the valid prefix is exactly
+                    # svals[:n_valid].  For floats the fill must be NaN
+                    # (+inf would sort BEFORE a real NaN and leak into
+                    # the prefix); real NaNs are counted separately so
+                    # the host parity (np.sort puts NaNs last among
+                    # valid values) can be reconstructed.
                     if is_int:
                         fill = jnp.asarray(np.iinfo(np.int64).max,
                                            jnp.int64)
                         filled = jnp.where(ok, v.astype(jnp.int64), fill)
+                        nan_valid = jnp.zeros((), jnp.int64)
                     else:
-                        filled = jnp.where(ok, v.astype(jnp.float64),
-                                           jnp.inf)
-                    return jnp.sort(filled), jnp.sum(ok, dtype=jnp.int64)
+                        f = v.astype(jnp.float64)
+                        filled = jnp.where(ok, f, jnp.nan)
+                        nan_valid = jnp.sum(ok & jnp.isnan(f),
+                                            dtype=jnp.int64)
+                    return (jnp.sort(filled),
+                            jnp.sum(ok, dtype=jnp.int64), nan_valid)
                 return jax.jit(sortcol)
 
             kern = self._shard_kernel(key, build)
-            svals_d, n_valid_d = kern(jnp.asarray(col.values),
-                                      jnp.asarray(col.validity))
-            svals, n_valid = self._readback((svals_d, n_valid_d))
-            n_valid = int(n_valid)
-            svals = svals[:n_valid]
+            svals_d, n_valid_d, nan_d = kern(jnp.asarray(col.values),
+                                             jnp.asarray(col.validity))
+            svals, n_valid, n_nan = self._readback(
+                (svals_d, n_valid_d, nan_d))
+            n_valid, n_nan = int(n_valid), int(n_nan)
+            if n_nan:
+                # sorted = [non-nan..., real NaNs + NULL fills]; rebuild
+                # the host ordering: non-nan values then real NaNs
+                svals = np.concatenate(
+                    [svals[:n_valid - n_nan],
+                     np.full(n_nan, np.nan, np.float64)])
+            else:
+                svals = svals[:n_valid]
             buckets, distinct = histogram_from_sorted(svals, n_buckets)
             out.append(ColumnStats(info.col_id, n, n - n_valid,
                                    distinct, buckets))

@@ -1,0 +1,81 @@
+"""Coprocessor observer host — the apply-path event seam.
+
+Reference: components/raftstore/src/coprocessor/mod.rs:98-594 — the
+``CoprocessorHost`` that CDC (components/cdc/src/observer.rs),
+resolved-ts (components/resolved_ts/src/lib.rs), backup-stream
+(components/backup-stream/src/observer.rs) and the split checker all
+register into.  Observers see committed apply events in order, plus
+region/role changes, and must never fail the apply.
+
+Events delivered:
+- ``on_apply_write(region_id, index, ops)``: the data WriteOps of one
+  applied entry, AFTER the engine write succeeded (ops carry raw cf/
+  key/value exactly as applied);
+- ``on_region_changed(region)``: split/merge/conf-change/snapshot;
+- ``on_role_change(region_id, is_leader)``: leadership transitions.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+
+class Observer:
+    """Base observer: override what you need (BoxObserver analogs)."""
+
+    def on_apply_write(self, region_id: int, index: int,
+                       ops: Sequence) -> None:
+        pass
+
+    def on_region_changed(self, region) -> None:
+        pass
+
+    def on_role_change(self, region_id: int, is_leader: bool) -> None:
+        pass
+
+
+class CoprocessorHost:
+    """Observer registry attached to one RaftStore (dispatcher.rs:451).
+
+    Dispatch is synchronous on the apply path (the reference's apply
+    poller calls observers inline too); observers do their heavy work on
+    their own workers, treating these callbacks as mailbox pushes.
+    Observer exceptions are swallowed — a broken subscriber must never
+    fail consensus.
+    """
+
+    def __init__(self):
+        self._observers: list[Observer] = []
+
+    def register(self, obs: Observer) -> None:
+        self._observers.append(obs)
+
+    def unregister(self, obs: Observer) -> None:
+        try:
+            self._observers.remove(obs)
+        except ValueError:
+            pass
+
+    # -- dispatch --
+
+    def notify_apply_write(self, region_id: int, index: int,
+                           ops: Sequence) -> None:
+        for obs in self._observers:
+            try:
+                obs.on_apply_write(region_id, index, ops)
+            except Exception:   # noqa: BLE001
+                pass
+
+    def notify_region_changed(self, region) -> None:
+        for obs in self._observers:
+            try:
+                obs.on_region_changed(region)
+            except Exception:   # noqa: BLE001
+                pass
+
+    def notify_role_change(self, region_id: int, is_leader: bool) -> None:
+        for obs in self._observers:
+            try:
+                obs.on_role_change(region_id, is_leader)
+            except Exception:   # noqa: BLE001
+                pass

@@ -175,6 +175,9 @@ class RaftPeer:
         # peer route responses before it learns the region's peer list
         # (reference: peer.rs Peer::peer_cache)
         self.peer_cache: dict[int, PeerMeta] = {}
+        # applied-but-not-yet-notified observer events + role tracking
+        self._pending_obs: list = []
+        self._last_role = False
 
     # ------------------------------------------------------------- props
 
@@ -312,8 +315,20 @@ class RaftPeer:
             if not wb.is_empty():
                 self.engine.write(wb)
             fail_point("apply::after_write")
+            # observers run AFTER the engine write so they only ever see
+            # durable state (coprocessor/mod.rs post-apply hooks)
+            if self._pending_obs:
+                host = self.store.coprocessor_host
+                for index, ops in self._pending_obs:
+                    host.notify_apply_write(self.region.id, index, ops)
+                self._pending_obs.clear()
             out.extend(rd.messages)
             self.node.advance(rd)
+        role = self.is_leader()
+        if role != self._last_role:
+            self._last_role = role
+            self.store.coprocessor_host.notify_role_change(
+                self.region.id, role)
         return out
 
     # ------------------------------------------------------------- apply
@@ -362,6 +377,7 @@ class RaftPeer:
                 # without spurious invalidation on log GC
                 self.data_index = entry.index
                 result = self._exec_write(wb, cmd)
+                self._pending_obs.append((entry.index, cmd.ops))
         if prop is not None:
             prop.cb(result)
 

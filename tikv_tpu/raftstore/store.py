@@ -61,6 +61,10 @@ class RaftStore:
         # config-file section so online changes flow through
         from ..config import RaftstoreConfig
         self.config = RaftstoreConfig()
+        # observer host: CDC/resolved-ts/backup hook the apply path here
+        # (coprocessor/mod.rs:98-594)
+        from .observer import CoprocessorHost
+        self.coprocessor_host = CoprocessorHost()
 
     # ------------------------------------------------------------- lifecycle
 
@@ -142,6 +146,7 @@ class RaftStore:
         host's region-change event (raftstore/src/coprocessor)."""
         for obs in getattr(self, "observers", ()):
             obs(self.store_id, region)
+        self.coprocessor_host.notify_region_changed(region)
 
     # ------------------------------------------------------------- messages
 

@@ -96,6 +96,9 @@ def main(argv=None) -> int:
         if args.config:
             from ..config import TikvConfig
             config = TikvConfig.from_file(args.config)
+            if config.security.enabled:
+                from .security import set_default
+                set_default(config.security)
         if args.status_addr and config is not None:
             config.server.status_addr = args.status_addr
         node = Node(args.addr, RemotePdClient(args.pd),

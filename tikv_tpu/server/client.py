@@ -25,7 +25,8 @@ class StoreClient:
 
     def __init__(self, addr: str):
         self.addr = addr
-        self._chan = grpc.insecure_channel(addr)
+        from .security import make_channel
+        self._chan = make_channel(addr)
 
     def call(self, method: str, req: dict, timeout: float = 10) -> dict:
         fn = self._chan.unary_unary(
@@ -52,7 +53,8 @@ class BatchCommandsClient:
         import queue
 
         self.addr = addr
-        self._chan = grpc.insecure_channel(addr)
+        from .security import make_channel
+        self._chan = make_channel(addr)
         self._q: "queue.Queue" = queue.Queue()
         self._pending: dict = {}
         self._mu = threading.Lock()
@@ -372,6 +374,52 @@ class TxnClient:
             (dag.ranges[0].start if dag.ranges else b"")
         return self._call_leader(key, "Coprocessor", {
             "tp": 105, "dag": wire.enc_dag(dag)})
+
+    # -- CDC / backup (§2.6 services) --
+
+    def cdc_stream(self, region_id: int, checkpoint_ts: int = 0,
+                   key_hint: bytes = b""):
+        """Subscribe to a region's change feed (cdcpb EventFeed analog):
+        yields {"events": [...], "resolved_ts": ts} messages."""
+        client, _region = self._leader_client(key_hint)
+        fn = client._chan.unary_stream(
+            "/tikv.Tikv/Cdc", request_serializer=wire.pack,
+            response_deserializer=wire.unpack)
+        for msg in fn({"region_id": region_id,
+                       "checkpoint_ts": checkpoint_ts}, timeout=300):
+            if msg.get("error"):
+                raise wire.RemoteError(msg["error"])
+            yield msg
+
+    def backup(self, storage_url: str, backup_ts: int = 0,
+               key_hint: bytes = b"") -> list:
+        """Back up every leader region on the routed store; returns the
+        per-region file metadata list (backuppb BackupResponse)."""
+        client, _region = self._leader_client(key_hint)
+        fn = client._chan.unary_stream(
+            "/tikv.Tikv/Backup", request_serializer=wire.pack,
+            response_deserializer=wire.unpack)
+        out = []
+        for msg in fn({"storage": storage_url,
+                       "backup_ts": backup_ts}, timeout=300):
+            if msg.get("error"):
+                raise wire.RemoteError(msg["error"])
+            out.append(msg)
+        return out
+
+    def restore(self, storage_url: str, names=None) -> int:
+        """Restore backup files through the transactional write path
+        (sst_importer download+ingest collapsed onto 2PC)."""
+        from ..backup import create_storage, read_backup_file, \
+            restore_rows
+        storage = create_storage(storage_url)
+        total = 0
+        for name in (names if names is not None else storage.list()):
+            if not name.endswith(".bak"):
+                continue
+            parsed = read_backup_file(storage_url, name)
+            total += restore_rows(self, parsed["rows"])
+        return total
 
     def coprocessor_stream(self, dag, paging_size: int = 0,
                            key_hint: Optional[bytes] = None):

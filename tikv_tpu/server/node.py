@@ -87,7 +87,8 @@ class GrpcTransport(Transport):
         chan = self._chans.get(store_id)
         if chan is None:
             addr = self._pd.get_store(store_id).address
-            chan = grpc.insecure_channel(addr)
+            from .security import make_channel
+            chan = make_channel(addr)
             self._chans[store_id] = chan
         return chan
 
@@ -227,6 +228,12 @@ class Node:
         self.storage = Storage(
             engine=self.raft_kv,
             lock_manager=LockManager(detector=_DetectorProxy(self)))
+        # §2.6 observers: resolved-ts + CDC tap the apply path
+        from ..cdc import CdcObserver, ResolvedTsObserver
+        self.resolved_ts = ResolvedTsObserver()
+        self.cdc = CdcObserver()
+        self.raft_store.coprocessor_host.register(self.resolved_ts)
+        self.raft_store.coprocessor_host.register(self.cdc)
         from .read_pool import ReadPool
         self.read_pool = ReadPool(
             max_concurrency=config.readpool.concurrency)
@@ -308,6 +315,16 @@ class Node:
                         self.pd.region_heartbeat(region, leader)
                     self.pd.store_heartbeat(
                         self.store_id, {"region_count": len(leaders)})
+                    # advance resolved-ts watermarks with a fresh TSO
+                    # (resolved_ts advance worker cadence).  The ts is
+                    # registered in the concurrency manager FIRST so any
+                    # later async-commit/1PC finalizes ABOVE the
+                    # published watermark (the reference's advance
+                    # worker updates max_ts for exactly this reason)
+                    ts = self.pd.tso()
+                    self.storage.concurrency_manager.update_max_ts(ts)
+                    self.resolved_ts.advance_all(
+                        ts, [r.id for r, _l in leaders])
                 except Exception:
                     pass    # PD outages must not stall raft
             if did == 0:
@@ -526,7 +543,9 @@ class Node:
                     {"region": wire.enc_region(p.region),
                      "leader": p.is_leader(),
                      "term": p.node.term,
-                     "applied": p.node.applied}
+                     "applied": p.node.applied,
+                     "resolved_ts": self.resolved_ts.resolver(
+                         p.region.id).resolved_ts}
                     for p in self.raft_store.peers.values()],
             }
 

@@ -99,7 +99,8 @@ class PdServer:
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
         self._server.add_generic_rpc_handlers((
             _GenericHandler("/pd.PD/", PdService(self.pd).handle),))
-        self.port = self._server.add_insecure_port(addr)
+        from .security import bind_port
+        self.port = bind_port(self._server, addr)
         assert self.port, f"cannot bind {addr}"
 
     def start(self) -> None:
@@ -116,7 +117,8 @@ class RemotePdClient:
     """PdClient protocol over the PD gRPC service (pd_client parity)."""
 
     def __init__(self, addr: str):
-        self._chan = grpc.insecure_channel(addr)
+        from .security import make_channel
+        self._chan = make_channel(addr)
 
     def _call(self, method: str, req: dict) -> dict:
         fn = self._chan.unary_unary(

@@ -37,10 +37,12 @@ class _GenericHandler(grpc.GenericRpcHandler):
             return None
         method = name[len(self._prefix):]
 
-        if method == "CoprocessorStream" and \
-                self._stream_dispatch is not None:
-            def stream(req: dict, ctx):
-                yield from self._stream_dispatch(req)
+        if self._stream_dispatch is not None and \
+                method in self._stream_dispatch:
+            fn = self._stream_dispatch[method]
+
+            def stream(req: dict, ctx, fn=fn):
+                yield from fn(req, ctx)
             return grpc.unary_stream_rpc_method_handler(
                 stream, request_deserializer=wire.unpack,
                 response_serializer=wire.pack)
@@ -70,10 +72,16 @@ class TikvServer:
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers))
         self._server.add_generic_rpc_handlers((
-            _GenericHandler("/tikv.Tikv/", self.service.handle,
-                            stream_dispatch=self.service.copr_stream,
-                            batch_dispatch=self.service.batch_commands),))
-        self.port = self._server.add_insecure_port(node.addr)
+            _GenericHandler(
+                "/tikv.Tikv/", self.service.handle,
+                stream_dispatch={
+                    "CoprocessorStream": self.service.copr_stream_rpc,
+                    "Cdc": self.service.cdc_stream,
+                    "Backup": self.service.backup_stream,
+                },
+                batch_dispatch=self.service.batch_commands),))
+        from .security import bind_port
+        self.port = bind_port(self._server, node.addr)
         assert self.port, f"cannot bind {node.addr}"
         # HTTP status server (/metrics, /config, /status —
         # status_server/mod.rs), bound from config or the explicit arg

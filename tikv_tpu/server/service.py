@@ -239,6 +239,86 @@ class KvService:
             resume_token=req.get("resume_token")))
         return self._enc_cop_resp(resp)
 
+    def copr_stream_rpc(self, req: dict, ctx=None):
+        yield from self.copr_stream(req)
+
+    def cdc_stream(self, req: dict, ctx=None):
+        """CDC event stream (components/cdc/src/service.rs): initial
+        scan at the checkpoint, then live change events from the apply
+        path, interleaved with resolved-ts heartbeats.  A resolved_ts
+        message promises no further event at or below it."""
+        import queue as _q
+
+        from ..cdc.delegate import initial_scan
+        from ..kv.engine import SnapContext
+        region_id = req["region_id"]
+        checkpoint_ts = req.get("checkpoint_ts") or 0
+        q: "_q.Queue" = _q.Queue()
+        # subscribe BEFORE fetching the scan ts: a commit landing in
+        # between then appears in the live queue, the scan, or both —
+        # at-least-once over (checkpoint_ts, scan_ts], never dropped
+        delegate = self.node.cdc.subscribe(region_id, q.put)
+        try:
+            scan_ts = self.node.pd.tso()
+            snap = self.node.raft_kv.snapshot(
+                SnapContext(region_id=region_id))
+            events = [e for e in initial_scan(snap, None, None, scan_ts)
+                      if e.commit_ts > checkpoint_ts]
+            yield {"events": [self._enc_event(e) for e in events],
+                   "resolved_ts": 0, "snapshot_ts": scan_ts}
+            last_resolved = 0
+            while True:
+                # read the watermark BEFORE draining: an event enqueued
+                # after the drain must never trail a resolved_ts that
+                # already covered its commit
+                rts = self.node.resolved_ts.resolver(region_id) \
+                    .resolved_ts
+                batch = []
+                try:
+                    batch.append(q.get(timeout=0.2))
+                    while True:
+                        try:
+                            batch.append(q.get_nowait())
+                        except _q.Empty:
+                            break
+                except _q.Empty:
+                    pass
+                batch = [e for e in batch if e.commit_ts > checkpoint_ts]
+                if batch or rts > last_resolved:
+                    last_resolved = max(last_resolved, rts)
+                    yield {"events": [self._enc_event(e) for e in batch],
+                           "resolved_ts": last_resolved}
+                if ctx is not None and not ctx.is_active():
+                    return
+        finally:
+            self.node.cdc.unsubscribe(region_id, delegate)
+
+    @staticmethod
+    def _enc_event(e) -> dict:
+        return {"key": e.key, "op": e.op, "commit_ts": e.commit_ts,
+                "start_ts": e.start_ts, "value": e.value}
+
+    def backup_stream(self, req: dict, ctx=None):
+        """Backup RPC (components/backup/src/service.rs): stream one
+        response per backed-up region."""
+        from ..backup import backup_region
+        from ..kv.engine import SnapContext
+        backup_ts = req.get("backup_ts") or self.node.pd.tso()
+        storage_url = req["storage"]
+        with self.node.lock:
+            rids = [p.region.id
+                    for p in self.node.raft_store.peers.values()
+                    if p.is_leader()]
+        for rid in rids:
+            try:
+                snap = self.node.raft_kv.snapshot(
+                    SnapContext(region_id=rid))
+                meta = backup_region(snap, rid, backup_ts, storage_url)
+                yield {"region_id": rid, "meta": meta,
+                       "backup_ts": backup_ts}
+            except Exception as e:      # noqa: BLE001
+                yield {"region_id": rid, "error": wire.enc_error(e)}
+
     def copr_stream(self, req: dict):
         """Server-streamed coprocessor pages (service/kv.rs:632
         coprocessor_stream).  One runner instance spans the stream, so

@@ -154,13 +154,16 @@ class MvccReader:
 
     def scan(self, start: Optional[bytes], end: Optional[bytes],
              limit: int, read_ts: int, desc: bool = False,
-             bypass_locks=()) -> list[tuple[bytes, bytes]]:
+             bypass_locks=(), ignore_locks: bool = False) -> list[tuple[bytes, bytes]]:
         """Resolve up to ``limit`` visible (user_key, value) pairs.
 
         Reference: reader/scanner/forward.rs (ForwardKvScanner) and
         backward.rs; SI isolation — a conflicting lock on any key reached
         before the limit is satisfied raises KeyIsLocked (including keys
-        with no committed version yet).
+        with no committed version yet).  ``ignore_locks`` reads only
+        committed data, skipping conflict checks entirely — the CDC
+        initializer's mode (its resolver tracks the pending locks, so
+        resolved-ts stays below them and no downstream finalizes early).
         """
         from ..txn_types import decode_key
         lower = encode_key(start) if start else None
@@ -179,6 +182,8 @@ class MvccReader:
 
         def check_locks_through(enc: Optional[bytes]):
             nonlocal lock_i
+            if ignore_locks:
+                return
             while lock_i < len(locks):
                 lk_enc, lock = locks[lock_i]
                 if enc is not None:
