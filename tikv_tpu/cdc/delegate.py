@@ -94,7 +94,13 @@ class CdcDelegate:
                         self._pending.pop((key, w.start_ts), None)
                     self._sink(ChangeEvent(key, "delete", commit_ts,
                                            w.start_ts))
-                # LOCK / ROLLBACK records emit nothing (delegate.rs)
+                else:
+                    # LOCK / ROLLBACK records emit nothing (delegate.rs)
+                    # but must still evict the cached prewrite value or
+                    # rolled-back txns leak payloads for the delegate's
+                    # lifetime
+                    with self._mu:
+                        self._pending.pop((key, w.start_ts), None)
 
 
 class CdcObserver(Observer):

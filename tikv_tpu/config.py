@@ -44,6 +44,10 @@ class RaftstoreConfig:
     region_split_check_ticks: int = 10  # split check every N ticks
     raft_log_gc_threshold: int = 1024
     hibernate_regions: bool = False
+    # batch-system pollers (0 = synchronous drive loop) and async
+    # raft-log writer threads (store-pool-size / store-io-pool-size)
+    store_pool_size: int = 0
+    store_io_pool_size: int = 1
 
 
 @dataclass

@@ -1,0 +1,232 @@
+"""Batch-system — the multi-raft actor runtime.
+
+Reference: components/batch-system/src/ — thousands of region FSMs
+multiplexed over a small poller pool: each FSM owns a ``BasicMailbox``
+(batch.rs ``Fsm`` + mailbox state machine), senders ``notify`` the
+scheduler queue on first message, pollers claim notified FSMs, drain a
+bounded batch of messages, and REQUEUE an FSM that still has work
+instead of spinning on it (reschedule fairness, batch.rs:292,340) — so
+one hot region cannot starve the rest.
+
+Python shape: the FSM invariant (one poller processes an FSM at a
+time) comes from the mailbox state field flipping idle→notified→
+processing under the mailbox lock; the GIL serializes bytecode but the
+pool still overlaps the blocking stages (WAL fsync, gRPC sends) that
+release it — exactly the IO the reference moves off the raft threads
+(store/async_io/write.rs).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+# mailbox states
+_IDLE = 0           # no pending messages, not scheduled
+_NOTIFIED = 1       # queued for a poller
+_PROCESSING = 2     # a poller owns it right now
+
+
+class Mailbox:
+    """One FSM's inbox (batch-system BasicMailbox)."""
+
+    def __init__(self, fsm_id):
+        self.fsm_id = fsm_id
+        self._msgs: deque = deque()
+        self._mu = threading.Lock()
+        self._state = _IDLE
+        self.closed = False
+
+    def push(self, msg) -> bool:
+        """→ True if the FSM must be (re)scheduled."""
+        with self._mu:
+            if self.closed:
+                return False
+            self._msgs.append(msg)
+            if self._state == _IDLE:
+                self._state = _NOTIFIED
+                return True
+            return False
+
+    def take(self, max_batch: int) -> list:
+        """Poller claims the mailbox and drains up to max_batch."""
+        with self._mu:
+            self._state = _PROCESSING
+            out = []
+            while self._msgs and len(out) < max_batch:
+                out.append(self._msgs.popleft())
+            return out
+
+    def finish(self) -> bool:
+        """Poller releases; → True if messages arrived meanwhile (the
+        FSM must requeue — the fairness hook)."""
+        with self._mu:
+            if self._msgs:
+                self._state = _NOTIFIED
+                return True
+            self._state = _IDLE
+            return False
+
+    def close(self) -> None:
+        with self._mu:
+            self.closed = True
+            self._msgs.clear()
+
+
+class Router:
+    """fsm_id → mailbox registry + the scheduler queue (router.rs)."""
+
+    def __init__(self):
+        self._mailboxes: dict = {}
+        self._mu = threading.Lock()
+        self.schedule_q: "queue.Queue" = queue.Queue()
+
+    def register(self, fsm_id) -> Mailbox:
+        mb = Mailbox(fsm_id)
+        with self._mu:
+            self._mailboxes[fsm_id] = mb
+        return mb
+
+    def close(self, fsm_id) -> None:
+        with self._mu:
+            mb = self._mailboxes.pop(fsm_id, None)
+        if mb is not None:
+            mb.close()
+
+    def mailbox(self, fsm_id) -> Optional[Mailbox]:
+        return self._mailboxes.get(fsm_id)
+
+    def send(self, fsm_id, msg) -> bool:
+        mb = self._mailboxes.get(fsm_id)
+        if mb is None:
+            return False
+        if mb.push(msg):
+            self.schedule_q.put(fsm_id)
+        return True
+
+    def broadcast(self, msg) -> None:
+        with self._mu:
+            ids = list(self._mailboxes)
+        for fsm_id in ids:
+            self.send(fsm_id, msg)
+
+
+class PollerPool:
+    """N poller threads draining the scheduler queue (batch.rs Poller).
+
+    ``handler(fsm_id, msgs)`` runs with the FSM's mailbox held in
+    PROCESSING state — the one-poller-per-FSM invariant the raftstore
+    peer code relies on for mutation safety.
+    """
+
+    def __init__(self, router: Router, handler: Callable,
+                 max_batch: int = 256, name: str = "poller"):
+        self._router = router
+        self._handler = handler
+        self._max_batch = max_batch
+        self._name = name
+        self._threads: list = []
+        self._stop = threading.Event()
+
+    def spawn(self, n: int) -> None:
+        for i in range(n):
+            t = threading.Thread(target=self._poll, daemon=True,
+                                 name=f"{self._name}-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def _poll(self) -> None:
+        q = self._router.schedule_q
+        while not self._stop.is_set():
+            try:
+                fsm_id = q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            mb = self._router.mailbox(fsm_id)
+            if mb is None or mb.closed:
+                continue
+            msgs = mb.take(self._max_batch)
+            try:
+                if msgs:
+                    self._handler(fsm_id, msgs)
+            except Exception:   # noqa: BLE001
+                # one FSM's failure must not kill the poller thread —
+                # log it and keep draining the rest of the store
+                import logging
+                logging.getLogger(__name__).exception(
+                    "fsm %r handler failed", fsm_id)
+            finally:
+                if mb.finish():
+                    # reschedule fairness: go to the BACK of the queue
+                    q.put(fsm_id)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+
+
+class WriteWorkerPool:
+    """Async raft-log IO (store/async_io/write.rs): WAL-bearing write
+    batches from many peers funnel to dedicated writer threads; each
+    worker GROUP-COMMITS everything queued at wake-up in one engine
+    write (one fsync covers many regions), then runs the peers'
+    post-persist callbacks (send messages, apply)."""
+
+    def __init__(self, engine, n_workers: int = 1):
+        self._engine = engine
+        self._q: "queue.Queue" = queue.Queue()
+        self._threads = []
+        self._stop = threading.Event()
+        for i in range(n_workers):
+            t = threading.Thread(target=self._run, daemon=True,
+                                 name=f"raftlog-writer-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def submit(self, wb, on_persisted: Callable) -> None:
+        self._q.put((wb, on_persisted))
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            while True:
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            # group commit: one engine write (one fsync) for the batch
+            merged = self._engine.write_batch()
+            for wb, _cb in batch:
+                merged._ops.extend(wb._ops)
+            try:
+                if not merged.is_empty():
+                    self._engine.write(merged)
+            except Exception:
+                # a failed raft-log write is unrecoverable — unpersisted
+                # entries must never be acked; the reference panics the
+                # process here (write.rs).  Log loudly and let the
+                # worker die rather than continue on a broken log.
+                import logging
+                logging.getLogger(__name__).critical(
+                    "raft-log write failed; store cannot continue",
+                    exc_info=True)
+                raise
+            for _wb, cb in batch:
+                try:
+                    cb()
+                except Exception:   # noqa: BLE001 — peer callbacks
+                    pass
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()

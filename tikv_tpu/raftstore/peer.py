@@ -139,6 +139,11 @@ class _PrefixStripIterator:
 class RaftPeer:
     def __init__(self, store, region: Region, peer_meta: PeerMeta,
                  engine: KvEngine, initial: bool = False, **raft_cfg):
+        import threading as _threading
+        # serializes poller processing against lease reads from handler
+        # threads in pooled mode (the LocalReader seam); uncontended in
+        # the synchronous drive mode
+        self.mu = _threading.RLock()
         self.store = store
         self.meta = peer_meta
         self.engine = engine
@@ -178,6 +183,8 @@ class RaftPeer:
         # applied-but-not-yet-notified observer events + role tracking
         self._pending_obs: list = []
         self._last_role = False
+        # an async raft-log write is in flight (batch_system write pool)
+        self._ready_inflight = False
 
     # ------------------------------------------------------------- props
 
@@ -208,6 +215,10 @@ class RaftPeer:
                 raise KeyNotInRegion(op.key, region)
 
     def propose(self, cmd: RaftCmd, cb: Callable) -> int:
+        with self.mu:
+            return self._propose_locked(cmd, cb)
+
+    def _propose_locked(self, cmd: RaftCmd, cb: Callable) -> int:
         if not self.is_leader():
             raise NotLeaderError(self.region.id, self.leader_peer())
         if self.merging is not None and (
@@ -239,6 +250,10 @@ class RaftPeer:
         LocalReader + ReadDelegate — applied_term == term guarantees all
         writes acked by previous leaders are in the applied state; writes
         acked by THIS leader were applied before their ack fired)."""
+        with self.mu:
+            return self._local_read_locked()
+
+    def _local_read_locked(self) -> Optional[RegionSnapshot]:
         node = self.node
         if not self.is_leader() or not node.in_lease():
             return None
@@ -251,6 +266,10 @@ class RaftPeer:
 
     def propose_read(self, cb: Callable) -> int:
         """Read barrier through the log (see module docstring)."""
+        with self.mu:
+            return self._propose_read_locked(cb)
+
+    def _propose_read_locked(self, cb: Callable) -> int:
         if not self.is_leader():
             raise NotLeaderError(self.region.id, self.leader_peer())
         index = self.node.propose(b"")
@@ -269,16 +288,42 @@ class RaftPeer:
 
     # ------------------------------------------------------------- ready
 
-    def handle_ready(self) -> list[Message]:
+    def handle_ready(self, async_writer=None,
+                     on_persisted=None) -> list[Message]:
         """Persist, apply, return messages to send.  Reference:
-        handle_raft_ready_append + the apply poller, collapsed."""
+        handle_raft_ready_append + the apply poller, collapsed.
+
+        ``async_writer`` (store/async_io/write.rs): append-only readies
+        (log entries + hard state, no apply, no snapshot) hand their
+        WAL batch to the write-worker pool and return WITHOUT their
+        messages — the append ack must not leave before the fsync.  The
+        pool persists (group-committed across peers) then calls
+        ``on_persisted(rd)`` from a poller-routed context, which sends
+        the messages and advances.  While one async persist is in
+        flight the peer produces no further ready (the _ready_inflight
+        gate), preserving the ready/advance protocol.
+        """
         from ..utils.failpoint import fail_point
         out: list[Message] = []
         while self.node.has_ready():
+            if self._ready_inflight:
+                break       # awaiting the async log write
             from ..utils.metrics import RAFT_READY_COUNTER
             RAFT_READY_COUNTER.inc()
             fail_point("peer::handle_ready")
             rd = self.node.ready()
+            if async_writer is not None and rd.snapshot is None and \
+                    not rd.committed_entries and rd.entries:
+                fail_point("raftlog::before_persist")
+                wb = self.engine.write_batch()
+                meta = self.node.storage.snapshot.metadata
+                self.peer_storage.persist(
+                    wb, rd.entries, rd.hard_state,
+                    truncated=(meta.index, meta.term))
+                self._ready_inflight = True
+                async_writer.submit(
+                    wb, lambda rd=rd: on_persisted(self.region.id, rd))
+                break
             wb = self.engine.write_batch()
             if rd.snapshot is not None:
                 fail_point("snapshot::before_apply")
@@ -330,6 +375,14 @@ class RaftPeer:
             self.store.coprocessor_host.notify_role_change(
                 self.region.id, role)
         return out
+
+    def on_log_persisted(self, rd) -> list[Message]:
+        """Async-IO completion: the log batch hit disk — now the acks
+        may leave and the ready advances (write.rs persisted callback).
+        Runs serialized with other peer work (poller mailbox)."""
+        self._ready_inflight = False
+        self.node.advance(rd)
+        return list(rd.messages)
 
     # ------------------------------------------------------------- apply
 

@@ -49,8 +49,10 @@ class RaftKv:
 
     def snapshot(self, ctx: SnapContext):
         peer = self._route(ctx)
-        # lease fast path (LocalReader): no proposal, no log barrier
-        if self._lock is not None:
+        # lease fast path (LocalReader): no proposal, no log barrier.
+        # local_read serializes on the peer mutex; the extra node lock
+        # covers the synchronous drive mode where pollers don't exist
+        if self._lock is not None and not self.store.pooled():
             with self._lock:
                 snap = peer.local_read()
         else:
@@ -60,7 +62,13 @@ class RaftKv:
             return snap
         self.barrier_reads += 1
         box: dict = {}
-        peer.propose_read(lambda r: box.__setitem__("result", r))
+        if self.store.pooled():
+            if not self.store._route_peer_msg(
+                    peer.region.id,
+                    ("read", lambda r: box.__setitem__("result", r))):
+                raise NotLeaderError(peer.region.id)    # mailbox gone
+        else:
+            peer.propose_read(lambda r: box.__setitem__("result", r))
         self._wait(box)
         return box["result"]
 
@@ -75,7 +83,16 @@ class RaftKv:
                 ops.append(WriteOp("delete", cf, key))
         cmd = RaftCmd(peer.region.id, peer.region.epoch, tuple(ops))
         box: dict = {}
-        peer.propose(cmd, lambda r: box.__setitem__("result", r))
+        if self.store.pooled():
+            # proposals ride the mailbox: the peer's poller serializes
+            # them with ready handling (fsm/peer.rs PeerMsg::RaftCommand)
+            if not self.store._route_peer_msg(
+                    peer.region.id,
+                    ("cmd", cmd,
+                     lambda r: box.__setitem__("result", r))):
+                raise NotLeaderError(peer.region.id)    # mailbox gone
+        else:
+            peer.propose(cmd, lambda r: box.__setitem__("result", r))
         self._wait(box)
 
     def kv_engine(self):

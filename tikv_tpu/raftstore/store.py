@@ -65,6 +65,10 @@ class RaftStore:
         # (coprocessor/mod.rs:98-594)
         from .observer import CoprocessorHost
         self.coprocessor_host = CoprocessorHost()
+        # guards self.peers mutations: pooled-mode pollers create/destroy
+        # peers (split/merge/conf-change) while other threads iterate
+        import threading as _threading
+        self.meta_mu = _threading.Lock()
 
     # ------------------------------------------------------------- lifecycle
 
@@ -103,8 +107,14 @@ class RaftStore:
                   initial: bool = False) -> RaftPeer:
         peer = RaftPeer(self, region, meta, self.engine, initial=initial,
                         **self._raft_cfg)
-        self.peers[region.id] = peer
+        with self.meta_mu:
+            self.peers[region.id] = peer
         return peer
+
+    def peers_snapshot(self) -> list:
+        """Stable peer list for iteration from any thread."""
+        with self.meta_mu:
+            return list(self.peers.values())
 
     def create_split_peer(self, wb, right: Region,
                           was_leader: bool) -> None:
@@ -115,13 +125,19 @@ class RaftStore:
         peer = self._add_peer(right, meta, initial=True)
         peer.peer_storage.write_initial_state(wb)
         peer.peer_storage.persist_region(wb, right)
+        if self.pooled():
+            self.router.register(right.id)
         if was_leader:
             # the parent's leader store campaigns the new region at once
             # so it gets a leader without waiting an election timeout
-            self._campaign_on_create.add(right.id)
+            if self.pooled():
+                self.router.send(right.id, ("campaign",))
+            else:
+                self._campaign_on_create.add(right.id)
 
     def destroy_peer(self, region_id: int) -> None:
-        peer = self.peers.pop(region_id, None)
+        with self.meta_mu:
+            peer = self.peers.pop(region_id, None)
         if peer is not None:
             wb = self.engine.write_batch()
             peer.peer_storage.destroy(wb)
@@ -136,7 +152,7 @@ class RaftStore:
         return peer
 
     def peer_by_key(self, key: bytes) -> RaftPeer:
-        for peer in self.peers.values():
+        for peer in self.peers_snapshot():
             if peer.region.contains(key):
                 return peer
         raise RegionNotFound(-1)
@@ -152,6 +168,18 @@ class RaftStore:
 
     def on_raft_message(self, region_id: int, to_peer: PeerMeta,
                         from_peer: PeerMeta, msg: Message) -> None:
+        if self.pooled():
+            if region_id not in self.peers and \
+                    msg.msg_type in (MsgType.APPEND, MsgType.HEARTBEAT,
+                                     MsgType.SNAPSHOT):
+                # shell creation needs the store meta; do it inline then
+                # route the message through the new mailbox
+                region = Region(region_id, peers=())
+                self._add_peer(region, to_peer)
+                self.router.register(region_id)
+            self._route_peer_msg(region_id,
+                                 ("raft", to_peer, from_peer, msg))
+            return
         peer = self.peers.get(region_id)
         if peer is None:
             # a message for a peer we don't have yet (add-peer or slow
@@ -174,15 +202,113 @@ class RaftStore:
         peer.peer_cache[from_peer.id] = from_peer
         peer.step(msg)
 
+    # --------------------------------------------------- pooled driving
+    #
+    # The batch-system mode (components/batch-system): each peer is an
+    # FSM with a mailbox; a poller pool drains them with reschedule
+    # fairness; append-only readies persist on the async write pool
+    # (group-committed fsyncs).  The synchronous drive() below remains
+    # the in-process fixture's deterministic single-threaded mode —
+    # the reference keeps both shapes too (test_raftstore's node
+    # simulator vs the real poll loops).
+
+    def start_pool(self, n_pollers: int = 2, n_writers: int = 1) -> None:
+        from .batch_system import PollerPool, Router, WriteWorkerPool
+        self.router = Router()
+        self.write_pool = WriteWorkerPool(self.engine, n_writers)
+        for region_id in self.peers:
+            self.router.register(region_id)
+        self._pool = PollerPool(self.router, self._handle_fsm,
+                                name=f"store-{self.store_id}")
+        self._pool.spawn(n_pollers)
+
+    def stop_pool(self) -> None:
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown()
+            self.write_pool.shutdown()
+            self._pool = None
+
+    def pooled(self) -> bool:
+        return getattr(self, "_pool", None) is not None
+
+    def _route_peer_msg(self, region_id: int, msg) -> bool:
+        return self.router.send(region_id, msg)
+
+    def _handle_fsm(self, region_id: int, msgs) -> None:
+        """Poller handler: one peer's message batch (mailbox held)."""
+        peer = self.peers.get(region_id)
+        if peer is None:
+            return
+        with peer.mu:
+            self._handle_fsm_locked(peer, region_id, msgs)
+
+    def _handle_fsm_locked(self, peer, region_id: int, msgs) -> None:
+        for m in msgs:
+            kind = m[0]
+            try:
+                if kind == "raft":
+                    _k, to_peer, from_peer, rmsg = m
+                    if to_peer.id == peer.meta.id:
+                        peer.peer_cache[from_peer.id] = from_peer
+                        peer.step(rmsg)
+                elif kind == "cmd":
+                    _k, cmd, cb = m
+                    try:
+                        peer.propose(cmd, cb)
+                    except Exception as e:      # noqa: BLE001
+                        cb(e)
+                elif kind == "read":
+                    _k, cb = m
+                    try:
+                        peer.propose_read(cb)
+                    except Exception as e:      # noqa: BLE001
+                        cb(e)
+                elif kind == "tick":
+                    peer.tick()
+                elif kind == "campaign":
+                    peer.node.campaign(force=True)
+                elif kind == "persisted":
+                    _k, rd = m
+                    self._send_all(peer, peer.on_log_persisted(rd))
+            except Exception:   # noqa: BLE001 — one bad msg, not the fsm
+                pass
+        self._send_all(peer, peer.handle_ready(
+            async_writer=self.write_pool,
+            on_persisted=self._on_persisted))
+        if peer.pending_destroy:
+            self.destroy_peer(region_id)
+            self.router.close(region_id)
+        self.transport.flush()
+
+    def _on_persisted(self, region_id: int, rd) -> None:
+        # runs on a writer thread: route back through the mailbox so the
+        # advance happens under the FSM invariant
+        self.router.send(region_id, ("persisted", rd))
+
+    def _send_all(self, peer: RaftPeer, msgs) -> None:
+        for msg in msgs:
+            target = self._peer_meta(peer.region, msg.to) or \
+                peer.peer_cache.get(msg.to)
+            if target is None:
+                continue
+            self.transport.send(target.store_id, peer.region.id, target,
+                                peer.meta, msg)
+
     # ------------------------------------------------------------- driving
 
     def tick(self) -> None:
-        for peer in list(self.peers.values()):
+        if self.pooled():
+            self.router.broadcast(("tick",))
+            return
+        for peer in self.peers_snapshot():
             peer.tick()
 
     def drive(self) -> int:
         """Handle all pending ready work; send messages.  Returns the
         number of messages sent (0 = quiescent)."""
+        if self.pooled():
+            return 0        # the poller pool owns peer processing
         sent = 0
         for region_id in list(self.peers):
             peer = self.peers.get(region_id)
@@ -281,7 +407,7 @@ class RaftStore:
         if threshold <= 0:
             return 0
         proposed = 0
-        for peer in list(self.peers.values()):
+        for peer in self.peers_snapshot():
             if not peer.is_leader() or peer.merging is not None:
                 continue
             size, entries = self._scan_region(peer)

@@ -228,12 +228,15 @@ class Node:
         self.storage = Storage(
             engine=self.raft_kv,
             lock_manager=LockManager(detector=_DetectorProxy(self)))
-        # §2.6 observers: resolved-ts + CDC tap the apply path
+        # §2.6 observers: CDC registers BEFORE resolved-ts so a commit
+        # event is enqueued while the lock still pins the watermark —
+        # the reverse order can publish a resolved_ts covering an event
+        # that has not reached any subscriber queue yet
         from ..cdc import CdcObserver, ResolvedTsObserver
         self.resolved_ts = ResolvedTsObserver()
         self.cdc = CdcObserver()
-        self.raft_store.coprocessor_host.register(self.resolved_ts)
         self.raft_store.coprocessor_host.register(self.cdc)
+        self.raft_store.coprocessor_host.register(self.resolved_ts)
         from .read_pool import ReadPool
         self.read_pool = ReadPool(
             max_concurrency=config.readpool.concurrency)
@@ -271,6 +274,13 @@ class Node:
 
     def start(self) -> None:
         self.bootstrap_or_join()
+        pool = self.config.raftstore.store_pool_size
+        if pool > 0:
+            # batch-system mode: pollers own peer processing + async
+            # raft-log writers; the drive thread degrades to the tick /
+            # heartbeat / split-check pacemaker
+            self.raft_store.start_pool(
+                pool, max(1, self.config.raftstore.store_io_pool_size))
         self._thread = threading.Thread(target=self._drive_loop,
                                         daemon=True, name="raft-drive")
         self._thread.start()
@@ -279,6 +289,7 @@ class Node:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self.raft_store.stop_pool()
 
     def _drive_loop(self) -> None:
         last_tick = time.monotonic()
@@ -333,6 +344,13 @@ class Node:
     def _wait_driver(self, done) -> None:
         """RaftKv blocks here while the drive thread makes progress."""
         deadline = time.monotonic() + 10.0
+        if self.raft_store.pooled():
+            # pollers complete the callback; just wait for it
+            while not done():
+                if time.monotonic() > deadline:
+                    raise TimeoutError("raft command stalled")
+                time.sleep(0.002)
+            return
         with self.lock:
             self.raft_store.drive()
             while not done():
