@@ -194,3 +194,74 @@ def test_grpc_and_copr_metrics_instrumented():
         assert m.RAFT_PROPOSE_COUNTER.labels("write").value > pbefore
     finally:
         node.stop()
+
+
+# ------------------------------------------------- error codes / health
+
+def test_error_codes_ride_the_wire():
+    from tikv_tpu.server import wire
+    from tikv_tpu.raftstore.metapb import NotLeaderError
+    from tikv_tpu.storage.mvcc.errors import WriteConflict
+
+    assert wire.enc_error(NotLeaderError(7))["code"] == \
+        "KV:Raftstore:NotLeader"
+    assert wire.enc_error(
+        WriteConflict(b"k", 1, 2, 3))["code"] == "KV:Storage:WriteConflict"
+    assert wire.enc_error(RuntimeError("x"))["code"] == "KV:Unknown"
+    from tikv_tpu.utils.error_code import spec
+    manifest = spec()
+    assert {"name": "KeyIsLocked",
+            "code": "KV:Storage:KeyIsLocked"} in manifest
+
+
+def test_log_redaction():
+    from tikv_tpu.utils import log_redact as lr
+    lr.set_redact(True)
+    assert b"secret" not in lr.redact_key(b"secret-key").encode()
+    assert lr.redact_value(b"secret") == "?"
+    # correlatable: same key -> same digest
+    assert lr.redact_key(b"k1") == lr.redact_key(b"k1")
+    assert lr.redact_key(b"k1") != lr.redact_key(b"k2")
+    lr.set_redact(False)
+    assert "secret" in lr.redact_key(b"secret-key")
+    lr.set_redact(True)
+
+
+def test_slow_score_rises_and_decays():
+    from tikv_tpu.utils.health import HealthController, SlowScore
+    s = SlowScore(timeout_s=0.1, window=8)
+    for _ in range(8):
+        s.record(0.5)               # every inspection times out
+    assert s.score > 5.0
+    assert not s.healthy() or s.score < 10.0
+    high = s.score
+    for _ in range(80):
+        s.record(0.001)             # healthy again: linear decay
+    assert s.score < high
+    assert s.score >= 1.0
+    h = HealthController()
+    h.record_write(0.01)
+    st = h.stats()
+    assert set(st) == {"slow_score", "slow_trend", "healthy"}
+
+
+def test_health_in_status_and_pd_heartbeat():
+    from tikv_tpu.pd import MockPd
+    from tikv_tpu.server.node import Node
+    import time as _t
+
+    pd = MockPd()
+    node = Node("test:0", pd)
+    node.start()
+    try:
+        from tikv_tpu.server.service import KvService
+        svc = KvService(node)
+        svc.handle("RawPut", {"key": b"hk", "value": b"hv"})
+        st = node.status()
+        assert "slow_score" in st["health"]
+        deadline = _t.time() + 3
+        while _t.time() < deadline and node.store_id not in pd.store_stats:
+            _t.sleep(0.05)
+        assert "slow_score" in pd.store_stats.get(node.store_id, {})
+    finally:
+        node.stop()

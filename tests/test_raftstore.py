@@ -429,3 +429,36 @@ def test_txn_storage_over_raft_cluster():
         from tikv_tpu.raftstore.peer_storage import data_key
         it = c.engines[sid].iterator_cf(CF_WRITE)
         assert it.seek_to_first()       # at least one write record
+
+
+def test_replica_read_serves_from_follower():
+    """Follower reads via ReadIndex (SURVEY §2.8.4): consistent at the
+    leader's commit point without touching the leader's read path."""
+    from tikv_tpu.kv.engine import SnapContext
+    from tikv_tpu.testing.cluster import Cluster
+
+    c = Cluster(3)
+    c.bootstrap()
+    c.start()
+    c.must_put(b"rr-k", b"v1")
+    leader_sid = c.leader_store(1)
+    follower_sid = [s for s in c.stores if s != leader_sid][0]
+    fkv = c.kvs[follower_sid]
+    assert not c.stores[follower_sid].peers[1].is_leader()
+    before = c.kvs[leader_sid].lease_reads + c.kvs[leader_sid].barrier_reads
+    snap = fkv.snapshot(SnapContext(region_id=1, replica_read=True))
+    from tikv_tpu.raftstore.peer_storage import data_key
+    assert snap.get_value(b"rr-k") == b"v1"
+    after = c.kvs[leader_sid].lease_reads + c.kvs[leader_sid].barrier_reads
+    assert after == before, "replica read leaked onto the leader's path"
+    # a LAGGING follower must wait for the apply, never serve stale:
+    # block appends to the follower, write, then read via replica path
+    c.transport.filters.append(
+        lambda frm, to, rid, msg: to != follower_sid)
+    c.must_put(b"rr-k", b"v2")
+    c.transport.filters.clear()
+    box = {}
+    c.stores[follower_sid].peers[1].replica_read(
+        lambda r: box.__setitem__("r", r))
+    c._drive_until(lambda: "r" in box)      # catch-up happens here
+    assert box["r"].get_value(b"rr-k") == b"v2"

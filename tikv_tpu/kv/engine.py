@@ -28,6 +28,8 @@ class SnapContext:
     region_id: int = 0
     read_ts: int = 0
     key_hint: bytes = b""
+    # serve from a FOLLOWER via ReadIndex (kvproto Context.replica_read)
+    replica_read: bool = False
 
 
 @dataclass

@@ -85,6 +85,10 @@ class MsgType(Enum):
     SNAPSHOT = auto()
     TRANSFER_LEADER = auto()    # local: admin transfer
     TIMEOUT_NOW = auto()
+    # follower/replica reads (raft §6.4 ReadIndex): a follower asks the
+    # leader for its commit index; serving waits until applied >= it
+    READ_INDEX = auto()
+    READ_INDEX_RESP = auto()
 
 
 @dataclass

@@ -126,6 +126,8 @@ class RawNode:
         self._lease_ack: dict[int, int] = {}
         self._hb_send_mono: dict[int, float] = {}   # send tick -> mono
         self._lease_ack_mono: dict[int, float] = {}  # nid -> mono of ack'd hb
+        # ReadIndex answers: (commit index, ctx) pairs the peer drains
+        self.read_states: list[tuple[int, int]] = []
 
     # ------------------------------------------------------------- helpers
 
@@ -452,9 +454,60 @@ class RawNode:
             MsgType.HEARTBEAT_RESPONSE: self._handle_heartbeat_response,
             MsgType.SNAPSHOT: self._handle_snapshot,
             MsgType.TIMEOUT_NOW: self._handle_timeout_now,
+            MsgType.READ_INDEX: self._handle_read_index,
+            MsgType.READ_INDEX_RESP: self._handle_read_index_resp,
         }.get(m.msg_type)
         if handler is not None:
             handler(m)
+
+    # -- follower reads (raft §6.4 ReadIndex) --
+
+    def request_read_index(self, ctx: int, read_ts: int = 0) -> bool:
+        """Follower/replica read: ask the leader for its commit index;
+        the answer lands in ``read_states``.  ``read_ts`` piggybacks so
+        the leader can bump its concurrency manager's max_ts and veto
+        reads that would race an async-commit prewrite.  Returns False
+        when no leader is known (the peer's tick retries)."""
+        if self.state == LEADER:
+            self._handle_read_index(Message(MsgType.READ_INDEX,
+                                            to=self.id, frm=self.id,
+                                            term=self.term, ctx=ctx,
+                                            index=read_ts))
+            return True
+        if not self.leader_id:
+            return False
+        self._send(Message(MsgType.READ_INDEX, to=self.leader_id,
+                           term=self.term, ctx=ctx, index=read_ts))
+        return True
+
+    def _handle_read_index(self, m: Message) -> None:
+        if self.state != LEADER:
+            return      # stale routing; requester retries
+        # the leader may only answer once it has committed in ITS term
+        # (an old-term commit index could run behind a newer leader)
+        if self.storage.term(self.commit) != self.term:
+            return      # pending noop: requester retries
+        # leadership confirmation (raft §6.4): a deposed leader behind a
+        # partition must NOT answer with its stale commit index — the
+        # quorum-acked lease is the evidence a heartbeat round would
+        # give (the same basis LocalReader uses)
+        if not self.in_lease():
+            return      # requester retries; a live leader re-earns it
+        # async-commit integration hook: the storage layer bumps max_ts
+        # for the piggybacked read_ts and vetoes when an in-flight
+        # prewrite's memory lock covers it (concurrency_manager)
+        hook = getattr(self, "read_index_hook", None)
+        if hook is not None and m.index and not hook(m.index):
+            return      # blocked by a memory lock: requester retries
+        if m.frm == self.id:
+            self.read_states.append((self.commit, m.ctx))
+        else:
+            self._send(Message(MsgType.READ_INDEX_RESP, to=m.frm,
+                               term=self.term, index=self.commit,
+                               ctx=m.ctx))
+
+    def _handle_read_index_resp(self, m: Message) -> None:
+        self.read_states.append((m.index, m.ctx))
 
     # -- elections --
 
@@ -663,7 +716,14 @@ class RawNode:
 
     def advance(self, rd: Ready) -> None:
         if rd.entries:
-            self._stable_index = rd.entries[-1].index
+            # raft-rs stable_to: only raise the stable mark if the log
+            # still holds the SAME entry — a truncation during an
+            # async-IO persist window invalidated this batch, and
+            # blindly advancing would let unpersisted replacement
+            # entries skip their WAL write
+            last = rd.entries[-1]
+            if self.storage.term(last.index) == last.term:
+                self._stable_index = max(self._stable_index, last.index)
         if rd.committed_entries:
             self.applied = rd.committed_entries[-1].index
         if rd.hard_state is not None:

@@ -181,14 +181,23 @@ class WriteWorkerPool:
         self._q: "queue.Queue" = queue.Queue()
         self._threads = []
         self._stop = threading.Event()
+        # a failed log write poisons the pool: peers fall back to the
+        # synchronous persist path where the error surfaces per-FSM
+        # instead of stranding _ready_inflight gates forever
+        self.failed = False
         for i in range(n_workers):
             t = threading.Thread(target=self._run, daemon=True,
                                  name=f"raftlog-writer-{i}")
             t.start()
             self._threads.append(t)
 
-    def submit(self, wb, on_persisted: Callable) -> None:
-        self._q.put((wb, on_persisted))
+    def submit(self, wb, on_persisted: Callable,
+               fail_cb: Optional[Callable] = None) -> None:
+        if self.failed:
+            if fail_cb is not None:
+                fail_cb()
+            return
+        self._q.put((wb, on_persisted, fail_cb))
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -204,22 +213,29 @@ class WriteWorkerPool:
                     break
             # group commit: one engine write (one fsync) for the batch
             merged = self._engine.write_batch()
-            for wb, _cb in batch:
+            for wb, _cb, _fail in batch:
                 merged._ops.extend(wb._ops)
             try:
                 if not merged.is_empty():
                     self._engine.write(merged)
             except Exception:
-                # a failed raft-log write is unrecoverable — unpersisted
-                # entries must never be acked; the reference panics the
-                # process here (write.rs).  Log loudly and let the
-                # worker die rather than continue on a broken log.
+                # a failed raft-log write means NOTHING in this batch
+                # may be acked (the reference panics here, write.rs);
+                # poison the pool and tell each peer so its inflight
+                # gate clears and the sync path surfaces the error
                 import logging
                 logging.getLogger(__name__).critical(
-                    "raft-log write failed; store cannot continue",
+                    "raft-log write failed; async IO disabled",
                     exc_info=True)
-                raise
-            for _wb, cb in batch:
+                self.failed = True
+                for _wb, _cb, fail_cb in batch:
+                    if fail_cb is not None:
+                        try:
+                            fail_cb()
+                        except Exception:   # noqa: BLE001
+                            pass
+                continue
+            for _wb, cb, _fail in batch:
                 try:
                     cb()
                 except Exception:   # noqa: BLE001 — peer callbacks

@@ -185,6 +185,12 @@ class RaftPeer:
         self._last_role = False
         # an async raft-log write is in flight (batch_system write pool)
         self._ready_inflight = False
+        # replica reads (ReadIndex): ctx -> (cb, read_ts, age), plus
+        # reads whose commit index the leader confirmed but we have not
+        # applied up to yet
+        self._replica_reads: dict[int, list] = {}
+        self._replica_read_ctx = 0
+        self._replica_waiting: list = []    # (index, cb)
 
     # ------------------------------------------------------------- props
 
@@ -264,6 +270,43 @@ class RaftPeer:
         snap.apply_index = node.applied
         return snap
 
+    def replica_read(self, cb: Callable, read_ts: int = 0) -> None:
+        """Follower/replica read (store read parallelism, SURVEY §2.8.4;
+        reference: test_replica_read.rs flow over raft ReadIndex).  The
+        snapshot is served once this peer has applied up to the commit
+        index the LEADER confirmed — same consistency as a leader
+        lease read, no leader load.  Dropped requests (no leader yet,
+        leader lease pending, message loss) are re-sent from tick() and
+        expire after ~2 election timeouts."""
+        with self.mu:
+            self._replica_read_ctx += 1
+            ctx = self._replica_read_ctx
+            self._replica_reads[ctx] = [cb, read_ts, 0]
+            self.node.request_read_index(ctx, read_ts)
+
+    def _serve_replica_reads(self) -> None:
+        """Drain ReadIndex answers + reads unblocked by new applies."""
+        node = self.node
+        if node.read_states:
+            states, node.read_states = node.read_states, []
+            for index, ctx in states:
+                ent = self._replica_reads.pop(ctx, None)
+                if ent is not None:
+                    self._replica_waiting.append((index, ent[0]))
+        if not self._replica_waiting:
+            return
+        still = []
+        for index, cb in self._replica_waiting:
+            if node.applied >= index:
+                snap = RegionSnapshot(self.engine.snapshot(),
+                                      self.region)
+                snap.data_index = self.data_index
+                snap.apply_index = node.applied
+                cb(snap)
+            else:
+                still.append((index, cb))
+        self._replica_waiting = still
+
     def propose_read(self, cb: Callable) -> int:
         """Read barrier through the log (see module docstring)."""
         with self.mu:
@@ -288,8 +331,8 @@ class RaftPeer:
 
     # ------------------------------------------------------------- ready
 
-    def handle_ready(self, async_writer=None,
-                     on_persisted=None) -> list[Message]:
+    def handle_ready(self, async_writer=None, on_persisted=None,
+                     on_persist_failed=None) -> list[Message]:
         """Persist, apply, return messages to send.  Reference:
         handle_raft_ready_append + the apply poller, collapsed.
 
@@ -312,7 +355,9 @@ class RaftPeer:
             RAFT_READY_COUNTER.inc()
             fail_point("peer::handle_ready")
             rd = self.node.ready()
-            if async_writer is not None and rd.snapshot is None and \
+            if async_writer is not None and \
+                    not getattr(async_writer, "failed", False) and \
+                    rd.snapshot is None and \
                     not rd.committed_entries and rd.entries:
                 fail_point("raftlog::before_persist")
                 wb = self.engine.write_batch()
@@ -322,7 +367,9 @@ class RaftPeer:
                     truncated=(meta.index, meta.term))
                 self._ready_inflight = True
                 async_writer.submit(
-                    wb, lambda rd=rd: on_persisted(self.region.id, rd))
+                    wb, lambda rd=rd: on_persisted(self.region.id, rd),
+                    fail_cb=(None if on_persist_failed is None else
+                             (lambda: on_persist_failed(self.region.id))))
                 break
             wb = self.engine.write_batch()
             if rd.snapshot is not None:
@@ -369,6 +416,7 @@ class RaftPeer:
                 self._pending_obs.clear()
             out.extend(rd.messages)
             self.node.advance(rd)
+        self._serve_replica_reads()
         role = self.is_leader()
         if role != self._last_role:
             self._last_role = role
@@ -626,3 +674,20 @@ class RaftPeer:
 
     def tick(self) -> None:
         self.node.tick()
+        if self._replica_reads:
+            self._retry_replica_reads()
+
+    def _retry_replica_reads(self) -> None:
+        """Re-send pending ReadIndex requests (dropped request, leader
+        without a lease yet, election churn) and expire hopeless ones."""
+        expire_at = 4 * self.node._election_tick
+        dead = []
+        for ctx, ent in self._replica_reads.items():
+            ent[2] += 1
+            if ent[2] >= expire_at:
+                dead.append(ctx)
+            elif ent[2] % 2 == 0:
+                self.node.request_read_index(ctx, ent[1])
+        for ctx in dead:
+            cb, _ts, _age = self._replica_reads.pop(ctx)
+            cb(NotLeaderError(self.region.id, self.leader_peer()))

@@ -21,7 +21,7 @@ from .store import RaftStore
 class RaftKv:
     def __init__(self, store: RaftStore,
                  driver: Optional[Callable[[Callable[[], bool]], None]] = None,
-                 lock=None):
+                 lock=None, latency_inspector=None):
         self.store = store
         self._driver = driver if driver is not None else self._local_drive
         # serializes lease reads against the apply loop so the engine
@@ -29,6 +29,9 @@ class RaftKv:
         self._lock = lock
         self.lease_reads = 0
         self.barrier_reads = 0
+        # write-path latency inspector feeding the health controller's
+        # slow score (store/async_io/write.rs:24 LatencyInspector)
+        self._latency_inspector = latency_inspector
 
     def _local_drive(self, done: Callable[[], bool]) -> None:
         for _ in range(10000):
@@ -49,6 +52,21 @@ class RaftKv:
 
     def snapshot(self, ctx: SnapContext):
         peer = self._route(ctx)
+        if ctx.replica_read and not peer.is_leader():
+            # follower read via ReadIndex (SURVEY §2.8.4): consistent at
+            # the leader's commit point, zero leader load.  In the
+            # synchronous drive mode registration must hold the node
+            # lock — the drive thread touches the same read state
+            # without peer.mu there.
+            box: dict = {}
+            cb = lambda r: box.__setitem__("result", r)  # noqa: E731
+            if self._lock is not None and not self.store.pooled():
+                with self._lock:
+                    peer.replica_read(cb, ctx.read_ts)
+            else:
+                peer.replica_read(cb, ctx.read_ts)
+            self._wait(box)
+            return box["result"]
         # lease fast path (LocalReader): no proposal, no log barrier.
         # local_read serializes on the peer mutex; the extra node lock
         # covers the synchronous drive mode where pollers don't exist
@@ -82,6 +100,8 @@ class RaftKv:
             else:
                 ops.append(WriteOp("delete", cf, key))
         cmd = RaftCmd(peer.region.id, peer.region.epoch, tuple(ops))
+        import time as _time
+        t0 = _time.perf_counter()
         box: dict = {}
         if self.store.pooled():
             # proposals ride the mailbox: the peer's poller serializes
@@ -93,7 +113,11 @@ class RaftKv:
                 raise NotLeaderError(peer.region.id)    # mailbox gone
         else:
             peer.propose(cmd, lambda r: box.__setitem__("result", r))
-        self._wait(box)
+        try:
+            self._wait(box)
+        finally:
+            if self._latency_inspector is not None:
+                self._latency_inspector(_time.perf_counter() - t0)
 
     def kv_engine(self):
         return self.store.engine

@@ -103,10 +103,15 @@ class RaftStore:
         peer.peer_storage.persist_region(wb, region)
         self.engine.write(wb)
 
+    # set by the node: leader-side async-commit check for ReadIndex
+    read_index_hook = None
+
     def _add_peer(self, region: Region, meta: PeerMeta,
                   initial: bool = False) -> RaftPeer:
         peer = RaftPeer(self, region, meta, self.engine, initial=initial,
                         **self._raft_cfg)
+        if self.read_index_hook is not None:
+            peer.node.read_index_hook = self.read_index_hook
         with self.meta_mu:
             self.peers[region.id] = peer
         return peer
@@ -172,11 +177,19 @@ class RaftStore:
             if region_id not in self.peers and \
                     msg.msg_type in (MsgType.APPEND, MsgType.HEARTBEAT,
                                      MsgType.SNAPSHOT):
-                # shell creation needs the store meta; do it inline then
-                # route the message through the new mailbox
-                region = Region(region_id, peers=())
-                self._add_peer(region, to_peer)
-                self.router.register(region_id)
+                # shell creation is check-then-act from concurrent
+                # transport threads: atomic under meta_mu, or two
+                # racers would clobber each other's peer + mailbox
+                with self.meta_mu:
+                    if region_id not in self.peers:
+                        region = Region(region_id, peers=())
+                        peer = RaftPeer(self, region, to_peer,
+                                        self.engine, **self._raft_cfg)
+                        if self.read_index_hook is not None:
+                            peer.node.read_index_hook = \
+                                self.read_index_hook
+                        self.peers[region_id] = peer
+                        self.router.register(region_id)
             self._route_peer_msg(region_id,
                                  ("raft", to_peer, from_peer, msg))
             return
@@ -271,11 +284,17 @@ class RaftStore:
                 elif kind == "persisted":
                     _k, rd = m
                     self._send_all(peer, peer.on_log_persisted(rd))
+                elif kind == "persist_failed":
+                    # async log write failed: clear the gate so the next
+                    # ready retries the persist synchronously, where the
+                    # engine error surfaces per-FSM
+                    peer._ready_inflight = False
             except Exception:   # noqa: BLE001 — one bad msg, not the fsm
                 pass
         self._send_all(peer, peer.handle_ready(
             async_writer=self.write_pool,
-            on_persisted=self._on_persisted))
+            on_persisted=self._on_persisted,
+            on_persist_failed=self._on_persist_failed))
         if peer.pending_destroy:
             self.destroy_peer(region_id)
             self.router.close(region_id)
@@ -285,6 +304,9 @@ class RaftStore:
         # runs on a writer thread: route back through the mailbox so the
         # advance happens under the FSM invariant
         self.router.send(region_id, ("persisted", rd))
+
+    def _on_persist_failed(self, region_id: int) -> None:
+        self.router.send(region_id, ("persist_failed",))
 
     def _send_all(self, peer: RaftPeer, msgs) -> None:
         for msg in msgs:

@@ -221,6 +221,20 @@ class TxnClient:
                 raise
         raise TxnError(f"unresolved lock on {key!r}")
 
+    def replica_get(self, key: bytes,
+                    version: Optional[int] = None) -> Optional[bytes]:
+        """Read from a FOLLOWER replica (replica_read) — consistent at
+        the leader's commit point, spreading read load off leaders."""
+        ts = version if version is not None else self.tso()
+        region, leader = self._lookup_region(key)
+        followers = [p for p in region.peers
+                     if leader is None or p.store_id != leader.store_id]
+        target = followers[0] if followers else leader
+        client = self._store_client(target.store_id)
+        r = client.call("KvGet", {"key": key, "version": ts,
+                                  "replica_read": True})
+        return r.get("value")
+
     def put(self, key: bytes, value: bytes) -> None:
         self.txn_write([("put", key, value)])
 

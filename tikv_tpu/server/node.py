@@ -222,12 +222,21 @@ class Node:
         # gc) so online raftstore changes take effect without restart
         self.raft_store.config = config.raftstore
         self.raft_store.observers = [self._report_region]
+        from ..utils.health import HealthController
+        self.health = HealthController()
         self.raft_kv = RaftKv(self.raft_store, driver=self._wait_driver,
-                              lock=self.lock)
+                              lock=self.lock,
+                              latency_inspector=self.health.record_write)
         from ..storage.lock_manager import LockManager
         self.storage = Storage(
             engine=self.raft_kv,
             lock_manager=LockManager(detector=_DetectorProxy(self)))
+        # async-commit integration for replica reads: a leader answering
+        # ReadIndex bumps max_ts for the piggybacked read_ts and vetoes
+        # while an in-flight prewrite's memory lock covers it
+        self.raft_store.read_index_hook = self._read_index_check
+        for _p in self.raft_store.peers.values():
+            _p.node.read_index_hook = self._read_index_check
         # §2.6 observers: CDC registers BEFORE resolved-ts so a commit
         # event is enqueued while the lock still pins the watermark —
         # the reverse order can publish a resolved_ts covering an event
@@ -254,6 +263,19 @@ class Node:
                 diff["device_row_threshold"]
         if "region_cache_capacity" in diff:
             self.copr_cache._capacity = diff["region_cache_capacity"]
+
+    def _read_index_check(self, read_ts: int) -> bool:
+        """Leader-side async-commit guard for replica reads: bump
+        max_ts, veto while a memory lock covers read_ts (the reference
+        forwards the same through its ReadIndex request)."""
+        from ..storage.mvcc.errors import KeyIsLocked
+        cm = self.storage.concurrency_manager
+        cm.update_max_ts(read_ts)
+        try:
+            cm.read_range_check(None, None, read_ts)
+        except KeyIsLocked:
+            return False
+        return True
 
     # ---------------------------------------------------------- lifecycle
 
@@ -324,8 +346,9 @@ class Node:
                 try:
                     for region, leader in leaders:
                         self.pd.region_heartbeat(region, leader)
-                    self.pd.store_heartbeat(
-                        self.store_id, {"region_count": len(leaders)})
+                    hb = {"region_count": len(leaders)}
+                    hb.update(self.health.stats())
+                    self.pd.store_heartbeat(self.store_id, hb)
                     # advance resolved-ts watermarks with a fresh TSO
                     # (resolved_ts advance worker cadence).  The ts is
                     # registered in the concurrency manager FIRST so any
@@ -557,6 +580,7 @@ class Node:
             return {
                 "store_id": self.store_id,
                 "addr": self.addr,
+                "health": self.health.stats(),
                 "regions": [
                     {"region": wire.enc_region(p.region),
                      "leader": p.is_leader(),

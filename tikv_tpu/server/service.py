@@ -74,7 +74,8 @@ class KvService:
 
     def KvGet(self, req: dict) -> dict:
         v = self.storage.get(req["key"], req["version"],
-                             tuple(req.get("bypass_locks", ())))
+                             tuple(req.get("bypass_locks", ())),
+                             replica_read=req.get("replica_read", False))
         return {"value": v, "not_found": v is None}
 
     def KvBatchGet(self, req: dict) -> dict:

@@ -88,6 +88,13 @@ def dec_raft_msg(d: dict) -> Message:
 # -- errors (kvrpcpb errorpb analog: stable identities over the wire) --
 
 def enc_error(e: Exception) -> dict:
+    d = _enc_error_body(e)
+    from ..utils.error_code import code_of
+    d.setdefault("code", code_of(e))    # stable KV:Subsystem:Name code
+    return d
+
+
+def _enc_error_body(e: Exception) -> dict:
     from ..raftstore.metapb import EpochNotMatch, NotLeaderError
     from ..storage.mvcc.errors import (
         AlreadyExist, Committed, KeyIsLocked, TxnLockNotFound, WriteConflict,

@@ -1,0 +1,54 @@
+"""Stable error codes for every subsystem.
+
+Reference: components/error_code/ — each error type carries a stable
+``KV:Subsystem:Name`` code so operators and tooling can match on
+errors across versions regardless of message wording; the crate also
+generates etc/error_code.toml from the definitions (mirrored by
+``spec()`` here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# subsystem registries: exception class name -> code
+_CODES = {
+    # raftstore
+    "NotLeaderError": "KV:Raftstore:NotLeader",
+    "RegionNotFound": "KV:Raftstore:RegionNotFound",
+    "EpochNotMatch": "KV:Raftstore:EpochNotMatch",
+    "KeyNotInRegion": "KV:Raftstore:KeyNotInRegion",
+    "RegionMerging": "KV:Raftstore:ProposalInMergingMode",
+    # storage / mvcc
+    "KeyIsLocked": "KV:Storage:KeyIsLocked",
+    "WriteConflict": "KV:Storage:WriteConflict",
+    "TxnLockNotFound": "KV:Storage:TxnLockNotFound",
+    "Committed": "KV:Storage:Committed",
+    "AlreadyExist": "KV:Storage:AlreadyExist",
+    "PessimisticLockRolledBack": "KV:Storage:PessimisticLockRolledBack",
+    "Deadlock": "KV:Storage:Deadlock",
+    # server
+    "ServerIsBusy": "KV:Server:IsBusy",
+    "TimeoutError": "KV:Server:Timeout",
+    # engine
+    "CorruptionError": "KV:Engine:Corruption",
+    # coprocessor
+    "NotImplementedError": "KV:Coprocessor:Unsupported",
+}
+
+UNKNOWN = "KV:Unknown"
+
+
+def code_of(e: Exception) -> str:
+    """Stable code for an exception (class-name keyed; subclass-aware)."""
+    for cls in type(e).__mro__:
+        code = _CODES.get(cls.__name__)
+        if code is not None:
+            return code
+    return UNKNOWN
+
+
+def spec() -> list:
+    """The error-code manifest (etc/error_code.toml generation role)."""
+    return sorted(({"name": n, "code": c} for n, c in _CODES.items()),
+                  key=lambda d: d["code"])

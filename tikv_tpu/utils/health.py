@@ -1,0 +1,92 @@
+"""Node health: slow score + slow trend.
+
+Reference: components/health_controller/ — raftstore feeds write-path
+latencies through a ``LatencyInspector``; the slow score (slow_score.rs)
+rises multiplicatively while inspections keep timing out and decays
+linearly while they pass, and PD weighs it in store heartbeats so
+scheduling steers away from a degrading store before it fails outright.
+``SlowTrend`` (trend.rs) compares a short latency window against a long
+one to catch degradation long before absolute thresholds trip.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+class SlowScore:
+    """1.0 (healthy) … 100.0 (dead-slow), the reference's score range.
+
+    ``record(duration_s)``: one write-path inspection.  Durations over
+    ``timeout_s`` count against the store; each evaluation window moves
+    the score up by the observed timeout ratio or decays it by 1.
+    """
+
+    def __init__(self, timeout_s: float = 0.1, window: int = 32):
+        self._timeout_s = timeout_s
+        self._window = window
+        self._mu = threading.Lock()
+        self._n = 0
+        self._n_slow = 0
+        self.score = 1.0
+
+    def record(self, duration_s: float) -> None:
+        with self._mu:
+            self._n += 1
+            if duration_s >= self._timeout_s:
+                self._n_slow += 1
+            if self._n >= self._window:
+                ratio = self._n_slow / self._n
+                if ratio > 0:
+                    # multiplicative rise proportional to timeout ratio
+                    self.score = min(100.0,
+                                     self.score * (1.0 + 9.0 * ratio))
+                else:
+                    self.score = max(1.0, self.score - 1.0)
+                self._n = 0
+                self._n_slow = 0
+
+    def healthy(self) -> bool:
+        return self.score < 10.0
+
+
+class SlowTrend:
+    """Short-window vs long-window latency ratio (trend.rs L1/L2)."""
+
+    def __init__(self, short: int = 16, long: int = 256):
+        self._short: deque = deque(maxlen=short)
+        self._long: deque = deque(maxlen=long)
+        self._mu = threading.Lock()
+
+    def record(self, duration_s: float) -> None:
+        with self._mu:
+            self._short.append(duration_s)
+            self._long.append(duration_s)
+
+    def ratio(self) -> float:
+        """> 1.0 = latency trending up; ~1.0 = steady."""
+        with self._mu:
+            if not self._short or not self._long:
+                return 1.0
+            s = sum(self._short) / len(self._short)
+            l = sum(self._long) / len(self._long)
+            return s / l if l > 0 else 1.0
+
+
+class HealthController:
+    """Store health rollup fed by the write path, reported to PD in
+    store heartbeats (worker/pd.rs) and exposed at /status."""
+
+    def __init__(self, timeout_s: float = 0.1):
+        self.slow_score = SlowScore(timeout_s=timeout_s)
+        self.slow_trend = SlowTrend()
+
+    def record_write(self, duration_s: float) -> None:
+        self.slow_score.record(duration_s)
+        self.slow_trend.record(duration_s)
+
+    def stats(self) -> dict:
+        return {"slow_score": round(self.slow_score.score, 2),
+                "slow_trend": round(self.slow_trend.ratio(), 3),
+                "healthy": self.slow_score.healthy()}

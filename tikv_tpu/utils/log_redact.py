@@ -1,0 +1,29 @@
+"""User-data redaction for logs.
+
+Reference: components/log_wrappers/ — user keys/values must never leak
+into logs verbatim (`log-backup`-safe display): values render as ``?``
+when redaction is on, keys as a hex digest prefix so operators can
+still correlate without seeing data.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+_enabled = True
+
+
+def set_redact(enabled: bool) -> None:
+    global _enabled
+    _enabled = enabled
+
+
+def redact_key(key: bytes) -> str:
+    """Correlatable but non-revealing key rendering."""
+    if not _enabled:
+        return repr(key)
+    return f"key~{hashlib.blake2s(key, digest_size=4).hexdigest()}"
+
+
+def redact_value(_value: bytes) -> str:
+    return "?" if _enabled else repr(_value)
