@@ -197,8 +197,8 @@ def test_kill9_restart_data_intact(tmp_path):
                 p.kill()
 
 
-def test_corrupt_newest_checkpoint_raises(path):
-    """ADVICE r2: a non-.tmp checkpoint is post-fsync-renamed, so a
+def test_corrupt_newest_artifact_raises(path):
+    """ADVICE r2: a non-.tmp run/checkpoint is post-fsync-renamed, so a
     corrupt newest generation is data loss — recovery must refuse to
     silently fall back to an older generation (whose WAL is gone)."""
     import pytest
@@ -207,8 +207,79 @@ def test_corrupt_newest_checkpoint_raises(path):
     for i in range(40):
         e.put_cf(CF_DEFAULT, b"key%04d" % i, b"x" * 32)
     assert e._gen >= 1
-    ck = e._ckpt_path(e._gen)
-    data = open(ck, "rb").read()
-    open(ck, "wb").write(data[:-4])     # chop the footer
+    # newest artifact: the last sorted run if any, else the base
+    target = e._run_path(e._runs[-1]) if e._runs else \
+        e._ckpt_path(e._gen)
+    data = open(target, "rb").read()
+    open(target, "wb").write(data[:-4])     # chop the footer
     with pytest.raises(CorruptionError):
         DiskEngine(path)
+
+
+def test_tiered_runs_flush_deltas_and_compact(path):
+    """LSM tiering: size-triggered flushes write DELTA runs (bounded by
+    changed keys, not total state); past max_runs a compaction folds
+    them into one base; range tombstones order correctly."""
+    e = DiskEngine(path, checkpoint_bytes=1 << 30, max_runs=3)
+    for i in range(20):
+        e.put_cf(CF_DEFAULT, b"a%04d" % i, b"x" * 40)
+    e.flush()
+    run1 = e._runs[-1]
+    sz1 = os.path.getsize(e._run_path(run1))
+    # second flush touches ONE key: its run must be far smaller
+    e.put_cf(CF_DEFAULT, b"a0000", b"y" * 40)
+    e.flush()
+    sz2 = os.path.getsize(e._run_path(e._runs[-1]))
+    assert sz2 < sz1 / 4, (sz1, sz2)
+    # delete_range + rewrite inside it: tombstone-then-put ordering
+    wb = e.write_batch()
+    wb.delete_range_cf(CF_DEFAULT, b"a0000", b"a0005")
+    e.write(wb)
+    e.put_cf(CF_DEFAULT, b"a0002", b"z")
+    e.flush()
+    # drive past max_runs -> compaction produced a base, runs cleared
+    while e._runs:
+        e.put_cf(CF_DEFAULT, b"pad", b"p")
+        e.flush()
+    files = os.listdir(path)
+    assert any(f.startswith("ckpt-") for f in files)
+    assert not any(f.startswith("sst-") for f in files)
+    # recovery over base + (possibly empty) runs reproduces the state
+    e2 = DiskEngine(path)
+    assert e2.get_value_cf(CF_DEFAULT, b"a0002") == b"z"
+    assert e2.get_value_cf(CF_DEFAULT, b"a0000") is None
+    assert e2.get_value_cf(CF_DEFAULT, b"a0001") is None
+    assert e2.get_value_cf(CF_DEFAULT, b"a0007") == b"x" * 40
+
+
+def test_recovery_from_base_plus_runs_without_compaction(path):
+    """Crash with live runs on disk: base -> runs -> WAL replay order."""
+    e = DiskEngine(path, checkpoint_bytes=1 << 30, max_runs=10)
+    e.put_cf(CF_DEFAULT, b"r1", b"v1")
+    e.flush()                           # run 1
+    e.put_cf(CF_DEFAULT, b"r2", b"v2")
+    e.put_cf(CF_DEFAULT, b"r1", b"v1b")
+    e.flush()                           # run 2 overrides r1
+    e.put_cf(CF_DEFAULT, b"r3", b"v3")  # WAL tail only
+    e._wal.close()                      # crash
+    e2 = DiskEngine(path)
+    assert e2.get_value_cf(CF_DEFAULT, b"r1") == b"v1b"
+    assert e2.get_value_cf(CF_DEFAULT, b"r2") == b"v2"
+    assert e2.get_value_cf(CF_DEFAULT, b"r3") == b"v3"
+    assert len(e2._runs) == 2
+
+
+def test_recovered_wal_records_survive_next_flush_crash(path):
+    """Regression (r4 review, confirmed data loss): records recovered
+    from the WAL must re-enter the dirty delta, or the next flush writes
+    a run WITHOUT them and deletes their WAL — the following crash then
+    loses them permanently."""
+    e = DiskEngine(path, checkpoint_bytes=1 << 30)
+    e.put_cf(CF_DEFAULT, b"tail-key", b"tail-val")
+    e._wal.close()                      # crash: key lives only in WAL
+    e2 = DiskEngine(path)
+    assert e2.get_value_cf(CF_DEFAULT, b"tail-key") == b"tail-val"
+    e2.flush()                          # run must CONTAIN the key
+    e2._wal.close()                     # crash again
+    e3 = DiskEngine(path)
+    assert e3.get_value_cf(CF_DEFAULT, b"tail-key") == b"tail-val"

@@ -3,18 +3,27 @@
 Reference roles: components/engine_rocks/src/engine.rs (RocksEngine — the
 persistent KvEngine behind the trait seam, engine_traits/src/engine.rs:13)
 and the raft-log durability contract of engine_traits/src/raft_engine.rs:84.
-The design is RocksDB's memtable+WAL shape with the SST levels collapsed
-to a single full-state checkpoint file (LSM-lite):
+The design is RocksDB's memtable+WAL shape with a two-level tier of
+on-disk artifacts (mini-LSM):
 
 - every committed WriteBatch appends one CRC-framed record to the WAL
   before mutating the in-memory state — crash recovery replays the WAL
-  over the last checkpoint and stops at the first torn/corrupt record;
-- when the WAL exceeds ``checkpoint_bytes`` the engine writes a complete
-  per-CF sorted dump to ``ckpt-<gen+1>.tmp``, fsyncs, atomically renames
-  to ``ckpt-<gen+1>``, starts ``wal-<gen+1>`` and removes older files;
+  over the persisted levels and stops at the first torn/corrupt record;
+- when the WAL exceeds ``checkpoint_bytes`` the engine FLUSHES ONLY THE
+  DELTA since the last flush as a sorted run ``sst-<gen>`` (per-key
+  final ops + range tombstones — the L0 sorted-run role), fsyncs,
+  renames atomically, then starts ``wal-<gen>`` and drops the old WAL;
+- when more than ``max_runs`` runs accumulate, a COMPACTION folds base +
+  runs into one full-state base ``ckpt-<gen>`` (the memtable holds the
+  merged view, so the dump is the merge — RocksDB's tiered L0→L1 shape
+  with the same write-amplification profile: deltas per flush, full
+  rewrite once per ``max_runs`` flushes);
+- recovery = newest base → runs in generation order → WAL tail;
 - reads (point/iterator/snapshot) are identical to MemoryEngine — the
   working set lives in sorted copy-on-write arrays, so the hot read path
-  (MVCC scans feeding the columnar/TPU pipeline) never touches disk.
+  (MVCC scans feeding the columnar/TPU pipeline) never touches disk
+  (the working set is memtable-resident by design; levels bound WRITE
+  amplification and recovery cost, not read memory).
 
 Durability level: ``sync=False`` (default) flushes to the OS page cache
 on every write — state survives process kill (SIGKILL) but not machine
@@ -33,6 +42,8 @@ from .traits import ALL_CFS
 
 _CKPT_MAGIC = b"TKV1CKPT"
 _CKPT_FOOTER = b"CKPTDONE"
+_RUN_MAGIC = b"TKV1RUN1"
+_RUN_FOOTER = b"RUN1DONE"
 _OP_PUT, _OP_DEL, _OP_DELR = 0, 1, 2
 
 
@@ -86,17 +97,23 @@ class DiskEngine(MemoryEngine):
     """KvEngine with WAL + checkpoint durability (see module docstring)."""
 
     def __init__(self, path: str, cfs=ALL_CFS, sync: bool = False,
-                 checkpoint_bytes: int = 16 << 20):
+                 checkpoint_bytes: int = 16 << 20, max_runs: int = 4):
         super().__init__(cfs)
         self.path = path
         self._cf_names = tuple(cfs)
         self._cf_index = {cf: i for i, cf in enumerate(self._cf_names)}
         self._sync = sync
         self._checkpoint_bytes = checkpoint_bytes
+        self._max_runs = max_runs
         os.makedirs(path, exist_ok=True)
         self._gen = 0
         self._wal = None
         self._wal_bytes = 0
+        # delta since the last flush: cf -> {key: ("put", v)|("del",)}
+        # plus range tombstones in arrival order
+        self._dirty: dict = {cf: {} for cf in self._cf_names}
+        self._dirty_ranges: dict = {cf: [] for cf in self._cf_names}
+        self._runs: list[int] = []      # live sst-run generations
         with self._mu:
             self._recover()
 
@@ -105,47 +122,92 @@ class DiskEngine(MemoryEngine):
     def _ckpt_path(self, gen: int) -> str:
         return os.path.join(self.path, f"ckpt-{gen:012d}")
 
+    def _run_path(self, gen: int) -> str:
+        return os.path.join(self.path, f"sst-{gen:012d}")
+
     def _wal_path(self, gen: int) -> str:
         return os.path.join(self.path, f"wal-{gen:012d}")
 
     def _recover(self) -> None:
-        gens = []
+        base_gens, run_gens = [], []
         for name in os.listdir(self.path):
-            if name.startswith("ckpt-") and not name.endswith(".tmp"):
+            if name.endswith(".tmp"):
+                continue
+            if name.startswith("ckpt-"):
                 try:
-                    gens.append(int(name[5:]))
+                    base_gens.append(int(name[5:]))
                 except ValueError:
                     continue
-        if gens:
-            gen = max(gens)
-            # A non-.tmp checkpoint is only ever produced by an atomic
+            elif name.startswith("sst-"):
+                try:
+                    run_gens.append(int(name[4:]))
+                except ValueError:
+                    continue
+        base = max(base_gens) if base_gens else 0
+        if base_gens:
+            # A non-.tmp artifact is only ever produced by an atomic
             # rename after fsync, so a newest-generation file that fails
             # validation is real corruption.  Falling back to an older
             # generation would silently drop every write since it — that
-            # generation's WAL was deleted when this checkpoint was cut
-            # (ADVICE r2).
-            if not self._load_checkpoint(self._ckpt_path(gen)):
+            # generation's WAL was deleted when it was cut (ADVICE r2).
+            if not self._load_checkpoint(self._ckpt_path(base)):
                 raise CorruptionError(
-                    f"newest checkpoint {self._ckpt_path(gen)} is corrupt; "
-                    "refusing to silently recover from an older generation")
-            self._gen = gen
+                    f"newest checkpoint {self._ckpt_path(base)} is "
+                    "corrupt; refusing to silently recover from an "
+                    "older generation")
+            self._gen = base
+        # delta runs above the base, in generation order
+        self._runs = sorted(g for g in run_gens if g > base)
+        for g in self._runs:
+            if not self._apply_run(self._run_path(g)):
+                raise CorruptionError(
+                    f"sorted run {self._run_path(g)} is corrupt; its "
+                    "WAL was already dropped — cannot skip it")
+            self._gen = g
         self._replay_wal(self._wal_path(self._gen))
         self._open_wal(self._wal_path(self._gen), append=True)
-        # sweep files a crash mid-checkpoint may have left behind
+        # sweep files a crash mid-flush/compaction may have left behind
+        keep_runs = set(self._runs)
         for name in os.listdir(self.path):
             full = os.path.join(self.path, name)
             stale = name.endswith(".tmp")
-            for prefix in ("ckpt-", "wal-"):
-                if name.startswith(prefix) and not name.endswith(".tmp"):
-                    try:
-                        stale = int(name[len(prefix):]) < self._gen
-                    except ValueError:
-                        pass
+            if name.startswith("ckpt-") and not stale:
+                try:
+                    stale = int(name[5:]) < base
+                except ValueError:
+                    pass
+            elif name.startswith("sst-") and not stale:
+                try:
+                    stale = int(name[4:]) not in keep_runs
+                except ValueError:
+                    pass
+            elif name.startswith("wal-") and not stale:
+                try:
+                    stale = int(name[4:]) < self._gen
+                except ValueError:
+                    pass
             if stale:
                 try:
                     os.remove(full)
                 except OSError:
                     pass
+
+    def _apply_run(self, path: str) -> bool:
+        """Load one sorted run: range tombstones first, then final
+        per-key ops (the flush wrote them in exactly that order)."""
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return False
+        if not (data.startswith(_RUN_MAGIC) and
+                data.endswith(_RUN_FOOTER)):
+            return False
+        payload = data[len(_RUN_MAGIC):-len(_RUN_FOOTER)]
+        batch = MemoryWriteBatch()
+        batch._ops = _unpack_ops(payload, self._cf_names)
+        self._write_locked(batch)
+        return True
 
     def _load_checkpoint(self, path: str) -> bool:
         try:
@@ -198,6 +260,11 @@ class DiskEngine(MemoryEngine):
                 batch = MemoryWriteBatch()
                 batch._ops = _unpack_ops(payload, self._cf_names)
                 self._write_locked(batch)
+                # replayed records live ONLY in this WAL segment: they
+                # must re-enter the dirty delta or the next flush writes
+                # a run without them and deletes their WAL — silent,
+                # permanent data loss on the following crash
+                self._record_dirty(batch._ops)
                 good = f.tell()
         # drop the torn tail so later appends don't interleave with it
         if os.path.exists(path) and good < os.path.getsize(path):
@@ -236,8 +303,9 @@ class DiskEngine(MemoryEngine):
                 os.fsync(self._wal.fileno())
             self._wal_bytes += 8 + len(payload)
             self._write_locked(batch)
+            self._record_dirty(batch._ops)
             if self._wal_bytes >= self._checkpoint_bytes:
-                self._checkpoint_locked()
+                self._flush_locked()
 
     def put_cf(self, cf: str, key: bytes, value: bytes) -> None:
         wb = MemoryWriteBatch()
@@ -252,15 +320,80 @@ class DiskEngine(MemoryEngine):
     # ------------------------------------------------------------ checkpoint
 
     def flush(self) -> None:
-        """Force a checkpoint (engine_traits MiscExt flush analog)."""
+        """Force a delta flush (engine_traits MiscExt flush analog)."""
         with self._mu:
-            self._checkpoint_locked()
+            self._flush_locked()
 
-    def _checkpoint_locked(self) -> None:
+    def _record_dirty(self, ops) -> None:
+        """Track the delta since the last flush (the next run's body)."""
+        for op in ops:
+            kind = op[0]
+            cf = op[1]
+            if kind == "put":
+                self._dirty[cf][op[2]] = ("put", op[3])
+            elif kind == "del":
+                self._dirty[cf][op[2]] = ("del",)
+            else:
+                s_, e_ = op[2], op[3]
+                # the tombstone applies BEFORE this segment's key ops on
+                # recovery, so keys already dirty in the range collapse
+                # to deletes and later puts still override
+                d = self._dirty[cf]
+                for k in [k for k in d if s_ <= k < e_]:
+                    d[k] = ("del",)
+                self._dirty_ranges[cf].append((s_, e_))
+
+    def _flush_locked(self) -> None:
+        """Write the dirty delta as a sorted run (L0 flush), rotate the
+        WAL, and compact when runs pile up."""
         from ..utils.failpoint import fail_point
         fail_point("ckpt::before_write")
         new_gen = self._gen + 1
-        tmp = self._ckpt_path(new_gen) + ".tmp"
+        tmp = self._run_path(new_gen) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_RUN_MAGIC)
+            for cf in self._cf_names:
+                for s_, e_ in self._dirty_ranges[cf]:
+                    f.write(_pack_op(("delr", cf, s_, e_),
+                                     self._cf_index))
+            for cf in self._cf_names:
+                for k in sorted(self._dirty[cf]):
+                    ent = self._dirty[cf][k]
+                    if ent[0] == "put":
+                        f.write(_pack_op(("put", cf, k, ent[1]),
+                                         self._cf_index))
+                    else:
+                        f.write(_pack_op(("del", cf, k),
+                                         self._cf_index))
+            f.write(_RUN_FOOTER)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, self._run_path(new_gen))
+        self._runs.append(new_gen)
+        for cf in self._cf_names:
+            self._dirty[cf] = {}
+            self._dirty_ranges[cf] = []
+        old_wal, old_gen = self._wal, self._gen
+        self._gen = new_gen
+        self._open_wal(self._wal_path(new_gen), append=False)
+        if old_wal is not None:
+            old_wal.close()
+        try:
+            os.remove(self._wal_path(old_gen))
+        except OSError:
+            pass
+        if len(self._runs) > self._max_runs:
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Fold base + runs into one full-state base (tiered L0→L1
+        compaction).  The memtable IS the merged view of base+runs at
+        this point (the WAL just rotated empty), so the dump is the
+        merge — one full rewrite per ``max_runs`` delta flushes."""
+        from ..utils.failpoint import fail_point
+        fail_point("compact::before_write")
+        gen = self._gen
+        tmp = self._ckpt_path(gen) + ".tmp"
         with open(tmp, "wb") as f:
             f.write(_CKPT_MAGIC)
             f.write(struct.pack(">B", len(self._cf_names)))
@@ -275,17 +408,21 @@ class DiskEngine(MemoryEngine):
             f.write(_CKPT_FOOTER)
             f.flush()
             os.fsync(f.fileno())
-        os.rename(tmp, self._ckpt_path(new_gen))
-        old_wal, old_gen = self._wal, self._gen
-        self._gen = new_gen
-        self._open_wal(self._wal_path(new_gen), append=False)
-        if old_wal is not None:
-            old_wal.close()
-        for p in (self._ckpt_path(old_gen), self._wal_path(old_gen)):
+        os.rename(tmp, self._ckpt_path(gen))
+        # drop everything the new base covers
+        for g in self._runs:
             try:
-                os.remove(p)
+                os.remove(self._run_path(g))
             except OSError:
                 pass
+        self._runs = []
+        for name in os.listdir(self.path):
+            if name.startswith("ckpt-") and not name.endswith(".tmp"):
+                try:
+                    if int(name[5:]) < gen:
+                        os.remove(os.path.join(self.path, name))
+                except (ValueError, OSError):
+                    pass
 
     def close(self) -> None:
         with self._mu:

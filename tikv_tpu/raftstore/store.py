@@ -106,12 +106,22 @@ class RaftStore:
     # set by the node: leader-side async-commit check for ReadIndex
     read_index_hook = None
 
-    def _add_peer(self, region: Region, meta: PeerMeta,
+    def _new_peer(self, region: Region, meta: PeerMeta,
                   initial: bool = False) -> RaftPeer:
+        """THE single peer constructor: every creation path (bootstrap,
+        restart load, split, shell-on-message) flows through here so
+        per-peer wiring (the ReadIndex async-commit hook) exists in one
+        place."""
         peer = RaftPeer(self, region, meta, self.engine, initial=initial,
                         **self._raft_cfg)
         if self.read_index_hook is not None:
-            peer.node.read_index_hook = self.read_index_hook
+            peer.node.read_index_hook = \
+                (lambda ts, p=peer: self.read_index_hook(ts, p.region))
+        return peer
+
+    def _add_peer(self, region: Region, meta: PeerMeta,
+                  initial: bool = False) -> RaftPeer:
+        peer = self._new_peer(region, meta, initial=initial)
         with self.meta_mu:
             self.peers[region.id] = peer
         return peer
@@ -182,12 +192,8 @@ class RaftStore:
                 # racers would clobber each other's peer + mailbox
                 with self.meta_mu:
                     if region_id not in self.peers:
-                        region = Region(region_id, peers=())
-                        peer = RaftPeer(self, region, to_peer,
-                                        self.engine, **self._raft_cfg)
-                        if self.read_index_hook is not None:
-                            peer.node.read_index_hook = \
-                                self.read_index_hook
+                        peer = self._new_peer(Region(region_id,
+                                                     peers=()), to_peer)
                         self.peers[region_id] = peer
                         self.router.register(region_id)
             self._route_peer_msg(region_id,

@@ -235,8 +235,6 @@ class Node:
         # ReadIndex bumps max_ts for the piggybacked read_ts and vetoes
         # while an in-flight prewrite's memory lock covers it
         self.raft_store.read_index_hook = self._read_index_check
-        for _p in self.raft_store.peers.values():
-            _p.node.read_index_hook = self._read_index_check
         # §2.6 observers: CDC registers BEFORE resolved-ts so a commit
         # event is enqueued while the lock still pins the watermark —
         # the reverse order can publish a resolved_ts covering an event
@@ -264,15 +262,17 @@ class Node:
         if "region_cache_capacity" in diff:
             self.copr_cache._capacity = diff["region_cache_capacity"]
 
-    def _read_index_check(self, read_ts: int) -> bool:
+    def _read_index_check(self, read_ts: int, region) -> bool:
         """Leader-side async-commit guard for replica reads: bump
-        max_ts, veto while a memory lock covers read_ts (the reference
-        forwards the same through its ReadIndex request)."""
+        max_ts, veto while a memory lock IN THIS REGION covers read_ts
+        (the reference forwards the same through its ReadIndex request;
+        an unrelated region's in-flight prewrite must not starve the
+        read)."""
         from ..storage.mvcc.errors import KeyIsLocked
         cm = self.storage.concurrency_manager
         cm.update_max_ts(read_ts)
         try:
-            cm.read_range_check(None, None, read_ts)
+            cm.read_region_check(region, read_ts)
         except KeyIsLocked:
             return False
         return True

@@ -95,6 +95,22 @@ class ConcurrencyManager:
                 if r.start <= k < r.end:
                     raise KeyIsLocked(k, lk)
 
+    def read_region_check(self, region, read_ts: int,
+                          bypass_locks=()) -> None:
+        """Scope the memory-lock check to one REGION (replica-read
+        veto): lock keys are raw user keys; region boundaries live in
+        the encoded txn keyspace, so each key encodes for the compare."""
+        if not self._table:
+            return
+        from .txn_types import encode_key
+        with self._mu:
+            items = list(self._table.items())
+        for k, lk in items:
+            if not self._blocks(lk, read_ts, bypass_locks):
+                continue
+            if region.contains(encode_key(k)):
+                raise KeyIsLocked(k, lk)
+
     @staticmethod
     def _blocks(lk: Lock, read_ts: int, bypass_locks) -> bool:
         from .txn_types import LockType

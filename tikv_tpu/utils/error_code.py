@@ -32,8 +32,6 @@ _CODES = {
     "TimeoutError": "KV:Server:Timeout",
     # engine
     "CorruptionError": "KV:Engine:Corruption",
-    # coprocessor
-    "NotImplementedError": "KV:Coprocessor:Unsupported",
 }
 
 UNKNOWN = "KV:Unknown"
