@@ -47,6 +47,43 @@ class ConfChange:
         return ConfChange(ConfChangeType(int(t)), int(n), ctx)
 
 
+@dataclass(frozen=True)
+class ConfChangeV2:
+    """Joint-consensus membership change (raft §6 / raft-rs
+    ConfChangeV2): several changes enter ATOMICALLY via the joint
+    config C_old,new — commits and elections need majorities of BOTH
+    sets until the leave entry retires C_old.
+
+    Wire format: ``2|<leave>|t:n,t:n,...|context`` — the leading "2|"
+    disambiguates from the V1 "<type>:<id>:<ctx>" format in the shared
+    CONF_CHANGE entry type.
+    """
+
+    changes: tuple = ()         # tuple[(ConfChangeType, node_id)]
+    context: bytes = b""
+    leave_joint: bool = False
+
+    def to_bytes(self) -> bytes:
+        body = b",".join(b"%d:%d" % (t.value, n)
+                         for t, n in self.changes)
+        return b"2|%d|%s|%s" % (int(self.leave_joint), body,
+                                self.context)
+
+    @staticmethod
+    def is_v2(data: bytes) -> bool:
+        return data.startswith(b"2|")
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "ConfChangeV2":
+        _tag, leave, body, ctx = b.split(b"|", 3)
+        changes = []
+        if body:
+            for part in body.split(b","):
+                t, n = part.split(b":")
+                changes.append((ConfChangeType(int(t)), int(n)))
+        return ConfChangeV2(tuple(changes), ctx, bool(int(leave)))
+
+
 @dataclass
 class HardState:
     """Durable before any message send (raft paper §5)."""
@@ -62,6 +99,8 @@ class SnapshotMetadata:
     term: int
     voters: tuple = ()
     learners: tuple = ()
+    # non-empty while the config is joint (C_old half of C_old,new)
+    voters_outgoing: tuple = ()
 
 
 @dataclass(frozen=True)

@@ -94,6 +94,11 @@ class RawNode:
         self.commit = hs.commit
         self.voters: set[int] = set(voters)
         self.learners: set[int] = set(learners)
+        # joint consensus (raft §6): non-empty while in C_old,new —
+        # commits and elections then need majorities of BOTH sets
+        init_out = getattr(storage, "initial_outgoing", None)
+        self.voters_outgoing: set[int] = \
+            set(init_out()) if callable(init_out) else set()
 
         self.state = FOLLOWER
         self.leader_id = 0
@@ -153,6 +158,25 @@ class RawNode:
     def _quorum(self) -> int:
         return len(self.voters) // 2 + 1
 
+    def in_joint(self) -> bool:
+        return bool(self.voters_outgoing)
+
+    def all_voters(self) -> set:
+        return self.voters | self.voters_outgoing
+
+    def _majority_of(self, ids: set, granted) -> bool:
+        """``granted(nid) -> bool`` holds for a majority of ``ids``."""
+        if not ids:
+            return True
+        return sum(1 for nid in ids if granted(nid)) >= \
+            len(ids) // 2 + 1
+
+    def _joint_won(self, granted) -> bool:
+        """Joint decision rule: majority of the incoming set AND (while
+        joint) of the outgoing set (raft §6 C_old,new)."""
+        return self._majority_of(self.voters, granted) and \
+            self._majority_of(self.voters_outgoing, granted)
+
     def _send(self, m: Message) -> None:
         m.frm = self.id
         if m.term == 0 and m.msg_type not in (MsgType.PRE_VOTE,):
@@ -194,7 +218,8 @@ class RawNode:
         last = self.last_index()
         self.progress = {
             nid: Progress(match=0, next=last + 1)
-            for nid in self.voters | self.learners if nid != self.id
+            for nid in self.voters | self.voters_outgoing | self.learners
+            if nid != self.id
         }
         self.progress[self.id] = Progress(match=last, next=last + 1,
                                           state=REPLICATE)
@@ -255,17 +280,17 @@ class RawNode:
             mono = self._lease_ack_mono.get(nid)
             return mono is not None and (now - mono) <= max_age
 
-        live = sum(1 for nid in self.voters
-                   if nid == self.id or ack_live(nid))
-        return live >= self._quorum()
+        def live(nid):
+            return nid == self.id or ack_live(nid)
+        return self._joint_won(live)
 
     def campaign(self, force: bool = False) -> None:
         if self._pre_vote and not force:
             self._become_pre_candidate()
-            if self._tally() >= self._quorum():     # single node
+            if self._tally_won():                   # single node
                 self._campaign_real()
                 return
-            for nid in self.voters:
+            for nid in self.all_voters():
                 if nid == self.id:
                     continue
                 self._msgs.append(Message(
@@ -277,19 +302,28 @@ class RawNode:
 
     def _campaign_real(self) -> None:
         self._become_candidate()
-        if self._tally() >= self._quorum():         # single node wins now
+        if self._tally_won():                       # single node wins now
             self._become_leader()
             return
-        for nid in self.voters:
+        for nid in self.all_voters():
             if nid == self.id:
                 continue
             self._send(Message(
                 MsgType.REQUEST_VOTE, to=nid, term=self.term,
                 log_term=self.last_term(), index=self.last_index()))
 
-    def _tally(self) -> int:
-        return sum(1 for nid, granted in self._votes.items()
-                   if granted and nid in self.voters)
+    def _tally_won(self) -> bool:
+        return self._joint_won(
+            lambda nid: self._votes.get(nid, False))
+
+    def _tally_lost(self) -> bool:
+        """A majority of either set rejected: the election cannot win."""
+        def rejected(nid):
+            return nid in self._votes and not self._votes[nid]
+        return (self._majority_of(self.voters, rejected) and
+                bool(self.voters)) or \
+            (bool(self.voters_outgoing) and
+             self._majority_of(self.voters_outgoing, rejected))
 
     # ------------------------------------------------------------- propose
 
@@ -336,9 +370,68 @@ class RawNode:
             self.voters.discard(cc.node_id)
             self.learners.discard(cc.node_id)
             self.progress.pop(cc.node_id, None)
-        self.storage.set_conf(sorted(self.voters), sorted(self.learners))
+        self.storage.set_conf(sorted(self.voters), sorted(self.learners),
+                              sorted(self.voters_outgoing))
         if self.state == LEADER:
             self._maybe_commit()    # quorum may have shrunk
+
+    def propose_conf_change_v2(self, cc2, force: bool = False) -> int:
+        """Propose a joint membership change (raft §6; raft-rs
+        ConfChangeV2).  Same one-in-flight rule as V1; ``force`` is the
+        auto-leave path — the LEAVE is proposed from the ENTER's apply,
+        where the enter is by definition the pending change it
+        supersedes (raft-rs auto transition does the same)."""
+        if self.state != LEADER:
+            raise NotLeader(self.leader_id)
+        if not force and self._pending_conf_index > self.applied:
+            raise ProposalDropped("conf change already in flight")
+        if not cc2.leave_joint and self.in_joint():
+            raise ProposalDropped("already in a joint config")
+        index = self.last_index() + 1
+        self._append_entries([Entry(self.term, index, cc2.to_bytes(),
+                                    EntryType.CONF_CHANGE)])
+        self._pending_conf_index = index
+        self._broadcast_append()
+        self._maybe_commit()
+        return index
+
+    def apply_conf_change_v2(self, cc2) -> None:
+        """Apply an enter-joint or leave-joint entry.
+
+        Enter: outgoing = current voters; the change list produces the
+        incoming set; decisions need BOTH majorities until leave.
+        Leave: outgoing clears; nodes in neither set drop out.
+        """
+        if cc2.leave_joint:
+            gone = self.voters_outgoing - self.voters - self.learners
+            self.voters_outgoing = set()
+            for nid in gone:
+                self.progress.pop(nid, None)
+        else:
+            if self.in_joint():
+                # raft-rs rejects entering a joint config while one is
+                # active — overwriting outgoing would drop the real
+                # C_old and break the both-majority invariant
+                return
+            self.voters_outgoing = set(self.voters)
+            for ctype, nid in cc2.changes:
+                if ctype is ConfChangeType.ADD_NODE:
+                    self.learners.discard(nid)
+                    self.voters.add(nid)
+                elif ctype is ConfChangeType.ADD_LEARNER:
+                    self.voters.discard(nid)
+                    self.learners.add(nid)
+                else:       # REMOVE_NODE
+                    self.voters.discard(nid)
+                    self.learners.discard(nid)
+            if self.state == LEADER:
+                for nid in (self.voters | self.learners) -                         set(self.progress) - {self.id}:
+                    self.progress[nid] = Progress(
+                        match=0, next=self.last_index() + 1)
+        self.storage.set_conf(sorted(self.voters), sorted(self.learners),
+                              sorted(self.voters_outgoing))
+        if self.state == LEADER:
+            self._maybe_commit()
 
     def transfer_leader(self, target: int) -> None:
         self.step(Message(MsgType.TRANSFER_LEADER, to=self.id,
@@ -399,12 +492,21 @@ class RawNode:
                                commit=min(pr.match, self.commit),
                                ctx=self._tick_count))
 
+    def _commit_index_of(self, ids: set) -> int:
+        matches = sorted((self.progress[nid].match for nid in ids
+                          if nid in self.progress), reverse=True)
+        if len(matches) < len(ids) // 2 + 1:
+            return 0
+        return matches[len(ids) // 2]
+
     def _maybe_commit(self) -> bool:
-        matches = sorted((pr.match for nid, pr in self.progress.items()
-                          if nid in self.voters), reverse=True)
-        if not matches:
+        if not self.progress:
             return False
-        n = matches[self._quorum() - 1]
+        n = self._commit_index_of(self.voters)
+        if self.in_joint():
+            # joint rule: an index commits only when BOTH configs'
+            # majorities replicated it (raft §6)
+            n = min(n, self._commit_index_of(self.voters_outgoing))
         if n > self.commit and self.storage.term(n) == self.term:
             self.commit = n
             return True
@@ -527,10 +629,9 @@ class RawNode:
         if self.state != PRE_CANDIDATE:
             return
         self._votes[m.frm] = not m.reject
-        if self._tally() >= self._quorum():
+        if self._tally_won():
             self._campaign_real()
-        elif sum(1 for nid, g in self._votes.items()
-                 if not g and nid in self.voters) >= self._quorum():
+        elif self._tally_lost():
             self._become_follower(self.term, 0)
 
     def _handle_vote(self, m: Message) -> None:
@@ -547,10 +648,9 @@ class RawNode:
         if self.state != CANDIDATE:
             return
         self._votes[m.frm] = not m.reject
-        if self._tally() >= self._quorum():
+        if self._tally_won():
             self._become_leader()
-        elif sum(1 for nid, g in self._votes.items()
-                 if not g and nid in self.voters) >= self._quorum():
+        elif self._tally_lost():
             self._become_follower(self.term, 0)
 
     # -- replication (follower side) --
@@ -609,6 +709,11 @@ class RawNode:
         self.storage.apply_snapshot(m.snapshot)
         self.voters = set(meta.voters)
         self.learners = set(meta.learners)
+        # a snapshot generated mid-joint carries C_old: the receiver
+        # must enforce both majorities too, or it could elect itself on
+        # an incoming-only majority (split brain in the joint window)
+        self.voters_outgoing = set(
+            getattr(meta, "voters_outgoing", ()))
         self.commit = meta.index
         self.applied = meta.index
         self._stable_index = meta.index

@@ -27,6 +27,9 @@ class MemoryRaftStorage:
         meta = self.snapshot.metadata
         return self.hard_state, meta.voters, meta.learners
 
+    def initial_outgoing(self) -> tuple:
+        return getattr(self.snapshot.metadata, "voters_outgoing", ())
+
     def first_index(self) -> int:
         return self.snapshot.metadata.index + 1
 
@@ -95,8 +98,10 @@ class MemoryRaftStorage:
         return self.snapshot
 
     def set_conf(self, voters: Sequence[int],
-                 learners: Sequence[int] = ()) -> None:
+                 learners: Sequence[int] = (),
+                 voters_outgoing: Sequence[int] = ()) -> None:
         meta = self.snapshot.metadata
         self.snapshot = Snapshot(
             SnapshotMetadata(meta.index, meta.term, tuple(voters),
-                             tuple(learners)), self.snapshot.data)
+                             tuple(learners), tuple(voters_outgoing)),
+            self.snapshot.data)

@@ -124,6 +124,26 @@ class AdminCmd:
                         merge_index, extra), off
 
 
+def encode_change_peer_v2(changes=(), leave: bool = False,
+                          target=None) -> bytes:
+    """The ONE encoder for change_peer_v2 admin payloads: ``changes`` =
+    [(type_str, Peer)], ``target`` = final peer list for LEAVE."""
+    import msgpack
+    return msgpack.packb({
+        "changes": [{"t": t, "peer": {"id": p.id, "store_id": p.store_id,
+                                      "learner": p.is_learner}}
+                    for t, p in changes],
+        "leave": leave,
+        "target": [{"id": p.id, "store_id": p.store_id,
+                    "learner": p.is_learner} for p in (target or ())],
+    }, use_bin_type=True)
+
+
+def decode_change_peer_v2(extra: bytes) -> dict:
+    import msgpack
+    return msgpack.unpackb(extra, raw=False)
+
+
 @dataclass(frozen=True)
 class RaftCmd:
     """One proposed command: header (routing + epoch check) + payload."""

@@ -23,6 +23,7 @@ from ..engine.traits import CF_RAFT, KvEngine
 from ..raft.messages import (
     ConfChange,
     ConfChangeType,
+    ConfChangeV2,
     EntryType,
     HardState,
     Message,
@@ -244,6 +245,17 @@ class RaftPeer:
                        "remove": ConfChangeType.REMOVE_NODE}[a.change_type]
             index = self.node.propose_conf_change(
                 ConfChange(cc_type, a.peer.id, cmd.to_bytes()))
+        elif cmd.admin is not None and cmd.admin.kind == "change_peer_v2":
+            from .cmd import decode_change_peer_v2
+            meta = decode_change_peer_v2(cmd.admin.extra)
+            tmap = {"add": ConfChangeType.ADD_NODE,
+                    "add_learner": ConfChangeType.ADD_LEARNER,
+                    "remove": ConfChangeType.REMOVE_NODE}
+            changes = tuple((tmap[c["t"]], c["peer"]["id"])
+                            for c in meta["changes"])
+            index = self.node.propose_conf_change_v2(ConfChangeV2(
+                changes, cmd.to_bytes(),
+                leave_joint=meta.get("leave", False)))
         else:
             index = self.node.propose(cmd.to_bytes())
         self.proposals.append(Proposal(index, self.node.term, cb))
@@ -420,6 +432,17 @@ class RaftPeer:
         role = self.is_leader()
         if role != self._last_role:
             self._last_role = role
+            if role and self.node.in_joint() and \
+                    self.node._pending_conf_index <= self.node.applied:
+                # the previous leader died between enter and leave: a
+                # NEW leader re-proposes the bare leave or the cluster
+                # stays joint forever (raft-rs auto transition)
+                try:
+                    self.node.propose_conf_change_v2(
+                        ConfChangeV2((), b"", leave_joint=True),
+                        force=True)
+                except Exception:   # noqa: BLE001 — retried next ready
+                    pass
             self.store.coprocessor_host.notify_role_change(
                 self.region.id, role)
         return out
@@ -455,10 +478,19 @@ class RaftPeer:
                 prop.cb({})     # read barrier / leader noop
             return
         if entry.entry_type is EntryType.CONF_CHANGE:
-            cc = ConfChange.from_bytes(entry.data)
-            cmd = RaftCmd.from_bytes(cc.context)
-            result = self._exec_admin(wb, cmd.admin, cc=cc,
-                                      index=entry.index)
+            if ConfChangeV2.is_v2(entry.data):
+                cc2 = ConfChangeV2.from_bytes(entry.data)
+                if cc2.context:
+                    cmd = RaftCmd.from_bytes(cc2.context)
+                    admin = cmd.admin
+                else:       # bare leave from a new leader
+                    admin = AdminCmd("change_peer_v2")
+                result = self._exec_change_peer_v2(wb, admin, cc2)
+            else:
+                cc = ConfChange.from_bytes(entry.data)
+                cmd = RaftCmd.from_bytes(cc.context)
+                result = self._exec_admin(wb, cmd.admin, cc=cc,
+                                          index=entry.index)
         else:
             cmd = RaftCmd.from_bytes(entry.data)
             try:
@@ -636,6 +668,85 @@ class RaftPeer:
             self.pending_destroy = True
         return {"region": new_region}
 
+    def _exec_change_peer_v2(self, wb, admin: AdminCmd, cc2) -> dict:
+        """Joint membership change apply (fsm/apply.rs ChangePeerV2 +
+        raft §6).  Enter: region carries the UNION of old and new peer
+        sets while raft enforces both majorities; the leader then
+        auto-proposes the LEAVE, whose apply installs the target set.
+        """
+        import struct as _struct
+
+        from dataclasses import replace
+        from .cmd import decode_change_peer_v2
+        from .peer_storage import joint_state_key
+        meta = decode_change_peer_v2(admin.extra) if admin.extra else             {"changes": [], "leave": True, "target": None}
+        region = self.region
+        self.node.apply_conf_change_v2(cc2)
+        # persist the joint state (BOTH sets: the incoming voters can't
+        # be derived from region.peers, which holds the union) so a
+        # restart mid-joint keeps the both-majority rules
+        node = self.node
+        if node.voters_outgoing:
+            out_s = sorted(node.voters_outgoing)
+            in_s = sorted(node.voters)
+            wb.put_cf(CF_RAFT, joint_state_key(region.id),
+                      _struct.pack(">II", len(out_s), len(in_s)) +
+                      b"".join(_struct.pack(">Q", v)
+                               for v in out_s + in_s))
+        else:
+            wb.delete_cf(CF_RAFT, joint_state_key(region.id))
+        if cc2.leave_joint:
+            if meta.get("target"):
+                target = tuple(PeerMeta(p["id"], p["store_id"],
+                                        p.get("learner", False))
+                               for p in meta["target"])
+            else:
+                # bare leave (new-leader re-proposal): the target is the
+                # post-leave raft membership filtered from the union
+                member = self.node.voters | self.node.learners
+                target = tuple(p for p in region.peers
+                               if p.id in member)
+            new_region = replace(
+                region, peers=target,
+                epoch=RegionEpoch(region.epoch.conf_ver + 1,
+                                  region.epoch.version))
+            self.peer_storage.persist_region(wb, new_region)
+            self.store.on_region_changed(self, new_region)
+            if not any(p.id == self.meta.id for p in target):
+                self.pending_destroy = True
+            return {"region": new_region}
+        # enter joint: union of old peers and the incoming changes
+        peers = {p.id: p for p in region.peers}
+        target = dict(peers)
+        for c in meta["changes"]:
+            p = c["peer"]
+            pm = PeerMeta(p["id"], p["store_id"], c["t"] == "add_learner")
+            if c["t"] == "remove":
+                target.pop(p["id"], None)
+            else:
+                target[p["id"]] = pm
+                peers[p["id"]] = pm
+        new_region = replace(
+            region, peers=tuple(peers.values()),
+            epoch=RegionEpoch(region.epoch.conf_ver + 1,
+                              region.epoch.version))
+        self.peer_storage.persist_region(wb, new_region)
+        self.store.on_region_changed(self, new_region)
+        if self.is_leader():
+            # auto-leave (raft-rs ConfChangeV2 auto transition): the
+            # leave entry carries the TARGET peer set for the meta
+            from .cmd import encode_change_peer_v2
+            leave_cmd = RaftCmd(
+                new_region.id, new_region.epoch,
+                admin=AdminCmd("change_peer_v2",
+                               extra=encode_change_peer_v2(
+                                   leave=True,
+                                   target=list(target.values()))))
+            self.node.propose_conf_change_v2(
+                ConfChangeV2((), leave_cmd.to_bytes(), leave_joint=True),
+                force=True)
+        return {"region": new_region, "joint": True}
+
     def _exec_compact_log(self, wb, admin: AdminCmd) -> dict:
         index = min(admin.compact_index, self.node.applied)
         if index > self.node.storage.snapshot.metadata.index:
@@ -667,7 +778,15 @@ class RaftPeer:
         t = self.node.storage.term(applied)
         if t is None:
             t = term
-        return self.peer_storage.generate_snapshot(applied, t, self.region)
+        # raft-level conf travels verbatim: while JOINT, a receiver
+        # must apply both-majority rules — deriving voters from the
+        # region's peer union would weaken elections to a single
+        # union-majority (unsafe: {old majority} can outvote there)
+        node = self.node
+        conf = (sorted(node.voters), sorted(node.learners),
+                sorted(node.voters_outgoing))
+        return self.peer_storage.generate_snapshot(applied, t,
+                                                   self.region, conf)
 
     def step(self, msg: Message) -> None:
         self.node.step(msg)

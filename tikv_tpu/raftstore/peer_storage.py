@@ -56,6 +56,13 @@ def region_state_key(region_id: int) -> bytes:
     return REGION_PREFIX + struct.pack(">Q", region_id) + b"m"
 
 
+def joint_state_key(region_id: int) -> bytes:
+    """Persisted joint-consensus outgoing voter set (ConfState's
+    voters_outgoing): non-empty between enter-joint and leave-joint so
+    a restarted peer keeps enforcing BOTH majorities."""
+    return REGION_PREFIX + struct.pack(">Q", region_id) + b"j"
+
+
 def merge_state_key(region_id: int) -> bytes:
     """Persisted PrepareMerge state (raft_serverpb MergeState analog):
     value = >Q prepare-apply-index.  Lives under the region's CF_RAFT
@@ -144,9 +151,22 @@ class PeerStorage:
         rid = self.region.id
         ms = PeerRaftStorage(voters=tuple(
             p.id for p in self.region.peers if not p.is_learner))
+        outgoing: tuple = ()
+        incoming = None
+        rawj = self.engine.get_value_cf(CF_RAFT, joint_state_key(rid))
+        if rawj:
+            n_out, n_in = struct.unpack_from(">II", rawj, 0)
+            vals = struct.unpack_from(f">{n_out + n_in}Q", rawj, 8)
+            outgoing = tuple(vals[:n_out])
+            # the true INCOMING set: region.peers holds the old/new
+            # UNION while joint, so deriving voters from it would
+            # weaken decisions to a union majority
+            incoming = tuple(vals[n_out:])
         ms.set_conf(
+            incoming if incoming is not None else
             [p.id for p in self.region.peers if not p.is_learner],
-            [p.id for p in self.region.peers if p.is_learner])
+            [p.id for p in self.region.peers if p.is_learner],
+            outgoing)
         raw = self.engine.get_value_cf(CF_RAFT, raft_state_key(rid))
         applied = 0
         if raw is not None:
@@ -156,7 +176,8 @@ class PeerStorage:
             if trunc_idx:
                 meta = ms.snapshot.metadata
                 ms.snapshot = Snapshot(SnapshotMetadata(
-                    trunc_idx, trunc_term, meta.voters, meta.learners))
+                    trunc_idx, trunc_term, meta.voters, meta.learners,
+                    meta.voters_outgoing))
             # replay the persisted log tail
             it = self.engine.iterator_cf(
                 CF_RAFT, raft_log_key(rid, 0),
@@ -221,7 +242,7 @@ class PeerStorage:
     # -- region snapshots (follower catch-up; store/snap.rs role) --
 
     def generate_snapshot(self, index: int, term: int,
-                          region: Region) -> Snapshot:
+                          region: Region, conf=None) -> Snapshot:
         snap = self.engine.snapshot()
         lower, upper = region_data_bounds(region)
         parts = [encode_region(region)]
@@ -236,9 +257,16 @@ class PeerStorage:
             for k, v in pairs:
                 body += _pack_bytes(k) + _pack_bytes(v)
             parts.append(_pack_bytes(cf.encode()) + body)
-        voters = tuple(p.id for p in region.peers if not p.is_learner)
-        learners = tuple(p.id for p in region.peers if p.is_learner)
-        return Snapshot(SnapshotMetadata(index, term, voters, learners),
+        if conf is not None:
+            voters, learners, outgoing = conf
+        else:
+            voters = tuple(p.id for p in region.peers
+                           if not p.is_learner)
+            learners = tuple(p.id for p in region.peers if p.is_learner)
+            outgoing = ()
+        return Snapshot(SnapshotMetadata(index, term, tuple(voters),
+                                         tuple(learners),
+                                         tuple(outgoing)),
                         _pack_bytes(parts[0]) + b"".join(parts[1:]))
 
     def apply_snapshot(self, wb, snap: Snapshot) -> Region:

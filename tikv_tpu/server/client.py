@@ -480,6 +480,16 @@ class TxnClient:
             "region_id": region_id, "change_type": "remove",
             "peer": wire.enc_peer(peer)})
 
+    def change_peers_joint(self, region_id: int, changes) -> None:
+        """Atomic multi-peer change (joint consensus): ``changes`` =
+        [("add"|"add_learner"|"remove", Peer)]."""
+        region = self.pd.get_region_by_id(region_id)
+        self._call_leader_by_region(region, "ChangePeerV2", {
+            "region_id": region_id,
+            "changes": [{"type": t, "peer": wire.enc_peer(p)}
+                        for t, p in changes]})
+        self._region_cache.clear()
+
     def merge(self, source_id: int, target_id: int) -> Region:
         """Merge the source region into its adjacent target."""
         region = self.pd.get_region_by_id(source_id)

@@ -459,6 +459,21 @@ class Node:
         if isinstance(box["result"], Exception):
             raise box["result"]
 
+    def change_peer_v2(self, region_id: int, changes) -> None:
+        """Atomic multi-peer change via joint consensus; ``changes`` =
+        [(type, Peer)] (raftstore ChangePeerV2)."""
+        from ..raftstore.cmd import encode_change_peer_v2
+        with self.lock:
+            peer = self.raft_store.region_peer(region_id)
+            cmd = RaftCmd(region_id, peer.region.epoch, admin=AdminCmd(
+                "change_peer_v2",
+                extra=encode_change_peer_v2(changes)))
+            box: dict = {}
+            peer.propose(cmd, lambda r: box.__setitem__("result", r))
+        self._wait_driver(lambda: "result" in box)
+        if isinstance(box["result"], Exception):
+            raise box["result"]
+
     def transfer_leader(self, region_id: int, to_peer_id: int) -> None:
         with self.lock:
             peer = self.raft_store.region_peer(region_id)

@@ -435,6 +435,12 @@ class KvService:
                               wire.dec_peer(req["peer"]))
         return {}
 
+    def ChangePeerV2(self, req: dict) -> dict:
+        changes = [(c["type"], wire.dec_peer(c["peer"]))
+                   for c in req["changes"]]
+        self.node.change_peer_v2(req["region_id"], changes)
+        return {}
+
     def TransferLeader(self, req: dict) -> dict:
         self.node.transfer_leader(req["region_id"], req["to_peer_id"])
         return {}

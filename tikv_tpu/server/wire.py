@@ -68,6 +68,7 @@ def enc_raft_msg(m: Message) -> dict:
         meta = m.snapshot.metadata
         out["snap"] = {"i": meta.index, "t": meta.term,
                        "v": list(meta.voters), "l": list(meta.learners),
+                       "vo": list(meta.voters_outgoing),
                        "d": m.snapshot.data}
     return out
 
@@ -77,7 +78,8 @@ def dec_raft_msg(d: dict) -> Message:
     if "snap" in d:
         s = d["snap"]
         snap = Snapshot(SnapshotMetadata(s["i"], s["t"], tuple(s["v"]),
-                                         tuple(s["l"])), s["d"])
+                                         tuple(s["l"]),
+                                         tuple(s.get("vo", ()))), s["d"])
     return Message(MsgType(d["t"]), to=d["to"], frm=d["frm"],
                    term=d["term"], log_term=d["lt"], index=d["i"],
                    entries=tuple(decode_entry(e) for e in d["e"]),

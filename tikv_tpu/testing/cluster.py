@@ -219,6 +219,27 @@ class Cluster:
             raise box["result"]
         return box["result"]["right"]
 
+    def change_peers_joint(self, region_id: int, changes) -> None:
+        """Atomic multi-peer change via joint consensus (raft §6;
+        reference: test_joint_consensus.rs).  ``changes``: list of
+        (change_type, Peer)."""
+        from ..raftstore.cmd import encode_change_peer_v2
+        peer = self.leader_peer(region_id)
+        assert peer is not None
+        cmd = RaftCmd(region_id, peer.region.epoch, admin=AdminCmd(
+            "change_peer_v2", extra=encode_change_peer_v2(changes)))
+        box: dict = {}
+        peer.propose(cmd, lambda r: box.__setitem__("result", r))
+        self._drive_until(lambda: "result" in box)
+        if isinstance(box["result"], Exception):
+            raise box["result"]
+        # drive until the auto-leave applied everywhere (joint cleared)
+        def left_joint():
+            return all(not s.peers[region_id].node.in_joint()
+                       for s in self.stores.values()
+                       if region_id in s.peers)
+        self._drive_until(left_joint)
+
     def change_peer(self, region_id: int, change_type: str,
                     peer_meta: Peer) -> None:
         peer = self.leader_peer(region_id)
