@@ -150,14 +150,10 @@ def test_joint_change_survives_leader_restart_mid_joint():
     c.pd.bootstrap_cluster(c.pd.get_store(1), region)
     c.elect_leader(1, 1)
     c.must_put(b"ra", b"1")
-    import msgpack as _mp
-
     from tikv_tpu.raftstore import AdminCmd, RaftCmd
+    from tikv_tpu.raftstore.cmd import encode_change_peer_v2
     leader = c.leader_peer(1)
-    extra = _mp.packb({"changes": [
-        {"t": "add", "peer": {"id": 104, "store_id": 4,
-                              "learner": False}}],
-        "leave": False}, use_bin_type=True)
+    extra = encode_change_peer_v2([("add", Peer(104, 4))])
     # propose the ENTER but crash the leader before the auto-leave
     # replicates: suppress its outbound messages after proposal applies
     box = {}

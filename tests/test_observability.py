@@ -265,3 +265,124 @@ def test_health_in_status_and_pd_heartbeat():
         assert "slow_score" in pd.store_stats.get(node.store_id, {})
     finally:
         node.stop()
+
+
+# -------------------------------------------------- quota / resource ctl
+
+def test_resource_group_throttles_and_default_unlimited():
+    import time as _t
+
+    from tikv_tpu.utils.quota import ResourceGroupManager
+    rgm = ResourceGroupManager()
+    rgm.put_group("analytics", ru_per_sec=50, burst=5)
+    # burst drains instantly, then ~50 RU/s: 20 requests cost >= ~0.2s
+    t0 = _t.perf_counter()
+    for _ in range(20):
+        rgm.charge_request("analytics")
+    elapsed = _t.perf_counter() - t0
+    assert elapsed >= 0.15, elapsed
+    g = rgm.group("analytics")
+    assert g.consumed_ru >= 20
+    assert g.throttled_s > 0
+    # unconfigured groups (incl. default) are unlimited
+    t0 = _t.perf_counter()
+    for _ in range(100):
+        rgm.charge_request(None)
+        rgm.charge_request("unknown")
+    assert _t.perf_counter() - t0 < 0.1
+
+
+def test_resource_groups_over_status_server():
+    import urllib.request
+
+    from tikv_tpu.pd import MockPd
+    from tikv_tpu.server.node import Node
+    from tikv_tpu.server.service import KvService
+    from tikv_tpu.server.status_server import StatusServer
+
+    pd = MockPd()
+    node = Node("test:0", pd)
+    node.start()
+    srv = StatusServer("127.0.0.1:0", node=node,
+                       config_controller=node.config_controller)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        req = urllib.request.Request(
+            f"{base}/resource_groups", method="POST",
+            data=json.dumps({"name": "batch",
+                             "ru_per_sec": 1000}).encode())
+        urllib.request.urlopen(req)
+        svc = KvService(node)
+        svc.handle("RawPut", {"key": b"qk", "value": b"qv",
+                              "resource_group": "batch"})
+        groups = json.load(
+            urllib.request.urlopen(f"{base}/resource_groups"))
+        assert groups and groups[0]["name"] == "batch"
+        assert groups[0]["consumed_ru"] >= 1
+    finally:
+        srv.stop()
+        node.stop()
+
+
+# ------------------------------------------------------ hibernate regions
+
+def test_hibernate_regions_quiesce_and_wake():
+    from tikv_tpu.testing.cluster import Cluster
+    c = Cluster(3)
+    c.bootstrap()
+    c.start()
+    for store in c.stores.values():
+        store.config.hibernate_regions = True
+    c.must_put(b"hib", b"1")
+    c.pump()
+    # drive idle ticks past the hibernate threshold
+    for _ in range(40):
+        for store in c.stores.values():
+            store.tick()
+        c.pump()
+    assert all(s.peers[1].hibernated for s in c.stores.values())
+    # hibernated: further ticks generate ZERO raft traffic
+    sent = 0
+    for _ in range(10):
+        for store in c.stores.values():
+            store.tick()
+            sent += store.drive()
+        sent += c.transport.route_all()
+    assert sent == 0, f"hibernated region still chatting: {sent} msgs"
+    # a write wakes the region and completes
+    c.must_put(b"hib2", b"2")
+    assert c.must_get(b"hib2") == b"2"
+    assert not c.leader_peer(1).hibernated
+
+
+def test_hibernated_region_recovers_from_leader_crash():
+    """Liveness: a crashed leader of a hibernating region is still
+    detected — followers slow-tick their election clocks instead of
+    stopping them (store/hibernate_state.rs tradeoff)."""
+    from tikv_tpu.testing.cluster import Cluster
+    c = Cluster(3)
+    c.bootstrap()
+    c.start()
+    for store in c.stores.values():
+        store.config.hibernate_regions = True
+    c.must_put(b"hl", b"1")
+    c.pump()
+    for _ in range(40):
+        for store in c.stores.values():
+            store.tick()
+        c.pump()
+    assert all(s.peers[1].hibernated for s in c.stores.values())
+    leader_sid = c.leader_store(1)
+    c.stop_store(leader_sid)
+    # slow election clocks: within ~8x the normal timeout a follower
+    # campaigns, wakes the survivors, and a new leader emerges
+    for _ in range(400):
+        for store in c.stores.values():
+            store.tick()
+        c.pump()
+        if c.leader_store(1) is not None:
+            break
+    assert c.leader_store(1) is not None, "no re-election after crash"
+    c.must_put(b"hl2", b"2")
+    assert c.must_get(b"hl2") == b"2"

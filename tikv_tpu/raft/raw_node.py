@@ -136,12 +136,16 @@ class RawNode:
 
     # ------------------------------------------------------------- helpers
 
-    def _has_pending_conf_entry(self) -> bool:
+    def _pending_conf_entry_index(self) -> int:
+        last = 0
         for e in self.storage.entries:
             if e.entry_type is EntryType.CONF_CHANGE and \
                     e.index > self.applied:
-                return True
-        return False
+                last = max(last, e.index)
+        return last
+
+    def _has_pending_conf_entry(self) -> bool:
+        return self._pending_conf_entry_index() > 0
 
     def _reset_timeout(self) -> None:
         self._elapsed = 0
@@ -211,6 +215,12 @@ class RawNode:
     def _become_leader(self) -> None:
         self.state = LEADER
         self.leader_id = self.id
+        # recompute the one-in-flight gate from the log: a new leader
+        # inheriting a committed-but-unapplied conf entry must not
+        # accept another conf change before applying it (raft-rs does
+        # the same when campaigning)
+        self._pending_conf_index = max(self._pending_conf_index,
+                                       self._pending_conf_entry_index())
         self._lead_transferee = 0
         self._lease_ack = {}
         self._hb_send_mono = {}
@@ -412,7 +422,7 @@ class RawNode:
                 # raft-rs rejects entering a joint config while one is
                 # active — overwriting outgoing would drop the real
                 # C_old and break the both-majority invariant
-                return
+                return False
             self.voters_outgoing = set(self.voters)
             for ctype, nid in cc2.changes:
                 if ctype is ConfChangeType.ADD_NODE:
@@ -432,6 +442,7 @@ class RawNode:
                               sorted(self.voters_outgoing))
         if self.state == LEADER:
             self._maybe_commit()
+        return True
 
     def transfer_leader(self, target: int) -> None:
         self.step(Message(MsgType.TRANSFER_LEADER, to=self.id,

@@ -186,6 +186,10 @@ class RaftPeer:
         self._last_role = False
         # an async raft-log write is in flight (batch_system write pool)
         self._ready_inflight = False
+        # hibernation (store/hibernate_state.rs): quiet peers stop
+        # ticking; any traffic wakes them
+        self._idle_ticks = 0
+        self.hibernated = False
         # replica reads (ReadIndex): ctx -> (cb, read_ts, age), plus
         # reads whose commit index the leader confirmed but we have not
         # applied up to yet
@@ -223,6 +227,7 @@ class RaftPeer:
 
     def propose(self, cmd: RaftCmd, cb: Callable) -> int:
         with self.mu:
+            self.wake()
             return self._propose_locked(cmd, cb)
 
     def _propose_locked(self, cmd: RaftCmd, cb: Callable) -> int:
@@ -789,12 +794,40 @@ class RaftPeer:
                                                    self.region, conf)
 
     def step(self, msg: Message) -> None:
+        # heartbeat chatter is not activity — counting it would keep
+        # every region awake forever; real entries/votes/snapshots wake
+        from ..raft.messages import MsgType as _MT
+        if msg.msg_type not in (_MT.HEARTBEAT, _MT.HEARTBEAT_RESPONSE) \
+                or msg.entries:
+            self.wake()
+        elif self.hibernated:
+            # a heartbeat reaching a hibernated peer means some peer is
+            # still awake (e.g. a rejoining follower): answer it
+            self.wake()
         self.node.step(msg)
 
+    HIBERNATE_IDLE_TICKS = 30   # ~3 election timeouts of quiet
+
     def tick(self) -> None:
+        if getattr(self.store.config, "hibernate_regions", False):
+            # hibernate (store/hibernate_state.rs:88): after sustained
+            # quiet the leader stops heartbeating entirely, and
+            # followers SLOW their election clocks 8× instead of
+            # stopping them — a crashed hibernating leader is still
+            # detected (pre-vote fires eventually and wakes the region)
+            # without per-tick chatter from thousands of idle regions.
+            self._idle_ticks += 1
+            if self._idle_ticks > self.HIBERNATE_IDLE_TICKS:
+                self.hibernated = True
+                if self.is_leader() or self._idle_ticks % 8 != 0:
+                    return
         self.node.tick()
         if self._replica_reads:
             self._retry_replica_reads()
+
+    def wake(self) -> None:
+        self._idle_ticks = 0
+        self.hibernated = False
 
     def _retry_replica_reads(self) -> None:
         """Re-send pending ReadIndex requests (dropped request, leader

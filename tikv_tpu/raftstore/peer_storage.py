@@ -297,4 +297,18 @@ class PeerStorage:
                 wb.put_cf(cf, k, v)
         self.persist_region(wb, region)
         self.persist_apply(wb, snap.metadata.index)
+        # a mid-joint snapshot must leave the receiver JOINT across a
+        # restart too: persist both voter sets, or clear (restore()
+        # would otherwise derive a single union config from the peers —
+        # the split-brain generate_snapshot's comment warns about)
+        meta = snap.metadata
+        outgoing = tuple(getattr(meta, "voters_outgoing", ()))
+        if outgoing:
+            out_s, in_s = sorted(outgoing), sorted(meta.voters)
+            wb.put_cf(CF_RAFT, joint_state_key(region.id),
+                      struct.pack(">II", len(out_s), len(in_s)) +
+                      b"".join(struct.pack(">Q", v)
+                               for v in out_s + in_s))
+        else:
+            wb.delete_cf(CF_RAFT, joint_state_key(region.id))
         return region

@@ -223,7 +223,9 @@ class Node:
         self.raft_store.config = config.raftstore
         self.raft_store.observers = [self._report_region]
         from ..utils.health import HealthController
+        from ..utils.quota import ResourceGroupManager
         self.health = HealthController()
+        self.resource_groups = ResourceGroupManager()
         self.raft_kv = RaftKv(self.raft_store, driver=self._wait_driver,
                               lock=self.lock,
                               latency_inspector=self.health.record_write)

@@ -57,6 +57,12 @@ class KvService:
         fn = getattr(self, method, None)
         if fn is None:
             return {"error": {"kind": "unimplemented", "method": method}}
+        # resource-control admission: the group's token bucket throttles
+        # BEFORE the request runs (resource_control ResourceLimiter);
+        # a second charge after the response covers the bytes touched
+        group = req.get("resource_group") if isinstance(req, dict)             else None
+        rgm = self.node.resource_groups
+        rgm.charge_request(group)
         prio = _READ_METHODS.get(method)
         t0 = time.perf_counter()
         if prio is not None:
@@ -64,6 +70,15 @@ class KvService:
                 lambda r: self.read_pool.run(lambda: fn(r), prio), req)
         else:
             resp = self._guard(fn, req)
+        nbytes = resp.get("__bytes", 0) if isinstance(resp, dict) else 0
+        if not nbytes and isinstance(resp, dict):
+            v = resp.get("value")
+            if isinstance(v, (bytes, bytearray)):
+                nbytes = len(v)
+            elif "rows" in resp and isinstance(resp["rows"], list):
+                nbytes = 32 * len(resp["rows"])     # row estimate
+        if nbytes:
+            rgm.charge_request(group, bytes_touched=nbytes, requests=0)
         m.GRPC_MSG_DURATION.labels(method).observe(
             time.perf_counter() - t0)
         m.GRPC_MSG_COUNTER.labels(

@@ -69,6 +69,11 @@ class StatusServer:
                 elif path == "/fail_point":
                     from ..utils import failpoint
                     self._json(200, failpoint.list_cfg())
+                elif path == "/resource_groups":
+                    node = outer._node
+                    groups = node.resource_groups.list_groups() \
+                        if node is not None else []
+                    self._json(200, groups)
                 else:
                     self._json(404, {"error": f"no route {path}"})
 
@@ -101,6 +106,16 @@ class StatusServer:
                     return
                 if path == "/config":
                     self._post_config(body)
+                elif path == "/resource_groups":
+                    node = outer._node
+                    if node is None:
+                        self._json(404, {"error": "no node"})
+                        return
+                    node.resource_groups.put_group(
+                        body["name"], float(body["ru_per_sec"]),
+                        body.get("priority", "medium"),
+                        body.get("burst"))
+                    self._json(200, {"ok": True})
                 elif path.startswith("/fail_point/"):
                     from ..utils import failpoint
                     name = path[len("/fail_point/"):]

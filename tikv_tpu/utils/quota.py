@@ -1,0 +1,125 @@
+"""Resource control — RU-based QoS groups + front-end quota limiting.
+
+Reference: components/resource_control/ (ResourceGroupManager +
+ResourceLimiter: named groups with request-unit budgets, consulted by
+the read pool and scheduler; groups sync from PD's meta storage and are
+visible at the status server's /resource_groups route) and
+components/tikv_util quota_limiter.rs (front-end throttle).
+
+RU model (simplified from the reference's RU config): 1 RU per request
+plus 1 RU per 4 KiB touched.  A group's token bucket refills at
+``ru_per_sec``; callers over budget BLOCK until tokens accrue (the
+reference's limiter queues futures the same way), so a runaway
+analytical group cannot starve the default group's point reads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+RU_PER_REQUEST = 1.0
+BYTES_PER_RU = 4096.0
+
+
+class TokenBucket:
+    """Leaky token bucket: rate tokens/s, capped at ``burst``."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None):
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else rate)
+        self._tokens = self.burst
+        self._last = time.monotonic()
+        self._mu = threading.Lock()
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def consume(self, n: float, max_wait_s: float = 5.0) -> float:
+        """Take ``n`` tokens, sleeping while the bucket refills.
+        Returns the seconds slept (throttle observability)."""
+        deadline = time.monotonic() + max_wait_s
+        slept = 0.0
+        while True:
+            with self._mu:
+                self._refill()
+                if self._tokens >= n:
+                    self._tokens -= n
+                    return slept
+                missing = n - self._tokens
+                wait = missing / self.rate if self.rate > 0 else max_wait_s
+            wait = min(wait, max(0.0, deadline - time.monotonic()))
+            if wait <= 0:
+                with self._mu:
+                    self._refill()
+                    self._tokens -= n       # debt: next callers wait
+                return slept
+            time.sleep(min(wait, 0.05))
+            slept += min(wait, 0.05)
+
+
+class ResourceGroup:
+    def __init__(self, name: str, ru_per_sec: float,
+                 priority: str = "medium", burst: Optional[float] = None):
+        self.name = name
+        self.ru_per_sec = ru_per_sec
+        self.priority = priority
+        self.bucket = TokenBucket(ru_per_sec, burst)
+        self.consumed_ru = 0.0
+        self.throttled_s = 0.0
+
+    def charge(self, ru: float) -> None:
+        self.consumed_ru += ru
+        self.throttled_s += self.bucket.consume(ru)
+
+    def stats(self) -> dict:
+        return {"name": self.name, "ru_per_sec": self.ru_per_sec,
+                "priority": self.priority,
+                "consumed_ru": round(self.consumed_ru, 2),
+                "throttled_s": round(self.throttled_s, 3)}
+
+
+class ResourceGroupManager:
+    """Named groups; unknown names fall through to ``default`` (which
+    is unlimited unless configured, like the reference's default
+    group)."""
+
+    def __init__(self):
+        self._groups: dict[str, ResourceGroup] = {}
+        self._mu = threading.Lock()
+
+    def put_group(self, name: str, ru_per_sec: float,
+                  priority: str = "medium",
+                  burst: Optional[float] = None) -> None:
+        with self._mu:
+            self._groups[name] = ResourceGroup(name, ru_per_sec,
+                                               priority, burst)
+
+    def remove_group(self, name: str) -> None:
+        with self._mu:
+            self._groups.pop(name, None)
+
+    def group(self, name: Optional[str]) -> Optional[ResourceGroup]:
+        if not name:
+            name = "default"
+        return self._groups.get(name)
+
+    def charge_request(self, name: Optional[str], bytes_touched: int = 0,
+                       requests: int = 1) -> None:
+        g = self.group(name)
+        if g is None:
+            return      # unconfigured group: unlimited
+        g.charge(requests * RU_PER_REQUEST +
+                 bytes_touched / BYTES_PER_RU)
+
+    def list_groups(self) -> list:
+        with self._mu:
+            return [g.stats() for g in self._groups.values()]
+
+
+def request_units(bytes_touched: int, requests: int = 1) -> float:
+    return requests * RU_PER_REQUEST + bytes_touched / BYTES_PER_RU
