@@ -1,0 +1,178 @@
+"""PD balancing operators + region buckets.
+
+Reference: PD's balance-region scheduler as TiKV sees it — the region
+heartbeat response carries one operator step which the store executes
+(components/raftstore/src/store/worker/pd.rs), and region buckets
+(components/pd_client/src/lib.rs:118-240) reported with heartbeats.
+"""
+
+import pytest
+
+from tikv_tpu.pd import MockPd
+from tikv_tpu.raftstore import Peer, Region, RegionEpoch, Store
+from tikv_tpu.testing.cluster import Cluster
+
+
+def _one_store_regions(cluster: Cluster) -> tuple[Region, Region]:
+    """Two single-replica regions, both living on store 1 only."""
+    r1 = Region(1, b"", b"m", RegionEpoch(1, 1), (Peer(101, 1),))
+    r2 = Region(2, b"m", b"", RegionEpoch(1, 1), (Peer(102, 1),))
+    store = cluster.stores[1]
+    store.bootstrap_region(r1)
+    store.bootstrap_region(r2)
+    cluster.pd.bootstrap_cluster(Store(1), r1)
+    for rid in (1, 2):
+        cluster.stores[1].peers[rid].node.campaign(force=True)
+    cluster.pump()
+    cluster.pd.region_heartbeat(r2, Peer(102, 1))
+    return r1, r2
+
+
+def _replica_counts(cluster: Cluster) -> dict:
+    return {sid: len(store.peers)
+            for sid, store in cluster.stores.items()}
+
+
+class TestBalance:
+    def test_disabled_scheduler_is_quiet(self):
+        cluster = Cluster(n_stores=3)
+        _one_store_regions(cluster)
+        assert cluster.run_pd_operators() == 0
+        assert _replica_counts(cluster) == {1: 2, 2: 0, 3: 0}
+
+    def test_balance_spreads_regions_across_stores(self):
+        cluster = Cluster(n_stores=3)
+        _one_store_regions(cluster)
+        cluster.pd.enable_balancing(replica_target=1)
+        executed = cluster.run_pd_operators()
+        assert executed > 0
+        counts = _replica_counts(cluster)
+        # no store hoards: both regions moved off the pile-up, each
+        # region still has exactly one replica
+        assert max(counts.values()) <= 1, counts
+        assert sum(counts.values()) == 2
+        # data survived the moves: writes still land through leaders
+        for rid, key in ((1, b"a"), (2, b"z")):
+            sid = cluster.leader_store(rid)
+            assert sid is not None and counts[sid] == 1
+
+    def test_leader_never_removed_directly(self):
+        """The move of a leader-held region must transfer leadership
+        before the donor replica is dropped."""
+        cluster = Cluster(n_stores=2)
+        _one_store_regions(cluster)
+        cluster.pd.enable_balancing(replica_target=1)
+        cluster.run_pd_operators()
+        counts = _replica_counts(cluster)
+        assert sum(counts.values()) == 2
+        # every surviving region has a live leader
+        for rid in (1, 2):
+            assert cluster.leader_store(rid) is not None
+
+
+class TestSchedulerPolicy:
+    def test_no_operator_when_balanced(self):
+        pd = MockPd()
+        pd.put_store(Store(1))
+        pd.put_store(Store(2))
+        pd.enable_balancing()
+        r1 = Region(1, b"", b"m", RegionEpoch(1, 1), (Peer(101, 1),))
+        r2 = Region(2, b"m", b"", RegionEpoch(1, 1), (Peer(102, 2),))
+        assert pd.region_heartbeat(r1, Peer(101, 1)) is None
+        assert pd.region_heartbeat(r2, Peer(102, 2)) is None
+
+    def test_add_then_remove_sequence(self):
+        pd = MockPd()
+        for sid in (1, 2):
+            pd.put_store(Store(sid))
+        pd.enable_balancing()
+        r1 = Region(1, b"", b"m", RegionEpoch(1, 1), (Peer(101, 1),))
+        r2 = Region(2, b"m", b"", RegionEpoch(1, 1), (Peer(102, 1),))
+        pd.region_heartbeat(r2, Peer(102, 1))
+        op = pd.region_heartbeat(r1, Peer(101, 1))
+        assert op["type"] == "add_peer"
+        new_peer = op["peer"]
+        assert new_peer["store_id"] == 2
+        # the add landed: next heartbeat moves leadership off the donor
+        grown = Region(1, b"", b"m", RegionEpoch(1, 2),
+                       (Peer(101, 1), Peer(new_peer["id"], 2)))
+        op2 = pd.region_heartbeat(grown, Peer(101, 1))
+        assert op2["type"] == "transfer_leader"
+        assert op2["peer"]["store_id"] == 2
+        # leadership moved: now the donor replica is dropped
+        op3 = pd.region_heartbeat(grown, Peer(new_peer["id"], 2))
+        assert op3 == {"type": "remove_peer",
+                       "peer": {"id": 101, "store_id": 1,
+                                "learner": False}}
+        shrunk = Region(1, b"", b"m", RegionEpoch(1, 3),
+                        (Peer(new_peer["id"], 2),))
+        assert pd.region_heartbeat(shrunk, Peer(new_peer["id"], 2)) is None
+
+
+class TestBuckets:
+    def test_heartbeat_stores_buckets(self):
+        pd = MockPd()
+        pd.put_store(Store(1))
+        r = Region(1, b"", b"", RegionEpoch(1, 1), (Peer(101, 1),))
+        pd.region_heartbeat(r, Peer(101, 1), buckets=[b"g", b"p"])
+        assert pd.get_buckets(1) == [b"g", b"p"]
+        assert pd.get_buckets(42) == []
+
+    def test_split_check_computes_bucket_bounds(self):
+        cluster = Cluster(n_stores=1)
+        cluster.bootstrap()
+        cluster.start()
+        for i in range(40):
+            cluster.must_put(b"k%03d" % i, b"v" * 64)
+        store = cluster.stores[1]
+        store.config.region_bucket_size_mb = 0.0005   # ~524 bytes
+        cluster.split_check_all()
+        peer = store.peers[1]
+        assert len(peer.buckets) >= 2
+        assert peer.buckets == sorted(peer.buckets)
+        # boundaries are bare user keys inside the region
+        for b in peer.buckets:
+            assert b.startswith(b"k")
+        # reported to PD with the next heartbeat round
+        cluster.heartbeat_pd()
+        assert cluster.pd.get_buckets(1) == peer.buckets
+
+
+class TestRoutingRegressions:
+    """Bugs exposed by cross-store balancing (regions on different
+    stores for the first time)."""
+
+    def test_region_not_found_is_a_typed_wire_error(self):
+        from tikv_tpu.raftstore.metapb import RegionNotFound
+        from tikv_tpu.server import wire
+        d = wire.enc_error(RegionNotFound(7))
+        assert d["kind"] == "region_not_found"
+        assert d["region_id"] == 7
+
+    def test_client_routes_with_encoded_keys(self):
+        """Region bounds are encoded keys; raw-key comparison routed
+        b"k049" into a region ending at encode_key(b"k025")."""
+        from tikv_tpu.server.client import TxnClient
+        from tikv_tpu.storage.txn_types import encode_key
+
+        left = Region(1, b"", encode_key(b"k025"), RegionEpoch(2, 1),
+                      (Peer(101, 1),))
+        right = Region(2, encode_key(b"k025"), b"", RegionEpoch(2, 1),
+                       (Peer(102, 2),))
+
+        class FakePd:
+            def get_region_with_leader(self, key):
+                for r in (left, right):
+                    if r.contains(key):
+                        return r, r.peers[0]
+                raise KeyError(key)
+
+        c = TxnClient.__new__(TxnClient)
+        c.pd = FakePd()
+        c._region_cache = {}
+        r1, _ = c._lookup_region(b"k001")
+        r2, _ = c._lookup_region(b"k049")
+        assert (r1.id, r2.id) == (1, 2)
+        # invalidation hits the region owning the key, not its sibling
+        c._invalidate_region(b"k049")
+        assert 1 in c._region_cache and 2 not in c._region_cache

@@ -48,6 +48,7 @@ class RaftstoreConfig:
     # raft-log writer threads (store-pool-size / store-io-pool-size)
     store_pool_size: int = 0
     store_io_pool_size: int = 1
+    region_bucket_size_mb: float = 32.0
 
 
 @dataclass

@@ -31,7 +31,8 @@ class PdClient(Protocol):
 
     def get_region_by_id(self, region_id: int) -> Optional[Region]: ...
 
-    def region_heartbeat(self, region: Region, leader: Peer) -> None: ...
+    def region_heartbeat(self, region: Region, leader: Peer,
+                         buckets=None) -> Optional[dict]: ...
 
     def ask_split(self, region: Region) -> tuple[int, list[int]]: ...
 
@@ -61,6 +62,16 @@ class MockPd:
         self._tso_physical = 1
         self._tso_logical = 0
         self.store_stats: dict[int, dict] = {}
+        # balancing scheduler (pd/scheduler.py): heartbeat responses
+        # carry one operator step when enabled
+        from .scheduler import Scheduler
+        self.scheduler = Scheduler(self)
+        self._pending_removals: dict[int, int] = {}   # region -> store
+        self._inflight_adds: dict[int, tuple] = {}    # region -> (peer, store)
+        self._replica_target = 1
+        # region buckets: sub-range split points for finer coprocessor
+        # parallelism (pd_client/src/lib.rs:118-240)
+        self._buckets: dict[int, list] = {}
 
     # -- lifecycle --
 
@@ -108,17 +119,22 @@ class MockPd:
         info = self._regions.get(region_id)
         return info.leader if info else None
 
-    def region_heartbeat(self, region: Region, leader: Peer) -> None:
+    def region_heartbeat(self, region: Region, leader: Peer,
+                         buckets=None):
         """Reference: pd.rs handle_heartbeat — accept newer epochs only;
         a newer region covering an older one's whole range evicts it
-        (how PD learns a merge: the absorbed source simply vanishes)."""
+        (how PD learns a merge: the absorbed source simply vanishes).
+        Returns one scheduling operator step, or None (the kvproto
+        RegionHeartbeatResponse shape)."""
         with self._lock:
             cur = self._regions.get(region.id)
             if cur is not None:
                 ce, ne = cur.region.epoch, region.epoch
                 if (ne.version, ne.conf_ver) < (ce.version, ce.conf_ver):
-                    return      # stale heartbeat
+                    return None     # stale heartbeat
             self._regions[region.id] = _RegionInfo(region, leader)
+            if buckets is not None:
+                self._buckets[region.id] = list(buckets)
             for rid, info in list(self._regions.items()):
                 if rid == region.id:
                     continue
@@ -128,6 +144,40 @@ class MockPd:
                     (o.end_key and o.end_key <= region.end_key))
                 if covered and (o.epoch.version < region.epoch.version):
                     del self._regions[rid]
+                    # the absorbed region never heartbeats again: drop
+                    # its scheduler/bucket state or counts skew forever
+                    self._inflight_adds.pop(rid, None)
+                    self._pending_removals.pop(rid, None)
+                    self._buckets.pop(rid, None)
+            # operator completion is observed, never assumed: an
+            # in-flight add clears when the heartbeat SHOWS the replica,
+            # a pending removal when it shows the donor gone (operators
+            # are fire-and-forget; the store may drop one)
+            inflight = self._inflight_adds.get(region.id)
+            if inflight is not None and any(
+                    p.store_id == inflight[1] for p in region.peers):
+                self._inflight_adds.pop(region.id, None)
+            pending = self._pending_removals.get(region.id)
+            if pending is not None and \
+                    all(p.store_id != pending for p in region.peers):
+                self._pending_removals.pop(region.id, None)
+            op = self.scheduler.operator_for(region, leader)
+            if op is not None and op.get("then_remove_store"):
+                self._pending_removals[region.id] = \
+                    op.pop("then_remove_store")
+            if op is not None and op["type"] == "add_peer":
+                self._inflight_adds[region.id] = \
+                    (op["peer"]["id"], op["peer"]["store_id"])
+            return op
+
+    def enable_balancing(self, replica_target: int = 1) -> None:
+        """Turn on the balance-region scheduler (PD's balance-region)."""
+        self._replica_target = replica_target
+        self.scheduler.enabled = True
+
+    def get_buckets(self, region_id: int) -> list:
+        """Sub-region bucket boundaries (pd_client buckets API)."""
+        return list(self._buckets.get(region_id, ()))
 
     def ask_split(self, region: Region) -> tuple[int, list[int]]:
         """→ (new_region_id, new peer ids aligned with region.peers)."""

@@ -1,0 +1,109 @@
+"""PD scheduling — replica/leader balancing operators + region buckets.
+
+Reference: PD's balance-region and balance-leader schedulers as TiKV
+sees them — the region heartbeat RESPONSE carries one operator step
+(kvproto RegionHeartbeatResponse: ChangePeer / TransferLeader), and the
+store executes it (components/raftstore/src/store/worker/pd.rs applies
+the response); buckets (pd_client/src/lib.rs:118-240) are sub-region
+split points reported with heartbeats for finer coprocessor
+parallelism.
+
+Policy (deliberately simple, the balance-region shape): move a replica
+from the store with the most replicas to the store with the fewest
+(that lacks one), one step per heartbeat — add the new peer first, drop
+the old one only after the add is visible in a later heartbeat; spread
+leaders across stores holding replicas.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Scheduler:
+    """Balancing decisions over the PD's region/store view."""
+
+    def __init__(self, pd, max_diff: int = 1):
+        self._pd = pd
+        self._max_diff = max_diff
+        self.enabled = False
+
+    def _replica_counts(self, regions) -> dict:
+        """Replica count per store, INCLUDING planned moves: an
+        in-flight add already loads its receiver and a pending removal
+        already unloads its donor — otherwise every region heartbeating
+        in the same round picks the same receiver and the cluster
+        oscillates instead of balancing."""
+        counts = {sid: 0 for sid in self._pd._stores}
+        for info in regions.values():
+            for p in info.region.peers:
+                if p.store_id in counts:
+                    counts[p.store_id] += 1
+        for _pid, sid in self._pd._inflight_adds.values():
+            if sid in counts:
+                counts[sid] += 1
+        for sid in self._pd._pending_removals.values():
+            if sid in counts:
+                counts[sid] -= 1
+        return counts
+
+    def operator_for(self, region, leader) -> Optional[dict]:
+        """One operator step for this region's heartbeat, or None.
+
+        Called with the PD lock held (from region_heartbeat)."""
+        if not self.enabled:
+            return None
+        counts = self._replica_counts(self._pd._regions)
+        if len(counts) < 2:
+            return None
+        peer_stores = {p.store_id for p in region.peers}
+        # a planned add that hasn't landed yet: re-issue the SAME
+        # operator each heartbeat until the replica shows up (the
+        # reference PD re-sends unfinished operators the same way)
+        inflight = self._pd._inflight_adds.get(region.id)
+        if inflight is not None:
+            pid, sid = inflight
+            if sid not in peer_stores:
+                return {"type": "add_peer",
+                        "peer": {"id": pid, "store_id": sid,
+                                 "learner": False}}
+        # pending removal FIRST: a previous add landed and the region is
+        # past its replica target — finish the move before planning
+        # another (the reference's operator is similarly one-at-a-time)
+        pending = self._pd._pending_removals.get(region.id)
+        if pending is not None and pending in peer_stores and \
+                len(region.peers) > self._pd._replica_target:
+            peer = next(p for p in region.peers
+                        if p.store_id == pending)
+            if leader is None or leader.store_id != pending:
+                return {"type": "remove_peer",
+                        "peer": {"id": peer.id,
+                                 "store_id": peer.store_id,
+                                 "learner": peer.is_learner}}
+            # never remove the leader directly: move leadership first
+            target = next((p for p in region.peers
+                           if p.store_id != pending), None)
+            if target is not None:
+                return {"type": "transfer_leader",
+                        "peer": {"id": target.id,
+                                 "store_id": target.store_id,
+                                 "learner": target.is_learner}}
+            return None
+        if len(region.peers) > self._pd._replica_target:
+            return None     # mid-move without a recorded donor: hold
+        # replica balance: most-loaded member store vs least-loaded
+        # non-member store
+        donors = sorted((s for s in peer_stores if s in counts),
+                        key=lambda s: -counts[s])
+        receivers = sorted((s for s in counts if s not in peer_stores),
+                           key=lambda s: counts[s])
+        if donors and receivers:
+            donor, receiver = donors[0], receivers[0]
+            if counts[donor] - counts[receiver] > self._max_diff:
+                new_id = self._pd._next_id = self._pd._next_id + 1
+                return {"type": "add_peer",
+                        "peer": {"id": new_id, "store_id": receiver,
+                                 "learner": False},
+                        # the follow-up step once the add lands
+                        "then_remove_store": donor}
+        return None

@@ -186,6 +186,8 @@ class RaftPeer:
         self._last_role = False
         # an async raft-log write is in flight (batch_system write pool)
         self._ready_inflight = False
+        # sub-region bucket boundaries (split-check pass computes them)
+        self.buckets: list = []
         # hibernation (store/hibernate_state.rs): quiet peers stop
         # ticking; any traffic wakes them
         self._idle_ticks = 0

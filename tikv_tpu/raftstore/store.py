@@ -426,6 +426,22 @@ class RaftStore:
                 continue
         return None
 
+    def _bucket_bounds(self, entries) -> list:
+        """Sub-region bucket boundaries every region_bucket_size_mb of
+        data (pd_client buckets: finer copr parallelism units)."""
+        bucket_bytes = int(getattr(self.config, "region_bucket_size_mb",
+                                   32) * (1 << 20))
+        if bucket_bytes <= 0 or not entries:
+            return []
+        out = []
+        acc = 0
+        for uk, sz in entries:
+            acc += sz
+            if acc >= bucket_bytes:
+                out.append(uk)
+                acc = 0
+        return out
+
     def split_check(self, pd) -> int:
         """One split-checker pass (store/worker/split_check.rs): leader
         peers over ``region_split_size_mb`` propose a half-split with
@@ -439,6 +455,7 @@ class RaftStore:
             if not peer.is_leader() or peer.merging is not None:
                 continue
             size, entries = self._scan_region(peer)
+            peer.buckets = self._bucket_bounds(entries)
             if size < threshold:
                 continue
             split_key = self.find_split_key(peer, entries)

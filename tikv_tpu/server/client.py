@@ -162,18 +162,26 @@ class TxnClient:
         return c
 
     def _lookup_region(self, key: bytes) -> tuple[Region, Peer]:
+        # region bounds live in the ENCODED keyspace (txn_types
+        # encode_key) — comparing raw user keys against them routes to
+        # the wrong region as soon as a split boundary sorts between
+        # the raw and encoded forms
+        from ..storage.txn_types import encode_key
+        ek = encode_key(key)
         for region, leader in self._region_cache.values():
-            if region.contains(key):
+            if region.contains(ek):
                 return region, leader
-        region, leader = self.pd.get_region_with_leader(key)
+        region, leader = self.pd.get_region_with_leader(ek)
         if leader is None:
             leader = region.peers[0]
         self._region_cache[region.id] = (region, leader)
         return region, leader
 
     def _invalidate_region(self, key: bytes) -> None:
+        from ..storage.txn_types import encode_key
+        ek = encode_key(key)
         for rid, (region, _leader) in list(self._region_cache.items()):
-            if region.contains(key):
+            if region.contains(ek):
                 del self._region_cache[rid]
 
     def _leader_client(self, key: bytes) -> tuple[StoreClient, Region]:

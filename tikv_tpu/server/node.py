@@ -195,6 +195,7 @@ class Node:
         self._wake = threading.Condition(self.lock)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._operator_busy = threading.Lock()
 
         import struct as _struct
         ident = self.engine.get_value_cf(CF_RAFT, STORE_IDENT_KEY)
@@ -338,7 +339,8 @@ class Node:
                 # periodic PD reporting (worker/pd.rs heartbeat loop)
                 if now - last_hb >= self._tick_interval * 10:
                     last_hb = now
-                    leaders = [(p.region, Peer(p.meta.id, self.store_id))
+                    leaders = [(p.region, Peer(p.meta.id, self.store_id),
+                                list(p.buckets))
                                for p in self.raft_store.peers.values()
                                if p.is_leader()]
                 else:
@@ -346,8 +348,11 @@ class Node:
             self.transport.flush()
             if leaders is not None:
                 try:
-                    for region, leader in leaders:
-                        self.pd.region_heartbeat(region, leader)
+                    for region, leader, buckets in leaders:
+                        op = self.pd.region_heartbeat(region, leader,
+                                                      buckets=buckets)
+                        if op:
+                            self._exec_operator(region.id, op)
                     hb = {"region_count": len(leaders)}
                     hb.update(self.health.stats())
                     self.pd.store_heartbeat(self.store_id, hb)
@@ -360,7 +365,7 @@ class Node:
                     ts = self.pd.tso()
                     self.storage.concurrency_manager.update_max_ts(ts)
                     self.resolved_ts.advance_all(
-                        ts, [r.id for r, _l in leaders])
+                        ts, [r.id for r, _l, _b in leaders])
                 except Exception:
                     pass    # PD outages must not stall raft
             if did == 0:
@@ -480,6 +485,31 @@ class Node:
         with self.lock:
             peer = self.raft_store.region_peer(region_id)
             peer.node.transfer_leader(to_peer_id)
+
+    def _exec_operator(self, region_id: int, op: dict) -> None:
+        """Apply one PD scheduling step (worker/pd.rs executes the
+        heartbeat response).  Runs on a worker thread — conf changes
+        block on apply and must never stall the heartbeat loop."""
+        if not self._operator_busy.acquire(blocking=False):
+            return      # one operator at a time, like the pd worker
+        def run():
+            try:
+                try:
+                    p = op.get("peer") or {}
+                    peer = Peer(p.get("id", 0), p.get("store_id", 0),
+                                p.get("learner", False))
+                    if op["type"] == "add_peer":
+                        self.change_peer(region_id, "add", peer)
+                    elif op["type"] == "remove_peer":
+                        self.change_peer(region_id, "remove", peer)
+                    elif op["type"] == "transfer_leader":
+                        self.transfer_leader(region_id, peer.id)
+                except Exception:   # noqa: BLE001 — next heartbeat retries
+                    pass
+            finally:
+                self._operator_busy.release()
+        threading.Thread(target=run, daemon=True,
+                         name="pd-operator").start()
 
     def region_applied(self, region_id: int) -> int:
         """Local peer's apply index (merge coordination probe)."""

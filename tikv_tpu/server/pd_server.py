@@ -69,9 +69,10 @@ class PdService:
                 "leader": wire.enc_peer(leader) if leader else None}
 
     def RegionHeartbeat(self, req: dict) -> dict:
-        self.pd.region_heartbeat(wire.dec_region(req["region"]),
-                                 wire.dec_peer(req["leader"]))
-        return {}
+        op = self.pd.region_heartbeat(wire.dec_region(req["region"]),
+                                      wire.dec_peer(req["leader"]),
+                                      buckets=req.get("buckets"))
+        return {"operator": op}
 
     def AskSplit(self, req: dict) -> dict:
         new_id, peer_ids = self.pd.ask_split(wire.dec_region(req["region"]))
@@ -162,9 +163,12 @@ class RemotePdClient:
         r = self._call("GetRegionById", {"region_id": region_id})
         return wire.dec_region(r["region"]) if r["region"] else None
 
-    def region_heartbeat(self, region, leader) -> None:
-        self._call("RegionHeartbeat", {"region": wire.enc_region(region),
-                                       "leader": wire.enc_peer(leader)})
+    def region_heartbeat(self, region, leader, buckets=None):
+        r = self._call("RegionHeartbeat",
+                       {"region": wire.enc_region(region),
+                        "leader": wire.enc_peer(leader),
+                        "buckets": buckets})
+        return r.get("operator")
 
     def ask_split(self, region):
         r = self._call("AskSplit", {"region": wire.enc_region(region)})

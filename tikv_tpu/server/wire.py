@@ -127,9 +127,12 @@ def _enc_error_body(e: Exception) -> dict:
     if isinstance(e, EpochNotMatch):
         return {"kind": "epoch_not_match",
                 "current": enc_region(e.current)}
-    from ..raftstore.metapb import RegionMerging
+    from ..raftstore.metapb import RegionMerging, RegionNotFound
     if isinstance(e, RegionMerging):
         return {"kind": "region_merging", "region_id": e.region_id}
+    if isinstance(e, RegionNotFound):
+        # a balanced-away or merged region: the client must re-route
+        return {"kind": "region_not_found", "region_id": e.region_id}
     from .read_pool import ServerIsBusy
     if isinstance(e, ServerIsBusy):
         return {"kind": "server_is_busy", "reason": e.reason}

@@ -110,7 +110,8 @@ class Cluster:
             for peer in store.peers.values():
                 if peer.is_leader():
                     self.pd.region_heartbeat(
-                        peer.region, Peer(peer.meta.id, sid))
+                        peer.region, Peer(peer.meta.id, sid),
+                        buckets=list(peer.buckets))
 
     def tick_all(self, times: int = 1) -> None:
         for _ in range(times):
@@ -307,6 +308,44 @@ class Cluster:
             n += store.split_check(self.pd)
         self.pump()
         return n
+
+    def run_pd_operators(self, max_steps: int = 30) -> int:
+        """Heartbeat every leader and EXECUTE the operators PD returns
+        (worker/pd.rs applies the RegionHeartbeatResponse) until the
+        scheduler goes quiet.  Returns the number of steps executed."""
+        executed = 0
+        for _ in range(max_steps):
+            ops = []
+            for sid, store in self.stores.items():
+                for peer in list(store.peers.values()):
+                    if peer.is_leader():
+                        op = self.pd.region_heartbeat(
+                            peer.region, Peer(peer.meta.id, sid),
+                            buckets=list(peer.buckets))
+                        if op:
+                            ops.append((peer.region.id, op))
+            if not ops:
+                return executed
+            for rid, op in ops:
+                p = op.get("peer") or {}
+                pm = Peer(p.get("id", 0), p.get("store_id", 0),
+                          p.get("learner", False))
+                if op["type"] == "add_peer":
+                    self.change_peer(rid, "add", pm)
+                elif op["type"] == "remove_peer":
+                    self.change_peer(rid, "remove", pm)
+                elif op["type"] == "transfer_leader":
+                    # the target replica materialises on its store only
+                    # once raft appends reach it — wait for that first
+                    self._drive_until(
+                        lambda r=rid, s=pm.store_id:
+                        r in self.stores[s].peers)
+                    self.transfer_leader(rid, pm.store_id)
+                    self._drive_until(
+                        lambda r=rid, s=pm.store_id:
+                        self.leader_store(r) == s)
+                executed += 1
+        return executed
 
     def transfer_leader(self, region_id: int, to_store: int) -> None:
         peer = self.leader_peer(region_id)
