@@ -1,0 +1,197 @@
+"""VARIANCE / STDDEV / BIT_AND|OR|XOR aggregates — host pipeline vs a
+numpy oracle, and device parity for the variance family.
+
+Reference: tidb_query_aggr/src/impl_variance.rs (moment triple
+count/sum/square_sum, sample vs population), impl_bit_op.rs (AND identity
+~0, OR/XOR identity 0, never NULL).
+"""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.datatype import Column, EvalType, FieldType
+from tikv_tpu.device import DeviceRunner
+from tikv_tpu.executors.columnar import ColumnarTable
+from tikv_tpu.executors.runner import BatchExecutorsRunner
+from tikv_tpu.testing.dag import DagSelect
+from tikv_tpu.testing.fixture import Table, TableColumn
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return DeviceRunner(chunk_rows=1 << 12)
+
+
+def make_snapshot(n=9_000, seed=11, groups=13):
+    rng = np.random.default_rng(seed)
+    table = Table(7600 + seed, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("k", 2, FieldType.long()),
+        TableColumn("v", 3, FieldType.long()),
+        TableColumn("r", 4, FieldType.double()),
+    ))
+    handles = np.arange(n, dtype=np.int64)
+    k = rng.integers(0, groups, n).astype(np.int64)
+    v = rng.integers(-500, 500, n).astype(np.int64)
+    r = rng.normal(3.0, 2.5, n)
+    vvalid = (np.arange(n) % 11) != 4
+    snap = ColumnarTable.from_arrays(table, handles, {
+        "k": Column(EvalType.INT, k, np.ones(n, bool)),
+        "v": Column(EvalType.INT, v, vvalid),
+        "r": Column(EvalType.REAL, r, np.ones(n, bool)),
+    })
+    return table, snap, (k, v, vvalid, r)
+
+
+def np_var(x, kind):
+    if kind == "var_pop":
+        return float(np.var(x))
+    if kind == "var_samp":
+        return float(np.var(x, ddof=1))
+    if kind == "stddev_pop":
+        return float(np.std(x))
+    return float(np.std(x, ddof=1))
+
+
+@pytest.mark.parametrize("kind", ["var_pop", "var_samp", "stddev_pop",
+                                  "stddev_samp"])
+def test_simple_variance_host_oracle(kind):
+    table, snap, (k, v, vvalid, r) = make_snapshot()
+    sel = DagSelect.from_table(table, ["id", "k", "v", "r"])
+    dag = sel.aggregate([], [(kind, sel.col("v")),
+                             (kind, sel.col("r"))]).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    (got_v, got_r), = res.rows()
+    assert got_v == pytest.approx(np_var(v[vvalid], kind), rel=1e-9)
+    assert got_r == pytest.approx(np_var(r, kind), rel=1e-9)
+
+
+def test_simple_variance_device_parity(runner):
+    table, snap, _ = make_snapshot(seed=12)
+    sel = DagSelect.from_table(table, ["id", "k", "v", "r"])
+    dag = sel.aggregate([], [("var_pop", sel.col("v")),
+                             ("stddev_samp", sel.col("r")),
+                             ("count_star", None)]).build()
+    assert runner.supports(dag)
+    host = BatchExecutorsRunner(dag, snap).handle_request()
+    dev = runner.handle_request(dag, snap)
+    for h, d in zip(host.rows()[0], dev.rows()[0]):
+        assert d == pytest.approx(h, rel=1e-6)
+
+
+def test_hash_variance_device_parity(runner):
+    table, snap, _ = make_snapshot(seed=13, groups=29)
+    sel = DagSelect.from_table(table, ["id", "k", "v", "r"])
+    dag = sel.aggregate([sel.col("k")],
+                        [("var_pop", sel.col("v")),
+                         ("var_samp", sel.col("r")),
+                         ("avg", sel.col("v"))]).build()
+    assert runner.supports(dag)
+    host = BatchExecutorsRunner(dag, snap).handle_request()
+    dev = runner.handle_request(dag, snap)
+    hrows = sorted(host.rows(), key=lambda t: t[-1])
+    drows = sorted(dev.rows(), key=lambda t: t[-1])
+    assert len(hrows) == len(drows)
+    for h, d in zip(hrows, drows):
+        for hx, dx in zip(h, d):
+            if isinstance(hx, float):
+                assert dx == pytest.approx(hx, rel=1e-6)
+            else:
+                assert dx == hx
+
+
+def test_hash_variance_host_oracle():
+    table, snap, (k, v, vvalid, r) = make_snapshot(seed=14, groups=7)
+    sel = DagSelect.from_table(table, ["id", "k", "v", "r"])
+    dag = sel.aggregate([sel.col("k")],
+                        [("var_pop", sel.col("v"))]).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    for var, key in res.rows():
+        mask = (k == key) & vvalid
+        assert var == pytest.approx(float(np.var(v[mask])), rel=1e-9)
+
+
+def test_variance_null_cases():
+    """count=0 → NULL for *_pop; count<2 → NULL for *_samp."""
+    table = Table(7777, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("v", 2, FieldType.long()),
+    ))
+    snap = ColumnarTable.from_arrays(table, np.arange(2, dtype=np.int64), {
+        "v": Column(EvalType.INT, np.array([5, 9], np.int64),
+                    np.array([True, False])),
+    })
+    sel = DagSelect.from_table(table, ["id", "v"])
+    dag = sel.aggregate([], [("var_pop", sel.col("v")),
+                             ("var_samp", sel.col("v")),
+                             ("stddev_samp", sel.col("v"))]).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    assert res.rows() == [(0.0, None, None)]
+
+
+def test_bit_ops_host_oracle():
+    table, snap, (k, v, vvalid, r) = make_snapshot(seed=15, groups=5)
+    sel = DagSelect.from_table(table, ["id", "k", "v", "r"])
+    dag = sel.aggregate([sel.col("k")],
+                        [("bit_and", sel.col("v")),
+                         ("bit_or", sel.col("v")),
+                         ("bit_xor", sel.col("v"))]).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    U64 = 0xFFFFFFFFFFFFFFFF
+    for band, bor, bxor, key in res.rows():
+        vals = v[(k == key) & vvalid]
+        # results are the u64 bit patterns (MySQL BIT_* → unsigned BIGINT)
+        assert band == int(np.bitwise_and.reduce(vals, initial=-1)) & U64
+        assert bor == int(np.bitwise_or.reduce(vals, initial=0)) & U64
+        assert bxor == int(np.bitwise_xor.reduce(vals, initial=0)) & U64
+
+
+def test_bit_ops_empty_group_identity():
+    """MySQL: BIT_AND() of no rows = 2^64-1 (unsigned BIGINT),
+    BIT_OR/XOR = 0, and never NULL."""
+    table = Table(7778, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("v", 2, FieldType.long()),
+    ))
+    snap = ColumnarTable.from_arrays(table, np.arange(1, dtype=np.int64), {
+        "v": Column(EvalType.INT, np.array([3], np.int64),
+                    np.array([False])),
+    })
+    sel = DagSelect.from_table(table, ["id", "v"])
+    dag = sel.aggregate([], [("bit_and", sel.col("v")),
+                             ("bit_or", sel.col("v")),
+                             ("bit_xor", sel.col("v"))]).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    assert res.rows() == [(0xFFFFFFFFFFFFFFFF, 0, 0)]
+
+
+def test_bit_ops_real_arg_rounds():
+    """MySQL rounds a REAL argument to the nearest integer before the
+    bit op (impl_bit_op.rs casts through u64): BIT_OR(2.6) = 3."""
+    table = Table(7779, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("r", 2, FieldType.double()),
+    ))
+    snap = ColumnarTable.from_arrays(table, np.arange(2, dtype=np.int64), {
+        "r": Column(EvalType.REAL, np.array([2.6, 4.2]),
+                    np.ones(2, bool)),
+    })
+    sel = DagSelect.from_table(table, ["id", "r"])
+    dag = sel.aggregate([], [("bit_or", sel.col("r")),
+                             ("bit_xor", sel.col("r"))]).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    assert res.rows() == [(3 | 4, 3 ^ 4)]
+
+
+def test_bit_ops_route_to_host(runner):
+    """No XLA scatter-bitop lowering → DeviceRunner must decline the plan
+    (endpoint then runs it on the vectorized host pipeline)."""
+    table, snap, _ = make_snapshot(seed=16)
+    sel = DagSelect.from_table(table, ["id", "k", "v", "r"])
+    dag = sel.aggregate([sel.col("k")],
+                        [("bit_xor", sel.col("v"))]).build()
+    assert not runner.supports(dag)

@@ -63,7 +63,9 @@ class ProjectionDesc:
 
 @dataclass(frozen=True)
 class AggExprDesc:
-    """One aggregate call. kind ∈ count|count_star|sum|avg|min|max|first."""
+    """One aggregate call. kind ∈ count|count_star|sum|avg|min|max|first|
+    var_pop|var_samp|stddev_pop|stddev_samp|bit_and|bit_or|bit_xor
+    (reference: tidb_query_aggr impl_variance.rs, impl_bit_op.rs)."""
 
     kind: str
     arg: Optional[Expr] = None  # None for count_star

@@ -258,7 +258,10 @@ class DeviceRunner:
             agg_rpns, specs = [], []
             for i, a in enumerate(terminal.aggs):
                 if a.kind not in ("count", "count_star", "sum", "avg",
-                                 "min", "max", "first"):
+                                 "min", "max", "first", "var_pop",
+                                 "var_samp", "stddev_pop", "stddev_samp"):
+                    # bit_and/or/xor: no XLA scatter-bitop lowering on TPU
+                    # → host (they're exact int ops; host numpy is fine)
                     return None
                 if a.arg is not None:
                     r = build_rpn(a.arg)
@@ -519,7 +522,7 @@ class DeviceRunner:
         """→ (summed fields, per-shard stacked fields shaped [1, ...])."""
         summed, stacked = {}, {}
         for k, v in s.items():
-            if k in ("count", "sum", "nonnull"):
+            if k in ("count", "sum", "nonnull", "sumsq"):
                 summed[k] = v
             else:
                 stacked[k] = v[None] if getattr(v, "ndim", 0) else \
@@ -649,6 +652,11 @@ class DeviceRunner:
                 st["pos"] = np.full(sshape, _BIG, np.int64)
                 st["value"] = np.zeros(
                     sshape, np.float64 if is_real else np.int64)
+            elif spec.kind in ("var_pop", "var_samp", "stddev_pop",
+                               "stddev_samp"):
+                sm["sum"] = np.zeros(shape, np.float64)
+                sm["sumsq"] = np.zeros(shape, np.float64)
+                sm["count"] = np.zeros(shape, np.int64)
             summed.append(sm)
             stacked.append(st)
         return summed, stacked

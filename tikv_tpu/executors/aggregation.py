@@ -20,13 +20,18 @@ import numpy as np
 
 from ..datatype import Column, ColumnBatch, EvalType, FieldType
 from ..expr import build_rpn, eval_rpn
+from ..ops import agg as _agg
 from .interface import BatchExecuteResult, TimedExecutor
 
 
 def _agg_ret_ft(kind: str, arg_et: Optional[EvalType]) -> FieldType:
     if kind in ("count", "count_star"):
         return FieldType.long(not_null=True)
-    if kind == "avg":
+    if kind in _agg.BIT_KINDS:
+        # MySQL BIT_* returns unsigned BIGINT and never NULL (identity
+        # for empty groups): BIT_AND() of no rows = 2^64-1
+        return FieldType.long(unsigned=True, not_null=True)
+    if kind == "avg" or kind in _agg.VAR_KINDS:
         return FieldType.double()
     if arg_et is EvalType.REAL:
         return FieldType.double()
@@ -58,6 +63,12 @@ class _AggState:
         if kind == "first":
             self.first_vals: list = []
             self.first_set: list = []
+        if kind in _agg.VAR_KINDS:
+            self.sum = np.zeros(0, dtype=np.float64)
+            self.sumsq = np.zeros(0, dtype=np.float64)
+        if kind in _agg.BIT_KINDS:
+            self.bit_ident = np.int64(_agg._BIT_IDENT[kind])
+            self.bits = np.zeros(0, dtype=np.int64)
 
     def grow(self, n_groups: int):
         cur = len(self.count)
@@ -77,6 +88,12 @@ class _AggState:
         if self.kind == "first":
             self.first_vals.extend([None] * extra)
             self.first_set.extend([False] * extra)
+        if self.kind in _agg.VAR_KINDS:
+            self.sumsq = np.concatenate(
+                [self.sumsq, np.zeros(extra, np.float64)])
+        if self.kind in _agg.BIT_KINDS:
+            self.bits = np.concatenate(
+                [self.bits, np.full(extra, self.bit_ident, np.int64)])
 
     def update(self, gids: np.ndarray, values, validity):
         """Scatter one batch into group states. gids: int group id per row."""
@@ -112,6 +129,14 @@ class _AggState:
                         self.first_vals[g] = None
                     else:
                         self.first_vals[g] = v.item() if hasattr(v, "item") else v
+        elif kind in _agg.VAR_KINDS:
+            np.add.at(self.count, gids, oki)
+            v64 = np.where(ok, values.astype(np.float64), 0.0)
+            np.add.at(self.sum, gids, v64)
+            np.add.at(self.sumsq, gids, v64 * v64)
+        elif kind in _agg.BIT_KINDS:
+            filled = np.where(ok, _agg._bit_int64(values), self.bit_ident)
+            _agg._bit_ufunc(kind).at(self.bits, gids, filled)
         else:
             raise ValueError(kind)
 
@@ -138,6 +163,17 @@ class _AggState:
         if kind == "first":
             et = self.et or EvalType.INT
             return Column.from_list(et, self.first_vals[:n_groups])
+        if kind in _agg.VAR_KINDS:
+            var, validity = _agg.var_arrays(
+                kind, self.sum[:n_groups], self.sumsq[:n_groups],
+                self.count[:n_groups])
+            return Column(EvalType.REAL, var, validity)
+        if kind in _agg.BIT_KINDS:
+            return Column.from_list(
+                EvalType.INT,
+                [b & 0xFFFFFFFFFFFFFFFF
+                 for b in self.bits[:n_groups].tolist()],
+                unsigned=True)
         raise ValueError(kind)
 
 

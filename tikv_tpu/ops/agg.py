@@ -16,6 +16,11 @@ State shapes (G = group capacity; G=1 for simple agg):
 - MIN    → {"min": v[G] (identity-filled), "nonnull": i64[G]}
 - MAX    → symmetric
 - FIRST  → {"value": v[G], "pos": i64[G] (global row pos, identity MAX)}
+- VAR_*  → {"sum": f64[G], "sumsq": f64[G], "count": i64[G]}
+  (reference impl_variance.rs keeps the same (count, sum, square_sum)
+  moment triple precisely because it merges by addition — psum-ready)
+- BIT_*  → {"bits": i64[G]} (u64 bit pattern; AND identity ~0, OR/XOR 0;
+  reference impl_bit_op.rs — result is never NULL)
 
 Hash-agg fast path: when the int key range fits the capacity, the group id
 is ``key - base`` (direct indexing — the reference's FastHashAgg plays the
@@ -39,7 +44,9 @@ from ..datatype import EvalType
 class AggSpec:
     """One aggregate function instance in a plan.
 
-    ``kind``: count | sum | avg | min | max | first | count_star
+    ``kind``: count | sum | avg | min | max | first | count_star |
+    var_pop | var_samp | stddev_pop | stddev_samp |
+    bit_and | bit_or | bit_xor
     ``arg``: index of the source column pair in the kernel inputs (ignored
     for count_star).
     """
@@ -47,6 +54,48 @@ class AggSpec:
     kind: str
     arg: int = 0
     eval_type: EvalType = EvalType.INT
+
+
+VAR_KINDS = ("var_pop", "var_samp", "stddev_pop", "stddev_samp")
+BIT_KINDS = ("bit_and", "bit_or", "bit_xor")
+
+# MySQL BIT_AND() of zero rows is ~0 (u64 max); OR/XOR start at 0.
+_BIT_IDENT = {"bit_and": -1, "bit_or": 0, "bit_xor": 0}
+
+
+def _bit_ufunc(kind: str):
+    return {"bit_and": np.bitwise_and, "bit_or": np.bitwise_or,
+            "bit_xor": np.bitwise_xor}[kind]
+
+
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _bit_int64(values):
+    """BIT_* operand coercion: MySQL rounds REAL args to the nearest
+    integer before the bit op (impl_bit_op.rs casts through u64)."""
+    if values.dtype.kind == "f":
+        return np.rint(values).astype(np.int64)
+    return values.astype(np.int64)
+
+
+def var_arrays(kind: str, s, sq, c):
+    """Vectorized variance finalize over per-group moment arrays.
+
+    → (values f64[G], validity bool[G]); MySQL NULLability: *_pop NULL
+    when count=0, *_samp NULL when count<2.
+    """
+    s = np.asarray(s, np.float64)
+    sq = np.asarray(sq, np.float64)
+    c = np.asarray(c, np.float64)
+    samp = kind in ("var_samp", "stddev_samp")
+    validity = c >= (2 if samp else 1)
+    cd = np.where(validity, c, 1.0)
+    denom = cd - 1 if samp else cd
+    var = np.maximum(0.0, (sq - s * s / cd) / np.where(validity, denom, 1.0))
+    if kind.startswith("stddev"):
+        var = np.sqrt(var)
+    return np.where(validity, var, 0.0), validity
 
 
 def _scatter_add(xp, target, idx, vals):
@@ -133,6 +182,19 @@ def simple_agg_tile(xp, specs: Sequence[AggSpec], cols: Sequence[tuple],
             pos = xp.min(xp.where(vmask, idxs, big))
             safe = xp.minimum(pos, n - 1)
             states.append({"value": values[safe], "pos": pos})
+        elif spec.kind in VAR_KINDS:
+            v64 = values.astype("float64")
+            zero = xp.zeros_like(v64)
+            s = xp.sum(xp.where(vmask, v64, zero))
+            sq = xp.sum(xp.where(vmask, v64 * v64, zero))
+            states.append({"sum": s, "sumsq": sq, "count": nonnull})
+        elif spec.kind in BIT_KINDS:
+            if xp is not np:
+                raise ValueError(f"{spec.kind} has no device tile kernel")
+            ident = np.int64(_BIT_IDENT[spec.kind])
+            filled = np.where(vmask, _bit_int64(values), ident)
+            states.append({"bits": _bit_ufunc(spec.kind).reduce(
+                filled, initial=ident, dtype=np.int64)})
         else:
             raise ValueError(f"unknown agg kind {spec.kind}")
     return states
@@ -165,6 +227,13 @@ def merge_simple_states(xp, specs, a: list[dict], b: list[dict],
             take_b = bpos < sa["pos"]
             out.append({"value": xp.where(take_b, sb["value"], sa["value"]),
                         "pos": xp.where(take_b, bpos, sa["pos"])})
+        elif spec.kind in VAR_KINDS:
+            out.append({"sum": sa["sum"] + sb["sum"],
+                        "sumsq": sa["sumsq"] + sb["sumsq"],
+                        "count": sa["count"] + sb["count"]})
+        elif spec.kind in BIT_KINDS:
+            out.append({"bits": _bit_ufunc(spec.kind)(sa["bits"],
+                                                      sb["bits"])})
         else:
             raise ValueError(spec.kind)
     return out
@@ -186,7 +255,28 @@ def finalize_simple(specs, states: list[dict]) -> list:
         elif spec.kind == "first":
             out.append(None if int(s["pos"]) == np.iinfo(np.int64).max
                        else _item(s["value"]))
+        elif spec.kind in VAR_KINDS:
+            out.append(_finalize_var(spec.kind, float(s["sum"]),
+                                     float(s["sumsq"]), int(s["count"])))
+        elif spec.kind in BIT_KINDS:
+            out.append(int(s["bits"]) & _U64)
     return out
+
+
+def _finalize_var(kind: str, s: float, sq: float, c: int):
+    """(sum, sumsq, count) → variance/stddev; MySQL NULLability:
+    *_pop NULL when count=0, *_samp NULL when count<2."""
+    if kind in ("var_samp", "stddev_samp"):
+        if c < 2:
+            return None
+        var = max(0.0, (sq - s * s / c) / (c - 1))
+    else:
+        if c == 0:
+            return None
+        var = max(0.0, sq / c - (s / c) ** 2)
+    if kind.startswith("stddev"):
+        return float(np.sqrt(var))
+    return var
 
 
 def _item(x):
@@ -272,6 +362,23 @@ def hash_agg_tile(xp, specs: Sequence[AggSpec], key: tuple,
             p = _scatter_min(xp, p, idx, xp.where(ok, rowpos, big))
             # value lookup happens at finalize on host (gather by pos)
             states.append({"pos": p})
+        elif spec.kind in VAR_KINDS:
+            v64 = values.astype("float64")
+            zero = xp.zeros_like(v64)
+            s = _scatter_add(xp, xp.zeros((slots,), dtype="float64"), idx,
+                             xp.where(ok, v64, zero))
+            sq = _scatter_add(xp, xp.zeros((slots,), dtype="float64"), idx,
+                              xp.where(ok, v64 * v64, zero))
+            c = _scatter_add(xp, xp.zeros((slots,), dtype="int64"), idx, oki)
+            states.append({"sum": s, "sumsq": sq, "count": c})
+        elif spec.kind in BIT_KINDS:
+            if xp is not np:
+                raise ValueError(f"{spec.kind} has no device tile kernel")
+            ident = np.int64(_BIT_IDENT[spec.kind])
+            t = np.full((slots,), ident, dtype=np.int64)
+            _bit_ufunc(spec.kind).at(
+                t, idx, np.where(ok, _bit_int64(values), ident))
+            states.append({"bits": t})
         else:
             raise ValueError(spec.kind)
     return {"present": present, "overflow": overflow, "states": states}
@@ -296,6 +403,13 @@ def merge_hash_states(xp, specs, a: dict, b: dict) -> dict:
                                "nonnull": sa["nonnull"] + sb["nonnull"]})
         elif spec.kind == "first":
             out_states.append({"pos": xp.minimum(sa["pos"], sb["pos"])})
+        elif spec.kind in VAR_KINDS:
+            out_states.append({"sum": sa["sum"] + sb["sum"],
+                               "sumsq": sa["sumsq"] + sb["sumsq"],
+                               "count": sa["count"] + sb["count"]})
+        elif spec.kind in BIT_KINDS:
+            out_states.append({"bits": _bit_ufunc(spec.kind)(sa["bits"],
+                                                             sb["bits"])})
         else:
             raise ValueError(spec.kind)
     return {
@@ -347,6 +461,16 @@ def finalize_hash(specs, state: dict, base: int, capacity: int,
             nn = np.asarray(s["nonnull"])[sel]
             results.append([None if c == 0 else vals[i].item()
                             for i, c in enumerate(nn)])
+        elif spec.kind in VAR_KINDS:
+            sums = np.asarray(s["sum"])[sel]
+            sqs = np.asarray(s["sumsq"])[sel]
+            cnt = np.asarray(s["count"])[sel]
+            results.append([_finalize_var(spec.kind, float(sums[i]),
+                                          float(sqs[i]), int(c))
+                            for i, c in enumerate(cnt)])
+        elif spec.kind in BIT_KINDS:
+            results.append([int(x) & _U64
+                            for x in np.asarray(s["bits"])[sel]])
         else:
             raise ValueError(f"finalize_hash: {spec.kind} unsupported here")
     return keys, results

@@ -80,9 +80,13 @@ class Scheduler:
                         "peer": {"id": peer.id,
                                  "store_id": peer.store_id,
                                  "learner": peer.is_learner}}
-            # never remove the leader directly: move leadership first
+            # never remove the leader directly: move leadership first.
+            # Target must be a VOTER — raft silently ignores
+            # transfer-leader to a learner (raw_node._handle_transfer),
+            # which would wedge the operator in a re-issue loop.
             target = next((p for p in region.peers
-                           if p.store_id != pending), None)
+                           if p.store_id != pending
+                           and not p.is_learner), None)
             if target is not None:
                 return {"type": "transfer_leader",
                         "peer": {"id": target.id,
