@@ -187,6 +187,25 @@ def test_bit_ops_real_arg_rounds():
     assert res.rows() == [(3 | 4, 3 ^ 4)]
 
 
+def test_bit_ops_real_half_rounds_away_from_zero():
+    """MySQL rounds .5 away from zero: BIT_OR(0.5)=1; BIT_OR(-0.5) is the
+    u64 pattern of -1 (2^64-1) — np.rint's half-to-even would give 0."""
+    table = Table(7780, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("r", 2, FieldType.double()),
+    ))
+    for val, expect in ((0.5, 1), (-0.5, 0xFFFFFFFFFFFFFFFF)):
+        snap = ColumnarTable.from_arrays(
+            table, np.arange(1, dtype=np.int64),
+            {"r": Column(EvalType.REAL, np.array([val]),
+                         np.ones(1, bool))})
+        sel = DagSelect.from_table(table, ["id", "r"])
+        dag = sel.aggregate([], [("bit_or", sel.col("r"))]).build()
+        res = BatchExecutorsRunner(dag, snap).handle_request()
+        assert res.rows() == [(expect,)], (val, res.rows())
+
+
 def test_bit_ops_route_to_host(runner):
     """No XLA scatter-bitop lowering → DeviceRunner must decline the plan
     (endpoint then runs it on the vectorized host pipeline)."""

@@ -85,12 +85,22 @@ class TopNDesc:
 
 
 @dataclass(frozen=True)
+class PartitionTopNDesc:
+    """Per-partition TopN (reference: tipb PartitionTopN executor,
+    tidb_query_executors/src/partition_top_n_executor.rs)."""
+
+    partition_by: tuple  # tuple[Expr]
+    order_by: tuple      # tuple[(Expr, desc: bool)]
+    limit: int
+
+
+@dataclass(frozen=True)
 class LimitDesc:
     limit: int
 
 
 ExecDesc = Union[TableScanDesc, IndexScanDesc, SelectionDesc, ProjectionDesc,
-                 AggregationDesc, TopNDesc, LimitDesc]
+                 AggregationDesc, TopNDesc, PartitionTopNDesc, LimitDesc]
 
 
 @dataclass(frozen=True)
@@ -137,6 +147,11 @@ class DAGRequest:
                                     for a in ex.aggs), ex.streamed))
             elif isinstance(ex, TopNDesc):
                 parts.append(("topn",
+                              tuple((expr_key(e), d) for e, d in ex.order_by),
+                              ex.limit))
+            elif isinstance(ex, PartitionTopNDesc):
+                parts.append(("ptopn",
+                              tuple(expr_key(e) for e in ex.partition_by),
                               tuple((expr_key(e), d) for e, d in ex.order_by),
                               ex.limit))
             elif isinstance(ex, LimitDesc):

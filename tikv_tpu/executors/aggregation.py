@@ -177,6 +177,105 @@ class _AggState:
         raise ValueError(kind)
 
 
+def _encode_gids(enc: GroupKeyEncoder, batch: ColumnBatch) -> np.ndarray:
+    """Map each row to a global group id (assigning new ids)."""
+    n = batch.num_rows
+    cols = [(c.values, c.validity) for c in batch.columns]
+    key_cols = []
+    for rpn in enc.rpns:
+        v, ok = eval_rpn(rpn, cols, n, np)
+        key_cols.append((np.broadcast_to(v, (n,)),
+                         np.broadcast_to(ok, (n,))))
+    # batch-local dictionary encode: single int key fast path
+    if len(key_cols) == 1 and key_cols[0][0].dtype.kind in "iu":
+        v, ok = key_cols[0]
+        any_null = not ok.all()
+        valid = v[ok] if any_null else v
+        if valid.size == 0:
+            inverse = np.zeros(n, dtype=np.int64)
+            local_keys = [(None,)]
+        else:
+            m = int(valid.min())
+            span = int(valid.max()) - m + 1
+            # O(n)-bounded: no absolute floor — early 32-row batches
+            # must not pay a span-sized table per batch
+            if span <= 4 * n:
+                # dense key domain: O(n) direct-index encode — no
+                # sort (fast_hash_aggr_executor.rs specialises the
+                # single-int-key case the same way)
+                idx = np.where(ok, v - m, span) if any_null \
+                    else v - m
+                seen = np.zeros(span + (2 if any_null else 1),
+                                np.bool_)
+                seen[idx] = True
+                local_of = np.cumsum(seen, dtype=np.int64) - 1
+                inverse = local_of[idx]
+                uniq_off = np.flatnonzero(seen[:span])
+                # rebuild keys in v's dtype: a uint64 domain above
+                # 2^63 overflows int64 + python-int addition
+                uniq_vals = uniq_off.astype(v.dtype) + v.dtype.type(m)
+                local_keys = [(x,) for x in uniq_vals.tolist()]
+                if any_null and seen[span]:
+                    local_keys.append((None,))
+            else:
+                # sparse domain: one sort over the valid rows only
+                uniq, inv_valid = np.unique(valid,
+                                            return_inverse=True)
+                local_keys = [(x,) for x in uniq.tolist()]
+                if any_null:
+                    inverse = np.full(n, len(local_keys), np.int64)
+                    inverse[ok] = inv_valid
+                    local_keys.append((None,))
+                else:
+                    inverse = inv_valid.astype(np.int64, copy=False)
+    elif len(key_cols) == 1 and key_cols[0][0].dtype.kind == "f":
+        v, ok = key_cols[0]
+        uniq, inverse = np.unique(
+            np.stack([np.where(ok, v, 0), ok.astype(v.dtype)]),
+            axis=1, return_inverse=True)
+        local_keys = [((uniq[0, j].item() if uniq[1, j] else None),)
+                      for j in range(uniq.shape[1])]
+    else:
+        rows = list(zip(*[
+            [vv.item() if o and hasattr(vv, "item") else (vv if o else None)
+             for vv, o in zip(v, ok)] for v, ok in key_cols]))
+        uniq_map: dict = {}
+        inverse = np.empty(n, dtype=np.int64)
+        local_keys = []
+        for i, key in enumerate(rows):
+            j = uniq_map.get(key)
+            if j is None:
+                j = len(local_keys)
+                uniq_map[key] = j
+                local_keys.append(key)
+            inverse[i] = j
+    # local id -> global id
+    l2g = np.empty(len(local_keys), dtype=np.int64)
+    for j, key in enumerate(local_keys):
+        g = enc.index.get(key)
+        if g is None:
+            g = len(enc.keys)
+            enc.index[key] = g
+            enc.keys.append(key)
+        l2g[j] = g
+    return l2g[inverse]
+
+
+class GroupKeyEncoder:
+    """Dictionary-encodes group/partition key expressions into stable
+    global group ids (first-seen order). Shared by the hash-agg executors
+    and BatchPartitionTopNExecutor (reference assigns group ids through
+    its hashmaps the same way)."""
+
+    def __init__(self, group_rpns):
+        self.rpns = group_rpns
+        self.index: dict = {}       # key tuple -> group id
+        self.keys: list = []        # group id -> key tuple
+
+    def gids(self, batch: ColumnBatch) -> np.ndarray:
+        return _encode_gids(self, batch)
+
+
 class _HashAggBase(TimedExecutor):
     """Shared machinery: dictionary-encode group keys per batch, scatter
     into growable per-group states, emit on drain."""
@@ -191,8 +290,7 @@ class _HashAggBase(TimedExecutor):
         arg_ets = [r.ret_type if r else None for r in self._agg_rpns]
         self._states = [_AggState(a.kind, et)
                         for a, et in zip(desc.aggs, arg_ets)]
-        self._group_index: dict = {}       # key tuple -> group id
-        self._group_keys: list = []        # group id -> key tuple
+        self._enc = GroupKeyEncoder(self._group_rpns)
         self._done = False
         group_fts = []
         for rpn in self._group_rpns:
@@ -207,98 +305,15 @@ class _HashAggBase(TimedExecutor):
     def schema(self) -> list[FieldType]:
         return self._schema
 
-    def _gids_for(self, batch: ColumnBatch) -> np.ndarray:
-        """Map each row to a global group id (assigning new ids)."""
-        n = batch.num_rows
-        cols = [(c.values, c.validity) for c in batch.columns]
-        key_cols = []
-        for rpn in self._group_rpns:
-            v, ok = eval_rpn(rpn, cols, n, np)
-            key_cols.append((np.broadcast_to(v, (n,)),
-                             np.broadcast_to(ok, (n,))))
-        # batch-local dictionary encode: single int key fast path
-        if len(key_cols) == 1 and key_cols[0][0].dtype.kind in "iu":
-            v, ok = key_cols[0]
-            any_null = not ok.all()
-            valid = v[ok] if any_null else v
-            if valid.size == 0:
-                inverse = np.zeros(n, dtype=np.int64)
-                local_keys = [(None,)]
-            else:
-                m = int(valid.min())
-                span = int(valid.max()) - m + 1
-                # O(n)-bounded: no absolute floor — early 32-row batches
-                # must not pay a span-sized table per batch
-                if span <= 4 * n:
-                    # dense key domain: O(n) direct-index encode — no
-                    # sort (fast_hash_aggr_executor.rs specialises the
-                    # single-int-key case the same way)
-                    idx = np.where(ok, v - m, span) if any_null \
-                        else v - m
-                    seen = np.zeros(span + (2 if any_null else 1),
-                                    np.bool_)
-                    seen[idx] = True
-                    local_of = np.cumsum(seen, dtype=np.int64) - 1
-                    inverse = local_of[idx]
-                    uniq_off = np.flatnonzero(seen[:span])
-                    # rebuild keys in v's dtype: a uint64 domain above
-                    # 2^63 overflows int64 + python-int addition
-                    uniq_vals = uniq_off.astype(v.dtype) + v.dtype.type(m)
-                    local_keys = [(x,) for x in uniq_vals.tolist()]
-                    if any_null and seen[span]:
-                        local_keys.append((None,))
-                else:
-                    # sparse domain: one sort over the valid rows only
-                    uniq, inv_valid = np.unique(valid,
-                                                return_inverse=True)
-                    local_keys = [(x,) for x in uniq.tolist()]
-                    if any_null:
-                        inverse = np.full(n, len(local_keys), np.int64)
-                        inverse[ok] = inv_valid
-                        local_keys.append((None,))
-                    else:
-                        inverse = inv_valid.astype(np.int64, copy=False)
-        elif len(key_cols) == 1 and key_cols[0][0].dtype.kind == "f":
-            v, ok = key_cols[0]
-            uniq, inverse = np.unique(
-                np.stack([np.where(ok, v, 0), ok.astype(v.dtype)]),
-                axis=1, return_inverse=True)
-            local_keys = [((uniq[0, j].item() if uniq[1, j] else None),)
-                          for j in range(uniq.shape[1])]
-        else:
-            rows = list(zip(*[
-                [vv.item() if o and hasattr(vv, "item") else (vv if o else None)
-                 for vv, o in zip(v, ok)] for v, ok in key_cols]))
-            uniq_map: dict = {}
-            inverse = np.empty(n, dtype=np.int64)
-            local_keys = []
-            for i, key in enumerate(rows):
-                j = uniq_map.get(key)
-                if j is None:
-                    j = len(local_keys)
-                    uniq_map[key] = j
-                    local_keys.append(key)
-                inverse[i] = j
-        # local id -> global id
-        l2g = np.empty(len(local_keys), dtype=np.int64)
-        for j, key in enumerate(local_keys):
-            g = self._group_index.get(key)
-            if g is None:
-                g = len(self._group_keys)
-                self._group_index[key] = g
-                self._group_keys.append(key)
-            l2g[j] = g
-        return l2g[inverse]
-
     def _update(self, batch: ColumnBatch):
         n = batch.num_rows
         if n == 0 and self._desc.group_by:
             return
-        gids = self._gids_for(batch) if self._desc.group_by else \
+        gids = self._enc.gids(batch) if self._desc.group_by else \
             np.zeros(n, dtype=np.int64)
-        if not self._desc.group_by and not self._group_keys:
-            self._group_keys.append(())
-        n_groups = len(self._group_keys)
+        if not self._desc.group_by and not self._enc.keys:
+            self._enc.keys.append(())
+        n_groups = len(self._enc.keys)
         cols = [(c.values, c.validity) for c in batch.columns]
         for st, rpn in zip(self._states, self._agg_rpns):
             st.grow(n_groups)
@@ -310,13 +325,13 @@ class _HashAggBase(TimedExecutor):
                           np.broadcast_to(ok, (n,)))
 
     def _emit(self) -> ColumnBatch:
-        n_groups = len(self._group_keys)
+        n_groups = len(self._enc.keys)
         agg_cols = [st.finalize_column(n_groups) for st in self._states]
         group_cols = []
         for k in range(len(self._group_rpns)):
             et = self._group_rpns[k].ret_type
             group_cols.append(Column.from_list(
-                et, [key[k] for key in self._group_keys]))
+                et, [key[k] for key in self._enc.keys]))
         return ColumnBatch(self._schema, agg_cols + group_cols)
 
     def _next_batch(self, scan_rows: int) -> BatchExecuteResult:
@@ -350,8 +365,8 @@ class BatchSimpleAggExecutor(_HashAggBase):
     def _next_batch(self, scan_rows: int) -> BatchExecuteResult:
         if self._done:
             return BatchExecuteResult(ColumnBatch.empty(self._schema), True)
-        if not self._group_keys:
-            self._group_keys.append(())
+        if not self._enc.keys:
+            self._enc.keys.append(())
         r = self._child.next_batch(scan_rows)
         self._update(r.batch)
         if r.is_drained:

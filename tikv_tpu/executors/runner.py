@@ -21,6 +21,7 @@ from ..copr.dag import (
     ProjectionDesc,
     SelectionDesc,
     TableScanDesc,
+    PartitionTopNDesc,
     TopNDesc,
 )
 from ..datatype import ColumnBatch, EvalType
@@ -92,6 +93,9 @@ def build_executors(dag: DAGRequest, storage: ScanStorage) -> BatchExecutor:
                 ex = BatchSlowHashAggExecutor(ex, d)
         elif isinstance(d, TopNDesc):
             ex = BatchTopNExecutor(ex, d)
+        elif isinstance(d, PartitionTopNDesc):
+            from .top_n import BatchPartitionTopNExecutor
+            ex = BatchPartitionTopNExecutor(ex, d)
         elif isinstance(d, LimitDesc):
             ex = BatchLimitExecutor(ex, d)
         else:

@@ -73,9 +73,11 @@ _U64 = 0xFFFFFFFFFFFFFFFF
 
 def _bit_int64(values):
     """BIT_* operand coercion: MySQL rounds REAL args to the nearest
-    integer before the bit op (impl_bit_op.rs casts through u64)."""
+    integer — half away from zero, so 0.5→1 and -0.5→-1 (np.rint's
+    half-to-even would give 0 for both) — before the bit op
+    (impl_bit_op.rs casts through u64)."""
     if values.dtype.kind == "f":
-        return np.rint(values).astype(np.int64)
+        return np.trunc(values + np.copysign(0.5, values)).astype(np.int64)
     return values.astype(np.int64)
 
 

@@ -190,8 +190,8 @@ def dec_expr(d: dict):
 
 def enc_dag(dag) -> dict:
     from ..copr.dag import (
-        AggregationDesc, IndexScanDesc, LimitDesc, ProjectionDesc,
-        SelectionDesc, TableScanDesc, TopNDesc,
+        AggregationDesc, IndexScanDesc, LimitDesc, PartitionTopNDesc,
+        ProjectionDesc, SelectionDesc, TableScanDesc, TopNDesc,
     )
     execs = []
     for ex in dag.executors:
@@ -227,6 +227,12 @@ def enc_dag(dag) -> dict:
             execs.append({"k": "topn", "limit": ex.limit,
                           "order_by": [{"e": enc_expr(e), "desc": d}
                                        for e, d in ex.order_by]})
+        elif isinstance(ex, PartitionTopNDesc):
+            execs.append({"k": "ptopn", "limit": ex.limit,
+                          "partition_by": [enc_expr(e)
+                                           for e in ex.partition_by],
+                          "order_by": [{"e": enc_expr(e), "desc": d}
+                                       for e, d in ex.order_by]})
         elif isinstance(ex, LimitDesc):
             execs.append({"k": "limit", "limit": ex.limit})
         else:   # pragma: no cover
@@ -242,7 +248,8 @@ def enc_dag(dag) -> dict:
 def dec_dag(d: dict):
     from ..copr.dag import (
         AggExprDesc, AggregationDesc, ColumnInfo, DAGRequest, IndexScanDesc,
-        LimitDesc, ProjectionDesc, SelectionDesc, TableScanDesc, TopNDesc,
+        LimitDesc, PartitionTopNDesc, ProjectionDesc, SelectionDesc,
+        TableScanDesc, TopNDesc,
     )
     from ..executors.ranges import KeyRange
     execs = []
@@ -273,6 +280,11 @@ def dec_dag(d: dict):
                 ex["streamed"]))
         elif k == "topn":
             execs.append(TopNDesc(
+                tuple((dec_expr(o["e"]), o["desc"])
+                      for o in ex["order_by"]), ex["limit"]))
+        elif k == "ptopn":
+            execs.append(PartitionTopNDesc(
+                tuple(dec_expr(e) for e in ex["partition_by"]),
                 tuple((dec_expr(o["e"]), o["desc"])
                       for o in ex["order_by"]), ex["limit"]))
         elif k == "limit":

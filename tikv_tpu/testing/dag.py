@@ -19,6 +19,7 @@ from ..copr.dag import (
     ProjectionDesc,
     SelectionDesc,
     TableScanDesc,
+    PartitionTopNDesc,
     TopNDesc,
 )
 from ..datatype import EvalType
@@ -103,6 +104,13 @@ class DagSelect:
     def order_by(self, expr: Expr, desc: bool = False,
                  limit: int = 10) -> "DagSelect":
         self._execs.append(TopNDesc(((expr, desc),), limit))
+        return self
+
+    def partition_top_n(self, partition_by, order_by,
+                        limit: int) -> "DagSelect":
+        """order_by: sequence of (Expr, desc) pairs."""
+        self._execs.append(PartitionTopNDesc(
+            tuple(partition_by), tuple(order_by), limit))
         return self
 
     def limit(self, n: int) -> "DagSelect":
