@@ -214,3 +214,23 @@ def test_bit_ops_route_to_host(runner):
     dag = sel.aggregate([sel.col("k")],
                         [("bit_xor", sel.col("v"))]).build()
     assert not runner.supports(dag)
+
+
+def test_bit_ops_real_near_tie_not_double_rounded():
+    """0.5 - 2^-54 must round DOWN to 0 (it is below the tie); a naive
+    trunc(v + 0.5) double-rounds it up to 1."""
+    v = 0.49999999999999994
+    table = Table(7781, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("r", 2, FieldType.double()),
+    ))
+    for val, expect in ((v, 0), (-v, 0), (1.5, 2), (2.5, 3)):
+        snap = ColumnarTable.from_arrays(
+            table, np.arange(1, dtype=np.int64),
+            {"r": Column(EvalType.REAL, np.array([val]),
+                         np.ones(1, bool))})
+        sel = DagSelect.from_table(table, ["id", "r"])
+        dag = sel.aggregate([], [("bit_or", sel.col("r"))]).build()
+        res = BatchExecutorsRunner(dag, snap).handle_request()
+        assert res.rows() == [(expect,)], (val, res.rows())

@@ -1,0 +1,265 @@
+"""causal_ts BatchTsoProvider + ApiV2 versioned RawKV.
+
+Reference: components/causal_ts/src/tso.rs (batched TSO windows, flush
+barrier) and components/api_version/src/api_v2.rs (raw MVCC key layout,
+RawValue flags/TTL encoding).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tikv_tpu.causal_ts import BatchTsoProvider
+from tikv_tpu.pd.client import MockPd
+from tikv_tpu.storage import Storage
+
+
+# ------------------------------------------------------------ provider
+
+class CountingPd:
+    """Deterministic TSO with call accounting."""
+
+    def __init__(self):
+        self.t = 0
+        self.batch_calls = []
+
+    def tso(self):
+        self.t += 1
+        return self.t
+
+    def tso_batch(self, count):
+        self.batch_calls.append(count)
+        start = self.t + 1
+        self.t += count
+        return list(range(start, self.t + 1))
+
+
+def test_provider_monotonic_and_batched():
+    pd = CountingPd()
+    p = BatchTsoProvider(pd, init_batch=4)
+    got = [p.get_ts() for _ in range(10)]
+    assert got == sorted(got) and len(set(got)) == 10
+    # 10 timestamps must not cost 10 PD calls
+    assert len(pd.batch_calls) <= 3
+
+
+def test_provider_adaptive_growth_and_shrink():
+    pd = CountingPd()
+    p = BatchTsoProvider(pd, init_batch=4, max_batch=64)
+    for _ in range(4):
+        p.get_ts()
+    p.get_ts()                      # exhausted window → renew doubles
+    assert p.batch_size == 8
+    p.flush()                       # only 1/8 used → shrink, floored at init
+    assert p.batch_size == 4
+    # whatever the floor, timestamps stay monotonic through resizes
+    last = p.get_ts()
+    for _ in range(20):
+        nxt = p.get_ts()
+        assert nxt > last
+        last = nxt
+
+
+def test_provider_flush_is_causality_barrier():
+    pd = CountingPd()
+    p = BatchTsoProvider(pd, init_batch=32)
+    before = p.get_ts()
+    # PD hands out more timestamps elsewhere (another node)
+    elsewhere = pd.tso_batch(10)[-1]
+    p.flush()
+    after = p.get_ts()
+    assert after > elsewhere > before
+
+
+def test_provider_with_mock_pd():
+    p = BatchTsoProvider(MockPd(), init_batch=8)
+    ts = [p.get_ts() for _ in range(20)]
+    assert ts == sorted(ts) and len(set(ts)) == 20
+
+
+def test_provider_without_batch_api():
+    class Plain:
+        def __init__(self):
+            self.t = 0
+
+        def tso(self):
+            self.t += 1
+            return self.t
+
+    p = BatchTsoProvider(Plain())
+    assert [p.get_ts() for _ in range(3)] == [1, 2, 3]
+
+
+# ------------------------------------------------------------ ApiV2 raw
+
+@pytest.fixture
+def v2():
+    return Storage(api_version=2)
+
+
+def test_v2_put_get_overwrite(v2):
+    v2.raw_put(b"k1", b"a")
+    v2.raw_put(b"k1", b"b")
+    assert v2.raw_get(b"k1") == b"b"
+    assert v2.raw_get(b"missing") is None
+
+
+def test_v2_versions_retained_in_engine(v2):
+    """ApiV2 keeps every version (MVCC — what RawKV CDC observes)."""
+    from tikv_tpu.engine.traits import CF_DEFAULT
+    from tikv_tpu.kv.engine import SnapContext
+    for i in range(3):
+        v2.raw_put(b"k", b"v%d" % i)
+    snap = v2.engine.snapshot(SnapContext())
+    enc = v2._raw_key(b"k")
+    it = snap.iterator_cf(CF_DEFAULT, enc, enc + b"\xff" * 9)
+    n, ok = 0, it.seek_to_first()
+    while ok:
+        n += 1
+        ok = it.next()
+    assert n == 3
+
+
+def test_v2_delete_is_tombstone(v2):
+    v2.raw_put(b"k", b"v")
+    v2.raw_delete(b"k")
+    assert v2.raw_get(b"k") is None
+    # put after delete resurrects
+    v2.raw_put(b"k", b"w")
+    assert v2.raw_get(b"k") == b"w"
+
+
+def test_v2_scan_latest_versions_only(v2):
+    for i in range(5):
+        v2.raw_put(b"k%d" % i, b"old")
+    for i in range(5):
+        v2.raw_put(b"k%d" % i, b"new%d" % i)
+    v2.raw_delete(b"k2")
+    got = v2.raw_scan(b"k0", None, 100)
+    assert got == [(b"k0", b"new0"), (b"k1", b"new1"),
+                   (b"k3", b"new3"), (b"k4", b"new4")]
+    rev = v2.raw_scan(b"k0", None, 2, desc=True)
+    assert rev == [(b"k4", b"new4"), (b"k3", b"new3")]
+
+
+def test_v2_ttl(v2, monkeypatch):
+    now = int(time.time())
+    v2.raw_put(b"t", b"v", ttl=100)
+    v2.raw_put(b"u", b"v")
+    ttl = v2.raw_get_key_ttl(b"t")
+    assert 90 <= ttl <= 100
+    assert v2.raw_get_key_ttl(b"u") == 0
+    assert v2.raw_get_key_ttl(b"absent") is None
+    # jump past expiry
+    monkeypatch.setattr(time, "time", lambda: now + 200)
+    assert v2.raw_get(b"t") is None
+    assert v2.raw_get_key_ttl(b"t") is None
+    assert v2.raw_get(b"u") == b"v"
+
+
+def test_v2_cas(v2):
+    ok, prev = v2.raw_compare_and_swap(b"c", None, b"1")
+    assert ok and prev is None
+    ok, prev = v2.raw_compare_and_swap(b"c", b"wrong", b"2")
+    assert not ok and prev == b"1"
+    ok, prev = v2.raw_compare_and_swap(b"c", b"1", b"2")
+    assert ok and v2.raw_get(b"c") == b"2"
+
+
+def test_v2_batch_ops_and_delete_range(v2):
+    v2.raw_batch_put([(b"a", b"1"), (b"b", b"2"), (b"c", b"3")])
+    got = dict(v2.raw_batch_get([b"a", b"b", b"zz"]))
+    assert got == {b"a": b"1", b"b": b"2", b"zz": None}
+    v2.raw_delete_range(b"a", b"c")
+    assert v2.raw_scan(b"", None, 10) == [(b"c", b"3")]
+
+
+def test_v2_with_real_provider():
+    pd = MockPd()
+    s = Storage(api_version=2, causal_ts=BatchTsoProvider(pd))
+    s.raw_put(b"x", b"1")
+    s.causal_ts.flush()     # leader-transfer barrier
+    s.raw_put(b"x", b"2")
+    assert s.raw_get(b"x") == b"2"
+
+
+def test_v1_unchanged():
+    s = Storage()
+    s.raw_put(b"k", b"v")
+    s.raw_put(b"k", b"w")       # overwrite in place, single version
+    from tikv_tpu.engine.traits import CF_DEFAULT
+    from tikv_tpu.kv.engine import SnapContext
+    snap = s.engine.snapshot(SnapContext())
+    assert snap.get_value_cf(CF_DEFAULT, b"rk") == b"w"
+    s.raw_delete(b"k")
+    assert s.raw_get(b"k") is None
+    # txn and raw keyspaces still disjoint
+    s.raw_put(b"q", b"raw")
+    assert s.raw_scan(b"", None, 10) == [(b"q", b"raw")]
+
+
+def test_causal_observer_flushes_on_leadership():
+    from tikv_tpu.causal_ts import CausalObserver
+    from tikv_tpu.raftstore.observer import CoprocessorHost
+
+    pd = CountingPd()
+    p = BatchTsoProvider(pd, init_batch=16)
+    before = p.get_ts()
+    elsewhere = pd.tso_batch(5)[-1]     # old leader's allocations
+    host = CoprocessorHost()
+    host.register(CausalObserver(p))
+    host.notify_role_change(1, True)    # this node elected leader
+    after = p.get_ts()
+    assert after > elsewhere > before
+    # losing leadership does not flush
+    calls = len(pd.batch_calls)
+    host.notify_role_change(1, False)
+    assert len(pd.batch_calls) == calls
+
+
+def test_v2_restart_seeds_counter_above_persisted_ts(tmp_path):
+    """A fresh Storage over an engine with existing v2 raw data must not
+    hand out timestamps below persisted versions (new writes would sort
+    behind old ones and vanish)."""
+    from tikv_tpu.engine.disk import DiskEngine
+    from tikv_tpu.kv.engine import LocalEngine
+
+    eng = DiskEngine(str(tmp_path / "d"))
+    s1 = Storage(engine=LocalEngine(eng), api_version=2)
+    for i in range(5):
+        s1.raw_put(b"k", b"v%d" % i)
+    assert s1.raw_get(b"k") == b"v4"
+    eng.close()
+
+    eng2 = DiskEngine(str(tmp_path / "d"))
+    s2 = Storage(engine=LocalEngine(eng2), api_version=2)
+    s2.raw_put(b"k", b"after-restart")
+    assert s2.raw_get(b"k") == b"after-restart"
+    eng2.close()
+
+
+def test_v1_rejects_ttl():
+    s = Storage(api_version=1)
+    with pytest.raises(ValueError):
+        s.raw_put(b"k", b"v", ttl=10)
+
+
+def test_v2_cas_concurrent_uniqueness():
+    import threading
+    s = Storage(api_version=2)
+    wins = []
+    barrier = threading.Barrier(4)
+
+    def worker(i):
+        barrier.wait()
+        ok, _ = s.raw_compare_and_swap(b"slot", None, b"w%d" % i)
+        if ok:
+            wins.append(i)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(wins) == 1, wins

@@ -1,0 +1,94 @@
+"""Causally-ordered timestamp provider for RawKV ApiV2.
+
+Reference: components/causal_ts/src/tso.rs — ``BatchTsoProvider`` keeps a
+pre-fetched window of PD timestamps so every raw write gets a causally
+ordered ts without a per-write PD round trip.  The window is renewed when
+exhausted (doubling up to a cap, halving back when demand drops), and
+``flush()`` discards the window and fetches a fresh one — called on region
+leader transfer so the new leader's first ts exceeds anything the old
+leader handed out (lib.rs ``CausalTsProvider::flush``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Protocol
+
+
+class CausalTsProvider(Protocol):
+    def get_ts(self) -> int: ...
+    def flush(self) -> None: ...
+
+
+class BatchTsoProvider:
+    """Pre-fetched TSO window with adaptive batch sizing.
+
+    ``pd`` needs ``tso_batch(count) -> list[int]`` (monotonic ascending)
+    or falls back to per-renew ``tso()``.
+    """
+
+    DEFAULT_BATCH = 128
+    MAX_BATCH = 8192
+
+    def __init__(self, pd, init_batch: int = DEFAULT_BATCH,
+                 max_batch: int = MAX_BATCH):
+        self._pd = pd
+        self._batch = init_batch
+        self._min_batch = init_batch
+        self._max_batch = max_batch
+        self._lock = threading.Lock()
+        self._window: list[int] = []
+        self._pos = 0
+
+    def _renew(self):
+        """Fetch the next window (caller holds the lock)."""
+        # adaptive sizing (tso.rs renew_tso_batch): a fully-consumed
+        # window grows the next one; an under-half-used window shrinks it
+        if self._window:
+            used = self._pos
+            if used >= len(self._window):
+                self._batch = min(self._batch * 2, self._max_batch)
+            elif used * 2 < len(self._window):
+                self._batch = max(self._min_batch, self._batch // 2)
+        fn = getattr(self._pd, "tso_batch", None)
+        self._window = list(fn(self._batch)) if fn is not None \
+            else [self._pd.tso()]
+        self._pos = 0
+
+    def get_ts(self) -> int:
+        with self._lock:
+            if self._pos >= len(self._window):
+                self._renew()
+            ts = self._window[self._pos]
+            self._pos += 1
+            return ts
+
+    def flush(self) -> None:
+        """Discard the window and pre-fetch a fresh one.  Any ts handed
+        out after flush() is greater than every PD ts allocated before
+        it — the causality barrier used on region leader transfer."""
+        with self._lock:
+            self._renew()
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch
+
+
+from .raftstore.observer import Observer as _Observer
+
+
+class CausalObserver(_Observer):
+    """Flushes the provider when a region BECOMES leader, so the new
+    leader's first raw-write ts exceeds every ts the old leader used.
+
+    Reference: components/causal_ts/src/observer.rs — registered on the
+    raftstore CoprocessorHost's role-change seam.
+    """
+
+    def __init__(self, provider):
+        self._provider = provider
+
+    def on_role_change(self, region_id: int, is_leader: bool) -> None:
+        if is_leader:
+            self._provider.flush()

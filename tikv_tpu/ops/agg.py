@@ -73,11 +73,17 @@ _U64 = 0xFFFFFFFFFFFFFFFF
 
 def _bit_int64(values):
     """BIT_* operand coercion: MySQL rounds REAL args to the nearest
-    integer — half away from zero, so 0.5→1 and -0.5→-1 (np.rint's
-    half-to-even would give 0 for both) — before the bit op
-    (impl_bit_op.rs casts through u64)."""
+    integer — half away from zero, so 0.5→1 and -0.5→-1 — before the bit
+    op (impl_bit_op.rs casts through u64).  np.rint alone rounds ties to
+    even (0.5→0); naive trunc(v+0.5) double-rounds values just below a
+    tie (0.5-2^-54 + 0.5 == 1.0 in f64).  So: rint everywhere, and only
+    exact .5 fractions are overridden away from zero."""
     if values.dtype.kind == "f":
-        return np.trunc(values + np.copysign(0.5, values)).astype(np.int64)
+        r = np.rint(values)
+        frac = values - np.trunc(values)
+        ties = np.abs(frac) == 0.5
+        r = np.where(ties, np.trunc(values) + np.copysign(1.0, values), r)
+        return r.astype(np.int64)
     return values.astype(np.int64)
 
 

@@ -42,6 +42,8 @@ class PdClient(Protocol):
 
     def tso(self) -> int: ...
 
+    def tso_batch(self, count: int) -> list: ...
+
 
 @dataclass
 class _RegionInfo:
@@ -217,3 +219,8 @@ class MockPd:
                     self._tso_physical += 1
                     self._tso_logical = 0
             return compose_ts(self._tso_physical, self._tso_logical)
+
+    def tso_batch(self, count: int) -> list:
+        """Allocate ``count`` monotonic timestamps (pd_client tso.rs
+        batch request — the causal_ts provider's renewal path)."""
+        return [self.tso() for _ in range(count)]

@@ -185,3 +185,6 @@ class RemotePdClient:
 
     def tso(self) -> int:
         return self._call("Tso", {})["ts"][0]
+
+    def tso_batch(self, count: int) -> list:
+        return self._call("Tso", {"count": count})["ts"]
