@@ -70,6 +70,14 @@ class BatchTsoProvider:
         with self._lock:
             self._renew()
 
+    def mark_stale(self) -> None:
+        """Invalidate the window WITHOUT a PD round trip: the next
+        get_ts() renews (and a renew failure raises there, at the write
+        that needs the ts — never swallowed).  Used from apply-path
+        observers where a blocking PD call is off limits."""
+        with self._lock:
+            self._pos = len(self._window)
+
     @property
     def batch_size(self) -> int:
         return self._batch
@@ -79,11 +87,16 @@ from .raftstore.observer import Observer as _Observer
 
 
 class CausalObserver(_Observer):
-    """Flushes the provider when a region BECOMES leader, so the new
-    leader's first raw-write ts exceeds every ts the old leader used.
+    """Invalidates the provider's window when a region BECOMES leader,
+    so the new leader's first raw-write ts exceeds every ts the old
+    leader used.
 
     Reference: components/causal_ts/src/observer.rs — registered on the
-    raftstore CoprocessorHost's role-change seam.
+    raftstore CoprocessorHost's role-change seam.  Uses ``mark_stale``
+    rather than ``flush``: the observer host swallows callback
+    exceptions and runs on the apply path, so the PD renewal (and any
+    renewal failure) must happen at the next get_ts() instead — where it
+    blocks only the write that needs it and raises to its caller.
     """
 
     def __init__(self, provider):
@@ -91,4 +104,4 @@ class CausalObserver(_Observer):
 
     def on_role_change(self, region_id: int, is_leader: bool) -> None:
         if is_leader:
-            self._provider.flush()
+            self._provider.mark_stale()

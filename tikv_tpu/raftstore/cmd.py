@@ -165,6 +165,17 @@ class RaftCmd:
         return b"".join(parts)
 
     @staticmethod
+    def peek_admin_kind(buf: bytes):
+        """Cheap wire peek: the admin kind string, or None for write
+        commands — without decoding the payload.  Owns the layout
+        knowledge (16-byte header + b"A" tag + length-prefixed kind) so
+        callers never hardcode offsets."""
+        if buf[16:17] != b"A":
+            return None
+        kind, _ = _unpack_bytes(buf, 17)
+        return kind.decode()
+
+    @staticmethod
     def from_bytes(buf: bytes) -> "RaftCmd":
         region_id, conf_ver, version = struct.unpack_from(">QII", buf, 0)
         off = 16

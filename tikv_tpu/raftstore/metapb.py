@@ -88,6 +88,12 @@ class RegionNotFound(Exception):
         self.region_id = region_id
 
 
+class InconsistentRegion(Exception):
+    """Consistency check failed: this replica's data digest differs from
+    the leader's at the same applied index (the reference panics —
+    fsm/apply.rs exec_verify_hash)."""
+
+
 class RegionMerging(Exception):
     """Writes rejected while a PrepareMerge is in flight (reference:
     raftstore Error::ProposalInMergingMode) — retryable after the merge

@@ -16,6 +16,7 @@ correctness never depends on them.
 
 from __future__ import annotations
 
+import struct
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -184,6 +185,8 @@ class RaftPeer:
         # applied-but-not-yet-notified observer events + role tracking
         self._pending_obs: list = []
         self._last_role = False
+        # (index, crc32) of the last applied ComputeHash
+        self.consistency_state: Optional[tuple] = None
         # an async raft-log write is in flight (batch_system write pool)
         self._ready_inflight = False
         # sub-region bucket boundaries (split-check pass computes them)
@@ -418,6 +421,14 @@ class RaftPeer:
                     self.peer_storage.persist_apply(wb, entry.index - 1)
                     self.engine.write(wb)
                     wb = self.engine.write_batch()
+                elif not wb.is_empty() and self._is_compute_hash(entry):
+                    # ComputeHash digests the ENGINE state: earlier
+                    # writes of this same ready batch must be flushed
+                    # first or replicas batching differently would
+                    # digest different visible prefixes at one index
+                    self.peer_storage.persist_apply(wb, entry.index - 1)
+                    self.engine.write(wb)
+                    wb = self.engine.write_batch()
                 self._apply_entry(wb, entry)
             if rd.committed_entries:
                 self.peer_storage.persist_apply(
@@ -453,6 +464,12 @@ class RaftPeer:
             self.store.coprocessor_host.notify_role_change(
                 self.region.id, role)
         return out
+
+    @staticmethod
+    def _is_compute_hash(entry) -> bool:
+        if not entry.data or entry.entry_type is EntryType.CONF_CHANGE:
+            return False
+        return RaftCmd.peek_admin_kind(entry.data) == "compute_hash"
 
     def on_log_persisted(self, rd) -> list[Message]:
         """Async-IO completion: the log batch hit disk — now the acks
@@ -559,7 +576,59 @@ class RaftPeer:
             return self._exec_commit_merge(wb, admin)
         if admin.kind == "rollback_merge":
             return self._exec_rollback_merge(wb, admin)
+        if admin.kind == "compute_hash":
+            return self._exec_compute_hash(index)
+        if admin.kind == "verify_hash":
+            return self._exec_verify_hash(admin)
         raise ValueError(admin.kind)    # pragma: no cover
+
+    # -- consistency check (worker/consistency_check.rs + fsm/apply.rs
+    #    exec_compute_hash/exec_verify_hash) --
+    #
+    # The leader proposes ComputeHash; EVERY replica, applying it at the
+    # same log index over the same replicated data, computes an identical
+    # digest of the region's data CFs.  The leader then proposes
+    # VerifyHash(index, its own digest); a replica whose stored digest
+    # for that index differs has diverged — the reference panics the
+    # node, here InconsistentRegion surfaces through the drive loop.
+
+    def _exec_compute_hash(self, index: int) -> dict:
+        import zlib
+        from ..engine.traits import CF_DEFAULT, CF_LOCK, CF_WRITE
+        from .peer_storage import region_data_bounds
+        lo, hi = region_data_bounds(self.region)
+        crc = 0
+        for cf in (CF_DEFAULT, CF_LOCK, CF_WRITE):
+            crc = zlib.crc32(cf.encode(), crc)
+            it = self.engine.iterator_cf(cf, lo, hi)
+            ok = it.seek_to_first()
+            while ok:
+                crc = zlib.crc32(it.key(), crc)
+                crc = zlib.crc32(it.value(), crc)
+                ok = it.next()
+        # region state participates too (apply.rs hashes the region state
+        # key): replicas at the same index must agree on the epoch
+        ep = self.region.epoch
+        crc = zlib.crc32(struct.pack(">QII", self.region.id, ep.conf_ver,
+                                     ep.version), crc)
+        self.consistency_state = (index, crc)
+        return {"compute_hash": {"index": index, "hash": crc}}
+
+    def _exec_verify_hash(self, admin: AdminCmd) -> dict:
+        expect_index, expect_hash = struct.unpack(">QI", admin.extra)
+        st = self.consistency_state
+        if st is None or st[0] != expect_index:
+            # stale/missed ComputeHash (e.g. this replica restarted or
+            # caught up via snapshot past the compute index): the
+            # reference logs and skips — a later round re-checks
+            return {"verify_hash": "skipped"}
+        if st[1] != expect_hash:
+            from .metapb import InconsistentRegion
+            raise InconsistentRegion(
+                f"region {self.region.id} hash mismatch at index "
+                f"{expect_index}: local {st[1]:#x} != leader "
+                f"{expect_hash:#x}")
+        return {"verify_hash": "ok"}
 
     def _exec_prepare_merge(self, wb, admin: AdminCmd,
                             index: int) -> dict:

@@ -219,7 +219,8 @@ class Storage:
     def raw_get_key_ttl(self, key: bytes) -> Optional[int]:
         """Remaining TTL seconds: None = key absent; 0 = no TTL set
         (raw_get_key_ttl in mod.rs — ApiV1Ttl/ApiV2 only)."""
-        assert self.api_version == 2, "TTL requires api_version=2"
+        if self.api_version != 2:
+            raise ValueError("TTL requires api_version=2")
         snap = self._engine.snapshot(SnapContext())
         value, expire = self._v2_newest(snap, self._raw_key(key))
         if value is None:
@@ -298,22 +299,49 @@ class Storage:
         from ..codec.number import decode_bytes_memcomparable
         from .txn_types import split_ts
         out = []
-        prev_enc = None
-        ok = it.seek_to_last() if desc else it.seek_to_first()
+        if not desc:
+            # ascending: the FIRST version seen for each key is its
+            # newest (ts suffix sorts newest first)
+            prev_enc = None
+            ok = it.seek_to_first()
+            while ok and len(out) < limit:
+                enc, _ts = split_ts(it.key())
+                if enc != prev_enc:
+                    prev_enc = enc
+                    value = self._v2_decode(it.value())[0]
+                    if value is not None:
+                        user, _ = decode_bytes_memcomparable(
+                            enc, len(RAW_PREFIX))
+                        out.append((user, value))
+                ok = it.next()
+            return out
+        # descending: versions arrive oldest→newest within each key, so
+        # the LAST version seen before the key changes is the newest —
+        # emit at each key boundary from the one ongoing iterator (no
+        # per-key point seeks)
+        cur_enc = None
+        cur_raw = None
+
+        def emit():
+            if cur_enc is None:
+                return
+            value = self._v2_decode(cur_raw)[0]
+            if value is not None:
+                user, _ = decode_bytes_memcomparable(cur_enc,
+                                                     len(RAW_PREFIX))
+                out.append((user, value))
+
+        ok = it.seek_to_last()
         while ok and len(out) < limit:
-            enc_with_ts = it.key()
-            enc, _ts = split_ts(enc_with_ts)
-            if enc != prev_enc:
-                # ascending: the first version seen for a key is its
-                # newest; descending: the first seen is the oldest, so
-                # point-look up the newest for that key instead
-                prev_enc = enc
-                value = self._v2_decode(it.value())[0] if not desc \
-                    else self._v2_newest(snap, enc)[0]
-                if value is not None:
-                    user, _ = decode_bytes_memcomparable(
-                        enc, len(RAW_PREFIX))
-                    out.append((user, value))
-            ok = it.prev() if desc else it.next()
+            enc, _ts = split_ts(it.key())
+            if enc != cur_enc:
+                emit()
+                if len(out) >= limit:
+                    break
+                cur_enc = enc
+            cur_raw = it.value()
+            ok = it.prev()
+        if len(out) < limit:
+            emit()
         return out
 

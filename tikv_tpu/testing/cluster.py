@@ -220,6 +220,37 @@ class Cluster:
             raise box["result"]
         return box["result"]["right"]
 
+    def check_consistency(self, region_id: int) -> int:
+        """Consistency check round (worker/consistency_check.rs): propose
+        ComputeHash, then VerifyHash with the leader's digest.  Every
+        replica that applies VerifyHash compares its own digest; a
+        diverged replica raises InconsistentRegion out of the drive loop.
+        Returns the checked hash."""
+        import struct as _struct
+        peer = self.leader_peer(region_id)
+        assert peer is not None
+        box: dict = {}
+        peer.propose(RaftCmd(region_id, peer.region.epoch,
+                             admin=AdminCmd("compute_hash")),
+                     lambda r: box.__setitem__("computed", r))
+        self._drive_until(lambda: "computed" in box)
+        if isinstance(box["computed"], Exception):
+            raise box["computed"]
+        got = box["computed"]["compute_hash"]
+        index, digest = got["index"], got["hash"]
+        peer.propose(RaftCmd(region_id, peer.region.epoch,
+                             admin=AdminCmd(
+                                 "verify_hash",
+                                 extra=_struct.pack(">QI", index, digest))),
+                     lambda r: box.__setitem__("verified", r))
+        self._drive_until(lambda: "verified" in box)
+        if isinstance(box["verified"], Exception):
+            raise box["verified"]
+        # the leader's own apply passed; drain remaining routing so every
+        # follower applies VerifyHash too (divergence raises here)
+        self.pump()
+        return digest
+
     def change_peers_joint(self, region_id: int, changes) -> None:
         """Atomic multi-peer change via joint consensus (raft §6;
         reference: test_joint_consensus.rs).  ``changes``: list of
