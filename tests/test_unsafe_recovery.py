@@ -1,0 +1,139 @@
+"""Unsafe recovery: force leader + dead-voter eviction after majority
+loss.  Reference: components/raftstore/src/store/unsafe_recovery.rs and
+tests/integrations/raftstore/test_unsafe_recovery.rs.
+"""
+
+import pytest
+
+from tikv_tpu.raft.raw_node import ProposalDropped
+from tikv_tpu.testing.cluster import Cluster
+
+
+def test_force_leader_refused_when_quorum_alive():
+    c = Cluster(3)
+    c.bootstrap()
+    c.start()
+    peer = c.stores[2].region_peer(1)
+    with pytest.raises(ValueError):
+        peer.node.enter_force_leader({101})      # 2 of 3 survive
+
+
+def test_force_leader_refused_from_failed_voter():
+    c = Cluster(3)
+    c.bootstrap()
+    c.start()
+    peer = c.stores[2].region_peer(1)
+    with pytest.raises(ValueError):
+        peer.node.enter_force_leader({peer.node.id, 101})
+
+
+def test_unsafe_recovery_majority_loss():
+    """Kill 2 of 3 stores; the survivor force-leads, evicts the dead
+    voters, and the region serves reads and writes again."""
+    c = Cluster(3)
+    c.bootstrap()
+    c.start()
+    for i in range(10):
+        c.must_put(b"k%02d" % i, b"v%d" % i)
+    leader_sid = c.leader_store(1)
+    dead = [s for s in c.stores if s != leader_sid][:1] + [leader_sid]
+    survivor_sid = next(s for s in c.stores if s not in dead)
+    for s in dead:
+        c.stop_store(s)
+    c.unsafe_recover(1, dead)
+    # the survivor now leads a single-voter region
+    peer = c.stores[survivor_sid].region_peer(1)
+    assert peer.is_leader()
+    assert {p.store_id for p in peer.region.peers} == {survivor_sid}
+    assert not peer.node.force_failed
+    # data written before the failure is intact and writable again
+    assert c.must_get(b"k03") == b"v3"
+    c.must_put(b"after", b"recovery")
+    assert c.must_get(b"after") == b"recovery"
+
+
+def test_unsafe_recovery_picks_longest_log():
+    """With two survivors of five, recovery must pick the one holding
+    the most complete log (PD's plan does)."""
+    c = Cluster(5)
+    c.bootstrap()
+    c.start()
+    for i in range(10):
+        c.must_put(b"k%02d" % i, b"v%d" % i)
+    c.pump()
+    # identify three stores to kill, keeping two survivors
+    leader_sid = c.leader_store(1)
+    others = [s for s in c.stores if s != leader_sid]
+    dead = [leader_sid] + others[:2]
+    for s in dead:
+        c.stop_store(s)
+    c.unsafe_recover(1, dead)
+    survivors = set(c.stores)
+    peer_stores = None
+    for sid in survivors:
+        p = c.stores[sid].region_peer(1)
+        if p.is_leader():
+            peer_stores = {x.store_id for x in p.region.peers}
+    assert peer_stores == survivors
+    assert c.must_get(b"k07") == b"v7"
+    c.must_put(b"post", b"5to2")
+    assert c.must_get(b"post") == b"5to2"
+
+
+def test_force_leader_blocks_normal_proposals():
+    c = Cluster(3)
+    c.bootstrap()
+    c.start()
+    leader_sid = c.leader_store(1)
+    dead = [s for s in c.stores if s != leader_sid]
+    for s in dead:
+        c.stop_store(s)
+    peer = c.stores[leader_sid].region_peer(1)
+    dead_ids = {p.id for p in peer.region.peers
+                if p.store_id in dead}
+    peer.node.enter_force_leader(dead_ids)
+    c._drive_until(lambda: peer.is_leader())
+    with pytest.raises(ProposalDropped):
+        peer.node.propose(b"data-write")
+
+
+def test_force_leader_joint_config_gates():
+    """Joint-config gate: survivors {1,2,3} of voters={1,4,5} /
+    outgoing={1,2,3} cannot win a normal election (1 of 3 incoming
+    alive), so force leader must be PERMITTED; and commits must advance
+    even when one joint side is entirely dead."""
+    from tikv_tpu.raft.raw_node import RawNode
+    from tikv_tpu.raft.storage import MemoryRaftStorage
+
+    n = RawNode(1, MemoryRaftStorage([1, 4, 5]))
+    n.voters_outgoing = {1, 2, 3}
+    n.enter_force_leader({4, 5})        # must not raise
+    assert n.force_failed == {4, 5}
+    # outgoing side fully dead: empty-after-exclusion must impose no
+    # commit constraint
+    n2 = RawNode(1, MemoryRaftStorage([4, 5, 6]))
+    n2.voters_outgoing = {1, 2, 3}
+    n2.force_failed = {1, 2, 3}
+    assert n2._commit_index_of({1, 2, 3}) == (1 << 62)
+
+
+def test_mark_stale_keeps_adaptive_sizing_honest():
+    from tikv_tpu.causal_ts import BatchTsoProvider
+
+    class Pd:
+        def __init__(self):
+            self.t = 0
+
+        def tso_batch(self, count):
+            start = self.t + 1
+            self.t += count
+            return list(range(start, self.t + 1))
+
+    p = BatchTsoProvider(Pd(), init_batch=16, max_batch=64)
+    p.get_ts()
+    for _ in range(10):
+        p.mark_stale()      # repeated leadership churn, light traffic
+        p.get_ts()
+    # each renew saw ~1 ts used of 16 → batch must have shrunk/stayed
+    # at the floor, never doubled toward max
+    assert p.batch_size == 16

@@ -39,6 +39,7 @@ class BatchTsoProvider:
         self._lock = threading.Lock()
         self._window: list[int] = []
         self._pos = 0
+        self._stale = False
 
     def _renew(self):
         """Fetch the next window (caller holds the lock)."""
@@ -54,10 +55,11 @@ class BatchTsoProvider:
         self._window = list(fn(self._batch)) if fn is not None \
             else [self._pd.tso()]
         self._pos = 0
+        self._stale = False
 
     def get_ts(self) -> int:
         with self._lock:
-            if self._pos >= len(self._window):
+            if self._stale or self._pos >= len(self._window):
                 self._renew()
             ts = self._window[self._pos]
             self._pos += 1
@@ -74,9 +76,11 @@ class BatchTsoProvider:
         """Invalidate the window WITHOUT a PD round trip: the next
         get_ts() renews (and a renew failure raises there, at the write
         that needs the ts — never swallowed).  Used from apply-path
-        observers where a blocking PD call is off limits."""
+        observers where a blocking PD call is off limits.  The true
+        ``_pos`` is preserved so adaptive sizing sees real usage, not a
+        faked full window."""
         with self._lock:
-            self._pos = len(self._window)
+            self._stale = True
 
     @property
     def batch_size(self) -> int:

@@ -93,6 +93,10 @@ class RawNode:
         self.vote = hs.vote
         self.commit = hs.commit
         self.voters: set[int] = set(voters)
+        # unsafe recovery: voter ids certified dead — excluded from all
+        # quorums while non-empty (in-memory only; PD re-issues the
+        # recovery plan after a restart, store/unsafe_recovery.rs)
+        self.force_failed: set[int] = set()
         self.learners: set[int] = set(learners)
         # joint consensus (raft §6): non-empty while in C_old,new —
         # commits and elections then need majorities of BOTH sets
@@ -169,7 +173,15 @@ class RawNode:
         return self.voters | self.voters_outgoing
 
     def _majority_of(self, ids: set, granted) -> bool:
-        """``granted(nid) -> bool`` holds for a majority of ``ids``."""
+        """``granted(nid) -> bool`` holds for a majority of ``ids``.
+
+        Unsafe recovery (store/unsafe_recovery.rs ForceLeader): voters
+        declared failed are excluded from every quorum computation, so
+        the surviving minority can elect and commit the membership
+        change that removes the dead peers.
+        """
+        if self.force_failed:
+            ids = ids - self.force_failed
         if not ids:
             return True
         return sum(1 for nid in ids if granted(nid)) >= \
@@ -266,7 +278,7 @@ class RawNode:
         campaigns immediately via TIMEOUT_NOW).
         """
         if self.state != LEADER or not self._pre_vote or \
-                self._lead_transferee:
+                self._lead_transferee or self.force_failed:
             return False
         window = self._election_tick - 2
         if window <= 0:
@@ -293,6 +305,36 @@ class RawNode:
         def live(nid):
             return nid == self.id or ack_live(nid)
         return self._joint_won(live)
+
+    def enter_force_leader(self, failed: set) -> None:
+        """Unsafe recovery: certify ``failed`` voter ids as dead and
+        campaign with the surviving minority as the full quorum.
+
+        Refused when the survivors alone still form a majority — a
+        normal election must be used then (the reference's PD-driven
+        plan applies the same gate), and when this node is itself in the
+        failed set.
+        """
+        failed = set(failed) & self.all_voters()
+        if self.id in failed:
+            raise ValueError("cannot force-lead from a failed voter")
+
+        def alive_majority(ids: set) -> bool:
+            if not ids:
+                return True
+            return sum(1 for n in ids if n not in failed) >= \
+                len(ids) // 2 + 1
+        # refuse only when a NORMAL election could still win — in a
+        # joint config that needs a live majority of BOTH sets
+        if alive_majority(self.voters) and \
+                alive_majority(self.voters_outgoing):
+            raise ValueError(
+                "survivors form a quorum; use a normal election")
+        self.force_failed = failed
+        self.campaign(force=True)
+
+    def exit_force_leader(self) -> None:
+        self.force_failed = set()
 
     def campaign(self, force: bool = False) -> None:
         if self._pre_vote and not force:
@@ -341,6 +383,11 @@ class RawNode:
         """Append a proposal; returns its index.  Raises if not leader."""
         if self.state != LEADER:
             raise NotLeader(self.leader_id)
+        if self.force_failed:
+            # force-leader mode exists ONLY to drive the membership
+            # change that evicts dead voters (unsafe_recovery.rs: normal
+            # proposals are rejected until recovery completes)
+            raise ProposalDropped("force leader: recovery in progress")
         if self._lead_transferee:
             raise ProposalDropped("leader transfer in progress")
         index = self.last_index() + 1
@@ -504,6 +551,13 @@ class RawNode:
                                ctx=self._tick_count))
 
     def _commit_index_of(self, ids: set) -> int:
+        if self.force_failed:
+            ids = ids - self.force_failed
+            if not ids:
+                # every voter of this set is certified dead: the set
+                # imposes NO constraint (mirrors _majority_of's vacuous
+                # truth) — 0 would freeze commits during recovery
+                return 1 << 62
         matches = sorted((self.progress[nid].match for nid in ids
                           if nid in self.progress), reverse=True)
         if len(matches) < len(ids) // 2 + 1:

@@ -220,6 +220,45 @@ class Cluster:
             raise box["result"]
         return box["result"]["right"]
 
+    def unsafe_recover(self, region_id: int, failed_stores) -> None:
+        """Unsafe recovery after majority loss (store/unsafe_recovery.rs,
+        PD's recovery plan): force-lead the healthiest survivor, then
+        evict every peer on the failed stores via one joint conf change.
+
+        Caller certifies ``failed_stores`` are permanently dead (the
+        stores must already be stopped); survivors-only quorums make a
+        resurrected dead store a split-brain risk, exactly as in the
+        reference."""
+        failed_stores = set(failed_stores)
+        survivors = []
+        for sid, store in self.stores.items():
+            if sid in failed_stores:
+                continue
+            try:
+                survivors.append(store.region_peer(region_id))
+            except Exception:   # noqa: BLE001 — store has no such peer
+                continue
+        assert survivors, "no surviving replica"
+        # PD picks the survivor with the most complete log
+        best = max(survivors, key=lambda p: p.node.last_index())
+        failed_peer_ids = {p.id for p in best.region.peers
+                           if p.store_id in failed_stores}
+        best.node.enter_force_leader(failed_peer_ids)
+        self._drive_until(lambda: best.is_leader())
+        dead = [("remove", p) for p in best.region.peers
+                if p.store_id in failed_stores]
+        from ..raftstore.cmd import encode_change_peer_v2
+        box: dict = {}
+        cmd = RaftCmd(region_id, best.region.epoch, admin=AdminCmd(
+            "change_peer_v2", extra=encode_change_peer_v2(dead)))
+        best.propose(cmd, lambda r: box.__setitem__("result", r))
+        self._drive_until(lambda: "result" in box)
+        if isinstance(box["result"], Exception):
+            raise box["result"]
+        # wait out the auto leave-joint so the final config is simple
+        self._drive_until(lambda: not best.node.in_joint())
+        best.node.exit_force_leader()
+
     def check_consistency(self, region_id: int) -> int:
         """Consistency check round (worker/consistency_check.rs): propose
         ComputeHash, then VerifyHash with the leader's digest.  Every
