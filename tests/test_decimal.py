@@ -1,0 +1,237 @@
+"""Real DECIMAL semantics end to end: MySQL scale/rounding rules,
+storage → scan → executors → aggregates, ordering, and codecs.
+
+Reference: tidb_query_datatype/src/codec/mysql/decimal.rs and the
+decimal ScalarFuncSig families in tidb_query_expr.
+"""
+
+from decimal import Decimal as D
+
+import numpy as np
+import pytest
+
+from tikv_tpu.datatype import Column, EvalType, FieldType
+from tikv_tpu.datatype import mydecimal as md
+from tikv_tpu.executors.columnar import ColumnarTable
+from tikv_tpu.executors.runner import BatchExecutorsRunner
+from tikv_tpu.expr import Expr
+from tikv_tpu.testing.dag import DagSelect
+from tikv_tpu.testing.fixture import Table, TableColumn
+
+
+# ------------------------------------------------------------- mydecimal
+
+def test_scale_rules():
+    assert md.add(D("1.25"), D("2.5")) == D("3.75")
+    # mul: scales add
+    assert md.mul(D("1.5"), D("0.25")) == D("0.375")
+    # div: dividend scale + 4, round half up
+    assert md.div(D("1"), D("3")) == D("0.3333")
+    assert md.div(D("1.0"), D("3")) == D("0.33333")
+    assert md.div(D("2"), D("3")) == D("0.6667")
+    assert md.div(D("5"), D("0")) is None
+    # mod follows dividend sign
+    assert md.mod(D("7"), D("-3")) == D("1")
+    assert md.mod(D("-7"), D("3")) == D("-1")
+    assert md.mod(D("1"), D("0")) is None
+
+
+def test_round_half_away_from_zero():
+    assert md.round_frac(D("2.5")) == D("3")
+    assert md.round_frac(D("-2.5")) == D("-3")
+    assert md.round_frac(D("1.245"), 2) == D("1.25")
+    assert md.round_frac(D("123"), -2) == D("1E+2")
+    assert md.to_int(D("-0.5")) == -1
+    assert md.truncate(D("1.999"), 1) == D("1.9")
+    assert md.ceil(D("1.01")) == D("2") and md.floor(D("-1.01")) == D("-2")
+
+
+def test_65_digit_precision():
+    a = D("9" * 40)
+    b = D("1." + "9" * 24)
+    got = md.add(a, b)
+    # all 65 significant digits survive (stdlib default context would
+    # have rounded to 28; f64 would have collapsed entirely)
+    assert got == D("1" + "0" * 40 + "." + "9" * 24)
+
+
+def test_from_string_prefix_parse():
+    assert md.from_string(b"12.5abc") == D("12.5")
+    assert md.from_string(b"  -3.25  ") == D("-3.25")
+    assert md.from_string(b"abc") == D(0)
+    assert md.from_string(b"") == D(0)
+    assert md.from_string(b"1e3x") == D(1000)
+    assert md.from_string(b"1.2.3") == D("1.2")
+
+
+def test_to_string_preserves_scale():
+    assert md.to_string(D("1.20")) == b"1.20"
+    assert md.to_string(D("-0.5000")) == b"-0.5000"
+
+
+# ------------------------------------------------------------- pipeline
+
+def make_snapshot():
+    table = Table(8600, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("k", 2, FieldType.long()),
+        TableColumn("price", 3, FieldType.new_decimal(flen=10, frac=2)),
+    ))
+    prices = [D("1.25"), D("2.50"), None, D("-0.75"), D("1.25"),
+              D("100.01")]
+    ks = [1, 1, 1, 2, 2, 2]
+    n = len(prices)
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"k": Column(EvalType.INT, np.array(ks, np.int64),
+                     np.ones(n, bool)),
+         "price": Column.from_list(EvalType.DECIMAL, prices)})
+    return table, snap
+
+
+def test_scan_and_filter_decimal():
+    table, snap = make_snapshot()
+    sel = DagSelect.from_table(table, ["id", "k", "price"])
+    dag = sel.where(Expr.call(
+        "GtDecimal", sel.col("price"),
+        Expr.const(D("1.25"), EvalType.DECIMAL))).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    assert [r[0] for r in res.rows()] == [1, 5]
+    assert res.rows()[0][2] == D("2.50")
+
+
+def test_decimal_arithmetic_projection():
+    table, snap = make_snapshot()
+    sel = DagSelect.from_table(table, ["id", "k", "price"])
+    dag = sel.project(
+        Expr.call("MultiplyDecimal", sel.col("price"),
+                  Expr.const(D("3"), EvalType.DECIMAL)),
+        Expr.call("DivideDecimal", sel.col("price"),
+                  Expr.const(D("0"), EvalType.DECIMAL)),
+    ).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    rows = res.rows()
+    assert rows[0] == (D("3.75"), None)      # div by zero → NULL
+    assert rows[3] == (D("-2.25"), None)
+
+
+def test_decimal_aggregates():
+    table, snap = make_snapshot()
+    sel = DagSelect.from_table(table, ["id", "k", "price"])
+    dag = sel.aggregate([sel.col("k")],
+                        [("sum", sel.col("price")),
+                         ("avg", sel.col("price")),
+                         ("min", sel.col("price")),
+                         ("max", sel.col("price")),
+                         ("count", sel.col("price"))]).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    by_k = {r[-1]: r[:-1] for r in res.rows()}
+    s, a, lo, hi, cnt = by_k[1]
+    assert s == D("3.75") and cnt == 2
+    assert a == D("1.875000")       # scale + 4 via decimal division
+    assert lo == D("1.25") and hi == D("2.50")
+    s2, a2, lo2, hi2, cnt2 = by_k[2]
+    assert s2 == D("100.51") and lo2 == D("-0.75") and hi2 == D("100.01")
+
+
+def test_decimal_topn_ordering():
+    table, snap = make_snapshot()
+    sel = DagSelect.from_table(table, ["id", "k", "price"])
+    dag = sel.order_by(sel.col("price"), desc=True, limit=3).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    assert [r[2] for r in res.rows()] == [D("100.01"), D("2.50"),
+                                          D("1.25")]
+
+
+def test_decimal_group_by_key():
+    table, snap = make_snapshot()
+    sel = DagSelect.from_table(table, ["id", "k", "price"])
+    dag = sel.aggregate([sel.col("price")],
+                        [("count_star", None)]).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    got = {r[1]: r[0] for r in res.rows()}
+    assert got[D("1.25")] == 2 and got[None] == 1
+
+
+def test_decimal_through_row_storage():
+    """Decimal datums survive the row codec (storage → MVCC scan)."""
+    from tikv_tpu.testing import init_with_data
+    table = Table(8601, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("amount", 2, FieldType.new_decimal()),
+    ))
+    store = init_with_data(table, [
+        (1, {"amount": D("12.34")}),
+        (2, {"amount": None}),
+        (3, {"amount": D("-0.01")}),
+    ])
+    dag = DagSelect.from_table(table).build()
+    res = BatchExecutorsRunner(dag, store).handle_request()
+    assert res.rows() == [(1, D("12.34")), (2, None), (3, D("-0.01"))]
+
+
+def test_decimal_casts():
+    from tikv_tpu.expr import build_rpn, eval_rpn
+    vals = np.array([D("1.5"), D("-2.5")], object)
+    pair = (vals, np.ones(2, bool))
+
+    def run(sig, ret_pairs=None):
+        e = Expr.call(sig, Expr.column(0, EvalType.DECIMAL))
+        rpn = build_rpn(e)
+        return eval_rpn(rpn, [pair], 2, np)
+
+    v, m = run("CastDecimalAsInt")
+    assert list(v) == [2, -3]           # half away from zero
+    v, m = run("CastDecimalAsReal")
+    assert list(v) == [1.5, -2.5]
+    v, m = run("CastDecimalAsString")
+    assert list(v) == [b"1.5", b"-2.5"]
+    e = Expr.call("CastStringAsDecimal", Expr.column(0, EvalType.BYTES))
+    v, m = eval_rpn(build_rpn(e),
+                    [(np.array([b"7.25x", b"nope"], object),
+                      np.ones(2, bool))], 2, np)
+    assert list(v) == [D("7.25"), D(0)]
+
+
+def test_decimal_wire_roundtrip():
+    from tikv_tpu.server.wire import pack, unpack
+    row = [D("1.20"), None, D("-99999999999999999999.000000001"), 5]
+    got = unpack(pack(row))
+    assert got == row and str(got[0]) == "1.20"
+
+
+def test_decimal_mc_datum_order():
+    from tikv_tpu.codec.mc_datum import decode_mc_datum, encode_mc_datum
+    vals = [D("-100.5"), D("-1"), D("0"), D("0.001"), D("1.25"),
+            D("99999999.99")]
+    encs = [encode_mc_datum(v) for v in vals]
+    assert encs == sorted(encs)         # byte order == numeric order
+    for v, e in zip(vals, encs):
+        d, off = decode_mc_datum(e)
+        assert d == v and off == len(e)
+
+
+def test_ceil_floor_dec_to_int_sigs():
+    """Regression: late-bound loop capture made CeilDecToInt floor."""
+    from tikv_tpu.expr import build_rpn, eval_rpn
+    pair = (np.array([D("1.5"), D("-1.5")], object), np.ones(2, bool))
+    for sig, expect in (("CeilDecToInt", [2, -1]),
+                        ("FloorDecToInt", [1, -2])):
+        e = Expr.call(sig, Expr.column(0, EvalType.DECIMAL))
+        v, m = eval_rpn(build_rpn(e), [pair], 2, np)
+        assert list(v) == expect, sig
+
+
+def test_mc_datum_high_precision_and_saturation():
+    from tikv_tpu.codec.mc_datum import decode_mc_datum, encode_mc_datum
+    a = D("1." + "0" * 27 + "1")
+    b = D("1." + "0" * 28)
+    ea, eb = encode_mc_datum(a), encode_mc_datum(b)
+    assert ea != eb and ea > eb          # distinct keys, correct order
+    assert decode_mc_datum(ea)[0] == a
+    # beyond-range magnitudes saturate instead of crashing
+    big = encode_mc_datum(D("1E+100"))
+    small = encode_mc_datum(D("-1E+100"))
+    assert small < encode_mc_datum(D("0")) < big

@@ -288,15 +288,17 @@ def test_string_compare_and_control():
 
 
 def test_decimal_family():
-    # scaled int64 at scale 2: 1.23 -> 123
-    a = (np.array([123, -50, 0], np.int64), np.array([True, True, False]))
-    b = (np.array([77, -50, 10], np.int64), np.array([True, True, True]))
+    from decimal import Decimal as Dec
+    a = (np.array([Dec("1.23"), Dec("-0.50"), Dec(0)], object),
+         np.array([True, True, False]))
+    b = (np.array([Dec("0.77"), Dec("-0.50"), Dec("0.10")], object),
+         np.array([True, True, True]))
     assert as_list(ev(call("PlusDecimal", c(0, DEC), c(1, DEC)),
-                     [a, b], 3)) == [200, -100, None]
+                     [a, b], 3)) == [Dec("2.00"), Dec("-1.00"), None]
     assert as_list(ev(call("GtDecimal", c(0, DEC), c(1, DEC)),
                      [a, b], 3)) == [1, 0, None]
     assert as_list(ev(call("AbsDecimal", c(0, DEC)), [a], 3)) == \
-        [123, 50, None]
+        [Dec("1.23"), Dec("0.50"), None]
     assert as_list(ev(call("DecimalIsNull", c(0, DEC)), [a], 3)) == \
         [0, 0, 1]
 

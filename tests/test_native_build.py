@@ -114,7 +114,7 @@ def test_native_big_values_spill_to_default_cf():
 
 
 def test_native_refuses_decimal_schema():
-    """DECIMAL payloads are tuples in the row codec — outside the native
+    """DECIMAL payloads are msgpack ExtType datums — outside the native
     envelope; the build must fall back, not mis-decode."""
     eng = MemoryEngine()
     table = Table(503, (

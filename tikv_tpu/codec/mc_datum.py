@@ -21,6 +21,15 @@ NIL_FLAG = 0x00
 BYTES_FLAG = 0x01
 INT_FLAG = 0x03
 FLOAT_FLAG = 0x05
+DECIMAL_FLAG = 0x06
+
+# DECIMAL memcomparable form: the value scaled to 10^30 (MySQL's max
+# scale) as a bias-shifted fixed-width big-endian integer — byte order
+# == numeric order across signs.  65+30 digits < 2^383, so 48 bytes with
+# a 2^383 bias always fit.  (The reference's decimal.rs writes its own
+# sortable word format; same property, different bytes.)
+_DEC_W = 48
+_DEC_BIAS = 1 << (_DEC_W * 8 - 1)
 
 
 def _encode_f64(v: float) -> bytes:
@@ -52,6 +61,20 @@ def encode_mc_datum(v) -> bytes:
         return bytes([FLOAT_FLAG]) + _encode_f64(v)
     if isinstance(v, (bytes, bytearray)):
         return bytes([BYTES_FLAG]) + encode_bytes_memcomparable(bytes(v))
+    import decimal
+    if isinstance(v, decimal.Decimal):
+        # prec must cover the scaled form (65 digits + 30 scale = 95);
+        # the thread's default 28-digit context would silently collide
+        # distinct keys
+        with decimal.localcontext(prec=100):
+            scaled = int(v.scaleb(30).to_integral_value(
+                rounding=decimal.ROUND_HALF_UP))
+        # saturate at the representable bound (MySQL clamps to the max
+        # decimal the same way) — values like 1E+100 are CTX-legal
+        lim = _DEC_BIAS - 1
+        scaled = max(-lim, min(lim, scaled))
+        return bytes([DECIMAL_FLAG]) + \
+            (scaled + _DEC_BIAS).to_bytes(_DEC_W, "big")
     raise TypeError(f"cannot mc-encode {type(v)}")
 
 
@@ -67,4 +90,14 @@ def decode_mc_datum(b: bytes, offset: int = 0):
         return _decode_f64(b, offset), offset + 8
     if flag == BYTES_FLAG:
         return decode_bytes_memcomparable(b, offset)
+    if flag == DECIMAL_FLAG:
+        import decimal
+        scaled = int.from_bytes(b[offset:offset + _DEC_W], "big") \
+            - _DEC_BIAS
+        # scale-30 form: numerically exact, original printed scale is
+        # not preserved (1.20 decodes == 1.2) — value order/equality is
+        # what index keys need
+        with decimal.localcontext(prec=100):
+            d = decimal.Decimal(scaled).scaleb(-30).normalize()
+        return d, offset + _DEC_W
     raise ValueError(f"bad datum flag {flag}")

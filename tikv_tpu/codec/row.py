@@ -3,10 +3,15 @@
 Reference: tidb_query_datatype/src/codec/datum.rs (self-describing datum
 encoding) and codec/row/v2 (compact row format). Our wire format is a
 msgpack map {column_id: datum} where a datum is a native msgpack scalar
-(int / float / bytes / None); DECIMAL is (b"\\x01dec", scaled_int, frac),
-DATETIME/ENUM/SET travel as their packed u64 cores. This keeps the format
-self-describing (schema evolution: missing column → default/NULL, like
-row-v2) while making host-side batch decode a single C-extension pass.
+(int / float / bytes / None); DECIMAL is a msgpack ExtType(1) carrying
+its exact, scale-preserving text form; DATETIME/ENUM/SET travel as their
+packed u64 cores. This keeps the format self-describing (schema
+evolution: missing column → default/NULL, like row-v2) while making
+host-side batch decode a single C-extension pass.
+
+``msgpack_default`` / ``msgpack_ext_hook`` are THE one codec for
+non-native datums — server/wire.py uses the same pair, so row storage
+and RPC encoding can never desynchronize.
 """
 
 from __future__ import annotations
@@ -16,6 +21,20 @@ from typing import Optional
 import msgpack
 
 _EXT_DECIMAL = 1
+
+
+def msgpack_default(obj):
+    import decimal
+    if isinstance(obj, decimal.Decimal):
+        return msgpack.ExtType(_EXT_DECIMAL, format(obj, "f").encode())
+    raise TypeError(f"unencodable datum: {type(obj)}")
+
+
+def msgpack_ext_hook(code, data):
+    if code == _EXT_DECIMAL:
+        from ..datatype.mydecimal import CTX
+        return CTX.create_decimal(data.decode())
+    return msgpack.ExtType(code, data)
 
 
 def encode_datum(v) -> object:
@@ -28,8 +47,9 @@ def decode_datum(v) -> object:
 
 def encode_row(cols: dict[int, object]) -> bytes:
     """cols: {column_id: python value or None}."""
-    return msgpack.packb(cols, use_bin_type=True)
+    return msgpack.packb(cols, use_bin_type=True, default=msgpack_default)
 
 
 def decode_row(data: bytes) -> dict[int, object]:
-    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+    return msgpack.unpackb(data, raw=False, strict_map_key=False,
+                           ext_hook=msgpack_ext_hook)

@@ -116,7 +116,7 @@ def _build_native(snap, table_id: int, col_infos: Sequence, read_ts: int):
                                   tuple(ids), tuple(kinds))
     except ValueError:
         # stored row payloads can hold datums outside the native
-        # envelope (DECIMAL tuples of *unrequested* columns, exotic
+        # envelope (DECIMAL ExtType datums of *unrequested* columns, exotic
         # tags): the interpreted path is the behavioral reference
         return None
 

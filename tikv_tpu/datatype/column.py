@@ -62,9 +62,15 @@ class Column:
         validity = np.fromiter((x is not None for x in items), dtype=np.bool_, count=n)
         dtype = eval_type.np_dtype
         if dtype == np.dtype(object):
+            # NULL slots hold a harmless same-type value so vectorized
+            # object ops never mix bytes with Decimal
+            if eval_type is EvalType.DECIMAL:
+                from .mydecimal import ZERO as fill
+            else:
+                fill = b""
             values = np.empty(n, dtype=object)
             for i, x in enumerate(items):
-                values[i] = x if x is not None else b""
+                values[i] = x if x is not None else fill
         else:
             if dtype == np.int64 and (unsigned or any(
                     x is not None and x >= 1 << 63 for x in items)):

@@ -24,7 +24,9 @@ class EvalType(enum.Enum):
 
     INT = "int"            # signed/unsigned 64-bit (device: int64 pair-emulated, or int32 fast path)
     REAL = "real"          # f64 on host, f32 accumulate-in-f64 on device
-    DECIMAL = "decimal"    # fixed point (host-side; device via scaled int64)
+    DECIMAL = "decimal"    # fixed point: decimal.Decimal objects, MySQL
+                           # 65-digit semantics (datatype/mydecimal.py);
+                           # host-only — device plans route INT/REAL
     BYTES = "bytes"        # var-length binary/string (host; device via dict-encoding)
     DATETIME = "datetime"  # packed u64 core time
     DURATION = "duration"  # i64 nanoseconds
@@ -42,7 +44,6 @@ class EvalType(enum.Enum):
             EvalType.DURATION,
             EvalType.ENUM,
             EvalType.SET,
-            EvalType.DECIMAL,
         )
 
     @property
@@ -54,10 +55,7 @@ class EvalType(enum.Enum):
             return np.dtype(np.float64)
         if self in (EvalType.DATETIME, EvalType.ENUM, EvalType.SET):
             return np.dtype(np.uint64)
-        if self is EvalType.DECIMAL:
-            # scaled integer representation: value * 10^frac_digits
-            return np.dtype(np.int64)
-        return np.dtype(object)  # BYTES / JSON
+        return np.dtype(object)  # BYTES / JSON / DECIMAL
 
 
 class FieldTypeTp(enum.IntEnum):

@@ -33,7 +33,7 @@ TILE_ROWS = 1 << 20
 
 
 def _device_dtype(eval_type: EvalType, values: np.ndarray) -> np.dtype:
-    if eval_type in (EvalType.INT, EvalType.DURATION, EvalType.DECIMAL):
+    if eval_type in (EvalType.INT, EvalType.DURATION):
         if values.size and (values.min() < -(2**31) or values.max() >= 2**31):
             return np.dtype(np.int64)
         return np.dtype(np.int32)

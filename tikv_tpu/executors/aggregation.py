@@ -31,6 +31,9 @@ def _agg_ret_ft(kind: str, arg_et: Optional[EvalType]) -> FieldType:
         # MySQL BIT_* returns unsigned BIGINT and never NULL (identity
         # for empty groups): BIT_AND() of no rows = 2^64-1
         return FieldType.long(unsigned=True, not_null=True)
+    if arg_et is EvalType.DECIMAL and kind not in _agg.VAR_KINDS:
+        # MySQL SUM/AVG/MIN/MAX over DECIMAL stay DECIMAL
+        return FieldType.new_decimal()
     if kind == "avg" or kind in _agg.VAR_KINDS:
         return FieldType.double()
     if arg_et is EvalType.REAL:
@@ -47,8 +50,16 @@ class _AggState:
         self.kind = kind
         self.et = et
         dtype = np.float64 if et is EvalType.REAL else np.int64
-        self.obj = et is EvalType.BYTES
-        self.sum = np.zeros(0, dtype=dtype) if not self.obj else None
+        self.dec = et is EvalType.DECIMAL
+        # obj: per-row python loops for order-sensitive states (BYTES
+        # and DECIMAL both compare as python objects)
+        self.obj = et is EvalType.BYTES or self.dec
+        if self.dec:
+            # DECIMAL sums stay exact decimals (np.add.at object loop;
+            # int 0 init is a valid Decimal addend)
+            self.sum = np.zeros(0, dtype=object)
+        else:
+            self.sum = np.zeros(0, dtype=dtype) if not self.obj else None
         self.count = np.zeros(0, dtype=np.int64)
         if kind in ("min", "max"):
             if self.obj:
@@ -107,8 +118,15 @@ class _AggState:
             np.add.at(self.count, gids, oki)
         elif kind in ("sum", "avg"):
             np.add.at(self.count, gids, oki)
-            masked = np.where(ok, values, 0).astype(self.sum.dtype)
-            np.add.at(self.sum, gids, masked)
+            if self.dec:
+                import decimal as _d
+                from ..datatype import mydecimal as _md
+                with _d.localcontext(_md.CTX):   # 65-digit sums
+                    np.add.at(self.sum, gids,
+                              np.where(ok, values, _md.ZERO))
+            else:
+                masked = np.where(ok, values, 0).astype(self.sum.dtype)
+                np.add.at(self.sum, gids, masked)
         elif kind in ("min", "max"):
             np.add.at(self.count, gids, oki)
             if self.obj:
@@ -145,18 +163,29 @@ class _AggState:
         if kind in ("count", "count_star"):
             return Column.from_values(EvalType.INT, self.count[:n_groups].copy())
         if kind == "sum":
-            et = EvalType.REAL if self.sum.dtype == np.float64 else EvalType.INT
             validity = self.count[:n_groups] > 0
+            if self.dec:
+                return Column(EvalType.DECIMAL,
+                              self.sum[:n_groups].copy(), validity)
+            et = EvalType.REAL if self.sum.dtype == np.float64 else EvalType.INT
             return Column(et, self.sum[:n_groups].copy(), validity)
         if kind == "avg":
             validity = self.count[:n_groups] > 0
+            if self.dec:
+                from ..datatype import mydecimal as _md
+                vals = np.empty(n_groups, dtype=object)
+                for g in range(n_groups):
+                    c = int(self.count[g])
+                    vals[g] = _md.div(self.sum[g], _md.from_int(c)) \
+                        if c else _md.ZERO
+                return Column(EvalType.DECIMAL, vals, validity)
             denom = np.maximum(self.count[:n_groups], 1)
             return Column(EvalType.REAL,
                           self.sum[:n_groups] / denom, validity)
         if kind in ("min", "max"):
             validity = self.count[:n_groups] > 0
             if self.obj:
-                return Column.from_list(EvalType.BYTES, self.vals[:n_groups])
+                return Column.from_list(self.et, self.vals[:n_groups])
             vals = np.where(validity, self.vals[:n_groups], 0)
             et = EvalType.REAL if vals.dtype == np.float64 else EvalType.INT
             return Column(et, vals.astype(self.vals.dtype), validity)
@@ -295,9 +324,11 @@ class _HashAggBase(TimedExecutor):
         group_fts = []
         for rpn in self._group_rpns:
             et = rpn.ret_type
-            group_fts.append(FieldType.double() if et is EvalType.REAL
-                             else FieldType.var_char() if et is EvalType.BYTES
-                             else FieldType.long())
+            group_fts.append(
+                FieldType.double() if et is EvalType.REAL
+                else FieldType.var_char() if et is EvalType.BYTES
+                else FieldType.new_decimal() if et is EvalType.DECIMAL
+                else FieldType.long())
         self._schema = [_agg_ret_ft(a.kind, et)
                         for a, et in zip(desc.aggs, arg_ets)] + group_fts
 

@@ -18,7 +18,7 @@ the host fast path, jax.numpy under trace) and maps
   device tiles, promoted as needed on host).
 
 Known deviations (tracked for later rounds): integer overflow wraps instead
-of erroring; DECIMAL sigs operate on scaled int64.
+of erroring.
 """
 
 from __future__ import annotations

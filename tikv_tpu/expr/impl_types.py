@@ -7,9 +7,8 @@ ops.  Sig names match the reference ScalarFuncSig variants.
 
 Type representations (datatype/eval_type.py): String = object array of
 bytes (binary collation — bytewise order matches MySQL's binary
-collation); Decimal = scaled int64 (comparisons and +/- assume operands
-share a scale — the plan compiler's responsibility here, a documented
-deviation from the reference's arbitrary-precision Decimal); Time =
+collation); Decimal = object array of decimal.Decimal with MySQL
+65-digit scale/rounding semantics (datatype/mydecimal.py); Time =
 packed u64 core (the bit layout is order-preserving: year in the top
 bits); Duration = i64 nanoseconds.
 """
@@ -160,27 +159,70 @@ def register() -> None:
         (av, am) = a
         return _ibool(xp, am & (av == 0)), np.ones_like(np.asarray(am))
 
-    # ---- decimal arithmetic (scaled int64, common scale) ----
+    # ---- decimal arithmetic (decimal.Decimal objects, MySQL 65-digit
+    #      semantics — datatype/mydecimal.py; reference decimal.rs) ----
 
-    @rpn_fn("PlusDecimal", 2, DEC, (DEC, DEC))
-    def plus_dec(xp, a, b):
-        (av, am), (bv, bm) = a, b
-        return av + bv, am & bm
+    from ..datatype import mydecimal as md
 
-    @rpn_fn("MinusDecimal", 2, DEC, (DEC, DEC))
-    def minus_dec(xp, a, b):
-        (av, am), (bv, bm) = a, b
-        return av - bv, am & bm
+    def _dec_map(fn, *arrs):
+        """Elementwise object-array map through a mydecimal op."""
+        return np.frompyfunc(fn, len(arrs), 1)(*arrs)
+
+    def _dec_nullable(fn, am, bm, av, bv):
+        """Binary op that may yield None (div/mod by zero → NULL)."""
+        res = _dec_map(fn, av, bv)
+        is_none = np.frompyfunc(lambda x: x is None, 1, 1)(res) \
+            .astype(bool)
+        res = np.where(is_none, md.ZERO, res)
+        return res, am & bm & ~is_none
+
+    for name, fn in (("PlusDecimal", md.add), ("MinusDecimal", md.sub),
+                     ("MultiplyDecimal", md.mul)):
+        @rpn_fn(name, 2, DEC, (DEC, DEC))
+        def _dec_arith(xp, a, b, _fn=fn):
+            (av, am), (bv, bm) = a, b
+            return _dec_map(_fn, av, bv), am & bm
+
+    for name, fn in (("DivideDecimal", md.div), ("ModDecimal", md.mod)):
+        @rpn_fn(name, 2, DEC, (DEC, DEC))
+        def _dec_divmod(xp, a, b, _fn=fn):
+            (av, am), (bv, bm) = a, b
+            return _dec_nullable(_fn, am, bm, av, bv)
 
     @rpn_fn("UnaryMinusDecimal", 1, DEC, (DEC,))
     def neg_dec(xp, a):
         (av, am) = a
-        return -av, am
+        return _dec_map(lambda x: -x, av), am
 
     @rpn_fn("AbsDecimal", 1, DEC, (DEC,))
     def abs_dec(xp, a):
         (av, am) = a
-        return np.abs(av), am
+        return _dec_map(abs, av), am
+
+    for name, fn in (("CeilDecToDec", md.ceil), ("FloorDecToDec", md.floor),
+                     ("RoundDec", md.round_frac),
+                     ("TruncateDecimalNoFrac", md.truncate)):
+        @rpn_fn(name, 1, DEC, (DEC,))
+        def _dec_round1(xp, a, _fn=fn):
+            (av, am) = a
+            return _dec_map(_fn, av), am
+
+    for name, fn in (("CeilDecToInt", md.ceil), ("FloorDecToInt", md.floor)):
+        @rpn_fn(name, 1, I, (DEC,))
+        def _dec_to_int_round(xp, a, _fn=fn):
+            (av, am) = a
+            # bind through _fn (early-bound default) — a late-bound `fn`
+            # would leave BOTH sigs evaluating the loop's last function
+            ints = _dec_map(lambda x: int(_fn(x)), av)
+            return ints.astype(np.int64), am
+
+    @rpn_fn("RoundWithFracDec", 2, DEC, (DEC, I))
+    def round_frac_dec(xp, a, f):
+        (av, am), (fv, fm) = a, f
+        return _dec_map(lambda x, k: md.round_frac(x, int(k)), av,
+                        np.broadcast_to(fv, np.shape(av))), am & fm
+
+    # ---- decimal casts ----
 
     @rpn_fn("CastDecimalAsDecimal", 1, DEC, (DEC,))
     def cast_dec_dec(xp, a):
@@ -188,17 +230,30 @@ def register() -> None:
 
     @rpn_fn("CastDecimalAsReal", 1, R, (DEC,))
     def cast_dec_real(xp, a):
-        # scale is column metadata the RPN layer doesn't carry; the plan
-        # compiler rescales — here scale-0 (integral decimals) converts
         (av, am) = a
-        return np.asarray(av, np.float64), am
+        return _dec_map(float, av).astype(np.float64), am
 
     @rpn_fn("CastIntAsDecimal", 1, DEC, (I,))
     def cast_int_dec(xp, a):
         (av, am) = a
-        return np.asarray(av, np.int64), am
+        return _dec_map(md.from_int, np.asarray(av)), am
+
+    @rpn_fn("CastRealAsDecimal", 1, DEC, (R,))
+    def cast_real_dec(xp, a):
+        (av, am) = a
+        return _dec_map(md.from_float, np.asarray(av)), am
 
     @rpn_fn("CastDecimalAsInt", 1, I, (DEC,))
     def cast_dec_int(xp, a):
         (av, am) = a
-        return np.asarray(av, np.int64), am
+        return _dec_map(md.to_int, av).astype(np.int64), am
+
+    @rpn_fn("CastStringAsDecimal", 1, DEC, (B,))
+    def cast_str_dec(xp, a):
+        (av, am) = a
+        return _dec_map(md.from_string, av), am
+
+    @rpn_fn("CastDecimalAsString", 1, B, (DEC,))
+    def cast_dec_str(xp, a):
+        (av, am) = a
+        return _dec_map(md.to_string, av), am

@@ -26,11 +26,14 @@ from ..raftstore.peer_storage import decode_entry, encode_entry
 
 
 def pack(obj: Any) -> bytes:
-    return msgpack.packb(obj, use_bin_type=True)
+    # non-native datums (DECIMAL) share the row codec's ExtType scheme
+    from ..codec.row import msgpack_default
+    return msgpack.packb(obj, use_bin_type=True, default=msgpack_default)
 
 
 def unpack(raw: bytes) -> Any:
-    return msgpack.unpackb(raw, raw=False)
+    from ..codec.row import msgpack_ext_hook
+    return msgpack.unpackb(raw, raw=False, ext_hook=msgpack_ext_hook)
 
 
 # -- metapb --
