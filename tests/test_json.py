@@ -1,0 +1,283 @@
+"""JSON datatype + scalar functions.
+
+Reference: tidb_query_datatype/src/codec/mysql/json/ and
+tidb_query_expr/src/impl_json.rs.
+"""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.datatype import Column, EvalType, FieldType
+from tikv_tpu.datatype import myjson as mj
+from tikv_tpu.executors.columnar import ColumnarTable
+from tikv_tpu.executors.runner import BatchExecutorsRunner
+from tikv_tpu.expr import Expr, build_rpn, eval_rpn
+from tikv_tpu.testing.dag import DagSelect
+from tikv_tpu.testing.fixture import Table, TableColumn
+
+
+# ------------------------------------------------------------- myjson
+
+def test_path_parse():
+    assert mj.parse_path("$.a.b") == [("key", "a"), ("key", "b")]
+    assert mj.parse_path('$."a b"[2]') == [("key", "a b"), ("idx", 2)]
+    assert mj.parse_path("$[*].x") == [("idx*",), ("key", "x")]
+    assert mj.parse_path("$.*") == [("key*",)]
+    assert mj.parse_path("$**.k") == [("**",), ("key", "k")]
+    with pytest.raises(ValueError):
+        mj.parse_path("a.b")
+
+
+def test_extract():
+    doc = {"a": {"b": [1, 2, {"c": 3}]}, "x": None}
+    assert mj.extract(doc, ["$.a.b[2].c"]) == 3
+    assert mj.extract(doc, ["$.a.b[9]"]) is mj.NOT_FOUND
+    assert mj.extract(doc, ["$.x"]) is None            # JSON null
+    assert mj.extract(doc, ["$.a.b[*]"]) == [1, 2, {"c": 3}]
+    assert mj.extract(doc, ["$.a.b[0]", "$.a.b[1]"]) == [1, 2]
+    assert mj.extract({"k": {"c": 1}, "j": {"c": 2}},
+                      ["$**.c"]) == [1, 2]
+    # scalar autowrap: $[0] of a scalar is the scalar
+    assert mj.extract(5, ["$[0]"]) == 5
+
+
+def test_type_and_eq():
+    assert mj.type_name(True) == b"BOOLEAN"
+    assert mj.type_name(1) == b"INTEGER"
+    assert mj.type_name(1.5) == b"DOUBLE"
+    assert mj.type_name(None) == b"NULL"
+    assert not mj.json_eq(True, 1)          # MySQL: true != 1 in JSON
+    assert mj.json_eq(1, 1.0)
+    assert mj.json_eq({"a": [1, 2]}, {"a": [1, 2]})
+
+
+def test_contains_and_member():
+    # reference vectors: json_contains.rs test_json_contains
+    cases = [
+        ({"a": {"a": 1}, "b": 2}, {"b": 2}, True),
+        ({}, {}, True),
+        ({"a": 1}, {}, True),
+        ({"a": 1}, 1, False),
+        ({"a": [1]}, [1], False),
+        ({"b": 2, "c": 3}, {"c": 3}, True),
+        (1, 1, True),
+        ([1], 1, True),
+        ([1, 2], [1], True),
+        ([1, 2], [1, 3], False),
+        ([1, 2], ["1"], False),
+        ([1, 2, [1, 3]], [1, 3], True),
+    ]
+    for target, cand, expect in cases:
+        assert mj.contains(target, cand) is expect, (target, cand)
+    assert mj.member_of(2, [1, 2, 3])
+    assert not mj.member_of(True, [1, 2])
+
+
+def test_merge_set_remove():
+    assert mj.merge_preserve([{"a": 1}, {"a": 2, "b": 3}]) == \
+        {"a": [1, 2], "b": 3}
+    assert mj.merge_preserve([[1], 2]) == [1, 2]
+    doc = {"a": {"b": 1}, "l": [1, 2]}
+    assert mj.json_set(doc, [(b"$.a.c", 9)]) == \
+        {"a": {"b": 1, "c": 9}, "l": [1, 2]}
+    assert mj.json_insert(doc, [(b"$.a.b", 9)]) == doc   # exists → no-op
+    assert mj.json_replace(doc, [(b"$.zz", 9)]) == doc   # absent → no-op
+    assert mj.json_set(doc, [(b"$.l[5]", 9)])["l"] == [1, 2, 9]  # append
+    assert mj.json_remove(doc, [b"$.a.b"]) == {"a": {}, "l": [1, 2]}
+    assert doc == {"a": {"b": 1}, "l": [1, 2]}           # inputs untouched
+
+
+def test_depth_length_keys_unquote():
+    assert mj.depth(1) == 1
+    assert mj.depth({"a": [1, {"b": 2}]}) == 4
+    assert mj.length({"a": 1, "b": 2}) == 2
+    assert mj.length(5) == 1
+    assert mj.length({"a": [1, 2, 3]}, b"$.a") == 3
+    assert mj.keys({"b": 1, "a": 2}) == ["b", "a"]
+    assert mj.unquote("hi") == b"hi"
+    assert mj.unquote([1, "x"]) == b'[1, "x"]'
+    assert mj.quote(b'a"b') == b'"a\\"b"'
+
+
+# ------------------------------------------------------------- sigs
+
+def jcol(vals, mask=None):
+    n = len(vals)
+    arr = np.empty(n, dtype=object)
+    for i, v in enumerate(vals):
+        arr[i] = v
+    return arr, (np.ones(n, bool) if mask is None
+                 else np.asarray(mask, bool))
+
+
+def run_sig(sig, pairs, ets):
+    e = Expr.call(sig, *[Expr.column(i, t) for i, t in enumerate(ets)])
+    rpn = build_rpn(e)
+    n = max(len(p[0]) for p in pairs)
+    return eval_rpn(rpn, pairs, n, np)
+
+
+J, B, I = EvalType.JSON, EvalType.BYTES, EvalType.INT
+
+
+def test_sig_type_unquote_depth():
+    docs = jcol([{"a": 1}, [1, 2], "s", 3, None])
+    v, m = run_sig("JsonTypeSig", [docs], [J])
+    assert list(v) == [b"OBJECT", b"ARRAY", b"STRING", b"INTEGER",
+                       b"NULL"]
+    v, m = run_sig("JsonUnquoteSig", [docs], [J])
+    assert v[2] == b"s" and v[0] == b'{"a": 1}'
+    v, m = run_sig("JsonDepthSig", [docs], [J])
+    assert list(v) == [2, 2, 1, 1, 1]
+
+
+def test_sig_extract_null_propagation():
+    docs = jcol([{"a": 5}, {"b": 1}, None], mask=[True, True, False])
+    paths = jcol([b"$.a"] * 3)
+    v, m = run_sig("JsonExtractSig", [docs, paths], [J, B])
+    assert list(m) == [True, False, False]   # no match → NULL
+    assert v[0] == 5
+
+
+def test_sig_valid_contains():
+    strs = jcol([b'{"x":1}', b"nope", b"[1,2]"])
+    v, m = run_sig("JsonValidStringSig", [strs], [B])
+    assert list(v) == [1, 0, 1]
+    a = jcol([[1, 2, 3], {"a": 1}])
+    b = jcol([[2], {"a": 2}])
+    v, m = run_sig("JsonContainsSig", [a, b], [J, J])
+    assert list(v) == [1, 0]
+
+
+def test_sig_array_object_merge():
+    a = jcol([1, "x"])
+    b = jcol([True, None], mask=[True, False])
+    v, m = run_sig("JsonArraySig", [a, b], [J, J])
+    assert v[0] == [1, True] and v[1] == ["x", None]
+    keys = jcol([b"k1", b"k2"])
+    v, m = run_sig("JsonObjectSig", [keys, a], [B, J])
+    assert v[0] == {"k1": 1} and v[1] == {"k2": "x"}
+    v, m = run_sig("JsonMergeSig", [jcol([{"a": 1}]), jcol([{"b": 2}])],
+                   [J, J])
+    assert v[0] == {"a": 1, "b": 2}
+
+
+def test_sig_modify_remove():
+    docs = jcol([{"a": 1}])
+    paths = jcol([b"$.b"])
+    vals = jcol([7])
+    v, m = run_sig("JsonSetSig", [docs, paths, vals], [J, B, J])
+    assert v[0] == {"a": 1, "b": 7}
+    v, m = run_sig("JsonRemoveSig", [jcol([{"a": 1, "b": 2}]),
+                                     jcol([b"$.a"])], [J, B])
+    assert v[0] == {"b": 2}
+
+
+def test_sig_casts():
+    v, m = run_sig("CastStringAsJson",
+                   [jcol([b'{"a": 1}', b"bad{"])], [B])
+    assert v[0] == {"a": 1} and list(m) == [True, False]
+    v, m = run_sig("CastJsonAsString", [jcol([[1, "a"]])], [J])
+    assert v[0] == b'[1, "a"]'
+    v, m = run_sig("CastJsonAsInt", [jcol([5, "12", True, [1]])], [J])
+    assert list(v) == [5, 12, 1, 0]
+    v, m = run_sig("CastJsonAsReal", [jcol(["2.5", 3])], [J])
+    assert list(v) == [2.5, 3.0]
+    pair = (np.array([7], np.int64), np.ones(1, bool))
+    v, m = run_sig("CastIntAsJson", [pair], [I])
+    assert v[0] == 7 and mj.type_name(v[0]) == b"INTEGER"
+
+
+# ------------------------------------------------------------- pipeline
+
+def test_json_through_pipeline():
+    table = Table(8700, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("doc", 2, FieldType.json()),
+    ))
+    docs = [{"name": "a", "tags": [1, 2]},
+            {"name": "b", "tags": [2, 3]},
+            None,
+            {"name": "c"}]
+    n = len(docs)
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"doc": Column.from_list(EvalType.JSON, docs)})
+    sel = DagSelect.from_table(table, ["id", "doc"])
+    # WHERE JSON_CONTAINS(doc->'$.tags', '2')
+    dag = sel.where(Expr.call(
+        "JsonContainsSig",
+        Expr.call("JsonExtractSig", sel.col("doc"),
+                  Expr.const(b"$.tags", EvalType.BYTES)),
+        Expr.call("CastStringAsJson",
+                  Expr.const(b"2", EvalType.BYTES)))).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    assert [r[0] for r in res.rows()] == [0, 1]
+    # projection of JSON_TYPE + JSON output column (fresh builder —
+    # DagSelect accumulates executors)
+    sel2 = DagSelect.from_table(table, ["id", "doc"])
+    dag2 = sel2.project(
+        Expr.call("JsonTypeSig", sel2.col("doc")),
+        Expr.call("JsonExtractSig", sel2.col("doc"),
+                  Expr.const(b"$.name", EvalType.BYTES))).build()
+    res2 = BatchExecutorsRunner(dag2, snap).handle_request()
+    rows = res2.rows()
+    assert rows[0] == (b"OBJECT", "a") and rows[2] == (None, None)
+
+
+def test_json_through_row_storage():
+    from tikv_tpu.testing import init_with_data
+    table = Table(8701, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("doc", 2, FieldType.json()),
+    ))
+    store = init_with_data(table, [
+        (1, {"doc": {"k": [1, {"d": True}]}}),
+        (2, {"doc": None}),
+    ])
+    dag = DagSelect.from_table(table).build()
+    res = BatchExecutorsRunner(dag, store).handle_request()
+    assert res.rows() == [(1, {"k": [1, {"d": True}]}), (2, None)]
+
+
+def test_modify_does_not_mutate_inserted_value():
+    """Regression: inserted values are copied; a later path leg must not
+    mutate the caller's object."""
+    val = {"x": 1}
+    doc = {}
+    out = mj.json_set(doc, [(b"$.a", val), (b"$.a.y", 2)])
+    assert out == {"a": {"x": 1, "y": 2}}
+    assert val == {"x": 1}
+
+
+def test_set_null_value_inserts_json_null():
+    """JSON_SET(doc, '$.a', NULL) -> {"a": null}, not SQL NULL."""
+    docs = jcol([{"x": 1}])
+    paths = jcol([b"$.a"])
+    vals = jcol([None], mask=[False])       # SQL NULL value arg
+    v, m = run_sig("JsonSetSig", [docs, paths, vals], [J, B, J])
+    assert list(m) == [True]
+    assert v[0] == {"x": 1, "a": None}
+
+
+def test_quoted_key_with_escapes():
+    assert mj.parse_path('$."a\\"b"') == [("key", 'a"b')]
+    assert mj.extract({'a"b': 7}, ['$."a\\"b"']) == 7
+
+
+def test_json_list_const_not_flattened():
+    docs = jcol([[1, 2, 9], [3]])
+    e = Expr.call("JsonContainsSig", Expr.column(0, J),
+                  Expr.const([1, 2], EvalType.JSON))
+    v, m = eval_rpn(build_rpn(e), [docs], 2, np)
+    assert list(v) == [1, 0] and list(m) == [True, True]
+
+
+def test_json_valid_const_broadcasts():
+    docs = jcol([{"a": 1}] * 3)
+    e = Expr.call("JsonValidJsonSig",
+                  Expr.const({"k": 2}, EvalType.JSON))
+    v, m = eval_rpn(build_rpn(e), [docs], 3, np)
+    assert np.broadcast_to(v, (3,)).tolist() == [1, 1, 1]

@@ -63,9 +63,12 @@ class Column:
         dtype = eval_type.np_dtype
         if dtype == np.dtype(object):
             # NULL slots hold a harmless same-type value so vectorized
-            # object ops never mix bytes with Decimal
+            # object ops never mix representations (frompyfunc sigs run
+            # over masked slots too)
             if eval_type is EvalType.DECIMAL:
                 from .mydecimal import ZERO as fill
+            elif eval_type is EvalType.JSON:
+                fill = None     # the JSON null literal
             else:
                 fill = b""
             values = np.empty(n, dtype=object)

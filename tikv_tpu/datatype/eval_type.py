@@ -183,6 +183,10 @@ class FieldType:
         return FieldType(tp=FieldTypeTp.VAR_CHAR)
 
     @staticmethod
+    def json() -> "FieldType":
+        return FieldType(tp=FieldTypeTp.JSON)
+
+    @staticmethod
     def new_decimal(flen: int = 20, frac: int = 4) -> "FieldType":
         # (named new_decimal: a constructor called "decimal" would shadow
         # the dataclass field's default with the function object)

@@ -38,7 +38,11 @@ def _const_pair(xp, node: RpnConst, device: bool):
         else:
             dt = "int64"
     else:
-        return np.asarray(v, dtype=object), np.ones((), dtype=bool)
+        # 0-d object scalar; np.asarray would FLATTEN a list/dict const
+        # (JSON documents) into an element-per-row array
+        arr = np.empty((), dtype=object)
+        arr[()] = v
+        return arr, np.ones((), dtype=bool)
     return xp.asarray(v, dtype=dt), xp.ones((), dtype=bool)
 
 

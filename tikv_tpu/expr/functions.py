@@ -577,6 +577,7 @@ _register_cast()
 _register_math()
 
 # family modules (imported late: they need the registry decorator above)
+from . import impl_json as _impl_json      # noqa: E402
 from . import impl_like as _impl_like      # noqa: E402
 from . import impl_string as _impl_string  # noqa: E402
 from . import impl_time as _impl_time      # noqa: E402
@@ -586,3 +587,4 @@ _impl_string.register()
 _impl_like.register()
 _impl_time.register()
 _impl_types.register()
+_impl_json.register()
