@@ -1,0 +1,224 @@
+"""Collations + ENUM/SET types.
+
+Reference: tidb_query_datatype/src/codec/collation/ (collator per id,
+sort-key contract) and codec/mysql/{enums,set}.rs.
+"""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.datatype import Column, EvalType, FieldType
+from tikv_tpu.datatype import collation as coll
+from tikv_tpu.executors.columnar import ColumnarTable
+from tikv_tpu.executors.runner import BatchExecutorsRunner
+from tikv_tpu.expr import Expr, build_rpn, eval_rpn
+from tikv_tpu.testing.dag import DagSelect
+from tikv_tpu.testing.fixture import Table, TableColumn
+
+B, I = EvalType.BYTES, EvalType.INT
+CI = coll.UTF8MB4_GENERAL_CI
+
+
+# ------------------------------------------------------------ sort keys
+
+def test_sort_keys():
+    assert coll.sort_key(b"Abc", coll.BINARY) == b"Abc"
+    # PAD SPACE: trailing spaces insignificant for _bin and _ci
+    assert coll.sort_key(b"abc  ", coll.UTF8MB4_BIN) == b"abc"
+    assert coll.eq(b"abc", b"abc   ", coll.UTF8MB4_BIN)
+    assert not coll.eq(b"abc", b"abc   ", coll.BINARY)
+    # general_ci: case-insensitive
+    assert coll.eq(b"HeLLo", b"hello", CI)
+    assert coll.compare(b"a", b"B", CI) < 0      # 'A' < 'B'
+    assert coll.compare(b"a", b"B", coll.BINARY) > 0   # 'a' > 'B' raw
+    # negative wire ids normalize
+    assert coll.sort_key(b"X ", -coll.UTF8MB4_BIN) == b"X"
+    # multi-byte: case folding through unicode
+    assert coll.eq("straße".encode(), "STRASSE".encode(), CI) is False
+    assert coll.eq("ÉCOLE".encode(), "école".encode(), CI)
+
+
+def test_enum_set_helpers():
+    elems = (b"red", b"green", b"blue")
+    assert coll.enum_name(2, elems) == b"green"
+    assert coll.enum_name(0, elems) == b""
+    assert coll.parse_enum(b"blue", elems) == 3
+    assert coll.parse_enum(b"nope", elems) == 0
+    assert coll.set_names(0b101, elems) == b"red,blue"
+    assert coll.parse_set(b"green,red", elems) == 0b011
+    assert coll.parse_set(b"", elems) == 0
+
+
+# ------------------------------------------------------------ expr sigs
+
+def scol(vals):
+    return (np.array(vals, dtype=object),
+            np.ones(len(vals), bool))
+
+
+def test_collated_string_compare():
+    a = scol([b"ABC", b"abc", b"xyz"])
+    b = scol([b"abc", b"abc  ", b"XYZ"])
+    # binary: only exact bytes equal
+    e = Expr.call("EqString", Expr.column(0, B), Expr.column(1, B))
+    v, m = eval_rpn(build_rpn(e), [a, b], 3, np)
+    assert list(v) == [0, 0, 0]
+    # general_ci via column collation: all equal
+    e = Expr.call("EqString", Expr.column(0, B, collation=CI),
+                  Expr.column(1, B, collation=CI))
+    v, m = eval_rpn(build_rpn(e), [a, b], 3, np)
+    assert list(v) == [1, 1, 1]
+    # ordering flips under ci ('a' < 'B')
+    e = Expr.call("LtString", Expr.column(0, B, collation=CI),
+                  Expr.const(b"B", B))
+    v, m = eval_rpn(build_rpn(e), [scol([b"a"]), ], 1, np)
+    assert list(v) == [1]
+
+
+def test_weight_string_sig():
+    a = scol([b"HeLLo  ", b"x"])
+    e = Expr.call("WeightString", Expr.column(0, B, collation=CI))
+    v, m = eval_rpn(build_rpn(e), [a], 2, np)
+    assert v[0] == coll.sort_key(b"hello", CI)
+    # binary collation: identity
+    e = Expr.call("WeightString", Expr.column(0, B))
+    v, m = eval_rpn(build_rpn(e), [a], 2, np)
+    assert v[0] == b"HeLLo  "
+
+
+def test_enum_set_sigs():
+    elems = (b"S", b"M", b"L")
+    pair = (np.array([1, 3, 0], np.uint64), np.ones(3, bool))
+    e = Expr.call("CastEnumAsString",
+                  Expr.column(0, EvalType.ENUM, elems=elems))
+    v, m = eval_rpn(build_rpn(e), [pair], 3, np)
+    assert list(v) == [b"S", b"L", b""]
+    e = Expr.call("CastEnumAsInt",
+                  Expr.column(0, EvalType.ENUM, elems=elems))
+    v, m = eval_rpn(build_rpn(e), [pair], 3, np)
+    assert list(v) == [1, 3, 0]
+    spair = (np.array([0b011, 0b100], np.uint64), np.ones(2, bool))
+    e = Expr.call("CastSetAsString",
+                  Expr.column(0, EvalType.SET, elems=elems))
+    v, m = eval_rpn(build_rpn(e), [spair], 2, np)
+    assert list(v) == [b"S,M", b"L"]
+    e = Expr.call("CastStringAsEnum",
+                  Expr.column(0, B, elems=elems))
+    v, m = eval_rpn(build_rpn(e), [scol([b"M", b"zz"])], 2, np)
+    assert list(v) == [2, 0]
+    e = Expr.call("CastStringAsSet",
+                  Expr.column(0, B, elems=elems))
+    v, m = eval_rpn(build_rpn(e), [scol([b"S,L"])], 1, np)
+    assert list(v) == [0b101]
+
+
+# ------------------------------------------------------------ pipeline
+
+def make_snapshot():
+    table = Table(8800, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("name", 2, FieldType.var_char(collation=CI)),
+        TableColumn("size", 3, FieldType.enum((b"S", b"M", b"L"))),
+    ))
+    names = [b"Alpha", b"ALPHA  ", b"beta", b"Gamma"]
+    sizes = [1, 2, 2, 3]
+    n = len(names)
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"name": Column.from_list(EvalType.BYTES, names),
+         "size": Column(EvalType.ENUM,
+                        np.array(sizes, np.uint64), np.ones(n, bool))})
+    return table, snap
+
+
+def test_ci_filter_through_pipeline():
+    table, snap = make_snapshot()
+    sel = DagSelect.from_table(table, ["id", "name", "size"])
+    # name = 'alpha' under the column's general_ci collation matches
+    # both case variants and the padded one
+    dag = sel.where(Expr.call("EqString", sel.col("name"),
+                              Expr.const(b"alpha", B))).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    assert [r[0] for r in res.rows()] == [0, 1]
+
+
+def test_ci_group_by_weight_string():
+    table, snap = make_snapshot()
+    sel = DagSelect.from_table(table, ["id", "name", "size"])
+    dag = sel.aggregate(
+        [Expr.call("WeightString", sel.col("name"))],
+        [("count_star", None)]).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    counts = sorted(r[0] for r in res.rows())
+    assert counts == [1, 1, 2]      # Alpha/ALPHA collapse
+
+
+def test_enum_column_through_pipeline():
+    table, snap = make_snapshot()
+    sel = DagSelect.from_table(table, ["id", "name", "size"])
+    dag = sel.project(
+        Expr.call("CastEnumAsString", sel.col("size")),
+        Expr.call("CastEnumAsInt", sel.col("size"))).build()
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    assert res.rows() == [(b"S", 1), (b"M", 2), (b"M", 2), (b"L", 3)]
+
+
+def test_collation_wire_roundtrip():
+    from tikv_tpu.server.wire import dec_dag, enc_dag
+    table, snap = make_snapshot()
+    sel = DagSelect.from_table(table, ["id", "name", "size"])
+    dag = sel.where(Expr.call("EqString", sel.col("name"),
+                              Expr.const(b"ALPHA", B))).build()
+    dag2 = dec_dag(enc_dag(dag))
+    r1 = BatchExecutorsRunner(dag, snap).handle_request()
+    r2 = BatchExecutorsRunner(dag2, snap).handle_request()
+    assert r1.rows() == r2.rows() and len(r1.rows()) == 2
+
+
+def test_in_string_honors_collation():
+    """Regression: IN must agree with = under the collation."""
+    a = scol([b"Alpha"])
+    e = Expr.call("InString", Expr.column(0, B, collation=CI),
+                  Expr.const(b"alpha", B), Expr.const(b"x", B))
+    v, m = eval_rpn(build_rpn(e), [a], 1, np)
+    assert list(v) == [1]
+
+
+def test_collation_survives_intermediate_function():
+    """Regression: wrapping a ci column in another string fn must keep
+    the subtree's collation for the outer comparison."""
+    a = scol([b"Alpha"])
+    e = Expr.call("EqString",
+                  Expr.call("Upper", Expr.column(0, B, collation=CI)),
+                  Expr.const(b"alpha", B))
+    v, m = eval_rpn(build_rpn(e), [a], 1, np)
+    assert list(v) == [1]
+
+
+def test_greatest_least_string_collated():
+    a, b = scol([b"a"]), scol([b"B"])
+    e = Expr.call("GreatestString", Expr.column(0, B, collation=CI),
+                  Expr.column(1, B, collation=CI))
+    v, m = eval_rpn(build_rpn(e), [a, b], 1, np)
+    assert v[0] == b"B"           # ci: 'a' < 'B'
+    e = Expr.call("GreatestString", Expr.column(0, B),
+                  Expr.column(1, B))
+    v, m = eval_rpn(build_rpn(e), [a, b], 1, np)
+    assert v[0] == b"a"           # binary: 'a' > 'B'
+
+
+def test_enum_parse_honors_collation():
+    elems = (b"red", b"green")
+    assert coll.parse_enum(b"RED ", elems, CI) == 1
+    assert coll.parse_enum(b"RED", elems) == 0     # binary: no match
+    assert coll.parse_set(b"GREEN,red", elems, CI) == 0b11
+
+
+def test_call_elems_wire_roundtrip():
+    from tikv_tpu.server.wire import dec_expr, enc_expr
+    e = Expr.call("CastStringAsEnum", Expr.const(b"M", B),
+                  elems=(b"S", b"M"))
+    e2 = dec_expr(enc_expr(e))
+    v, m = eval_rpn(build_rpn(e2), [], 1, np)
+    assert int(np.asarray(v).item()) == 2

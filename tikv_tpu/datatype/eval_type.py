@@ -179,8 +179,18 @@ class FieldType:
         return FieldType(tp=FieldTypeTp.DOUBLE, flag=flag)
 
     @staticmethod
-    def var_char() -> "FieldType":
-        return FieldType(tp=FieldTypeTp.VAR_CHAR)
+    def var_char(collation: int = 63) -> "FieldType":
+        return FieldType(tp=FieldTypeTp.VAR_CHAR, collation=collation)
+
+    @staticmethod
+    def enum(elems, collation: int = 63) -> "FieldType":
+        return FieldType(tp=FieldTypeTp.ENUM, collation=collation,
+                         elems=tuple(elems))
+
+    @staticmethod
+    def set_(elems, collation: int = 63) -> "FieldType":
+        return FieldType(tp=FieldTypeTp.SET, collation=collation,
+                         elems=tuple(elems))
 
     @staticmethod
     def json() -> "FieldType":

@@ -183,6 +183,8 @@ def json_eq(a, b) -> bool:
     """Type-aware equality: JSON true != 1 (python True == 1 would)."""
     if isinstance(a, bool) or isinstance(b, bool):
         return isinstance(a, bool) and isinstance(b, bool) and a is b
+    if isinstance(a, int) and isinstance(b, int):
+        return a == b       # exact — float() would collapse above 2^53
     if isinstance(a, (int, float)) and isinstance(b, (int, float)):
         return float(a) == float(b)
     if type(a) is not type(b):

@@ -67,7 +67,10 @@ def eval_rpn(rpn: RpnExpression, columns: Sequence[tuple], n_rows, xp=np):
                 del stack[-node.n_args:]
             else:
                 args = []
-            stack.append(node.meta.fn(xp, *args))
+            if node.meta.needs_ctx:
+                stack.append(node.meta.fn(xp, *args, ctx=node.ctx))
+            else:
+                stack.append(node.meta.fn(xp, *args))
         else:  # pragma: no cover
             raise AssertionError(node)
     assert len(stack) == 1, f"malformed RPN: stack depth {len(stack)}"

@@ -38,14 +38,19 @@ class RpnFnMeta:
     ret: EvalType
     args: tuple                   # arg EvalTypes; for variadic, the repeated type
     fn: Callable                  # fn(xp, *pairs) -> pair
+    # sig consults the node's (collation, elems) context — eval passes
+    # ``ctx=`` (collation-dispatched string sigs, enum/set sigs)
+    needs_ctx: bool = False
 
 
 FUNCTIONS: dict[str, RpnFnMeta] = {}
 
 
-def rpn_fn(name: str, arity: Optional[int], ret: EvalType, args: tuple):
+def rpn_fn(name: str, arity: Optional[int], ret: EvalType, args: tuple,
+           needs_ctx: bool = False):
     def deco(fn):
-        FUNCTIONS[name] = RpnFnMeta(name, arity, ret, args, fn)
+        FUNCTIONS[name] = RpnFnMeta(name, arity, ret, args, fn,
+                                    needs_ctx)
         return fn
     return deco
 

@@ -45,26 +45,52 @@ def register() -> None:
         "Eq": lambda a, b: a == b,
         "Ne": lambda a, b: a != b,
     }
+    from ..datatype import collation as coll
+
+    def _collate(av, bv, c):
+        """Map both string operands to their collation sort keys (63 =
+        binary = identity, the overwhelmingly common case)."""
+        if coll.normalize_id(c) == coll.BINARY:
+            return av, bv
+        sk = np.frompyfunc(lambda s: coll.sort_key(s, c), 1, 1)
+        return sk(np.asarray(av, object)), sk(np.asarray(bv, object))
+
     for fam, ty in _FAMS:
         for stem, op in cmps.items():
+            if ty is B:
+                @rpn_fn(stem + fam, 2, I, (ty, ty), needs_ctx=True)
+                def _cmp_str(xp, a, b, ctx=(63, ()), _op=op):
+                    (av, am), (bv, bm) = a, b
+                    av, bv = _collate(av, bv, ctx[0])
+                    return _ibool(xp, _cmp_vals(B, xp, av, bv, _op)), \
+                        am & bm
+                continue
+
             @rpn_fn(stem + fam, 2, I, (ty, ty))
             def _cmp(xp, a, b, _op=op, _ty=ty):
                 (av, am), (bv, bm) = a, b
                 return _ibool(xp, _cmp_vals(_ty, xp, av, bv, _op)), am & bm
 
-        @rpn_fn("NullEq" + fam, 2, I, (ty, ty))
-        def _null_eq(xp, a, b, _ty=ty):
+        @rpn_fn("NullEq" + fam, 2, I, (ty, ty), needs_ctx=(ty is B))
+        def _null_eq(xp, a, b, _ty=ty, ctx=(63, ())):
             (av, am), (bv, bm) = a, b
+            if _ty is B:
+                av, bv = _collate(av, bv, ctx[0])
             both_null = ~am & ~bm
             eq = am & bm & _cmp_vals(_ty, xp, av, bv, lambda x, y: x == y)
             return _ibool(xp, both_null | eq), np.ones_like(np.asarray(am))
 
-        @rpn_fn("In" + fam, None, I, (ty,))
-        def _in(xp, *pairs, _ty=ty):
+        @rpn_fn("In" + fam, None, I, (ty,), needs_ctx=(ty is B))
+        def _in(xp, *pairs, _ty=ty, ctx=(63, ())):
             (pv, pm) = pairs[0]
+            if _ty is B:
+                # IN must agree with = under the collation
+                pv, _ = _collate(pv, pv, ctx[0])
             hit = None
             any_null = ~np.asarray(pm)
             for (lv, lm) in pairs[1:]:
+                if _ty is B:
+                    lv, _ = _collate(lv, lv, ctx[0])
                 h = pm & lm & _cmp_vals(_ty, xp, pv, lv,
                                         lambda x, y: x == y)
                 hit = h if hit is None else (hit | h)
@@ -111,34 +137,27 @@ def register() -> None:
                 out_m = m | out_m
             return out_v, out_m
 
-    # ---- Greatest / Least (order types; String uses bytes order) ----
+    # ---- Greatest / Least (order types; String orders by collation) ----
     for fam, ty in (("String", B), ("Decimal", DEC), ("Time", T),
                     ("Duration", D)):
-        @rpn_fn("Greatest" + fam, None, ty, (ty,))
-        def _greatest(xp, *pairs, _ty=ty):
-            out_v, valid = pairs[0]
-            for (v, m) in pairs[1:]:
-                if _ty is B:
-                    take = _cmp_vals(_ty, xp, v, out_v,
-                                     lambda x, y: x > y)
-                    out_v = np.where(take, v, out_v)
-                else:
-                    out_v = np.maximum(out_v, v)
-                valid = valid & m
-            return out_v, valid
-
-        @rpn_fn("Least" + fam, None, ty, (ty,))
-        def _least(xp, *pairs, _ty=ty):
-            out_v, valid = pairs[0]
-            for (v, m) in pairs[1:]:
-                if _ty is B:
-                    take = _cmp_vals(_ty, xp, v, out_v,
-                                     lambda x, y: x < y)
-                    out_v = np.where(take, v, out_v)
-                else:
-                    out_v = np.minimum(out_v, v)
-                valid = valid & m
-            return out_v, valid
+        for stem, gt in (("Greatest", True), ("Least", False)):
+            @rpn_fn(stem + fam, None, ty, (ty,),
+                    needs_ctx=(ty is B))
+            def _extreme(xp, *pairs, _ty=ty, _gt=gt, ctx=(63, ())):
+                out_v, valid = pairs[0]
+                for (v, m) in pairs[1:]:
+                    if _ty is B:
+                        kv, kout = _collate(v, out_v, ctx[0])
+                        take = _cmp_vals(
+                            B, xp, kv, kout,
+                            (lambda x, y: x > y) if _gt
+                            else (lambda x, y: x < y))
+                        out_v = np.where(take, v, out_v)
+                    else:
+                        out_v = (np.maximum if _gt else np.minimum)(
+                            out_v, v)
+                    valid = valid & m
+                return out_v, valid
 
     # ---- IsNull / IsTrue / IsFalse (canonical reference names) ----
     for fam, ty in (("Int", I), ("Real", R), ("String", B),
@@ -257,3 +276,58 @@ def register() -> None:
     def cast_dec_str(xp, a):
         (av, am) = a
         return _dec_map(md.to_string, av), am
+
+    # ---- collation surface (codec/collation/) ----
+
+    @rpn_fn("WeightString", 1, B, (B,), needs_ctx=True)
+    def weight_string(xp, a, ctx=(63, ())):
+        """WEIGHT_STRING(str): the collation sort key — what MySQL uses
+        for ORDER BY/GROUP BY under the collation; planners wrap string
+        order/group expressions with this to get collated semantics."""
+        (av, am) = a
+        sk = np.frompyfunc(lambda s: coll.sort_key(s, ctx[0]), 1, 1)
+        return np.asarray(sk(np.asarray(av, object)), object), am
+
+    # ---- enum / set (codec/mysql/enums.rs, set.rs; cast arms) ----
+    #
+    # ENUM columns hold the 1-based ordinal (0 = ''), SET columns the
+    # element bitmask — both uint64 on host and device-native; the name
+    # table rides the FieldType elems through the expr ctx.
+
+    E, S = EvalType.ENUM, EvalType.SET
+
+    @rpn_fn("CastEnumAsString", 1, B, (E,), needs_ctx=True)
+    def cast_enum_str(xp, a, ctx=(63, ())):
+        (av, am) = a
+        f = np.frompyfunc(lambda o: coll.enum_name(int(o), ctx[1]), 1, 1)
+        return np.asarray(f(np.asarray(av)), object), am
+
+    @rpn_fn("CastEnumAsInt", 1, I, (E,))
+    def cast_enum_int(xp, a):
+        (av, am) = a
+        return np.asarray(av).astype(np.int64), am
+
+    @rpn_fn("CastStringAsEnum", 1, EvalType.ENUM, (B,), needs_ctx=True)
+    def cast_str_enum(xp, a, ctx=(63, ())):
+        (av, am) = a
+        f = np.frompyfunc(
+            lambda s: coll.parse_enum(s, ctx[1], ctx[0]), 1, 1)
+        return np.asarray(f(np.asarray(av, object))).astype(np.uint64), am
+
+    @rpn_fn("CastSetAsString", 1, B, (S,), needs_ctx=True)
+    def cast_set_str(xp, a, ctx=(63, ())):
+        (av, am) = a
+        f = np.frompyfunc(lambda m: coll.set_names(int(m), ctx[1]), 1, 1)
+        return np.asarray(f(np.asarray(av)), object), am
+
+    @rpn_fn("CastSetAsInt", 1, I, (S,))
+    def cast_set_int(xp, a):
+        (av, am) = a
+        return np.asarray(av).astype(np.int64), am
+
+    @rpn_fn("CastStringAsSet", 1, EvalType.SET, (B,), needs_ctx=True)
+    def cast_str_set(xp, a, ctx=(63, ())):
+        (av, am) = a
+        f = np.frompyfunc(
+            lambda s: coll.parse_set(s, ctx[1], ctx[0]), 1, 1)
+        return np.asarray(f(np.asarray(av, object))).astype(np.uint64), am

@@ -32,6 +32,10 @@ class RpnColumnRef:
 class RpnFnCall:
     meta: RpnFnMeta
     n_args: int
+    # (collation, enum/set elems) — only consulted when meta.needs_ctx;
+    # mirrors the reference's collator/elems dispatch from tipb
+    # FieldType (expr_builder.rs map_expr_node_to_rpn_func by collation)
+    ctx: tuple = (63, ())
 
 
 RpnNode = Union[RpnConst, RpnColumnRef, RpnFnCall]
@@ -57,12 +61,27 @@ class RpnExpression:
             elif isinstance(n, RpnColumnRef):
                 out.append(("col", n.col_idx, n.eval_type.value))
             else:
-                out.append(("f", n.meta.name, n.n_args))
+                out.append(("f", n.meta.name, n.n_args, n.ctx))
         return tuple(out)
 
     def max_column_idx(self) -> int:
         return max((n.col_idx for n in self.nodes
                     if isinstance(n, RpnColumnRef)), default=-1)
+
+
+def _subtree_ctx(e: Expr) -> tuple:
+    """First non-binary collation / non-empty elems anywhere below
+    ``e`` (pre-order) — the effective string context of the subtree."""
+    coll, elems = 63, ()
+    stack = list(e.children)
+    while stack and (coll == 63 or not elems):
+        n = stack.pop(0)
+        if coll == 63 and n.collation != 63:
+            coll = n.collation
+        if not elems and n.elems:
+            elems = n.elems
+        stack.extend(n.children)
+    return coll, elems
 
 
 def build_rpn(tree: Expr) -> RpnExpression:
@@ -89,7 +108,21 @@ def build_rpn(tree: Expr) -> RpnExpression:
                 raise ValueError(f"{e.sig}: variadic sig needs >=1 arg")
             for c in e.children:
                 walk(c)
-            nodes.append(RpnFnCall(meta, len(e.children)))
+            ctx = (63, ())
+            if meta.needs_ctx:
+                # collation/elems: explicit on the call, else inherited
+                # from the SUBTREE — tipb derives a call's field_type
+                # collation the same way, so `Upper(ci_col)` keeps ci
+                coll = e.collation
+                elems: tuple = e.elems
+                if coll == 63 or not elems:
+                    sc, se = _subtree_ctx(e)
+                    if coll == 63:
+                        coll = sc
+                    if not elems:
+                        elems = se
+                ctx = (coll, tuple(elems))
+            nodes.append(RpnFnCall(meta, len(e.children), ctx))
         else:
             raise ValueError(f"bad expr kind {e.kind}")
 

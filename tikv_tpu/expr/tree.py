@@ -28,6 +28,10 @@ class Expr:
     col_idx: int = -1
     sig: str = ""
     children: tuple = field(default_factory=tuple)
+    # tipb Expr.field_type carries these; string sigs dispatch on the
+    # collation, enum/set sigs need the definition's name table
+    collation: int = 63
+    elems: tuple = ()
 
     # -- constructors -------------------------------------------------------
 
@@ -40,12 +44,16 @@ class Expr:
         return Expr(kind="const", value=None, eval_type=eval_type)
 
     @staticmethod
-    def column(idx: int, eval_type: EvalType = EvalType.INT) -> "Expr":
-        return Expr(kind="column", col_idx=idx, eval_type=eval_type)
+    def column(idx: int, eval_type: EvalType = EvalType.INT,
+               collation: int = 63, elems: tuple = ()) -> "Expr":
+        return Expr(kind="column", col_idx=idx, eval_type=eval_type,
+                    collation=collation, elems=tuple(elems))
 
     @staticmethod
-    def call(sig: str, *children: "Expr") -> "Expr":
-        return Expr(kind="call", sig=sig, children=tuple(children))
+    def call(sig: str, *children: "Expr", collation: int = 63,
+             elems: tuple = ()) -> "Expr":
+        return Expr(kind="call", sig=sig, children=tuple(children),
+                    collation=collation, elems=tuple(elems))
 
     # -- sugar for tests / plan builders ------------------------------------
 

@@ -174,10 +174,20 @@ def enc_expr(e) -> dict:
         return {"k": "c", "v": e.value,
                 "et": e.eval_type.value if e.eval_type else None}
     if e.kind == "column":
-        return {"k": "col", "i": e.col_idx,
-                "et": e.eval_type.value if e.eval_type else None}
-    return {"k": "f", "sig": e.sig,
-            "ch": [enc_expr(c) for c in e.children]}
+        out = {"k": "col", "i": e.col_idx,
+               "et": e.eval_type.value if e.eval_type else None}
+        if e.collation != 63:
+            out["coll"] = e.collation
+        if e.elems:
+            out["elems"] = list(e.elems)
+        return out
+    out = {"k": "f", "sig": e.sig,
+           "ch": [enc_expr(c) for c in e.children]}
+    if e.collation != 63:
+        out["coll"] = e.collation
+    if e.elems:
+        out["elems"] = list(e.elems)
+    return out
 
 
 def dec_expr(d: dict):
@@ -187,8 +197,12 @@ def dec_expr(d: dict):
     if d["k"] == "c":
         return Expr(kind="const", value=d["v"], eval_type=et)
     if d["k"] == "col":
-        return Expr(kind="column", col_idx=d["i"], eval_type=et)
-    return Expr.call(d["sig"], *(dec_expr(c) for c in d["ch"]))
+        return Expr(kind="column", col_idx=d["i"], eval_type=et,
+                    collation=d.get("coll", 63),
+                    elems=tuple(d.get("elems", ())))
+    return Expr.call(d["sig"], *(dec_expr(c) for c in d["ch"]),
+                     collation=d.get("coll", 63),
+                     elems=tuple(d.get("elems", ())))
 
 
 def enc_dag(dag) -> dict:

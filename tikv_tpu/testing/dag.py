@@ -72,10 +72,14 @@ class DagSelect:
     # -- helpers ------------------------------------------------------------
 
     def col(self, name: str) -> Expr:
-        """Column reference by name → offset in the scan output."""
+        """Column reference by name → offset in the scan output;
+        collation/elems ride along from the column's FieldType."""
         for i, c in enumerate(self._scan_cols):
             if c.name == name:
-                return Expr.column(i, c.field_type.eval_type)
+                ft = c.field_type
+                return Expr.column(i, ft.eval_type,
+                                   collation=ft.collation,
+                                   elems=ft.elems)
         raise KeyError(name)
 
     # -- pipeline stages ----------------------------------------------------
