@@ -222,3 +222,23 @@ def test_call_elems_wire_roundtrip():
     e2 = dec_expr(enc_expr(e))
     v, m = eval_rpn(build_rpn(e2), [], 1, np)
     assert int(np.asarray(v).item()) == 2
+
+
+def test_binary_column_wins_coercion():
+    """MySQL coercion: comparing a binary column with a ci column
+    compares bytes (binary wins)."""
+    a, b = scol([b"A"]), scol([b"a"])
+    e = Expr.call("EqString", Expr.column(0, B),
+                  Expr.column(1, B, collation=CI))
+    v, m = eval_rpn(build_rpn(e), [a, b], 1, np)
+    assert list(v) == [0]
+    # ci col vs const: ci applies (consts are coercible)
+    e = Expr.call("EqString", Expr.column(0, B, collation=CI),
+                  Expr.const(b"A", B))
+    v, m = eval_rpn(build_rpn(e), [b], 1, np)
+    assert list(v) == [1]
+
+
+def test_enum_name_out_of_range_is_empty():
+    assert coll.enum_name(5, (b"S", b"M")) == b""
+    assert coll.enum_name(-1, (b"S",)) == b""

@@ -1,0 +1,189 @@
+"""resource_metering (per-tag CPU/keys attribution) and the CPU/heap
+profiler routes.
+
+Reference: components/resource_metering/ (tag factory, sub-recorders,
+top-N reporter) and src/server/status_server/profile.rs.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tikv_tpu.resource_metering import (
+    Recorder,
+    ResourceTagFactory,
+    TagRecord,
+)
+
+
+def test_attach_attributes_cpu_and_requests():
+    rec = Recorder()
+    with rec.attach("rg1|select"):
+        x = 0
+        for i in range(200_000):
+            x += i * i
+    report = rec.harvest()
+    assert report["rg1|select"].requests == 1
+    assert report["rg1|select"].cpu_secs > 0
+    # window drained
+    assert rec.harvest() == {}
+
+
+def test_read_keys_attributed_to_current_tag():
+    rec = Recorder()
+    with rec.attach("rg2"):
+        rec.record_read_keys(123)
+        rec.record_write_keys(4)
+    r = rec.harvest()["rg2"]
+    assert r.read_keys == 123 and r.write_keys == 4
+
+
+def test_tags_isolated_across_threads():
+    rec = Recorder()
+
+    def worker(tag, keys):
+        with rec.attach(tag):
+            rec.record_read_keys(keys)
+
+    ts = [threading.Thread(target=worker, args=(f"t{i}", i * 10))
+          for i in range(1, 5)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    rep = rec.harvest()
+    assert {t: r.read_keys for t, r in rep.items()} == \
+        {"t1": 10, "t2": 20, "t3": 30, "t4": 40}
+
+
+def test_top_n_folds_into_others():
+    rec = Recorder(max_tags=3)
+    for i in range(10):
+        rec.record(f"tag{i}", cpu_secs=float(i), requests=1)
+    rep = rec.harvest()
+    assert len(rep) == 4 and "others" in rep
+    assert rep["others"].requests == 7
+    assert "tag9" in rep and "tag0" not in rep
+
+
+def test_subscriber_receives_reports():
+    rec = Recorder()
+    got = []
+    rec.subscribe(got.append)
+    rec.record("x", requests=1)
+    rec.harvest()
+    assert len(got) == 1 and got[0]["x"].requests == 1
+
+
+def test_endpoint_attribution():
+    from tikv_tpu.copr import CopRequest, Endpoint, REQ_TYPE_DAG
+    from tikv_tpu.resource_metering import GLOBAL_RECORDER
+    from tikv_tpu.testing import DagSelect, init_with_data, product_table
+
+    GLOBAL_RECORDER.harvest()   # clear
+    table = product_table()
+    store = init_with_data(table, [
+        (i, {"name": b"x", "count": i}) for i in range(1, 6)])
+    ep = Endpoint(lambda req: store)
+    q = DagSelect.from_table(table)
+    ep.handle(CopRequest(REQ_TYPE_DAG, q.build(),
+                         resource_group="rg-a", request_source="dag"))
+    rep = GLOBAL_RECORDER.harvest()
+    tag = ResourceTagFactory.tag("rg-a", "dag")
+    assert rep[tag].requests == 1 and rep[tag].read_keys == 5
+
+
+# ------------------------------------------------------------- profiler
+
+def test_profile_cpu_captures_busy_thread():
+    from tikv_tpu.utils.profiler import profile_cpu
+
+    stop = threading.Event()
+
+    def busy_loop_marker():
+        while not stop.is_set():
+            sum(i * i for i in range(1000))
+
+    t = threading.Thread(target=busy_loop_marker)
+    t.start()
+    try:
+        out = profile_cpu(seconds=0.3, hz=200)
+    finally:
+        stop.set()
+        t.join()
+    assert "busy_loop_marker" in out
+    # folded format: "stack count" lines
+    top = out.splitlines()[0]
+    assert top.rsplit(" ", 1)[1].isdigit()
+
+
+def test_heap_profiler_snapshot():
+    from tikv_tpu.utils.profiler import HeapProfiler, memory_usage
+
+    HeapProfiler.activate()
+    try:
+        keep = [bytearray(100_000) for _ in range(10)]
+        out = HeapProfiler.snapshot()
+        assert "total tracked" in out
+        mu = memory_usage()
+        assert mu["max_rss_bytes"] > 0 and mu["traced_bytes"] > 0
+        del keep
+    finally:
+        HeapProfiler.deactivate()
+
+
+def test_status_server_routes():
+    from tikv_tpu.server.status_server import StatusServer
+
+    srv = StatusServer("127.0.0.1:0")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        from tikv_tpu.resource_metering import GLOBAL_RECORDER
+        GLOBAL_RECORDER.record("route-test", cpu_secs=0.5, requests=2)
+        body = urllib.request.urlopen(
+            base + "/resource_metering", timeout=10).read()
+        rep = json.loads(body)
+        assert rep["route-test"]["requests"] == 2
+        prof = urllib.request.urlopen(
+            base + "/debug/pprof/profile?seconds=0.2", timeout=10).read()
+        assert isinstance(prof, bytes)
+        req = urllib.request.Request(
+            base + "/debug/pprof/heap_activate", data=b"{}",
+            method="POST")
+        assert json.loads(urllib.request.urlopen(
+            req, timeout=10).read())["active"] is True
+        heap = urllib.request.urlopen(
+            base + "/debug/pprof/heap", timeout=10).read()
+        assert heap
+        mem = json.loads(urllib.request.urlopen(
+            base + "/debug/memory", timeout=10).read())
+        assert mem["max_rss_bytes"] > 0
+        req = urllib.request.Request(
+            base + "/debug/pprof/heap_deactivate", data=b"{}",
+            method="POST")
+        urllib.request.urlopen(req, timeout=10)
+    finally:
+        srv.stop()
+
+
+def test_read_keys_counts_scanned_not_output_rows():
+    """COUNT(*) over N rows is N rows of read work, not 1."""
+    from tikv_tpu.copr import CopRequest, Endpoint, REQ_TYPE_DAG
+    from tikv_tpu.resource_metering import GLOBAL_RECORDER
+    from tikv_tpu.testing import DagSelect, init_with_data, product_table
+
+    GLOBAL_RECORDER.harvest()
+    table = product_table()
+    store = init_with_data(table, [
+        (i, {"name": b"x", "count": i}) for i in range(1, 51)])
+    ep = Endpoint(lambda req: store)
+    q = DagSelect.from_table(table)
+    ep.handle(CopRequest(REQ_TYPE_DAG, q.count().build(),
+                         resource_group="agg"))
+    rep = GLOBAL_RECORDER.harvest()
+    assert rep["agg"].read_keys == 50

@@ -47,6 +47,10 @@ class CopRequest:
     # from the previous page (stable across snapshots)
     paging_size: int = 0
     resume_token: object = None
+    # resource attribution (kvrpcpb Context resource_group_tag /
+    # request_source — resource_metering tag.rs)
+    resource_group: str = "default"
+    request_source: str = ""
 
 
 @dataclass
@@ -140,24 +144,35 @@ class Endpoint:
         return checksum_kv_pairs(keys, vals)
 
     def handle(self, req: CopRequest) -> CopResponse:
+        from ..resource_metering import (
+            GLOBAL_RECORDER,
+            ResourceTagFactory,
+        )
         from ..utils import metrics as m
         if req.tp != REQ_TYPE_DAG:
             raise NotImplementedError(f"request type {req.tp}")
+        tag = ResourceTagFactory.tag(req.resource_group,
+                                     req.request_source)
         t0 = time.perf_counter_ns()
-        storage = self._snapshot_provider(req)
-        backend = self._pick_backend(req, storage)
-        if req.paging_size > 0:
-            backend = "host"    # pages are a host-pipeline contract
-            from ..executors.runner import BatchExecutorsRunner
-            result = BatchExecutorsRunner(
-                req.dag, storage,
-                resume_token=req.resume_token).handle_request(
-                    max_rows=req.paging_size)
-        elif backend == "device":
-            result = self._device_runner.handle_request(req.dag, storage)
-        else:
-            from ..executors.runner import BatchExecutorsRunner
-            result = BatchExecutorsRunner(req.dag, storage).handle_request()
+        with GLOBAL_RECORDER.attach(tag):
+            storage = self._snapshot_provider(req)
+            backend = self._pick_backend(req, storage)
+            if req.paging_size > 0:
+                backend = "host"    # pages are a host-pipeline contract
+                from ..executors.runner import BatchExecutorsRunner
+                result = BatchExecutorsRunner(
+                    req.dag, storage,
+                    resume_token=req.resume_token).handle_request(
+                        max_rows=req.paging_size)
+            elif backend == "device":
+                result = self._device_runner.handle_request(req.dag,
+                                                            storage)
+            else:
+                from ..executors.runner import BatchExecutorsRunner
+                result = BatchExecutorsRunner(req.dag,
+                                              storage).handle_request()
+            from ..resource_metering import scanned_rows
+            GLOBAL_RECORDER.record_read_keys(scanned_rows(result))
         elapsed = time.perf_counter_ns() - t0
         m.COPR_REQ_COUNTER.labels(backend).inc()
         m.COPR_REQ_DURATION.labels(backend).observe(elapsed / 1e9)

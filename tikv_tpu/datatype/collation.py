@@ -87,9 +87,10 @@ def eq(a: bytes, b: bytes, collation: int = BINARY) -> bool:
 # ---------------------------------------------------------------- enum/set
 
 def enum_name(ordinal: int, elems) -> bytes:
-    """MySQL ENUM: 1-based ordinal into the definition; 0 is the empty
-    ('data truncated') value."""
-    if ordinal == 0:
+    """MySQL ENUM: 1-based ordinal into the definition; 0 — and any
+    ordinal beyond the table (stale/corrupt row after a definition
+    shrink) — is the empty ('data truncated') value, never an error."""
+    if ordinal <= 0 or ordinal > len(elems):
         return b""
     name = elems[int(ordinal) - 1]
     return name if isinstance(name, bytes) else str(name).encode()

@@ -145,17 +145,24 @@ def register() -> None:
                     needs_ctx=(ty is B))
             def _extreme(xp, *pairs, _ty=ty, _gt=gt, ctx=(63, ())):
                 out_v, valid = pairs[0]
-                for (v, m) in pairs[1:]:
-                    if _ty is B:
-                        kv, kout = _collate(v, out_v, ctx[0])
+                if _ty is B:
+                    # collate each operand ONCE; carry the
+                    # accumulator's keys instead of re-collating it
+                    # per operand (sort_key is a per-char python loop)
+                    out_k, _ = _collate(out_v, out_v, ctx[0])
+                    for (v, m) in pairs[1:]:
+                        kv, _ = _collate(v, v, ctx[0])
                         take = _cmp_vals(
-                            B, xp, kv, kout,
+                            B, xp, kv, out_k,
                             (lambda x, y: x > y) if _gt
                             else (lambda x, y: x < y))
                         out_v = np.where(take, v, out_v)
-                    else:
-                        out_v = (np.maximum if _gt else np.minimum)(
-                            out_v, v)
+                        out_k = np.where(take, kv, out_k)
+                        valid = valid & m
+                    return out_v, valid
+                for (v, m) in pairs[1:]:
+                    out_v = (np.maximum if _gt else np.minimum)(
+                        out_v, v)
                     valid = valid & m
                 return out_v, valid
 

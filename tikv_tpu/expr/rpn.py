@@ -70,17 +70,30 @@ class RpnExpression:
 
 
 def _subtree_ctx(e: Expr) -> tuple:
-    """First non-binary collation / non-empty elems anywhere below
-    ``e`` (pre-order) — the effective string context of the subtree."""
-    coll, elems = 63, ()
+    """Effective (collation, elems) of ``e``'s subtree.
+
+    Collation coercion follows MySQL: COLUMN collations are explicit —
+    if any string column in the subtree is binary, binary wins over a
+    ci column (comparing bin_col to ci_col compares bytes); a ci
+    collation applies only when no string column says binary.  Consts
+    and intermediate calls are coercible (no vote).  Elems: first
+    non-empty table anywhere below.
+    """
+    from ..datatype import EvalType
+    colls: list = []
+    elems: tuple = ()
     stack = list(e.children)
-    while stack and (coll == 63 or not elems):
+    while stack:
         n = stack.pop(0)
-        if coll == 63 and n.collation != 63:
-            coll = n.collation
+        if n.kind == "column" and n.eval_type is EvalType.BYTES:
+            colls.append(n.collation)
         if not elems and n.elems:
             elems = n.elems
         stack.extend(n.children)
+    if any(c == 63 for c in colls):
+        coll = 63
+    else:
+        coll = next((c for c in colls if c != 63), 63)
     return coll, elems
 
 

@@ -1,0 +1,142 @@
+"""Per-request CPU / keys attribution by resource tag.
+
+Reference: components/resource_metering/ — a ``ResourceTagFactory``
+stamps every request with its resource-group / request-source tag, thread
+``SubRecorder``s sample per-tag CPU (recorder/sub_recorder/cpu.rs) and
+logical work (summary.rs: read keys), and a reporter aggregates windows,
+keeping the top-N consumers and folding the rest into an ``others``
+bucket before publishing (reporter/, pubsub.rs).
+
+Here the tag rides a contextvar (the Python analog of the reference's
+thread-local tag cell), CPU comes from ``time.thread_time`` deltas
+around the attached scope, and subscribers get per-window reports.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from dataclasses import dataclass, field
+
+_CURRENT_TAG: contextvars.ContextVar = contextvars.ContextVar(
+    "resource_tag", default=None)
+
+
+@dataclass
+class TagRecord:
+    cpu_secs: float = 0.0
+    read_keys: int = 0
+    write_keys: int = 0
+    requests: int = 0
+
+    def merge(self, other: "TagRecord") -> None:
+        self.cpu_secs += other.cpu_secs
+        self.read_keys += other.read_keys
+        self.write_keys += other.write_keys
+        self.requests += other.requests
+
+
+class ResourceTagFactory:
+    """Builds tags from request context (reference: tag.rs — the tag is
+    (resource_group, request_source) squeezed into bytes)."""
+
+    @staticmethod
+    def tag(resource_group: str = "default",
+            source: str = "") -> str:
+        return f"{resource_group}|{source}" if source else resource_group
+
+
+class Recorder:
+    """Accumulates per-tag records; ``attach`` scopes attribution."""
+
+    def __init__(self, max_tags: int = 100):
+        self._lock = threading.Lock()
+        self._records: dict[str, TagRecord] = {}
+        self._max_tags = max_tags
+        self._subs: list = []
+
+    # -- attribution ----------------------------------------------------
+
+    class _Scope:
+        def __init__(self, rec: "Recorder", tag: str):
+            self._rec = rec
+            self._tag = tag
+            self._token = None
+            self._t0 = 0.0
+
+        def __enter__(self):
+            self._token = _CURRENT_TAG.set(self._tag)
+            self._t0 = time.thread_time()
+            return self
+
+        def __exit__(self, *exc):
+            dt = time.thread_time() - self._t0
+            _CURRENT_TAG.reset(self._token)
+            self._rec.record(self._tag, cpu_secs=dt, requests=1)
+            return False
+
+    def attach(self, tag: str) -> "_Scope":
+        return Recorder._Scope(self, tag)
+
+    @staticmethod
+    def current_tag():
+        return _CURRENT_TAG.get()
+
+    def record(self, tag=None, cpu_secs: float = 0.0,
+               read_keys: int = 0, write_keys: int = 0,
+               requests: int = 0) -> None:
+        tag = tag if tag is not None else (_CURRENT_TAG.get() or "default")
+        with self._lock:
+            rec = self._records.get(tag)
+            if rec is None:
+                rec = self._records[tag] = TagRecord()
+            rec.merge(TagRecord(cpu_secs, read_keys, write_keys,
+                                requests))
+
+    def record_read_keys(self, n: int) -> None:
+        self.record(read_keys=n)
+
+    def record_write_keys(self, n: int) -> None:
+        self.record(write_keys=n)
+
+    # -- reporting ------------------------------------------------------
+
+    def subscribe(self, callback) -> None:
+        """callback(report: dict[tag, TagRecord]) per harvest — the
+        pubsub seam (reference pubsub.rs datasinks)."""
+        self._subs.append(callback)
+
+    def harvest(self) -> dict:
+        """Drain the window: top max_tags by CPU stay named, the tail
+        folds into ``others`` (reference reporter keeps
+        max_resource_groups and aggregates the rest)."""
+        with self._lock:
+            records = self._records
+            self._records = {}
+        if len(records) > self._max_tags:
+            ranked = sorted(records.items(),
+                            key=lambda kv: -kv[1].cpu_secs)
+            kept = dict(ranked[:self._max_tags])
+            others = TagRecord()
+            for _tag, rec in ranked[self._max_tags:]:
+                others.merge(rec)
+            kept["others"] = others
+            records = kept
+        for cb in list(self._subs):
+            cb(records)
+        return records
+
+
+GLOBAL_RECORDER = Recorder()
+
+
+def scanned_rows(result) -> int:
+    """Rows actually SCANNED by a SelectResult — the first operator's
+    produced rows (the scan), not the final output count: a COUNT(*)
+    over 1M rows did 1M rows of read work, not 1 (summary.rs records
+    scanned keys the same way)."""
+    summaries = getattr(result, "exec_summaries", None)
+    if summaries:
+        return int(summaries[0].num_produced_rows)
+    return result.batch.num_rows

@@ -360,13 +360,17 @@ class TxnClient:
 
     def coprocessor(self, dag, key_hint: Optional[bytes] = None,
                     force_backend: Optional[str] = None,
-                    paging_size: int = 0, resume_token=None) -> dict:
+                    paging_size: int = 0, resume_token=None,
+                    resource_group: str = "default",
+                    request_source: str = "") -> dict:
         key = key_hint if key_hint is not None else \
             (dag.ranges[0].start if dag.ranges else b"")
         return self._call_leader(key, "Coprocessor", {
             "tp": 103, "dag": wire.enc_dag(dag),
             "force_backend": force_backend,
-            "paging_size": paging_size, "resume_token": resume_token})
+            "paging_size": paging_size, "resume_token": resume_token,
+            "resource_group": resource_group,
+            "request_source": request_source})
 
     def coprocessor_paged(self, dag, paging_size: int,
                           key_hint: Optional[bytes] = None):

@@ -252,7 +252,9 @@ class KvService:
         resp = self.endpoint.handle(CopRequest(
             REQ_TYPE_DAG, dag, req.get("force_backend"),
             paging_size=req.get("paging_size", 0),
-            resume_token=req.get("resume_token")))
+            resume_token=req.get("resume_token"),
+            resource_group=req.get("resource_group", "default"),
+            request_source=req.get("request_source", "")))
         return self._enc_cop_resp(resp)
 
     def copr_stream_rpc(self, req: dict, ctx=None):
@@ -345,6 +347,13 @@ class KvService:
 
         from ..copr.endpoint import CopResponse
         from ..executors.runner import BatchExecutorsRunner
+        from ..resource_metering import (
+            GLOBAL_RECORDER,
+            ResourceTagFactory,
+            scanned_rows as _scanned_rows,
+        )
+        tag = ResourceTagFactory.tag(req.get("resource_group", "default"),
+                                     req.get("request_source", ""))
         try:
             dag = wire.dec_dag(req["dag"])
             page = req.get("paging_size", 0) or \
@@ -354,7 +363,12 @@ class KvService:
             runner = BatchExecutorsRunner(dag, storage)
             while True:
                 t0 = _time.perf_counter_ns()
-                result = runner.handle_request(max_rows=page)
+                # per-page attribution: the stream can outlive several
+                # metering windows
+                with GLOBAL_RECORDER.attach(tag):
+                    result = runner.handle_request(max_rows=page)
+                    GLOBAL_RECORDER.record_read_keys(
+                        _scanned_rows(result))
                 yield self._enc_cop_resp(CopResponse(
                     result, _time.perf_counter_ns() - t0, "host"))
                 if result.is_drained:

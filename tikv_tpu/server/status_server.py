@@ -74,6 +74,38 @@ class StatusServer:
                     groups = node.resource_groups.list_groups() \
                         if node is not None else []
                     self._json(200, groups)
+                elif path == "/resource_metering":
+                    from ..resource_metering import GLOBAL_RECORDER
+                    report = GLOBAL_RECORDER.harvest()
+                    self._json(200, {
+                        tag: {"cpu_secs": r.cpu_secs,
+                              "read_keys": r.read_keys,
+                              "write_keys": r.write_keys,
+                              "requests": r.requests}
+                        for tag, r in report.items()})
+                elif path == "/debug/pprof/profile":
+                    # ?seconds=N (default 1): folded-stacks CPU profile
+                    # (status_server profile.rs dump_one_cpu_profile)
+                    from ..utils.profiler import profile_cpu
+                    q = self.path.split("?", 1)
+                    secs = 1.0
+                    try:
+                        if len(q) == 2:
+                            for kv in q[1].split("&"):
+                                if kv.startswith("seconds="):
+                                    secs = min(30.0, float(kv[8:]))
+                    except ValueError:
+                        self._json(400, {"error": "bad seconds"})
+                        return
+                    self._reply(200, profile_cpu(secs).encode(),
+                                "text/plain")
+                elif path == "/debug/pprof/heap":
+                    from ..utils.profiler import HeapProfiler
+                    self._reply(200, HeapProfiler.snapshot().encode(),
+                                "text/plain")
+                elif path == "/debug/memory":
+                    from ..utils.profiler import memory_usage
+                    self._json(200, memory_usage())
                 else:
                     self._json(404, {"error": f"no route {path}"})
 
@@ -125,6 +157,19 @@ class StatusServer:
                     else:
                         failpoint.remove(name)
                     self._json(200, {"ok": True})
+                elif path == "/debug/pprof/heap_activate":
+                    from ..utils.profiler import HeapProfiler
+                    try:
+                        frames = int(body.get("frames", 16))
+                    except (TypeError, ValueError):
+                        self._json(400, {"error": "bad frames"})
+                        return
+                    HeapProfiler.activate(frames)
+                    self._json(200, {"active": True})
+                elif path == "/debug/pprof/heap_deactivate":
+                    from ..utils.profiler import HeapProfiler
+                    HeapProfiler.deactivate()
+                    self._json(200, {"active": False})
                 else:
                     self._json(404, {"error": f"no route {path}"})
 

@@ -1,0 +1,106 @@
+"""CPU sampling profiler + heap profiling.
+
+Reference: src/server/status_server/profile.rs (pprof CPU flamegraph via
+the ``pprof`` crate's sampling profiler; jemalloc heap profiles through
+tikv_alloc) and components/profiler/.  The Python-native equivalents:
+
+- CPU: a sampler thread walks ``sys._current_frames()`` at a fixed
+  interval and aggregates collapsed stacks — the flamegraph.pl /
+  speedscope "folded" format, the same artifact the reference's
+  /debug/pprof/profile serves.
+- Heap: ``tracemalloc`` snapshots (allocation sites by size), the
+  jemalloc heap-profile analog.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import tracemalloc
+from collections import Counter
+
+
+def profile_cpu(seconds: float = 1.0, hz: int = 100,
+                whole_process: bool = True) -> str:
+    """Sample all thread stacks for ``seconds`` → folded-stacks text
+    ("frame;frame;frame count" per line, heaviest first)."""
+    interval = 1.0 / hz
+    me = threading.get_ident()
+    folded: Counter = Counter()
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                stack.append(f"{code.co_name} "
+                             f"({code.co_filename.rsplit('/', 1)[-1]}"
+                             f":{f.f_lineno})")
+                f = f.f_back
+            if stack:
+                folded[";".join(reversed(stack))] += 1
+        time.sleep(interval)
+    lines = [f"{stack} {n}"
+             for stack, n in folded.most_common()]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class HeapProfiler:
+    """tracemalloc activation + snapshot rendering."""
+
+    @staticmethod
+    def activate(nframes: int = 16) -> None:
+        if not tracemalloc.is_tracing():
+            tracemalloc.start(nframes)
+
+    @staticmethod
+    def deactivate() -> None:
+        if tracemalloc.is_tracing():
+            tracemalloc.stop()
+
+    @staticmethod
+    def is_active() -> bool:
+        return tracemalloc.is_tracing()
+
+    @staticmethod
+    def snapshot(top: int = 50) -> str:
+        """Top allocation sites by retained size (activates tracing on
+        first use — the first snapshot then only covers allocations
+        from this point, exactly like enabling jemalloc profiling)."""
+        if not tracemalloc.is_tracing():
+            HeapProfiler.activate()
+            return ("# heap profiling just activated; allocations are "
+                    "tracked from now — re-request for data\n")
+        snap = tracemalloc.take_snapshot()
+        all_stats = snap.statistics("lineno")
+        total = sum(s.size for s in all_stats)
+        stats = all_stats[:top]
+        out = [f"# total tracked: {total} bytes"]
+        for s in stats:
+            frame = s.traceback[0]
+            out.append(f"{s.size}\t{s.count}\t"
+                       f"{frame.filename.rsplit('/', 1)[-1]}"
+                       f":{frame.lineno}")
+        return "\n".join(out) + "\n"
+
+
+def memory_usage() -> dict:
+    """Process memory accounting (tikv_util sys/memory.rs analog)."""
+    import resource
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    out = {"max_rss_bytes": ru.ru_maxrss * 1024}
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        out["rss_bytes"] = pages * 4096
+    except OSError:     # pragma: no cover — non-linux
+        pass
+    if tracemalloc.is_tracing():
+        cur, peak = tracemalloc.get_traced_memory()
+        out["traced_bytes"] = cur
+        out["traced_peak_bytes"] = peak
+    return out
