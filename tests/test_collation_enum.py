@@ -242,3 +242,14 @@ def test_binary_column_wins_coercion():
 def test_enum_name_out_of_range_is_empty():
     assert coll.enum_name(5, (b"S", b"M")) == b""
     assert coll.enum_name(-1, (b"S",)) == b""
+
+
+def test_explicit_call_collation_beats_columns():
+    """A non-binary collation set explicitly on a call node (COLLATE
+    clause) outranks the binary column vote."""
+    a = scol([b"A"])
+    e = Expr.call("EqString",
+                  Expr.call("Upper", Expr.column(0, B), collation=CI),
+                  Expr.const(b"a", B))
+    v, m = eval_rpn(build_rpn(e), [a], 1, np)
+    assert list(v) == [1]

@@ -1,0 +1,105 @@
+"""Debug gRPC service (src/server/debug.rs analog) over a live server:
+raw engine get, region info/size, MVCC dump, raft log inspect,
+bad-region tombstone.
+"""
+
+import pytest
+
+from tikv_tpu.server.client import TxnClient
+from tikv_tpu.server.node import Node
+from tikv_tpu.server.pd_server import PdServer, RemotePdClient
+from tikv_tpu.server.server import TikvServer
+from tikv_tpu.raftstore.metapb import Store as StoreMeta
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    servers = []
+    for _ in range(2):
+        node = Node("127.0.0.1:0", RemotePdClient(pd_addr))
+        srv = TikvServer(node)
+        node.addr = f"127.0.0.1:{srv.port}"
+        node.pd.put_store(StoreMeta(node.store_id, node.addr))
+        srv.start()
+        servers.append(srv)
+    client = TxnClient(pd_addr)
+    client.add_peer(1, servers[1].node.store_id)
+    client.put(b"dbg_a", b"1")
+    client.put(b"dbg_b", b"x" * 300)      # big value → default CF row
+    yield {"servers": servers, "client": client}
+    for srv in servers:
+        srv.stop()
+    pd_server.stop()
+
+
+def sid(cluster, i=0):
+    return cluster["servers"][i].node.store_id
+
+
+def test_region_info(cluster):
+    r = cluster["client"].debug(sid(cluster), "DebugRegionInfo",
+                                {"region_id": 1})
+    assert r["region"]["id"] == 1
+    assert r["raft_state"]["commit"] >= 1
+    assert r["raft_state"]["last_index"] >= r["raft_state"]["applied"] - 1
+    from tikv_tpu.server.wire import RemoteError
+    with pytest.raises(RemoteError, match="region_not_found"):
+        cluster["client"].debug(sid(cluster), "DebugRegionInfo",
+                                {"region_id": 999})
+
+
+def test_region_size(cluster):
+    r = cluster["client"].debug(sid(cluster), "DebugRegionSize",
+                                {"region_id": 1})
+    assert r["sizes"]["write"] > 0
+    assert r["sizes"]["default"] > 300    # the big value landed there
+
+
+def test_mvcc_dump(cluster):
+    r = cluster["client"].debug(sid(cluster), "DebugScanMvcc",
+                                {"start": b"dbg_", "end": b"dbg_z"})
+    by_key = {k["key"]: k for k in r["keys"]}
+    assert b"dbg_a" in by_key and b"dbg_b" in by_key
+    w = by_key[b"dbg_a"]["writes"][0]
+    assert w["type"] == "PUT" and w["commit_ts"] > w["start_ts"]
+    assert w["short_value"] == b"1"
+    assert by_key[b"dbg_b"]["writes"][0]["short_value"] is None
+
+
+def test_debug_get_raw_engine_key(cluster):
+    from tikv_tpu.raftstore.peer_storage import data_key
+    from tikv_tpu.storage.txn_types import append_ts, encode_key
+    # find dbg_a's write record via the mvcc dump, then read it raw
+    r = cluster["client"].debug(sid(cluster), "DebugScanMvcc",
+                                {"start": b"dbg_a", "end": b"dbg_b"})
+    commit_ts = r["keys"][0]["writes"][0]["commit_ts"]
+    raw_key = data_key(append_ts(encode_key(b"dbg_a"), commit_ts))
+    got = cluster["client"].debug(sid(cluster), "DebugGet",
+                                  {"cf": "write", "key": raw_key})
+    assert got["value"] is not None
+
+
+def test_raft_log_inspect(cluster):
+    info = cluster["client"].debug(sid(cluster), "DebugRegionInfo",
+                                   {"region_id": 1})
+    idx = info["raft_state"]["applied"]
+    r = cluster["client"].debug(sid(cluster), "DebugRaftLog",
+                                {"region_id": 1, "index": idx})
+    assert "entry" in r and r["entry"]["index"] == idx
+
+
+def test_tombstone_bad_region(cluster):
+    """Tombstoning the FOLLOWER's replica removes it from that store;
+    the healthy store still serves."""
+    victim = sid(cluster, 1)
+    r = cluster["client"].debug(victim, "DebugRecoverRegion",
+                                {"region_id": 1})
+    assert r["tombstoned"] == 1
+    from tikv_tpu.server.wire import RemoteError
+    with pytest.raises(RemoteError, match="region_not_found"):
+        cluster["client"].debug(victim, "DebugRegionInfo",
+                                {"region_id": 1})
+    assert cluster["client"].get(b"dbg_a") == b"1"

@@ -1,0 +1,114 @@
+"""GrpcTransport per-store batching, overflow, backoff, rediscovery.
+
+Reference: src/server/raft_client.rs (Queue overflow :198-226,
+reconnect/backoff, address re-resolution via resolve.rs).
+"""
+
+import time
+
+import pytest
+
+from tikv_tpu.raft.messages import Message, MsgType
+from tikv_tpu.raftstore.metapb import Peer
+from tikv_tpu.server.node import GrpcTransport, _StoreConn
+
+
+class FakePd:
+    def __init__(self):
+        self.resolves = 0
+
+    def get_store(self, sid):
+        self.resolves += 1
+
+        class S:
+            address = f"127.0.0.1:1"   # nothing listens here
+        return S()
+
+
+def msg():
+    return Message(MsgType.HEARTBEAT, to=2, frm=1, term=1)
+
+
+def fill(tr, n=3):
+    for _ in range(n):
+        tr.send(2, 1, Peer(102, 2), Peer(101, 1), msg())
+
+
+def test_queue_bounded_drops_overflow():
+    tr = GrpcTransport(FakePd())
+    conn = tr._conn(2)
+    conn.MAX_QUEUE = 5
+    fill(tr, 9)
+    assert len(conn.queue) == 5     # 4 dropped, queue capped
+
+
+def test_send_failure_backs_off_and_rediscovers():
+    pd = FakePd()
+    tr = GrpcTransport(pd)
+    fill(tr, 2)
+    conn = tr._conn(2)
+
+    calls = []
+
+    def bad_channel(c):
+        calls.append(time.monotonic())
+        raise ConnectionError("down")
+
+    tr._channel = bad_channel
+    tr.flush()
+    assert conn.fail_count == 1 and conn.next_attempt > time.monotonic()
+    assert conn.channel is None and conn.addr is None   # rediscovery
+    # during the backoff window further flushes do NOT attempt
+    fill(tr, 1)
+    tr.flush()
+    assert len(calls) == 1
+    # backoff grows exponentially
+    conn.next_attempt = 0.0
+    tr.flush()
+    assert conn.fail_count == 2
+    d1 = _StoreConn.BACKOFF_BASE
+    assert conn.next_attempt - time.monotonic() > d1 * 1.5
+
+
+def test_success_resets_backoff_and_batches():
+    tr = GrpcTransport(FakePd())
+    fill(tr, 7)
+    conn = tr._conn(2)
+    conn.fail_count = 3
+    sent = []
+
+    class Chan:
+        def unary_unary(self, method, request_serializer=None,
+                        response_deserializer=None):
+            def call(payload, timeout=None):
+                sent.append(payload)
+                return {}
+            return call
+
+    tr._channel = lambda c: Chan()
+    tr.flush()
+    assert conn.fail_count == 0 and conn.next_attempt == 0.0
+    # one batched RPC carrying all 7 messages
+    assert len(sent) == 1 and len(sent[0]["msgs"]) == 7
+
+
+def test_batch_cap_splits_across_flushes():
+    tr = GrpcTransport(FakePd())
+    conn = tr._conn(2)
+    conn.MAX_BATCH = 4
+    fill(tr, 10)
+    sent = []
+
+    class Chan:
+        def unary_unary(self, *a, **k):
+            def call(payload, timeout=None):
+                sent.append(len(payload["msgs"]))
+                return {}
+            return call
+
+    tr._channel = lambda c: Chan()
+    tr.flush()
+    assert sent == [4] and len(conn.queue) == 6
+    tr.flush()
+    tr.flush()
+    assert sent == [4, 4, 2]

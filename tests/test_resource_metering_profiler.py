@@ -187,3 +187,26 @@ def test_read_keys_counts_scanned_not_output_rows():
                          resource_group="agg"))
     rep = GLOBAL_RECORDER.harvest()
     assert rep["agg"].read_keys == 50
+
+
+def test_streamed_pages_record_delta_not_cumulative():
+    """Summaries are cumulative across pages of one runner; metering
+    must record per-page deltas (300 scanned rows -> 300, not 600)."""
+    from tikv_tpu.resource_metering import scanned_rows
+    from tikv_tpu.executors.runner import BatchExecutorsRunner
+    from tikv_tpu.testing import DagSelect, init_with_data, product_table
+
+    table = product_table()
+    store = init_with_data(table, [
+        (i, {"name": b"x", "count": i}) for i in range(1, 301)])
+    dag = DagSelect.from_table(table).build()
+    runner = BatchExecutorsRunner(dag, store)
+    total, prev = 0, 0
+    while True:
+        r = runner.handle_request(max_rows=100)
+        scanned = scanned_rows(r)
+        total += max(0, scanned - prev)
+        prev = scanned
+        if r.is_drained:
+            break
+    assert total == 300

@@ -1,0 +1,79 @@
+"""Chunked snapshot streaming over the real gRPC transport.
+
+Reference: src/server/snap.rs — large region snapshots travel on a
+dedicated chunked stream; the raft message carries only metadata.
+A new peer added after ~1MB of writes must be populated via chunks
+(SNAP_CHUNK forced tiny to guarantee the path).
+"""
+
+import time
+
+import pytest
+
+from tikv_tpu.server.node import GrpcTransport, Node
+from tikv_tpu.server.pd_server import PdServer, RemotePdClient
+from tikv_tpu.server.server import TikvServer
+from tikv_tpu.server.client import TxnClient
+from tikv_tpu.raftstore.metapb import Store as StoreMeta
+
+
+@pytest.fixture
+def small_chunks(monkeypatch):
+    monkeypatch.setattr(GrpcTransport, "SNAP_CHUNK", 8 * 1024)
+
+
+def test_new_peer_populated_via_chunked_snapshot(small_chunks):
+    from tikv_tpu.utils.metrics import SNAP_CHUNK_COUNTER
+    chunks_before = SNAP_CHUNK_COUNTER.value
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    servers = []
+    try:
+        for _ in range(2):
+            node = Node("127.0.0.1:0", RemotePdClient(pd_addr))
+            srv = TikvServer(node)
+            node.addr = f"127.0.0.1:{srv.port}"
+            node.pd.put_store(StoreMeta(node.store_id, node.addr))
+            srv.start()
+            servers.append(srv)
+        client = TxnClient(pd_addr)
+        # ~1MB of data BEFORE the second peer exists → it can only
+        # catch up via a snapshot, which now must exceed SNAP_CHUNK
+        payload = b"V" * 4096
+        for i in range(256):
+            client.put(b"snapkey%04d" % i, payload)
+        client.add_peer(1, servers[1].node.store_id)
+        # wait until the new peer holds the data (snapshot applied)
+        eng = servers[1].node.engine
+        from tikv_tpu.raftstore.peer_storage import data_key
+        from tikv_tpu.storage.txn_types import append_ts, encode_key
+        from tikv_tpu.engine.traits import CF_WRITE
+
+        def follower_has_data():
+            it = eng.iterator_cf(CF_WRITE,
+                                 data_key(encode_key(b"snapkey0000")),
+                                 data_key(encode_key(b"snapkey9999")))
+            n, ok = 0, it.seek_to_first()
+            while ok:
+                n += 1
+                ok = it.next()
+            return n >= 256
+
+        deadline = time.time() + 30
+        while time.time() < deadline and not follower_has_data():
+            time.sleep(0.2)
+        assert follower_has_data(), "snapshot never applied on follower"
+        # chunk reassembly buffers drained (claimed by the raft msg)
+        svc = servers[1].service if hasattr(servers[1], "service") else None
+        if svc is not None:
+            assert not svc._snap_ready and not svc._snap_parts
+        # and reads through the follower's store agree
+        got = client.get(b"snapkey0100")
+        assert got == payload
+        # the data really travelled as chunks (≥1MB at 8KB/chunk)
+        assert SNAP_CHUNK_COUNTER.value - chunks_before >= 100
+    finally:
+        for srv in servers:
+            srv.stop()
+        pd_server.stop()

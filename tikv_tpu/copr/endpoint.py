@@ -172,7 +172,15 @@ class Endpoint:
                 result = BatchExecutorsRunner(req.dag,
                                               storage).handle_request()
             from ..resource_metering import scanned_rows
-            GLOBAL_RECORDER.record_read_keys(scanned_rows(result))
+            if backend == "device" and not result.exec_summaries:
+                # the device feed always scans the whole snapshot; its
+                # results carry no per-operator summaries
+                est = getattr(storage, "estimated_rows", None)
+                n = est() if callable(est) else None
+                GLOBAL_RECORDER.record_read_keys(
+                    n if n is not None else result.batch.num_rows)
+            else:
+                GLOBAL_RECORDER.record_read_keys(scanned_rows(result))
         elapsed = time.perf_counter_ns() - t0
         m.COPR_REQ_COUNTER.labels(backend).inc()
         m.COPR_REQ_DURATION.labels(backend).observe(elapsed / 1e9)

@@ -80,20 +80,26 @@ def _subtree_ctx(e: Expr) -> tuple:
     non-empty table anywhere below.
     """
     from ..datatype import EvalType
-    colls: list = []
+    col_colls: list = []
+    explicit = None     # non-binary collation on a call/const node =
+    #                     an explicit COLLATE clause → highest precedence
     elems: tuple = ()
     stack = list(e.children)
     while stack:
         n = stack.pop(0)
         if n.kind == "column" and n.eval_type is EvalType.BYTES:
-            colls.append(n.collation)
+            col_colls.append(n.collation)
+        elif n.collation != 63 and explicit is None:
+            explicit = n.collation
         if not elems and n.elems:
             elems = n.elems
         stack.extend(n.children)
-    if any(c == 63 for c in colls):
+    if explicit is not None:
+        return explicit, elems
+    if any(c == 63 for c in col_colls):
         coll = 63
     else:
-        coll = next((c for c in colls if c != 63), 63)
+        coll = next((c for c in col_colls if c != 63), 63)
     return coll, elems
 
 

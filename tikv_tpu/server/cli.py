@@ -73,6 +73,32 @@ def main(argv=None) -> int:
     gc.add_argument("--safe-point", type=int, required=True)
     ctl_sub.add_parser("stores")
     ctl_sub.add_parser("tso")
+    # debug service (src/server/debug.rs surface; tikv-ctl raft/mvcc/
+    # size/recover subcommands)
+    dg = ctl_sub.add_parser("debug-get")
+    dg.add_argument("store_id", type=int)
+    dg.add_argument("cf")
+    dg.add_argument("key")
+    di = ctl_sub.add_parser("region-info")
+    di.add_argument("store_id", type=int)
+    di.add_argument("region_id", type=int)
+    ds = ctl_sub.add_parser("region-size")
+    ds.add_argument("store_id", type=int)
+    ds.add_argument("region_id", type=int)
+    dm = ctl_sub.add_parser("mvcc")
+    dm.add_argument("store_id", type=int)
+    dm.add_argument("start")
+    dm.add_argument("--end", default="")
+    dm.add_argument("--limit", type=int, default=20)
+    dl = ctl_sub.add_parser("raft-log")
+    dl.add_argument("store_id", type=int)
+    dl.add_argument("region_id", type=int)
+    dl.add_argument("index", type=int)
+    dr = ctl_sub.add_parser("tombstone")
+    dr.add_argument("store_id", type=int)
+    dr.add_argument("region_id", type=int)
+    dc = ctl_sub.add_parser("compact")
+    dc.add_argument("store_id", type=int)
 
     args = p.parse_args(argv)
 
@@ -166,6 +192,35 @@ def main(argv=None) -> int:
             print(s.id, s.address)
     elif args.op == "tso":
         print(c.tso())
+    elif args.op == "debug-get":
+        r = c.debug(args.store_id, "DebugGet",
+                    {"cf": args.cf, "key": args.key.encode()})
+        print(json.dumps(r, default=repr))
+    elif args.op == "region-info":
+        r = c.debug(args.store_id, "DebugRegionInfo",
+                    {"region_id": args.region_id})
+        print(json.dumps(r, default=repr, indent=2))
+    elif args.op == "region-size":
+        r = c.debug(args.store_id, "DebugRegionSize",
+                    {"region_id": args.region_id})
+        print(json.dumps(r, default=repr))
+    elif args.op == "mvcc":
+        r = c.debug(args.store_id, "DebugScanMvcc",
+                    {"start": args.start.encode(),
+                     "end": args.end.encode() if args.end else None,
+                     "limit": args.limit})
+        print(json.dumps(r, default=repr, indent=2))
+    elif args.op == "raft-log":
+        r = c.debug(args.store_id, "DebugRaftLog",
+                    {"region_id": args.region_id, "index": args.index})
+        print(json.dumps(r, default=repr))
+    elif args.op == "tombstone":
+        r = c.debug(args.store_id, "DebugRecoverRegion",
+                    {"region_id": args.region_id})
+        print(json.dumps(r, default=repr))
+    elif args.op == "compact":
+        r = c.debug(args.store_id, "DebugCompact", {})
+        print(json.dumps(r, default=repr))
     return 0
 
 

@@ -532,3 +532,8 @@ class TxnClient:
 
     def status(self, store_id: int) -> dict:
         return self._store_client(store_id).call("Status", {})
+
+    def debug(self, store_id: int, method: str, req: dict) -> dict:
+        """Debug-service RPC against one specific store (debug.rs is
+        store-local by design — it inspects that store's engine)."""
+        return self._store_client(store_id).call(method, req)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 import grpc
@@ -46,51 +47,153 @@ from ..kv.engine import WriteData
 from . import wire
 
 
+class _StoreConn:
+    """Per-peer-store connection state: bounded message queue, channel,
+    exponential backoff, address rediscovery.
+
+    Reference: src/server/raft_client.rs — ``Queue`` with overflow
+    (:198-226), reconnect backoff, and re-resolving the store address
+    through PD after failures (resolve.rs)."""
+
+    MAX_QUEUE = 4096
+    MAX_BATCH = 512
+    BACKOFF_BASE = 0.1
+    BACKOFF_MAX = 3.0
+
+    def __init__(self, store_id: int):
+        self.store_id = store_id
+        self.queue: deque = deque()
+        self.lock = threading.Lock()
+        self.channel = None
+        self.addr = None
+        self.fail_count = 0
+        self.next_attempt = 0.0     # monotonic deadline while backing off
+
+    def push(self, msg: dict) -> bool:
+        """→ False when the queue is full (message dropped — raft
+        retries; the reference drops on a full Queue the same way)."""
+        with self.lock:
+            if len(self.queue) >= self.MAX_QUEUE:
+                return False
+            self.queue.append(msg)
+            return True
+
+    def pop_batch(self) -> list:
+        with self.lock:
+            n = min(len(self.queue), self.MAX_BATCH)
+            return [self.queue.popleft() for _ in range(n)]
+
+    def on_failure(self, now: float) -> None:
+        self.fail_count += 1
+        delay = min(self.BACKOFF_BASE * (2 ** (self.fail_count - 1)),
+                    self.BACKOFF_MAX)
+        self.next_attempt = now + delay
+        # force address rediscovery: the store may have moved.  Close
+        # the channel (native sockets) rather than waiting for GC.
+        if self.channel is not None:
+            try:
+                self.channel.close()
+            except Exception:   # noqa: BLE001 — already broken
+                pass
+        self.channel = None
+        self.addr = None
+
+    def on_success(self) -> None:
+        self.fail_count = 0
+        self.next_attempt = 0.0
+
+
 class GrpcTransport(Transport):
     """Store-to-store raft transport over gRPC.
 
-    Reference: src/server/raft_client.rs — per-store buffered channels
-    with address resolution through PD (src/server/resolve.rs)."""
+    Reference: src/server/raft_client.rs — per-store connections with
+    BatchRaftMessage buffering + overflow, exponential backoff with PD
+    address rediscovery on failure."""
 
     def __init__(self, pd: PdClient):
         self._pd = pd
-        self._chans: dict[int, grpc.Channel] = {}
-        self._buf: list[tuple] = []
+        self._conns: dict[int, _StoreConn] = {}
         self._lock = threading.Lock()
 
-    def send(self, to_store, region_id, to_peer, from_peer, msg) -> None:
+    def _conn(self, store_id: int) -> _StoreConn:
         with self._lock:
-            self._buf.append((to_store, {
-                "region_id": region_id,
-                "to_peer": wire.enc_peer(to_peer),
-                "from_peer": wire.enc_peer(from_peer),
-                "msg": wire.enc_raft_msg(msg)}))
+            conn = self._conns.get(store_id)
+            if conn is None:
+                conn = self._conns[store_id] = _StoreConn(store_id)
+            return conn
+
+    def send(self, to_store, region_id, to_peer, from_peer, msg) -> None:
+        ok = self._conn(to_store).push({
+            "region_id": region_id,
+            "to_peer": wire.enc_peer(to_peer),
+            "from_peer": wire.enc_peer(from_peer),
+            "msg": wire.enc_raft_msg(msg)})
+        if not ok:
+            from ..utils.metrics import RAFT_MSG_DROP_COUNTER
+            RAFT_MSG_DROP_COUNTER.labels("full").inc()
 
     def flush(self) -> None:
-        with self._lock:
-            buf, self._buf = self._buf, []
-        by_store: dict[int, list] = {}
-        for sid, m in buf:
-            by_store.setdefault(sid, []).append(m)
-        for sid, msgs in by_store.items():
+        now = time.monotonic()
+        for conn in list(self._conns.values()):
+            if not conn.queue:
+                continue
+            if now < conn.next_attempt:
+                continue            # backing off; messages keep queuing
+            msgs = conn.pop_batch()
+            if not msgs:
+                continue
             try:
-                chan = self._channel(sid)
+                chan = self._channel(conn)
+                self._extract_snapshots(chan, msgs)
                 call = chan.unary_unary(
                     "/tikv.Tikv/BatchRaft",
                     request_serializer=wire.pack,
                     response_deserializer=wire.unpack)
                 call({"msgs": msgs}, timeout=5)
+                conn.on_success()
             except Exception:
-                pass    # raft tolerates message loss; retried by protocol
+                # raft tolerates the lost batch (protocol retries); the
+                # conn backs off and re-resolves its address
+                conn.on_failure(time.monotonic())
+                from ..utils.metrics import RAFT_MSG_DROP_COUNTER
+                RAFT_MSG_DROP_COUNTER.labels("send_fail").inc(len(msgs))
 
-    def _channel(self, store_id: int):
-        chan = self._chans.get(store_id)
-        if chan is None:
-            addr = self._pd.get_store(store_id).address
+    # a snapshot payload beyond this rides the chunk stream instead of
+    # the raft message (src/server/snap.rs SNAP_CHUNK_LEN = 1MiB; the
+    # raft batch then stays small regardless of region size)
+    SNAP_CHUNK = 256 * 1024
+
+    def _extract_snapshots(self, chan, msgs: list) -> None:
+        """Large snapshots: ship data as ordered SnapshotChunk RPCs,
+        leave only meta + the claim key on the raft message."""
+        for m in msgs:
+            snap = m["msg"].get("snap")
+            if snap is None or len(snap.get("d", b"")) <= self.SNAP_CHUNK:
+                continue
+            data = snap["d"]
+            key = (f"{m['region_id']}/{m['to_peer']['id']}/"
+                   f"{snap['i']}/{snap['t']}")
+            call = chan.unary_unary(
+                "/tikv.Tikv/SnapshotChunk",
+                request_serializer=wire.pack,
+                response_deserializer=wire.unpack)
+            from ..utils.metrics import SNAP_CHUNK_COUNTER
+            total = -(-len(data) // self.SNAP_CHUNK)
+            for seq in range(total):
+                chunk = data[seq * self.SNAP_CHUNK:
+                             (seq + 1) * self.SNAP_CHUNK]
+                call({"key": key, "seq": seq, "total": total,
+                      "data": chunk}, timeout=10)
+                SNAP_CHUNK_COUNTER.inc()
+            snap["d"] = b""
+            snap["ext_key"] = key
+
+    def _channel(self, conn: _StoreConn):
+        if conn.channel is None:
+            conn.addr = self._pd.get_store(conn.store_id).address
             from .security import make_channel
-            chan = make_channel(addr)
-            self._chans[store_id] = chan
-        return chan
+            conn.channel = make_channel(conn.addr)
+        return conn.channel
 
 
 # Reference: components/keys STORE_IDENT_KEY (0x01 0x01) — the store's

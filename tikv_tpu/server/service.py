@@ -9,6 +9,7 @@ callables dict → dict; server.py binds them to gRPC methods.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Optional
 
@@ -43,6 +44,11 @@ class KvService:
         self.storage: Storage = node.storage
         self.endpoint: Endpoint = node.endpoint
         self.read_pool = node.read_pool
+        # partially-received chunked snapshots: key -> {seq: bytes};
+        # assembled payloads: key -> bytes (src/server/snap.rs recv task)
+        self._snap_parts: dict = {}
+        self._snap_ready: dict = {}
+        self._snap_lock = threading.Lock()
 
     # ---------------------------------------------------------- helpers
 
@@ -361,14 +367,19 @@ class KvService:
             creq = CopRequest(REQ_TYPE_DAG, dag)
             storage = self.endpoint.snapshot_for(creq)
             runner = BatchExecutorsRunner(dag, storage)
+            scanned_prev = 0
             while True:
                 t0 = _time.perf_counter_ns()
                 # per-page attribution: the stream can outlive several
-                # metering windows
+                # metering windows.  Summaries are CUMULATIVE across
+                # pages of one runner — record the per-page delta, not
+                # the running total
                 with GLOBAL_RECORDER.attach(tag):
                     result = runner.handle_request(max_rows=page)
+                    scanned = _scanned_rows(result)
                     GLOBAL_RECORDER.record_read_keys(
-                        _scanned_rows(result))
+                        max(0, scanned - scanned_prev))
+                    scanned_prev = scanned
                 yield self._enc_cop_resp(CopResponse(
                     result, _time.perf_counter_ns() - t0, "host"))
                 if result.is_drained:
@@ -440,11 +451,51 @@ class KvService:
 
     # ---------------------------------------------------------- raft
 
+    # bound on buffered in-flight snapshots: an unclaimed payload (the
+    # raft batch carrying its claim failed; the leader re-sends at a
+    # NEW index/key) must not leak for the process lifetime
+    _SNAP_BUF_MAX = 8
+
+    def SnapshotChunk(self, req: dict) -> dict:
+        """One chunk of a large region snapshot (src/server/snap.rs —
+        the dedicated snapshot stream; here ordered unary chunks).
+        The final chunk assembles the payload, which the matching raft
+        message (carrying only meta + the key) then claims."""
+        key = req["key"]
+        with self._snap_lock:
+            parts = self._snap_parts.setdefault(key, {})
+            parts[req["seq"]] = req["data"]
+            if len(parts) == req["total"]:
+                self._snap_ready[key] = b"".join(
+                    parts[i] for i in range(req["total"]))
+                del self._snap_parts[key]
+            # evict oldest unclaimed buffers (dict = insertion order)
+            for store in (self._snap_parts, self._snap_ready):
+                while len(store) > self._SNAP_BUF_MAX:
+                    store.pop(next(iter(store)))
+        return {}
+
     def Raft(self, req: dict) -> dict:
+        msg = req["msg"]
+        snap = msg.get("snap")
+        if snap is not None and "ext_key" in snap:
+            with self._snap_lock:
+                data = self._snap_ready.pop(snap["ext_key"], None)
+            if data is None:
+                # chunks lost/incomplete: drop — raft re-sends the
+                # snapshot (snap.rs treats a broken stream the same)
+                from ..utils.metrics import RAFT_MSG_DROP_COUNTER
+                RAFT_MSG_DROP_COUNTER.labels("snap_incomplete").inc()
+                return {}
+            snap = dict(snap)
+            snap.pop("ext_key")
+            snap["d"] = data
+            msg = dict(msg)
+            msg["snap"] = snap
         self.node.on_raft_message(
             req["region_id"], wire.dec_peer(req["to_peer"]),
             wire.dec_peer(req["from_peer"]),
-            wire.dec_raft_msg(req["msg"]))
+            wire.dec_raft_msg(msg))
         return {}
 
     def BatchRaft(self, req: dict) -> dict:
@@ -488,3 +539,143 @@ class KvService:
 
     def Status(self, req: dict) -> dict:
         return self.node.status()
+
+    # ------------------------------------------------- debug service
+    #
+    # Reference: src/server/debug.rs + service/debug.rs — the raw
+    # inspection surface behind tikv-ctl: engine gets, region meta/size,
+    # MVCC record dumps, raft log inspection, bad-region recovery.
+
+    def DebugGet(self, req: dict) -> dict:
+        """Raw engine read: (cf, key) exactly as stored — no MVCC."""
+        snap = self.node.engine.snapshot()
+        v = snap.get_value_cf(req["cf"], req["key"])
+        return {"value": v}
+
+    def DebugRegionInfo(self, req: dict) -> dict:
+        peer = self.node.raft_store.peers.get(req["region_id"])
+        if peer is None:
+            return {"error": {"kind": "region_not_found",
+                              "region_id": req["region_id"]}}
+        node = peer.node
+        return {
+            "region": wire.enc_region(peer.region),
+            "raft_state": {"term": node.term, "commit": node.commit,
+                           "applied": node.applied,
+                           "last_index": node.last_index(),
+                           "is_leader": peer.is_leader()},
+            "consistency_state": peer.consistency_state,
+        }
+
+    def DebugRegionSize(self, req: dict) -> dict:
+        """Per-CF byte sizes of one region (debug.rs region_size)."""
+        from ..engine.traits import CF_DEFAULT, CF_LOCK, CF_WRITE
+        from ..raftstore.peer_storage import region_data_bounds
+        peer = self.node.raft_store.peers.get(req["region_id"])
+        if peer is None:
+            return {"error": {"kind": "region_not_found",
+                              "region_id": req["region_id"]}}
+        lo, hi = region_data_bounds(peer.region)
+        snap = self.node.engine.snapshot()
+        sizes = {}
+        for cf in (CF_DEFAULT, CF_LOCK, CF_WRITE):
+            total = 0
+            it = snap.iterator_cf(cf, lo, hi)
+            ok = it.seek_to_first()
+            while ok:
+                total += len(it.key()) + len(it.value())
+                ok = it.next()
+            sizes[cf] = total
+        return {"sizes": sizes}
+
+    def DebugScanMvcc(self, req: dict) -> dict:
+        """MVCC record dump for a user-key range (debug.rs mvcc scan):
+        per key — lock, committed writes, default payload versions."""
+        from ..storage.mvcc.reader import MvccReader
+        from ..storage.txn_types import (
+            Lock, Write, append_ts, encode_key, split_ts,
+        )
+        from ..engine.traits import CF_DEFAULT, CF_LOCK, CF_WRITE
+        from ..raftstore.peer_storage import data_key
+        from ..codec.keys import DATA_PREFIX
+        snap = self.node.engine.snapshot()
+        lo = data_key(encode_key(req["start"]))
+        # open end: everything under the data prefix (b"{" — the same
+        # sentinel region_data_bounds uses; data_key(b"y") would cut off
+        # encoded keys starting at bytes >= 0x79)
+        hi = data_key(encode_key(req["end"])) if req.get("end") else \
+            bytes([DATA_PREFIX[0] + 1])
+        limit = req.get("limit", 100)
+        out: dict[bytes, dict] = {}
+
+        def enc_user(enc_with_prefix: bytes, strip_ts: bool):
+            from ..storage.txn_types import decode_key
+            k = enc_with_prefix[1:]         # strip data prefix
+            if strip_ts:
+                k, _ = split_ts(k)
+            return decode_key(k)
+
+        it = snap.iterator_cf(CF_LOCK, lo, hi)
+        ok = it.seek_to_first()
+        while ok and len(out) < limit:
+            user = enc_user(it.key(), strip_ts=False)
+            lock = Lock.from_bytes(it.value())
+            out.setdefault(user, {})["lock"] = {
+                "type": lock.lock_type.name, "start_ts": lock.start_ts,
+                "ttl": lock.ttl, "primary": lock.primary}
+            ok = it.next()
+        it = snap.iterator_cf(CF_WRITE, lo, hi)
+        ok = it.seek_to_first()
+        while ok:
+            user = enc_user(it.key(), strip_ts=True)
+            if user not in out and len(out) >= limit:
+                ok = it.next()      # full: only existing keys may grow
+                continue
+            _, commit_ts = split_ts(it.key()[1:])
+            w = Write.from_bytes(it.value())
+            out.setdefault(user, {}).setdefault("writes", []).append({
+                "type": w.write_type.name, "start_ts": w.start_ts,
+                "commit_ts": commit_ts,
+                "short_value": w.short_value})
+            ok = it.next()
+        return {"keys": [{"key": k, **v} for k, v in out.items()]}
+
+    def DebugRaftLog(self, req: dict) -> dict:
+        """One raft log entry by (region, index) — debug.rs raft_log."""
+        peer = self.node.raft_store.peers.get(req["region_id"])
+        if peer is None:
+            return {"error": {"kind": "region_not_found",
+                              "region_id": req["region_id"]}}
+        try:
+            entries = peer.node.storage.slice(req["index"],
+                                              req["index"] + 1)
+        except Exception as e:   # noqa: BLE001 — compacted/oob ride back
+            return {"error": {"kind": "other", "message": str(e)}}
+        if not entries:
+            return {"error": {"kind": "other", "message": "no entry"}}
+        e = entries[0]
+        return {"entry": {"term": e.term, "index": e.index,
+                          "type": e.entry_type.name,
+                          "data_len": len(e.data)}}
+
+    def DebugRecoverRegion(self, req: dict) -> dict:
+        """Tombstone a bad replica on THIS store so the region can be
+        re-replicated from healthy peers (debug.rs recover/bad-regions
+        + tikv-ctl tombstone)."""
+        rid = req["region_id"]
+        peer = self.node.raft_store.peers.get(rid)
+        if peer is None:
+            return {"error": {"kind": "region_not_found",
+                              "region_id": rid}}
+        self.node.raft_store.destroy_peer(rid)
+        return {"tombstoned": rid}
+
+    def DebugCompact(self, req: dict) -> dict:
+        """Force an engine compaction pass when the engine has one
+        (DiskEngine LSM tiers); no-op otherwise."""
+        eng = self.node.engine
+        fn = getattr(eng, "compact", None)
+        if callable(fn):
+            fn()
+            return {"compacted": True}
+        return {"compacted": False}

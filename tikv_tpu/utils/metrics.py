@@ -262,6 +262,13 @@ RAFT_APPLY_COUNTER = REGISTRY.counter(
     "tikv_raftstore_apply_total", "applied raft entries")
 RAFT_READY_COUNTER = REGISTRY.counter(
     "tikv_raftstore_ready_handled_total", "raft ready batches handled")
+RAFT_MSG_DROP_COUNTER = REGISTRY.counter(
+    "tikv_server_raft_message_dropped_total",
+    "raft messages dropped by the transport (queue full / send failed)",
+    labels=("reason",))
+SNAP_CHUNK_COUNTER = REGISTRY.counter(
+    "tikv_server_snapshot_chunks_sent_total",
+    "snapshot chunks shipped on the dedicated stream")
 COPR_REQ_COUNTER = REGISTRY.counter(
     "tikv_coprocessor_request_total", "coprocessor requests by backend",
     labels=("backend",))
