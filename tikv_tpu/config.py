@@ -32,6 +32,11 @@ class ServerConfig:
 class StorageConfig:
     data_dir: str = ""                  # "" = in-memory engine
     scheduler_concurrency: int = 4
+    # encryption at rest ([security.encryption] in the reference's
+    # config): path to a 64-hex-char master key file; "" = plaintext.
+    # Data keys + the encrypted file dictionary live in the data dir
+    # (components/encryption manager/ + file_dict_file.rs).
+    master_key_file: str = ""
 
 
 @dataclass

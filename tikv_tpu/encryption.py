@@ -1,0 +1,281 @@
+"""Encryption at rest: per-file data keys under a master key.
+
+Reference: components/encryption/ — a ``DataKeyManager`` issues one data
+key per file epoch, every file records (key_id, iv) in an encrypted file
+dictionary (file_dict_file.rs), the dictionary itself is sealed by the
+master key (master_key/ file or KMS backends), and data keys rotate
+without rewriting old files.  AES-256-CTR via OpenSSL — the exact
+primitive the reference uses (crypter.rs), reached here through ctypes
+on libcrypto instead of rust-openssl.
+
+CTR keeps ciphertext length == plaintext length and is seekable, so the
+WAL's append stream and torn-tail truncation semantics survive
+unchanged under encryption.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+import struct
+import threading
+import zlib
+
+import msgpack
+
+# ---------------------------------------------------------------- OpenSSL
+
+_lib = None
+
+
+def _crypto():
+    global _lib
+    if _lib is None:
+        name = ctypes.util.find_library("crypto") or "libcrypto.so.3"
+        lib = ctypes.CDLL(name)
+        lib.EVP_CIPHER_CTX_new.restype = ctypes.c_void_p
+        lib.EVP_aes_256_ctr.restype = ctypes.c_void_p
+        lib.EVP_EncryptInit_ex.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_char_p]
+        lib.EVP_EncryptUpdate.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_int]
+        lib.EVP_CIPHER_CTX_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def aes_ctr_xor(key: bytes, iv: bytes, data: bytes,
+                offset: int = 0) -> bytes:
+    """AES-256-CTR keystream XOR at a byte ``offset`` into the stream
+    (encrypt == decrypt).  Seekability: the counter advances by
+    offset//16 blocks and the first offset%16 keystream bytes are
+    discarded."""
+    assert len(key) == 32 and len(iv) == 16
+    if not data:
+        return b""
+    lib = _crypto()
+    blocks = offset // 16
+    skip = offset % 16
+    ctr = (int.from_bytes(iv, "big") + blocks) % (1 << 128)
+    iv_adj = ctr.to_bytes(16, "big")
+    ctx = lib.EVP_CIPHER_CTX_new()
+    try:
+        ok = lib.EVP_EncryptInit_ex(ctx, lib.EVP_aes_256_ctr(), None,
+                                    key, iv_adj)
+        assert ok == 1, "EVP init failed"
+        src = bytes(skip) + data
+        out = ctypes.create_string_buffer(len(src) + 16)
+        outl = ctypes.c_int(0)
+        ok = lib.EVP_EncryptUpdate(ctx, out, ctypes.byref(outl), src,
+                                   len(src))
+        assert ok == 1 and outl.value == len(src), "EVP update failed"
+        return out.raw[skip:len(src)]
+    finally:
+        lib.EVP_CIPHER_CTX_free(ctx)
+
+
+# ------------------------------------------------------------- master key
+
+class MasterKeyFile:
+    """Master key from a local file (master_key/file.rs): 64 hex chars.
+    ``create`` generates one — operationally that file belongs in a KMS
+    or mounted secret, exactly as the reference documents."""
+
+    def __init__(self, path: str):
+        with open(path) as f:
+            self.key = bytes.fromhex(f.read().strip())
+        assert len(self.key) == 32, "master key must be 32 bytes (hex)"
+
+    @staticmethod
+    def create(path: str) -> "MasterKeyFile":
+        # 0600: a world-readable master key defeats the whole scheme
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(os.urandom(32).hex())
+        return MasterKeyFile(path)
+
+
+class MissingFileKey(RuntimeError):
+    """A read-side file has no dictionary entry — the file predates
+    encryption (plaintext migration) or the dictionary was lost.
+    Decrypting with a fabricated key would yield garbage that recovery
+    could mistake for a torn log and TRUNCATE; failing loudly is the
+    only safe answer."""
+
+
+class WrongMasterKey(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------- data key mgr
+
+_DICT_MAGIC = b"TKVENC1\n"
+
+
+class DataKeyManager:
+    """Per-file data keys + encrypted file dictionary.
+
+    Layout of the dict file: MAGIC | iv(16) | ctr(master, payload) |
+    crc32(payload).  Payload (msgpack): {keys: {id: key}, files:
+    {name: [key_id, iv]}, current: id}.  A wrong master key fails the
+    crc and raises WrongMasterKey — never silently serves garbage.
+    """
+
+    def __init__(self, master: MasterKeyFile, dict_path: str):
+        self._master = master
+        self._path = dict_path
+        self._lock = threading.Lock()
+        self._keys: dict[int, bytes] = {}
+        self._files: dict[str, tuple] = {}
+        self._current = 0
+        if os.path.exists(dict_path):
+            self._load()
+        else:
+            self._current = 1
+            self._keys[1] = os.urandom(32)
+            self._persist()
+
+    # -- dict persistence --
+
+    def _load(self) -> None:
+        with open(self._path, "rb") as f:
+            blob = f.read()
+        assert blob.startswith(_DICT_MAGIC), "bad encryption dict"
+        iv = blob[len(_DICT_MAGIC):len(_DICT_MAGIC) + 16]
+        body = blob[len(_DICT_MAGIC) + 16:-4]
+        (crc,) = struct.unpack(">I", blob[-4:])
+        payload = aes_ctr_xor(self._master.key, iv, body)
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            raise WrongMasterKey(
+                "encryption dictionary does not open with this master "
+                "key (rotated? wrong file?)")
+        d = msgpack.unpackb(payload, raw=False,
+                            strict_map_key=False)
+        self._keys = {int(k): v for k, v in d["keys"].items()}
+        self._files = {n: (int(kid), iv_)
+                       for n, (kid, iv_) in d["files"].items()}
+        self._current = int(d["current"])
+
+    def _persist(self) -> None:
+        payload = msgpack.packb({
+            "keys": self._keys,
+            "files": {n: [kid, iv_]
+                      for n, (kid, iv_) in self._files.items()},
+            "current": self._current}, use_bin_type=True)
+        iv = os.urandom(16)
+        blob = (_DICT_MAGIC + iv +
+                aes_ctr_xor(self._master.key, iv, payload) +
+                struct.pack(">I", zlib.crc32(payload) & 0xFFFFFFFF))
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+
+    # -- per-file API --
+
+    def file_info(self, name: str, create: bool = True):
+        """→ (key, iv) for ``name``; registers a fresh (current-epoch
+        key, random iv) pair on first use."""
+        with self._lock:
+            got = self._files.get(name)
+            if got is None:
+                if not create:
+                    return None
+                got = (self._current, os.urandom(16))
+                self._files[name] = got
+                self._persist()
+            kid, iv = got
+            return self._keys[kid], iv
+
+    def remove_file(self, name: str) -> None:
+        self.remove_files([name])
+
+    def remove_files(self, names) -> None:
+        """Batch removal: ONE dictionary persist/fsync for any number
+        of deletions (compaction removes several runs at once)."""
+        with self._lock:
+            changed = False
+            for name in names:
+                if self._files.pop(name, None) is not None:
+                    changed = True
+            if changed:
+                self._persist()
+
+    def renew_file(self, name: str):
+        """Fresh (current key, fresh iv) for ``name``, replacing any
+        prior entry in one persist.  Every artifact WRITE must renew:
+        re-encrypting different content under a retained (key, iv) is
+        the CTR two-time pad."""
+        with self._lock:
+            got = (self._current, os.urandom(16))
+            self._files[name] = got
+            self._persist()
+            return self._keys[got[0]], got[1]
+
+    def has_file(self, name: str) -> bool:
+        with self._lock:
+            return name in self._files
+
+    def xor(self, name: str, data: bytes, offset: int = 0,
+            create: bool = True) -> bytes:
+        got = self.file_info(name, create=create)
+        if got is None:
+            raise MissingFileKey(name)
+        key, iv = got
+        return aes_ctr_xor(key, iv, data, offset)
+
+    # -- rotation --
+
+    def rotate_data_key(self) -> int:
+        """New epoch: FUTURE files use a fresh key; old files keep
+        theirs (no rewrite) — encryption/manager.rs rotation."""
+        with self._lock:
+            kid = max(self._keys) + 1
+            self._keys[kid] = os.urandom(32)
+            self._current = kid
+            self._persist()
+            return kid
+
+    def rotate_master_key(self, new_master: MasterKeyFile) -> None:
+        """Reseal the dictionary under a new master key — data keys
+        (and every data file) stay untouched."""
+        with self._lock:
+            self._master = new_master
+            self._persist()
+
+
+class EncryptedFile:
+    """Append-stream wrapper: write() encrypts at the running offset —
+    drop-in for the WAL file object (tell/flush/fileno/close pass
+    through; ciphertext length == plaintext length under CTR)."""
+
+    def __init__(self, fobj, mgr: DataKeyManager, name: str):
+        self._f = fobj
+        self._mgr = mgr
+        self._name = name
+        self._offset = fobj.tell()
+
+    def write(self, data: bytes) -> int:
+        # create=False: the opener registered this file; fabricating a
+        # key here would split the stream across two keys
+        enc = self._mgr.xor(self._name, data, self._offset,
+                            create=False)
+        self._offset += len(data)
+        return self._f.write(enc)
+
+    def tell(self) -> int:
+        return self._f.tell()
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def fileno(self) -> int:
+        return self._f.fileno()
+
+    def close(self) -> None:
+        self._f.close()

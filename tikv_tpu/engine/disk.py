@@ -97,12 +97,17 @@ class DiskEngine(MemoryEngine):
     """KvEngine with WAL + checkpoint durability (see module docstring)."""
 
     def __init__(self, path: str, cfs=ALL_CFS, sync: bool = False,
-                 checkpoint_bytes: int = 16 << 20, max_runs: int = 4):
+                 checkpoint_bytes: int = 16 << 20, max_runs: int = 4,
+                 encryption=None):
         super().__init__(cfs)
         self.path = path
         self._cf_names = tuple(cfs)
         self._cf_index = {cf: i for i, cf in enumerate(self._cf_names)}
         self._sync = sync
+        # encryption-at-rest (tikv_tpu/encryption.py DataKeyManager):
+        # every artifact (WAL/ckpt/run) is AES-CTR'd under its own
+        # per-file data key; None = plaintext
+        self._enc = encryption
         self._checkpoint_bytes = checkpoint_bytes
         self._max_runs = max_runs
         os.makedirs(path, exist_ok=True)
@@ -187,17 +192,41 @@ class DiskEngine(MemoryEngine):
                 except ValueError:
                     pass
             if stale:
-                try:
-                    os.remove(full)
-                except OSError:
-                    pass
+                self._rm(full)
+
+    def _read_file(self, path: str):
+        """Whole-file read with decryption (ckpt/run artifacts).
+        An on-disk file UNKNOWN to the key dictionary raises
+        MissingFileKey — fabricating a key would decrypt to garbage
+        that recovery could mistake for torn data and truncate."""
+        with open(path, "rb") as f:
+            data = f.read()
+        if self._enc is not None:
+            data = self._enc.xor(os.path.basename(path), data,
+                                 create=False)
+        return data
+
+    def _write_file_atomic(self, path: str, data: bytes) -> None:
+        """tmp-write + fsync + rename, encrypting under a FRESH
+        (key, iv) for the final name: a crash between the tmp write and
+        the rename can replay this generation with different content —
+        reusing the persisted iv would be a CTR two-time pad."""
+        if self._enc is not None:
+            from ..encryption import aes_ctr_xor
+            key, iv = self._enc.renew_file(os.path.basename(path))
+            data = aes_ctr_xor(key, iv, data)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def _apply_run(self, path: str) -> bool:
         """Load one sorted run: range tombstones first, then final
         per-key ops (the flush wrote them in exactly that order)."""
         try:
-            with open(path, "rb") as f:
-                data = f.read()
+            data = self._read_file(path)
         except OSError:
             return False
         if not (data.startswith(_RUN_MAGIC) and
@@ -211,8 +240,7 @@ class DiskEngine(MemoryEngine):
 
     def _load_checkpoint(self, path: str) -> bool:
         try:
-            with open(path, "rb") as f:
-                data = f.read()
+            data = self._read_file(path)
         except OSError:
             return False
         if not (data.startswith(_CKPT_MAGIC) and
@@ -242,8 +270,15 @@ class DiskEngine(MemoryEngine):
         return True
 
     def _replay_wal(self, path: str) -> None:
+        import io
         try:
-            f = open(path, "rb")
+            if self._enc is not None:
+                # CTR-decrypt the whole segment, then parse exactly as
+                # plaintext: a torn tail decrypts to garbage and fails
+                # the record CRC — same stop-at-tear semantics
+                f = io.BytesIO(self._read_file(path))
+            else:
+                f = open(path, "rb")
         except OSError:
             return
         with f:
@@ -272,7 +307,23 @@ class DiskEngine(MemoryEngine):
                 f.truncate(good)
 
     def _open_wal(self, path: str, append: bool) -> None:
+        if self._enc is not None:
+            from ..encryption import MissingFileKey
+            name = os.path.basename(path)
+            exists = os.path.exists(path) and os.path.getsize(path) > 0
+            if not append or not exists:
+                # truncating write or fresh segment: new CTR stream
+                self._enc.renew_file(name)
+            elif not self._enc.has_file(name):
+                # appending ciphertext into a plaintext-era WAL would
+                # corrupt both halves — refuse (plaintext→encrypted
+                # migration needs an explicit rewrite)
+                raise MissingFileKey(name)
         self._wal = open(path, "ab" if append else "wb")
+        if self._enc is not None:
+            from ..encryption import EncryptedFile
+            self._wal = EncryptedFile(self._wal, self._enc,
+                                      os.path.basename(path))
         self._wal_bytes = self._wal.tell()
 
     # ------------------------------------------------------------ writes
@@ -349,26 +400,23 @@ class DiskEngine(MemoryEngine):
         from ..utils.failpoint import fail_point
         fail_point("ckpt::before_write")
         new_gen = self._gen + 1
-        tmp = self._run_path(new_gen) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(_RUN_MAGIC)
-            for cf in self._cf_names:
-                for s_, e_ in self._dirty_ranges[cf]:
-                    f.write(_pack_op(("delr", cf, s_, e_),
-                                     self._cf_index))
-            for cf in self._cf_names:
-                for k in sorted(self._dirty[cf]):
-                    ent = self._dirty[cf][k]
-                    if ent[0] == "put":
-                        f.write(_pack_op(("put", cf, k, ent[1]),
-                                         self._cf_index))
-                    else:
-                        f.write(_pack_op(("del", cf, k),
-                                         self._cf_index))
-            f.write(_RUN_FOOTER)
-            f.flush()
-            os.fsync(f.fileno())
-        os.rename(tmp, self._run_path(new_gen))
+        parts = [_RUN_MAGIC]
+        for cf in self._cf_names:
+            for s_, e_ in self._dirty_ranges[cf]:
+                parts.append(_pack_op(("delr", cf, s_, e_),
+                                      self._cf_index))
+        for cf in self._cf_names:
+            for k in sorted(self._dirty[cf]):
+                ent = self._dirty[cf][k]
+                if ent[0] == "put":
+                    parts.append(_pack_op(("put", cf, k, ent[1]),
+                                          self._cf_index))
+                else:
+                    parts.append(_pack_op(("del", cf, k),
+                                          self._cf_index))
+        parts.append(_RUN_FOOTER)
+        self._write_file_atomic(self._run_path(new_gen),
+                                b"".join(parts))
         self._runs.append(new_gen)
         for cf in self._cf_names:
             self._dirty[cf] = {}
@@ -378,12 +426,17 @@ class DiskEngine(MemoryEngine):
         self._open_wal(self._wal_path(new_gen), append=False)
         if old_wal is not None:
             old_wal.close()
-        try:
-            os.remove(self._wal_path(old_gen))
-        except OSError:
-            pass
+        self._rm(self._wal_path(old_gen))
         if len(self._runs) > self._max_runs:
             self._compact_locked()
+
+    def _rm(self, path: str) -> None:
+        try:
+            os.remove(path)
+        except OSError:
+            return
+        if self._enc is not None:
+            self._enc.remove_file(os.path.basename(path))
 
     def _compact_locked(self) -> None:
         """Fold base + runs into one full-state base (tiered L0→L1
@@ -393,26 +446,25 @@ class DiskEngine(MemoryEngine):
         from ..utils.failpoint import fail_point
         fail_point("compact::before_write")
         gen = self._gen
-        tmp = self._ckpt_path(gen) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(_CKPT_MAGIC)
-            f.write(struct.pack(">B", len(self._cf_names)))
-            for cfi, cf in enumerate(self._cf_names):
-                data = self._cfs[cf]
-                f.write(struct.pack(">BQ", cfi, len(data.keys)))
-                for k, v in zip(data.keys, data.vals):
-                    f.write(struct.pack(">I", len(k)))
-                    f.write(k)
-                    f.write(struct.pack(">I", len(v)))
-                    f.write(v)
-            f.write(_CKPT_FOOTER)
-            f.flush()
-            os.fsync(f.fileno())
-        os.rename(tmp, self._ckpt_path(gen))
-        # drop everything the new base covers
+        parts = [_CKPT_MAGIC, struct.pack(">B", len(self._cf_names))]
+        for cfi, cf in enumerate(self._cf_names):
+            data = self._cfs[cf]
+            parts.append(struct.pack(">BQ", cfi, len(data.keys)))
+            for k, v in zip(data.keys, data.vals):
+                parts.append(struct.pack(">I", len(k)))
+                parts.append(k)
+                parts.append(struct.pack(">I", len(v)))
+                parts.append(v)
+        parts.append(_CKPT_FOOTER)
+        self._write_file_atomic(self._ckpt_path(gen), b"".join(parts))
+        # drop everything the new base covers; ONE dict persist for the
+        # whole batch of key removals
+        removed = []
         for g in self._runs:
+            p = self._run_path(g)
             try:
-                os.remove(self._run_path(g))
+                os.remove(p)
+                removed.append(os.path.basename(p))
             except OSError:
                 pass
         self._runs = []
@@ -421,8 +473,11 @@ class DiskEngine(MemoryEngine):
                 try:
                     if int(name[5:]) < gen:
                         os.remove(os.path.join(self.path, name))
+                        removed.append(name)
                 except (ValueError, OSError):
                     pass
+        if self._enc is not None and removed:
+            self._enc.remove_files(removed)
 
     def close(self) -> None:
         with self._mu:

@@ -290,7 +290,21 @@ class Node:
             self.engine = engine
         elif data_dir is not None:
             from ..engine.disk import DiskEngine
-            self.engine = DiskEngine(data_dir)
+            enc = None
+            mk_path = getattr(getattr(config, "storage", None),
+                              "master_key_file", "") if config else ""
+            if mk_path:
+                import os as _os
+
+                from ..encryption import DataKeyManager, MasterKeyFile
+                # data dir first: the key path may live inside it
+                _os.makedirs(data_dir, exist_ok=True)
+                master = MasterKeyFile(mk_path) \
+                    if _os.path.exists(mk_path) \
+                    else MasterKeyFile.create(mk_path)
+                enc = DataKeyManager(
+                    master, _os.path.join(data_dir, "ENCRYPTION_DICT"))
+            self.engine = DiskEngine(data_dir, encryption=enc)
         else:
             self.engine = MemoryEngine()
         self.lock = threading.RLock()
