@@ -1,0 +1,182 @@
+"""ImportSST bulk load, the PD feature gate, and service events.
+
+Reference: components/sst_importer + src/import/sst_service.rs,
+pd_client feature_gate.rs, components/service/service_event.rs.
+"""
+
+import time
+
+import pytest
+
+from tikv_tpu.pd.feature_gate import FEATURES, FeatureGate
+from tikv_tpu.sst_importer import SstWriter, mvcc_sst, read_sst
+from tikv_tpu.service_event import (
+    ServiceEvent,
+    ServiceEventChannel,
+    attach,
+)
+
+
+# ------------------------------------------------------------- sst file
+
+def test_sst_roundtrip_sorted_and_checksummed():
+    w = SstWriter()
+    w.put("default", b"b", b"2")
+    w.put("default", b"a", b"1")
+    w.put("write", b"c", b"3")
+    blob = w.finish()
+    pairs = read_sst(blob)
+    assert pairs == [("default", b"a", b"1"), ("default", b"b", b"2"),
+                     ("write", b"c", b"3")]
+    with pytest.raises(ValueError):
+        read_sst(blob[:-1] + b"\x00")   # corrupt checksum
+    with pytest.raises(ValueError):
+        read_sst(b"garbage")
+
+
+def test_mvcc_sst_builds_percolator_records():
+    w = mvcc_sst([(b"k1", b"small"), (b"k2", b"B" * 300)], commit_ts=50)
+    pairs = read_sst(w.finish())
+    cfs = [cf for cf, _k, _v in pairs]
+    assert cfs.count("write") == 2 and cfs.count("default") == 1
+
+
+# ------------------------------------------------------------- e2e load
+
+@pytest.fixture(scope="module")
+def cluster():
+    from tikv_tpu.raftstore.metapb import Store as StoreMeta
+    from tikv_tpu.server.client import TxnClient
+    from tikv_tpu.server.node import Node
+    from tikv_tpu.server.pd_server import PdServer, RemotePdClient
+    from tikv_tpu.server.server import TikvServer
+
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    servers = []
+    for _ in range(2):
+        node = Node("127.0.0.1:0", RemotePdClient(pd_addr))
+        srv = TikvServer(node)
+        node.addr = f"127.0.0.1:{srv.port}"
+        node.pd.put_store(StoreMeta(node.store_id, node.addr))
+        srv.start()
+        servers.append(srv)
+    client = TxnClient(pd_addr)
+    client.add_peer(1, servers[1].node.store_id)
+    yield {"pd": pd_server, "servers": servers, "client": client}
+    for srv in servers:
+        srv.stop()
+    pd_server.stop()
+
+
+def test_bulk_load_then_query(cluster):
+    client = cluster["client"]
+    ts = client.tso()
+    rows = [(b"bulk%04d" % i, b"payload-%04d" % i) for i in range(2000)]
+    blob = mvcc_sst(rows, commit_ts=ts).finish()
+    sid = cluster["servers"][0].node.store_id
+    assert client.import_switch_mode(sid, True) is True
+    n = client.ingest_sst(blob, b"bulk0000")
+    assert n == 2000
+    assert client.import_switch_mode(sid, False) is False
+    # visible through the normal txn read path
+    assert client.get(b"bulk0042") == b"payload-0042"
+    assert client.get(b"bulk1999") == b"payload-1999"
+    # and replicated: the follower holds the records too
+    time.sleep(0.3)
+    from tikv_tpu.engine.traits import CF_WRITE
+    from tikv_tpu.raftstore.peer_storage import data_key
+    from tikv_tpu.storage.txn_types import append_ts, encode_key
+    snap = cluster["servers"][1].node.engine.snapshot()
+    assert snap.get_value_cf(
+        CF_WRITE, data_key(append_ts(encode_key(b"bulk0042"), ts)))
+
+
+def test_ingest_out_of_range_refused(cluster):
+    client = cluster["client"]
+    # split so the target region no longer covers "zzz"
+    client.split(b"m")
+    ts = client.tso()
+    blob = mvcc_sst([(b"a-key", b"1"), (b"zzz", b"2")], ts).finish()
+    from tikv_tpu.server.wire import RemoteError
+    with pytest.raises(RemoteError):
+        client.ingest_sst(blob, b"a-key")   # spans the split boundary
+
+
+# ------------------------------------------------------------- gate
+
+def test_feature_gate():
+    g = FeatureGate("6.5.0")
+    assert g.can_enable("joint_consensus")
+    assert g.can_enable("causal_ts")
+    assert not g.can_enable("resource_control")
+    g.set_version("7.1.0")
+    assert g.can_enable("resource_control")
+    with pytest.raises(ValueError):
+        g.set_version("6.0.0")          # monotonic
+    with pytest.raises(KeyError):
+        g.can_enable("warp_drive")
+    assert set(FEATURES) >= {"joint_consensus", "buckets"}
+
+
+def test_feature_gate_over_pd(cluster):
+    node = cluster["servers"][0].node
+    assert node.feature_gate.can_enable("unsafe_recovery")
+
+
+# ------------------------------------------------------------- events
+
+def test_service_events_pause_resume():
+    from tikv_tpu.raftstore.metapb import Store as StoreMeta
+    from tikv_tpu.server.client import TxnClient
+    from tikv_tpu.server.node import Node
+    from tikv_tpu.server.pd_server import PdServer, RemotePdClient
+    from tikv_tpu.server.server import TikvServer
+    from tikv_tpu.server.wire import RemoteError
+
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    node = Node("127.0.0.1:0", RemotePdClient(pd_addr))
+    srv = TikvServer(node)
+    node.addr = f"127.0.0.1:{srv.port}"
+    node.pd.put_store(StoreMeta(node.store_id, node.addr))
+    srv.start()
+    chan = ServiceEventChannel()
+    attach(chan, srv)
+    client = TxnClient(pd_addr)
+    try:
+        client.put(b"se", b"1")
+        chan.post(ServiceEvent.PAUSE_GRPC)
+        deadline = time.time() + 5
+        paused = False
+        while time.time() < deadline:
+            try:
+                client.status(node.store_id)
+            except RemoteError as e:
+                paused = e.kind == "server_is_busy"
+                break
+            time.sleep(0.05)
+        assert paused
+        chan.post(ServiceEvent.CONTINUE_GRPC)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                client.status(node.store_id)
+                break
+            except RemoteError:
+                time.sleep(0.05)
+        assert client.get(b"se") == b"1"
+        chan.post(ServiceEvent.EXIT)
+        deadline = time.time() + 5
+        while time.time() < deadline and not getattr(srv, "_stopped",
+                                                     False):
+            time.sleep(0.05)
+        assert srv._stopped
+    finally:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+        pd_server.stop()

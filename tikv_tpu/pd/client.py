@@ -224,3 +224,10 @@ class MockPd:
         """Allocate ``count`` monotonic timestamps (pd_client tso.rs
         batch request — the causal_ts provider's renewal path)."""
         return [self.tso() for _ in range(count)]
+
+    def cluster_version(self) -> str:
+        """Lowest version across the cluster (feature_gate.rs source)."""
+        return getattr(self, "_cluster_version", "8.0.0")
+
+    def set_cluster_version(self, v: str) -> None:
+        self._cluster_version = v

@@ -1,0 +1,57 @@
+"""Cluster-version feature gate.
+
+Reference: components/pd_client/src/feature_gate.rs — PD publishes the
+lowest version across the cluster; stores enable version-gated features
+only once every member supports them.  The version is monotonic: a
+joining old node cannot un-launch a feature already in use.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def parse_version(v: str) -> tuple:
+    core = v.split("-", 1)[0]
+    parts = core.split(".")
+    return tuple(int(x) for x in (parts + ["0", "0"])[:3])
+
+
+# feature → minimum cluster version (feature_gate.rs FEATURES table)
+FEATURES = {
+    "pipelined_pessimistic_lock": (4, 0, 8),
+    "joint_consensus": (5, 0, 0),
+    "async_commit": (5, 0, 0),
+    "causal_ts": (6, 1, 0),
+    "resource_control": (7, 0, 0),
+    "buckets": (6, 1, 0),
+    "unsafe_recovery": (6, 1, 0),
+}
+
+
+class FeatureGate:
+    def __init__(self, version: str = "0.0.0"):
+        self._lock = threading.Lock()
+        self._version = parse_version(version)
+
+    def set_version(self, version: str) -> None:
+        """Monotonic: a lower version than already observed is refused
+        (feature_gate.rs set_version)."""
+        v = parse_version(version)
+        with self._lock:
+            if v < self._version:
+                raise ValueError(
+                    f"cluster version cannot move backwards "
+                    f"({self._version} -> {v})")
+            self._version = v
+
+    @property
+    def version(self) -> tuple:
+        with self._lock:
+            return self._version
+
+    def can_enable(self, feature: str) -> bool:
+        need = FEATURES.get(feature)
+        if need is None:
+            raise KeyError(f"unknown feature {feature!r}")
+        return self.version >= need

@@ -533,6 +533,43 @@ class TxnClient:
     def status(self, store_id: int) -> dict:
         return self._store_client(store_id).call("Status", {})
 
+    def ingest_sst(self, sst_blob: bytes, region_key: bytes,
+                   chunk: int = 256 * 1024) -> int:
+        """Bulk load one built SST onto the region owning ``region_key``
+        (upload chunks → ingest; src/import/sst_service.rs flow)."""
+        import time as _time
+        import uuid as _uuid
+        last = None
+        for _attempt in range(4):
+            region, leader = self._lookup_region(region_key)
+            uuid = _uuid.uuid4().hex
+            total = max(1, -(-len(sst_blob) // chunk))
+            sc = self._store_client(leader.store_id)
+            try:
+                for seq in range(total):
+                    sc.call("ImportUpload", {
+                        "uuid": uuid, "seq": seq, "total": total,
+                        "data": sst_blob[seq * chunk:(seq + 1) * chunk]})
+                r = sc.call("ImportIngest", {"uuid": uuid,
+                                             "region_id": region.id})
+                return r["ingested"]
+            except wire.RemoteError as e:
+                if e.kind in ("not_leader", "epoch_not_match",
+                              "region_merging", "server_is_busy"):
+                    # stale routing / transient: refresh and retry
+                    self._invalidate_region(region_key)
+                    last = e
+                    _time.sleep(0.05)
+                    continue
+                raise
+        raise last
+
+    def import_switch_mode(self, store_id: int,
+                           import_mode: bool) -> bool:
+        r = self._store_client(store_id).call(
+            "ImportSwitchMode", {"import": import_mode})
+        return r["import_mode"]
+
     def debug(self, store_id: int, method: str, req: dict) -> dict:
         """Debug-service RPC against one specific store (debug.rs is
         store-local by design — it inspects that store's engine)."""

@@ -344,6 +344,15 @@ class Node:
         from ..utils.quota import ResourceGroupManager
         self.health = HealthController()
         self.resource_groups = ResourceGroupManager()
+        # bulk-load import mode (sst_importer import_mode.rs): split
+        # checks pause while set
+        self.import_mode = False
+        # version-gated features (pd_client feature_gate.rs); refreshed
+        # on the heartbeat cadence (_refresh_feature_gate), so a PD
+        # outage at boot or a later cluster upgrade is picked up
+        from ..pd.feature_gate import FeatureGate
+        self.feature_gate = FeatureGate()
+        self._refresh_feature_gate()
         self.raft_kv = RaftKv(self.raft_store, driver=self._wait_driver,
                               lock=self.lock,
                               latency_inspector=self.health.record_write)
@@ -446,7 +455,12 @@ class Node:
                     self.raft_store.tick()
                     ticks += 1
                     every = self.config.raftstore.region_split_check_ticks
-                    if every > 0 and ticks % every == 0:
+                    if every > 0 and ticks % every == 0 and \
+                            not self.import_mode:
+                        # import mode suspends split checks so a bulk
+                        # load isn't fighting auto-splits mid-ingest
+                        # (sst_importer import_mode.rs relaxes the
+                        # engine the same way)
                         try:
                             self.raft_store.split_check(self.pd)
                         except Exception:
@@ -472,6 +486,7 @@ class Node:
                             self._exec_operator(region.id, op)
                     hb = {"region_count": len(leaders)}
                     hb.update(self.health.stats())
+                    self._refresh_feature_gate()
                     self.pd.store_heartbeat(self.store_id, hb)
                     # advance resolved-ts watermarks with a fresh TSO
                     # (resolved_ts advance worker cadence).  The ts is
@@ -568,6 +583,39 @@ class Node:
         if isinstance(box["result"], Exception):
             raise box["result"]
         return box["result"]["right"]
+
+    def _refresh_feature_gate(self) -> None:
+        try:
+            cv = getattr(self.pd, "cluster_version", None)
+            if callable(cv):
+                self.feature_gate.set_version(cv())
+        except Exception:   # noqa: BLE001 — PD outage: next heartbeat
+            pass
+
+    def ingest_sst(self, region_id: int, pairs) -> int:
+        """Atomically land pre-built SST pairs in one raft command on
+        the target region (sst_importer ingest; fsm/apply.rs IngestSst).
+        Keys must be engine-encoded and inside the region's range —
+        range violations are refused before proposing."""
+        from ..raftstore.cmd import WriteOp
+        from ..raftstore.metapb import KeyNotInRegion
+        from ..storage.txn_types import split_ts
+        with self.lock:
+            peer = self.raft_store.region_peer(region_id)
+            region = peer.region
+            for _cf, key, _v in pairs:
+                bare = split_ts(key)[0] if len(key) > 8 else key
+                if not region.contains(bare):
+                    raise KeyNotInRegion(key, region)
+            ops = tuple(WriteOp("put", cf, key, value)
+                        for cf, key, value in pairs)
+            cmd = RaftCmd(region_id, region.epoch, ops=ops)
+            box: dict = {}
+            peer.propose(cmd, lambda r: box.__setitem__("result", r))
+        self._wait_driver(lambda: "result" in box)
+        if isinstance(box["result"], Exception):
+            raise box["result"]
+        return len(ops)
 
     def change_peer(self, region_id: int, change_type: str,
                     peer_meta: Peer) -> None:

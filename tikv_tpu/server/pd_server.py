@@ -93,6 +93,9 @@ class PdService:
         n = req.get("count", 1)
         return {"ts": [self.pd.tso() for _ in range(n)]}
 
+    def GetClusterVersion(self, req: dict) -> dict:
+        return {"version": self.pd.cluster_version()}
+
 
 class PdServer:
     def __init__(self, addr: str, pd: Optional[MockPd] = None):
@@ -188,3 +191,6 @@ class RemotePdClient:
 
     def tso_batch(self, count: int) -> list:
         return self._call("Tso", {"count": count})["ts"]
+
+    def cluster_version(self) -> str:
+        return self._call("GetClusterVersion", {})["version"]

@@ -68,6 +68,7 @@ class TikvServer:
     def __init__(self, node: Node, max_workers: int = 8,
                  status_addr: Optional[str] = None):
         self.node = node
+        self._stopped = False
         self.service = KvService(node)
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers))
@@ -95,12 +96,14 @@ class TikvServer:
                 config_controller=node.config_controller)
 
     def start(self) -> None:
+        self._stopped = False
         self.node.start()
         self._server.start()
         if self.status_server is not None:
             self.status_server.start()
 
     def stop(self, grace: Optional[float] = 0.5) -> None:
+        self._stopped = True    # service_event dispatcher exits on this
         if self.status_server is not None:
             self.status_server.stop()
         self._server.stop(grace)

@@ -49,6 +49,11 @@ class KvService:
         self._snap_parts: dict = {}
         self._snap_ready: dict = {}
         self._snap_lock = threading.Lock()
+        # staged bulk-load SSTs by uuid (src/import/sst_service.rs)
+        self._import_parts: dict = {}
+        self._import_staged: dict = {}
+        # ServiceEvent PAUSE_GRPC state (components/service)
+        self.paused = False
 
     # ---------------------------------------------------------- helpers
 
@@ -60,6 +65,11 @@ class KvService:
 
     def handle(self, method: str, req: dict) -> dict:
         from ..utils import metrics as m
+        if self.paused:
+            # ServiceEvent.PAUSE_GRPC (components/service): reject
+            # instead of queueing — clients back off and retry
+            return {"error": {"kind": "server_is_busy",
+                              "reason": "service paused"}}
         fn = getattr(self, method, None)
         if fn is None:
             return {"error": {"kind": "unimplemented", "method": method}}
@@ -539,6 +549,55 @@ class KvService:
 
     def Status(self, req: dict) -> dict:
         return self.node.status()
+
+    # ---------------------------------------------- ImportSST service
+    #
+    # Reference: src/import/sst_service.rs — upload stages file chunks
+    # by uuid, ingest lands a staged file atomically on its region,
+    # switch_mode pauses housekeeping during the bulk load.
+
+    _IMPORT_STAGE_MAX = 16
+
+    def ImportUpload(self, req: dict) -> dict:
+        uuid = req["uuid"]
+        with self._snap_lock:       # reuse: small, rarely contended
+            if uuid not in self._import_parts and \
+                    uuid not in self._import_staged and \
+                    (len(self._import_parts) +
+                     len(self._import_staged)) >= self._IMPORT_STAGE_MAX:
+                # refuse NEW uploads instead of silently evicting a
+                # fully-staged blob someone is about to ingest
+                return {"error": {"kind": "server_is_busy",
+                                  "reason": "import staging full"}}
+            parts = self._import_parts.setdefault(uuid, {})
+            parts[req["seq"]] = req["data"]
+            done = len(parts) == req["total"]
+            if done:
+                self._import_staged[uuid] = b"".join(
+                    parts[i] for i in range(req["total"]))
+                del self._import_parts[uuid]
+        return {"staged": done}
+
+    def ImportIngest(self, req: dict) -> dict:
+        from ..sst_importer import read_sst
+        uuid = req["uuid"]
+        with self._snap_lock:
+            blob = self._import_staged.get(uuid)
+        if blob is None:
+            return {"error": {"kind": "other",
+                              "message": f"no staged sst {uuid!r}"}}
+        pairs = read_sst(blob)      # ValueError on corruption → guard
+        # the staged blob survives a FAILED ingest (epoch change /
+        # leadership move) so the client can retry without re-uploading
+        # (sst_service keeps the file the same way)
+        n = self.node.ingest_sst(req["region_id"], pairs)
+        with self._snap_lock:
+            self._import_staged.pop(uuid, None)
+        return {"ingested": n}
+
+    def ImportSwitchMode(self, req: dict) -> dict:
+        self.node.import_mode = bool(req["import"])
+        return {"import_mode": self.node.import_mode}
 
     # ------------------------------------------------- debug service
     #

@@ -1,0 +1,85 @@
+"""Bulk load: SST build / upload / atomic ingest.
+
+Reference: components/sst_importer/ + src/import/ — a client (TiDB
+Lightning / BR restore) BUILDS sorted files locally, uploads them in
+chunks to every replica's store, then issues an ingest that lands the
+file atomically; import mode relaxes background housekeeping while the
+bulk load runs (import_mode.rs).
+
+The TPU-native engine has no RocksDB SST to hard-link, so "ingest"
+proposes the file's ops as ONE raft command on the target region —
+atomic, replicated, and epoch-checked exactly like any admin write —
+while this module keeps the reference's file format seam: a
+self-contained sorted, checksummed artifact the client can build
+offline (incl. pre-timestamped MVCC records, the Lightning trick of
+writing Percolator state directly).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import msgpack
+
+_SST_MAGIC = b"TKVSST1\n"
+
+
+class SstWriter:
+    """Client-side builder: collect (cf, key, value), emit one sorted,
+    crc-sealed artifact (sst_importer writer.rs analog)."""
+
+    def __init__(self):
+        self._pairs: list[tuple] = []
+
+    def put(self, cf: str, key: bytes, value: bytes) -> None:
+        self._pairs.append((cf, key, value))
+
+    def __len__(self) -> int:
+        return len(self._pairs)
+
+    def finish(self) -> bytes:
+        self._pairs.sort(key=lambda p: (p[0], p[1]))
+        payload = msgpack.packb(
+            [[cf, bytes(k), bytes(v)] for cf, k, v in self._pairs],
+            use_bin_type=True)
+        return (_SST_MAGIC + payload +
+                struct.pack(">I", zlib.crc32(payload) & 0xFFFFFFFF))
+
+
+def read_sst(blob: bytes) -> list:
+    """→ [(cf, key, value)]; raises ValueError on a corrupt artifact."""
+    if not blob.startswith(_SST_MAGIC) or len(blob) < len(_SST_MAGIC) + 4:
+        raise ValueError("bad sst magic")
+    payload = blob[len(_SST_MAGIC):-4]
+    (crc,) = struct.unpack(">I", blob[-4:])
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise ValueError("sst checksum mismatch")
+    return [(cf, k, v) for cf, k, v in
+            msgpack.unpackb(payload, raw=False)]
+
+
+def mvcc_sst(rows, commit_ts: int, start_ts: int = 0) -> SstWriter:
+    """Pre-timestamped Percolator records for ``rows`` = [(user_key,
+    value)] — committed state written directly (write CF + default CF
+    for long values), the Lightning/BR-restore ingestion shape.
+    """
+    from .engine.traits import CF_DEFAULT, CF_WRITE
+    from .storage.txn_types import (
+        SHORT_VALUE_MAX_LEN,
+        Write,
+        WriteType,
+        append_ts,
+        encode_key,
+    )
+    start_ts = start_ts or commit_ts - 1
+    w = SstWriter()
+    for key, value in rows:
+        enc = encode_key(key)
+        if len(value) <= SHORT_VALUE_MAX_LEN:
+            rec = Write(WriteType.PUT, start_ts, short_value=value)
+        else:
+            rec = Write(WriteType.PUT, start_ts)
+            w.put(CF_DEFAULT, append_ts(enc, start_ts), value)
+        w.put(CF_WRITE, append_ts(enc, commit_ts), rec.to_bytes())
+    return w
