@@ -98,7 +98,7 @@ class DiskEngine(MemoryEngine):
 
     def __init__(self, path: str, cfs=ALL_CFS, sync: bool = False,
                  checkpoint_bytes: int = 16 << 20, max_runs: int = 4,
-                 encryption=None):
+                 encryption=None, compaction_filter=None):
         super().__init__(cfs)
         self.path = path
         self._cf_names = tuple(cfs)
@@ -108,6 +108,11 @@ class DiskEngine(MemoryEngine):
         # every artifact (WAL/ckpt/run) is AES-CTR'd under its own
         # per-file data key; None = plaintext
         self._enc = encryption
+        # GC-in-compaction hook (gc_worker/compaction_filter.rs):
+        # filter_cf(cf, keys, vals) -> (keys, vals) applied while the
+        # compaction dumps the new base; CF_ORDER fixes cross-CF
+        # decision order (write before default)
+        self._compaction_filter = compaction_filter
         self._checkpoint_bytes = checkpoint_bytes
         self._max_runs = max_runs
         os.makedirs(path, exist_ok=True)
@@ -446,6 +451,23 @@ class DiskEngine(MemoryEngine):
         from ..utils.failpoint import fail_point
         fail_point("compact::before_write")
         gen = self._gen
+        filt = self._compaction_filter
+        if filt is not None:
+            # apply the GC filter to the LIVE memtable in the order the
+            # filter dictates (write-CF decisions drive default drops);
+            # the checkpoint below then persists the filtered state
+            order = [cf for cf in getattr(filt, "CF_ORDER", ())
+                     if cf in self._cf_names]
+            order += [cf for cf in self._cf_names if cf not in order]
+            for cf in order:
+                keys, vals = filt.filter_cf(cf, self._cfs[cf].keys,
+                                            self._cfs[cf].vals)
+                if keys is not self._cfs[cf].keys:
+                    # respect the copy-on-write snapshot contract:
+                    # pinned generations are shared with live readers
+                    data = self._writable(cf)
+                    data.keys = list(keys)
+                    data.vals = list(vals)
         parts = [_CKPT_MAGIC, struct.pack(">B", len(self._cf_names))]
         for cfi, cf in enumerate(self._cf_names):
             data = self._cfs[cf]

@@ -577,7 +577,7 @@ class RaftPeer:
         if admin.kind == "rollback_merge":
             return self._exec_rollback_merge(wb, admin)
         if admin.kind == "compute_hash":
-            return self._exec_compute_hash(index)
+            return self._exec_compute_hash(index, admin)
         if admin.kind == "verify_hash":
             return self._exec_verify_hash(admin)
         raise ValueError(admin.kind)    # pragma: no cover
@@ -592,10 +592,20 @@ class RaftPeer:
     # for that index differs has diverged — the reference panics the
     # node, here InconsistentRegion surfaces through the drive loop.
 
-    def _exec_compute_hash(self, index: int) -> dict:
+    def _exec_compute_hash(self, index: int,
+                           admin: Optional[AdminCmd] = None) -> dict:
         import zlib
         from ..engine.traits import CF_DEFAULT, CF_LOCK, CF_WRITE
         from .peer_storage import region_data_bounds
+        # GC via each node's LOCAL compaction filter legitimately drops
+        # versions at/below the safe point at node-local times — raw
+        # bytes of two healthy replicas may differ below it.  The
+        # leader pins its safe point into the proposal; every replica
+        # hashes only versions ABOVE it, so the digest is deterministic
+        # whether or not a replica has compacted yet.
+        safe_point = 0
+        if admin is not None and len(admin.extra) == 8:
+            (safe_point,) = struct.unpack(">Q", admin.extra)
         lo, hi = region_data_bounds(self.region)
         crc = 0
         for cf in (CF_DEFAULT, CF_LOCK, CF_WRITE):
@@ -603,7 +613,15 @@ class RaftPeer:
             it = self.engine.iterator_cf(cf, lo, hi)
             ok = it.seek_to_first()
             while ok:
-                crc = zlib.crc32(it.key(), crc)
+                key = it.key()
+                if safe_point and cf in (CF_DEFAULT, CF_WRITE) and \
+                        len(key) > 9:
+                    from ..storage.txn_types import split_ts
+                    _, ts = split_ts(key[1:])
+                    if ts <= safe_point:
+                        ok = it.next()
+                        continue
+                crc = zlib.crc32(key, crc)
                 crc = zlib.crc32(it.value(), crc)
                 ok = it.next()
         # region state participates too (apply.rs hashes the region state

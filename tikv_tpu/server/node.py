@@ -286,6 +286,10 @@ class Node:
         self.pd = pd
         if engine is not None and data_dir is not None:
             raise ValueError("pass engine= or data_dir=, not both")
+        # advertised GC safe point cache — feeds the engine compaction
+        # filter and the auto GcManager tick (gc_worker/gc_manager.rs)
+        self._gc_safe_point = 0
+        self._gc_running = False
         if engine is not None:
             self.engine = engine
         elif data_dir is not None:
@@ -304,7 +308,11 @@ class Node:
                     else MasterKeyFile.create(mk_path)
                 enc = DataKeyManager(
                     master, _os.path.join(data_dir, "ENCRYPTION_DICT"))
-            self.engine = DiskEngine(data_dir, encryption=enc)
+            from ..storage.txn.gc import MvccCompactionFilter
+            self.engine = DiskEngine(
+                data_dir, encryption=enc,
+                compaction_filter=MvccCompactionFilter(
+                    lambda: self._gc_safe_point))
         else:
             self.engine = MemoryEngine()
         self.lock = threading.RLock()
@@ -487,6 +495,7 @@ class Node:
                     hb = {"region_count": len(leaders)}
                     hb.update(self.health.stats())
                     self._refresh_feature_gate()
+                    self._gc_manager_tick()
                     self.pd.store_heartbeat(self.store_id, hb)
                     # advance resolved-ts watermarks with a fresh TSO
                     # (resolved_ts advance worker cadence).  The ts is
@@ -583,6 +592,32 @@ class Node:
         if isinstance(box["result"], Exception):
             raise box["result"]
         return box["result"]["right"]
+
+    def _gc_manager_tick(self) -> None:
+        """Auto-GC (gc_worker/gc_manager.rs): when PD's safe point
+        advances, sweep versions below it on a BACKGROUND worker — the
+        reference runs GC on a dedicated thread because a full-store
+        sweep inline in the tick loop would stall raft heartbeats.
+        The engine's compaction filter catches anything missed later."""
+        try:
+            sp = self.pd.get_gc_safe_point()
+        except Exception:   # noqa: BLE001 — PD outage: next heartbeat
+            return
+        if sp <= self._gc_safe_point or self._gc_running:
+            return
+        self._gc_safe_point = sp
+        self._gc_running = True
+
+        def work():
+            try:
+                self.run_gc(sp)
+            except Exception:   # noqa: BLE001 — retried at next advance
+                self._gc_safe_point = 0
+            finally:
+                self._gc_running = False
+
+        threading.Thread(target=work, daemon=True,
+                         name="gc-worker").start()
 
     def _refresh_feature_gate(self) -> None:
         try:

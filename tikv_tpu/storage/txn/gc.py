@@ -16,7 +16,14 @@ from typing import Optional
 from ...engine.traits import CF_WRITE
 from ..mvcc.reader import MvccReader, _PAST_VERSIONS
 from ..mvcc.txn import MvccTxn
-from ..txn_types import Write, WriteType, decode_key, encode_key, split_ts
+from ..txn_types import (
+    Write,
+    WriteType,
+    append_ts,
+    decode_key,
+    encode_key,
+    split_ts,
+)
 
 
 def gc_key(txn: MvccTxn, reader: MvccReader, key: bytes,
@@ -58,3 +65,76 @@ def gc_range(txn: MvccTxn, reader: MvccReader, start: Optional[bytes],
         removed += gc_key(txn, reader, decode_key(enc), safe_point)
         ok = it.seek(enc + _PAST_VERSIONS)
     return removed
+
+
+class MvccCompactionFilter:
+    """GC folded into engine compaction — the production path
+    (src/server/gc_worker/compaction_filter.rs): as the engine rewrites
+    its base, write-CF versions at/below the safe point are dropped by
+    the same per-key rule as gc_key, and the default-CF payload rows of
+    dropped PUTs go with them.  No extra scan, no write amplification.
+
+    Engine contract (DiskEngine ``compaction_filter=``): the engine
+    calls ``filter_cf(cf, keys, vals) -> (keys, vals)`` for each CF
+    during compaction, offering CF_WRITE before CF_DEFAULT (the write
+    pass decides which default rows die).  Keys arrive as ENGINE keys
+    (data prefix + encoded user key [+ ts]).
+    """
+
+    # process write before default: write decisions drive default drops
+    CF_ORDER = ("write", "default")
+
+    def __init__(self, safe_point_provider):
+        self._safe_point = safe_point_provider
+        self._drop_defaults: set = set()
+
+    def filter_cf(self, cf: str, keys: list, vals: list):
+        if cf == CF_WRITE:
+            return self._filter_write(keys, vals)
+        if cf == "default":
+            if not self._drop_defaults:
+                return keys, vals
+            keep = [i for i, k in enumerate(keys)
+                    if k not in self._drop_defaults]
+            self._drop_defaults = set()
+            return [keys[i] for i in keep], [vals[i] for i in keep]
+        return keys, vals
+
+    def _filter_write(self, keys: list, vals: list):
+        safe = int(self._safe_point() or 0)
+        if not safe:
+            return keys, vals
+        out_k: list = []
+        out_v: list = []
+        cur_enc = None
+        kept_newest = False
+        # engine keys sort newest-version-first within a user key
+        for k, v in zip(keys, vals):
+            if len(k) <= 9 or not k.startswith(b"z"):
+                out_k.append(k)
+                out_v.append(v)
+                continue
+            enc, commit_ts = split_ts(k[1:])
+            if enc != cur_enc:
+                cur_enc = enc
+                kept_newest = False
+            if commit_ts > safe:
+                out_k.append(k)
+                out_v.append(v)
+                continue
+            w = Write.from_bytes(v)
+            drop = True
+            if not kept_newest:
+                if w.write_type is WriteType.PUT:
+                    drop = False
+                if w.write_type in (WriteType.PUT, WriteType.DELETE):
+                    kept_newest = True
+            if drop:
+                if w.write_type is WriteType.PUT and \
+                        w.short_value is None:
+                    self._drop_defaults.add(
+                        b"z" + append_ts(enc, w.start_ts))
+                continue
+            out_k.append(k)
+            out_v.append(v)
+        return out_k, out_v

@@ -269,8 +269,19 @@ class Cluster:
         peer = self.leader_peer(region_id)
         assert peer is not None
         box: dict = {}
+        # pin the cluster GC safe point into the proposal: replicas
+        # hash only versions above it, so node-local compaction-filter
+        # GC timing cannot fake a divergence
+        sp = 0
+        try:
+            sp = self.pd.get_gc_safe_point()
+        except Exception:   # noqa: BLE001 — no PD in some fixtures
+            pass
+        import struct as _struct
         peer.propose(RaftCmd(region_id, peer.region.epoch,
-                             admin=AdminCmd("compute_hash")),
+                             admin=AdminCmd(
+                                 "compute_hash",
+                                 extra=_struct.pack(">Q", sp))),
                      lambda r: box.__setitem__("computed", r))
         self._drive_until(lambda: "computed" in box)
         if isinstance(box["computed"], Exception):
