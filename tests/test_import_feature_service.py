@@ -180,3 +180,67 @@ def test_service_events_pause_resume():
         except Exception:
             pass
         pd_server.stop()
+
+
+def test_sigterm_graceful_shutdown(tmp_path):
+    """`python -m tikv_tpu.server tikv` exits cleanly on SIGTERM,
+    flushing its durable engine (signal handler -> ServiceEvent.EXIT)."""
+    import select
+    import signal
+    import subprocess
+    import sys
+
+    from tikv_tpu.server.pd_server import PdServer
+
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tikv_tpu.server", "tikv",
+         "--addr", "127.0.0.1:0",
+         "--pd", f"127.0.0.1:{pd_server.port}",
+         "--data-dir", str(tmp_path / "d")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        # deadline-guarded reads: a wedged server must FAIL the test,
+        # not hang it on a blocking readline
+        deadline = time.time() + 20
+        line = ""
+        while time.time() < deadline and "listening on" not in line:
+            ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+            if ready:
+                line = proc.stdout.readline()
+        assert "listening on" in line, line
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=20)
+        assert rc == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        pd_server.stop()
+
+
+def test_read_pool_watermarks():
+    import threading
+
+    from tikv_tpu.server.read_pool import ReadPool
+
+    pool = ReadPool(max_concurrency=2, max_pending=8)
+    gate = threading.Event()
+    started = threading.Barrier(3)
+
+    def slow():
+        started.wait()
+        gate.wait()
+        return 1
+
+    ts = [threading.Thread(target=lambda: pool.run(slow))
+          for _ in range(2)]
+    for t in ts:
+        t.start()
+    started.wait()      # both tasks running
+    assert pool.running == 2
+    gate.set()
+    for t in ts:
+        t.join()
+    assert pool.running == 0 and pool.running_peak == 2
+    assert pool.served == 2

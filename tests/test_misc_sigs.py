@@ -1,0 +1,139 @@
+"""impl_misc sig family: math stragglers, inet/uuid, string fillers.
+
+Reference: impl_math.rs, impl_miscellaneous.rs, impl_string.rs.
+"""
+
+import numpy as np
+import pytest
+
+from tikv_tpu.datatype import EvalType
+from tikv_tpu.expr import Expr, build_rpn, eval_rpn
+
+I, R, B = EvalType.INT, EvalType.REAL, EvalType.BYTES
+
+
+def run(sig, pairs, ets):
+    e = Expr.call(sig, *[Expr.column(i, t) for i, t in enumerate(ets)])
+    n = max((len(p[0]) for p in pairs if np.shape(p[0])), default=1)
+    return eval_rpn(build_rpn(e), pairs, n, np)
+
+
+def icol(vals):
+    return np.array(vals, np.int64), np.ones(len(vals), bool)
+
+
+def rcol(vals):
+    return np.array(vals, np.float64), np.ones(len(vals), bool)
+
+
+def scol(vals):
+    return np.array(vals, object), np.ones(len(vals), bool)
+
+
+def test_log_sigs():
+    v, m = run("Log1Arg", [rcol([np.e, 1.0, -1.0, 0.0])], [R])
+    assert v[0] == pytest.approx(1.0) and v[1] == 0.0
+    assert list(m) == [True, True, False, False]
+    v, m = run("Log2Args", [rcol([2.0, 10.0, 1.0]),
+                            rcol([8.0, 1000.0, 5.0])], [R, R])
+    assert v[0] == pytest.approx(3.0) and v[1] == pytest.approx(3.0)
+    assert list(m) == [True, True, False]      # base 1 illegal
+
+
+def test_sign_pi_conv():
+    v, m = run("Sign", [rcol([-2.5, 0.0, 7.0])], [R])
+    assert list(v) == [-1, 0, 1]
+    v, m = eval_rpn(build_rpn(Expr.call("PI")), [], 1, np)
+    assert np.asarray(v).reshape(-1)[0] == pytest.approx(np.pi)
+    v, m = run("Conv", [scol([b"ff", b"-17", b"zz"]),
+                        icol([16, 10, 10]), icol([10, 16, 2])],
+               [B, I, I])
+    assert v[0] == b"255"
+    assert v[1] == b"FFFFFFFFFFFFFFEF"      # -17 as u64 hex
+    assert v[2] == b"0"                     # no valid digits
+
+
+def test_round_with_frac():
+    v, m = run("RoundWithFracReal", [rcol([2.345, -2.345]),
+                                     icol([2, 2])], [R, I])
+    assert list(v) == [2.35, -2.35]
+    v, m = run("RoundWithFracInt", [icol([12345, -155]),
+                                    icol([-2, -1])], [I, I])
+    assert list(v) == [12300, -160]
+
+
+def test_inet_family():
+    v, m = run("IsIPv4", [scol([b"1.2.3.4", b"nope", b"::1"])], [B])
+    assert list(v) == [1, 0, 0]
+    v, m = run("IsIPv6", [scol([b"::1", b"1.2.3.4"])], [B])
+    assert list(v) == [1, 0]
+    v, m = run("InetAton", [scol([b"1.0.0.1", b"bad"])], [B])
+    assert v[0] == 16777217 and list(m) == [True, False]
+    v, m = run("InetNtoa", [icol([16777217])], [I])
+    assert v[0] == b"1.0.0.1"
+    v, m = run("Inet6Aton", [scol([b"::1"])], [B])
+    assert v[0] == b"\x00" * 15 + b"\x01"
+    v, m = run("Inet6Ntoa", [scol([b"\x00" * 15 + b"\x01"])], [B])
+    assert v[0] == b"::1"
+
+
+def test_uuid():
+    v1, m = eval_rpn(build_rpn(Expr.call("Uuid")), [], 1, np)
+    v2, m = eval_rpn(build_rpn(Expr.call("Uuid")), [], 1, np)
+    s = bytes(np.asarray(v1).item())
+    assert len(s) == 36 and s.count(b"-") == 4
+    assert np.asarray(v1).item() != np.asarray(v2).item()
+
+
+def test_field_and_make_set():
+    v, m = run("FieldInt", [icol([3, 9]), icol([1, 1]),
+                            icol([3, 3])], [I, I, I])
+    assert list(v) == [2, 0]
+    v, m = run("MakeSet", [icol([0b101, 0b010]),
+                           scol([b"a", b"a"]), scol([b"b", b"b"]),
+                           scol([b"c", b"c"])], [I, B, B, B])
+    assert list(v) == [b"a,c", b"b"]
+
+
+def test_format_hex_oct_insert():
+    v, m = run("Format", [rcol([1234567.891]), icol([2])], [R, I])
+    assert v[0] == b"1,234,567.89"
+    v, m = run("HexStrArg", [scol([b"abc"])], [B])
+    assert v[0] == b"616263"
+    v, m = run("OctString", [scol([b"12", b"8x", b"junk"])], [B])
+    assert list(v) == [b"14", b"10", b"0"]
+    v, m = run("InsertUtf8", [scol([b"Quadratic"]), icol([3]),
+                              icol([4]), scol([b"What"])],
+               [B, I, I, B])
+    assert v[0] == b"QuWhattic"
+
+
+def test_misc_arith():
+    v, m = run("MultiplyIntUnsigned", [icol([2 ** 62, 3]),
+                                       icol([4, 5])], [I, I])
+    # u64 wrap: 2^62 * 4 mod 2^64 = 0
+    assert int(v[0]) == 0 and int(v[1]) == 15
+    assert list(m) == [True, True]
+    from decimal import Decimal as D
+    v, m = run("UnaryNotDecimal",
+               [(np.array([D(0), D("1.5")], object),
+                 np.ones(2, bool))], [EvalType.DECIMAL])
+    assert list(v) == [1, 0]
+
+
+def test_review_regressions():
+    # per-row distinct UUIDs over a multi-row batch
+    v, m = eval_rpn(build_rpn(Expr.call("Uuid")), [icol([1, 2, 3])],
+                    3, np)
+    assert np.shape(v) == (3,) and len({bytes(x) for x in v}) == 3
+    # huge frac: identity, not a crash
+    v, m = run("RoundWithFracReal", [rcol([1.5]), icol([10_000_000])],
+               [R, I])
+    assert v[0] == 1.5 and m[0]
+    # negative to_base renders signed
+    v, m = run("Conv", [scol([b"18446744073709551615"]),
+                        icol([10]), icol([-10])], [B, I, I])
+    assert v[0] == b"-1"
+    # SIGN(NaN) -> NULL
+    v, m = run("Sign", [rcol([float("nan"), 2.0])], [R])
+    assert list(m) == [False, True] and v[1] == 1

@@ -69,6 +69,8 @@ def eval_rpn(rpn: RpnExpression, columns: Sequence[tuple], n_rows, xp=np):
                 args = []
             if node.meta.needs_ctx:
                 stack.append(node.meta.fn(xp, *args, ctx=node.ctx))
+            elif node.meta.needs_rows:
+                stack.append(node.meta.fn(xp, *args, n_rows=n_rows))
             else:
                 stack.append(node.meta.fn(xp, *args))
         else:  # pragma: no cover

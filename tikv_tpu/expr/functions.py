@@ -41,16 +41,19 @@ class RpnFnMeta:
     # sig consults the node's (collation, elems) context — eval passes
     # ``ctx=`` (collation-dispatched string sigs, enum/set sigs)
     needs_ctx: bool = False
+    # nondeterministic 0-arity sigs (UUID, RAND) must produce one value
+    # PER ROW — eval passes ``n_rows=``
+    needs_rows: bool = False
 
 
 FUNCTIONS: dict[str, RpnFnMeta] = {}
 
 
 def rpn_fn(name: str, arity: Optional[int], ret: EvalType, args: tuple,
-           needs_ctx: bool = False):
+           needs_ctx: bool = False, needs_rows: bool = False):
     def deco(fn):
         FUNCTIONS[name] = RpnFnMeta(name, arity, ret, args, fn,
-                                    needs_ctx)
+                                    needs_ctx, needs_rows)
         return fn
     return deco
 
@@ -583,6 +586,7 @@ _register_math()
 
 # family modules (imported late: they need the registry decorator above)
 from . import impl_json as _impl_json      # noqa: E402
+from . import impl_misc as _impl_misc      # noqa: E402
 from . import impl_like as _impl_like      # noqa: E402
 from . import impl_string as _impl_string  # noqa: E402
 from . import impl_time as _impl_time      # noqa: E402
@@ -593,3 +597,4 @@ _impl_like.register()
 _impl_time.register()
 _impl_types.register()
 _impl_json.register()
+_impl_misc.register()

@@ -132,6 +132,24 @@ def main(argv=None) -> int:
                     config=config)
         server = TikvServer(node, status_addr=args.status_addr)
         server.start()
+        # graceful shutdown on SIGTERM/SIGINT through the service-event
+        # channel (cmd/tikv-server main.rs signal handler)
+        import signal
+
+        from ..service_event import (
+            ServiceEvent,
+            ServiceEventChannel,
+            attach,
+        )
+        events = ServiceEventChannel()
+        attach(events, server)
+
+        def _on_signal(signum, _frame):
+            print(f"received signal {signum}; shutting down", flush=True)
+            events.post(ServiceEvent.EXIT)
+
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
         if server.status_server is not None:
             print(f"status server on port {server.status_server.port}",
                   flush=True)

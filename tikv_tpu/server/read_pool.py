@@ -17,6 +17,11 @@ from __future__ import annotations
 
 import threading
 
+from ..utils.metrics import (
+    READ_POOL_PENDING_GAUGE,
+    READ_POOL_RUNNING_GAUGE,
+)
+
 
 class ServerIsBusy(Exception):
     def __init__(self, reason: str = "read pool saturated"):
@@ -32,6 +37,8 @@ class ReadPool:
         self._pending = 0
         self.served = 0
         self.rejected = 0
+        self.running = 0
+        self.running_peak = 0
 
     def run(self, fn, priority: str = "normal"):
         """Execute ``fn`` under the pool's concurrency cap.
@@ -45,11 +52,31 @@ class ReadPool:
                 raise ServerIsBusy(
                     f"{self._pending} reads pending (max {self._max_pending})")
             self._pending += 1
+            self._publish_gauges()
         try:
             with self._slots:
                 with self._mu:
                     self.served += 1
-                return fn()
+                    self.running += 1
+                    # running-task watermark (read_pool.rs
+                    # running_threads tracking feeding busy decisions)
+                    self.running_peak = max(self.running_peak,
+                                            self.running)
+                    self._publish_gauges()
+                try:
+                    return fn()
+                finally:
+                    with self._mu:
+                        self.running -= 1
+                        self._publish_gauges()
         finally:
             with self._mu:
                 self._pending -= 1
+                self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        """Caller holds the lock.  'pending' exposes tasks WAITING for
+        a slot (admitted minus running) so saturation alerts don't fire
+        on merely-executing reads."""
+        READ_POOL_RUNNING_GAUGE.set(self.running)
+        READ_POOL_PENDING_GAUGE.set(max(0, self._pending - self.running))

@@ -269,6 +269,12 @@ RAFT_MSG_DROP_COUNTER = REGISTRY.counter(
 SNAP_CHUNK_COUNTER = REGISTRY.counter(
     "tikv_server_snapshot_chunks_sent_total",
     "snapshot chunks shipped on the dedicated stream")
+READ_POOL_RUNNING_GAUGE = REGISTRY.gauge(
+    "tikv_unified_read_pool_running_tasks",
+    "read-pool tasks currently executing")
+READ_POOL_PENDING_GAUGE = REGISTRY.gauge(
+    "tikv_unified_read_pool_pending_tasks",
+    "read-pool tasks admitted and waiting for a slot")
 COPR_REQ_COUNTER = REGISTRY.counter(
     "tikv_coprocessor_request_total", "coprocessor requests by backend",
     labels=("backend",))
