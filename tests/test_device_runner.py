@@ -301,3 +301,124 @@ def test_hash_agg_sparse_distinct_overflow_falls_back(runner):
     host = BatchExecutorsRunner(dag, snap).handle_request()
     dev = small.handle_request(dag, snap)
     assert canon(dev.rows()) == canon(host.rows())
+
+
+def make_time_snapshot(n=20_000, seed=31):
+    from tikv_tpu.datatype.time import pack_datetime
+    rng = np.random.default_rng(seed)
+    table = Table(7300 + seed, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("k", 2, FieldType.long()),
+        TableColumn("t", 3, FieldType(tp=__import__(
+            "tikv_tpu.datatype.eval_type",
+            fromlist=["FieldTypeTp"]).FieldTypeTp.DATETIME)),
+        TableColumn("d", 4, FieldType(tp=__import__(
+            "tikv_tpu.datatype.eval_type",
+            fromlist=["FieldTypeTp"]).FieldTypeTp.DURATION)),
+    ))
+    years = rng.integers(1990, 2030, n)
+    months = rng.integers(1, 13, n)
+    days = rng.integers(1, 29, n)
+    t = pack_datetime(years, months, days).astype(np.uint64)
+    d = rng.integers(-10**12, 10**12, n).astype(np.int64)
+    k = rng.integers(0, 20, n).astype(np.int64)
+    tvalid = (np.arange(n) % 13) != 5
+    snap = ColumnarTable.from_arrays(table, np.arange(n, dtype=np.int64), {
+        "k": Column(EvalType.INT, k, np.ones(n, bool)),
+        "t": Column(EvalType.DATETIME, t, tvalid),
+        "d": Column(EvalType.DURATION, d, np.ones(n, bool)),
+    })
+    return table, snap
+
+
+def test_datetime_filter_topn_on_device(runner):
+    """DATETIME columns ride the device: time-range filters and
+    ORDER BY time LIMIT k (packed u64 core order == time order)."""
+    from tikv_tpu.datatype.time import pack_datetime
+    table, snap = make_time_snapshot()
+    cutoff = int(pack_datetime(2015, 6, 1))
+    sel = DagSelect.from_table(table, ["id", "k", "t", "d"])
+    dag = sel.where(Expr.call(
+        "GtTime", sel.col("t"),
+        Expr.const(cutoff, EvalType.DATETIME))) \
+        .order_by(sel.col("t"), desc=True, limit=25).build()
+    assert runner.supports(dag)
+    host, dev = run_both(runner, dag, snap)
+    assert_same(host, dev)
+    assert len(dev.rows()) == 25
+
+
+def test_datetime_min_max_agg_on_device(runner):
+    table, snap = make_time_snapshot(seed=32)
+    sel = DagSelect.from_table(table, ["id", "k", "t", "d"])
+    dag = sel.aggregate([sel.col("k")],
+                        [("min", sel.col("t")), ("max", sel.col("t")),
+                         ("count", sel.col("t")),
+                         ("min", sel.col("d")),
+                         ("max", sel.col("d"))]).build()
+    assert runner.supports(dag)
+    host, dev = run_both(runner, dag, snap)
+    assert_same(host, dev)
+
+
+def test_datetime_sum_declined(runner):
+    table, snap = make_time_snapshot(seed=33)
+    sel = DagSelect.from_table(table, ["id", "k", "t", "d"])
+    dag = sel.aggregate([], [("sum", sel.col("t"))]).build()
+    assert not runner.supports(dag)
+
+
+def test_datetime_beyond_int63_falls_back(runner):
+    """Year >= 8192 packs above 2^63: the feed guard must route to
+    host transparently with identical results."""
+    from tikv_tpu.datatype.time import pack_datetime
+    table, _ = make_time_snapshot(n=4_000, seed=34)
+    # snapshot with a year-9999 row (packs above 2^63)
+    n = 4_000
+    rng = np.random.default_rng(34)
+    t = pack_datetime(rng.integers(1990, 2030, n), 1, 1).astype(np.uint64)
+    t[7] = int(pack_datetime(9999, 12, 31))
+    snap2 = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64), {
+            "k": Column(EvalType.INT,
+                        rng.integers(0, 5, n).astype(np.int64),
+                        np.ones(n, bool)),
+            "t": Column(EvalType.DATETIME, t, np.ones(n, bool)),
+            "d": Column(EvalType.DURATION,
+                        np.zeros(n, np.int64), np.ones(n, bool)),
+        })
+    sel = DagSelect.from_table(table, ["id", "k", "t", "d"])
+    dag = sel.aggregate([sel.col("k")],
+                        [("max", sel.col("t"))]).build()
+    host = BatchExecutorsRunner(dag, snap2).handle_request()
+    dev = runner.handle_request(dag, snap2)     # falls back internally
+    assert_same(host, dev)
+
+
+def test_datetime_topn_microsecond_precision(runner):
+    """Sub-f64-resolution timestamps (differ only in micro bits) must
+    still order exactly on the device TopN path."""
+    from tikv_tpu.datatype.time import pack_datetime
+    n = 4_096
+    base = int(pack_datetime(2024, 5, 5, 12))
+    t = (np.uint64(base) + np.arange(n, dtype=np.uint64))  # micro steps
+    rng = np.random.default_rng(40)
+    perm = rng.permutation(n)
+    table = Table(7400, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("t", 2, FieldType(tp=__import__(
+            "tikv_tpu.datatype.eval_type",
+            fromlist=["FieldTypeTp"]).FieldTypeTp.DATETIME)),
+    ))
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"t": Column(EvalType.DATETIME, t[perm], np.ones(n, bool))})
+    sel = DagSelect.from_table(table, ["id", "t"])
+    dag = sel.order_by(sel.col("t"), desc=True, limit=10).build()
+    host, dev = run_both(runner, dag, snap)
+    # exact: the ten largest micro-stamps in strict order
+    assert [r[1] for r in dev.rows()] == \
+        sorted(t.tolist(), reverse=True)[:10]
+    assert host.rows() == dev.rows()

@@ -137,3 +137,19 @@ def test_review_regressions():
     # SIGN(NaN) -> NULL
     v, m = run("Sign", [rcol([float("nan"), 2.0])], [R])
     assert list(m) == [False, True] and v[1] == 1
+
+
+def test_review_regressions_2():
+    # ROUND(int64max, -19) -> 0, no overflow crash
+    v, m = run("RoundWithFracInt", [icol([2**63 - 1]), icol([-19])],
+               [I, I])
+    assert int(v[0]) == 0 and m[0]
+    # MySQL short-form inet
+    v, m = run("InetAton", [scol([b"127.1", b"127.0.1", b"256.1"])],
+               [B])
+    assert int(v[0]) == 2130706433
+    assert int(v[1]) == (127 << 24) | 1
+    assert list(m) == [True, True, False]
+    # OCT beyond u64 wraps, never emits malformed text
+    v, m = run("OctString", [scol([b"-18446744073709551617"])], [B])
+    assert v[0] == oct((2**64 - (2**64 + 1)) % 2**64)[2:].encode()

@@ -82,7 +82,14 @@ _CHUNK_TOPN = 1 << 23
 
 class _FallbackToHost(Exception):
     """Raised when a runtime property (not the plan) forces the host path."""
-_DEVICE_ETS = (EvalType.INT, EvalType.REAL)
+#  DATETIME (packed u64 core — the bit layout is order-preserving) and
+#  DURATION (i64 ns) are device-native dense columns: comparisons, topN
+#  and min/max/count ride the same kernels as INT.  Years >= 8192 pack
+#  above 2^63 and would corrupt the int64 carries — the feed guard
+#  routes such columns to host.
+_DEVICE_ETS = (EvalType.INT, EvalType.REAL, EvalType.DATETIME,
+               EvalType.DURATION)
+_TIME_ETS = (EvalType.DATETIME, EvalType.DURATION)
 
 # TopN sort-key sentinels (float64 keys; any real data is far inside these)
 _EXCLUDED_ASC = 1e308
@@ -265,6 +272,9 @@ class DeviceRunner:
                     return None
                 if a.arg is not None:
                     r = build_rpn(a.arg)
+                    if r.ret_type in _TIME_ETS and a.kind not in (
+                            "count", "min", "max", "first"):
+                        return None     # SUM(datetime) etc. → host
                     agg_rpns.append(r)
                     rpns_to_check.append(r)
                     specs.append(AggSpec(a.kind, i, r.ret_type))
@@ -813,6 +823,17 @@ class DeviceRunner:
             key2 = vv if desc else -vv
             null_key = jnp.int32(lo.min + 1) if desc else jnp.int32(lo.max)
             excl = jnp.int32(lo.min)
+        elif v.dtype in (jnp.int64, jnp.uint64):
+            # exact 64-bit candidate keys: an f64 key collapses values
+            # within 512 of each other at DATETIME magnitudes (~2^61),
+            # and top_k over collapsed ties can DROP the true top rows
+            # before the host refine ever sees them.  u64 cores are
+            # < 2^63 (feed guard) so the int64 view preserves order.
+            lo = np.iinfo(np.int64)
+            vv = jnp.maximum(v.astype(jnp.int64), lo.min + 2)
+            key2 = vv if desc else -vv
+            null_key = jnp.int64(lo.min + 1) if desc else jnp.int64(lo.max)
+            excl = jnp.int64(lo.min)
         else:
             keyf = jnp.asarray(v, jnp.float64)
             key2 = keyf if desc else -keyf
@@ -910,6 +931,9 @@ class DeviceRunner:
         # key/arg expressions, not just on which columns are shipped
         meta_key = (dag.plan_key(), dag.ranges)
         meta = self._request_meta(storage, meta_key)
+        if meta.get("force_host"):
+            from ..executors.runner import BatchExecutorsRunner
+            return BatchExecutorsRunner(dag, storage).handle_request()
 
         memo: dict = {}
 
@@ -943,6 +967,14 @@ class DeviceRunner:
                 for ci in plan.used_cols:
                     col = batch.columns[ci]
                     dt = _device_dtype(col.eval_type, col.values)
+                    if dt == np.dtype(np.uint64) and col.values.size \
+                            and int(col.values.max()) >= (1 << 63):
+                        # packed cores above 2^63 (year >= 8192) would
+                        # wrap in the int64 state carries.  Remember the
+                        # verdict: repeat requests must not rebuild the
+                        # preceding columns just to re-discover it.
+                        meta["force_host"] = True
+                        raise _FallbackToHost("u64 column beyond int64")
                     cols.append((np.ascontiguousarray(
                         col.values.astype(dt, copy=False)),
                         np.ascontiguousarray(col.validity)))
@@ -951,15 +983,15 @@ class DeviceRunner:
                 meta.setdefault("dtypes", tuple(dts))
             return meta["host_cols"]
 
-        if "dtypes" not in meta:
-            host_cols()
-        dtypes = meta["dtypes"]
-
-        feed_key = (tuple(plan.scan.columns[ci].col_id
-                          for ci in plan.used_cols),
-                    tuple(dtypes), dag.ranges)
-        feed = self._get_feed(storage, feed_key, host_cols, n)
         try:
+            if "dtypes" not in meta:
+                host_cols()
+            dtypes = meta["dtypes"]
+
+            feed_key = (tuple(plan.scan.columns[ci].col_id
+                              for ci in plan.used_cols),
+                        tuple(dtypes), dag.ranges)
+            feed = self._get_feed(storage, feed_key, host_cols, n)
             if plan.kind == "simple_agg":
                 result = self._run_simple(dag, plan, dtypes, n, feed)
             elif plan.kind == "hash_agg":
@@ -1450,12 +1482,16 @@ class DeviceRunner:
         cand_cols = [(v[gidx], m[gidx]) for v, m in host_cols()]
         ov, _om = eval_rpn(plan.order_rpn, cand_cols, len(gidx), np)
         ov = np.broadcast_to(ov, (len(gidx),))
-        if plan.order_rpn.ret_type is EvalType.INT:
-            # exact int ordering (no f64 collapse above 2^53); NULL is the
-            # smallest value, so asc → NULL first, desc → NULL last.
-            # Clamp to min+2 so negation cannot overflow int64.min.
+        if plan.order_rpn.ret_type in (EvalType.INT, EvalType.DATETIME,
+                                       EvalType.DURATION):
+            # exact int ordering (no f64 collapse above 2^53 — a packed
+            # DATETIME core at ~2^61 loses sub-millisecond bits in f64);
+            # NULL is the smallest value, so asc → NULL first, desc →
+            # NULL last.  Clamp to min+2 so negation cannot overflow.
+            # DATETIME u64 cores are < 2^63 (feed guard) so the int64
+            # view is order-preserving.
             lo, hi = np.iinfo(np.int64).min, np.iinfo(np.int64).max
-            vals = np.maximum(np.asarray(ov, dtype=np.int64), lo + 2)
+            vals = np.maximum(np.asarray(ov).astype(np.int64), lo + 2)
             if plan.order_desc:
                 key = np.where(ok, -vals, hi)
             else:

@@ -49,7 +49,14 @@ class _AggState:
     def __init__(self, kind: str, et: Optional[EvalType]):
         self.kind = kind
         self.et = et
-        dtype = np.float64 if et is EvalType.REAL else np.int64
+        if et is EvalType.REAL:
+            dtype = np.float64
+        elif et in (EvalType.DATETIME, EvalType.ENUM, EvalType.SET):
+            # unsigned cores: mixing them with int64 identities would
+            # silently promote to float64 (and round above 2^53)
+            dtype = np.uint64
+        else:
+            dtype = np.int64
         self.dec = et is EvalType.DECIMAL
         # obj: per-row python loops for order-sensitive states (BYTES
         # and DECIMAL both compare as python objects)
@@ -65,11 +72,12 @@ class _AggState:
             if self.obj:
                 self.vals: list = []
             else:
-                ident = (np.inf if kind == "min" else -np.inf) \
-                    if dtype == np.float64 else \
-                    (np.iinfo(np.int64).max if kind == "min"
-                     else np.iinfo(np.int64).min)
-                self.ident = ident
+                if dtype == np.float64:
+                    ident = np.inf if kind == "min" else -np.inf
+                else:
+                    info = np.iinfo(dtype)
+                    ident = info.max if kind == "min" else info.min
+                self.ident = dtype(ident)
                 self.vals = np.zeros(0, dtype=dtype)
         if kind == "first":
             self.first_vals: list = []

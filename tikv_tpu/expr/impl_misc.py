@@ -152,6 +152,8 @@ def register() -> None:
             k = int(k)
             if k >= 0:
                 return int(x)
+            if -k > 18:
+                return 0        # 10^19 exceeds every int64 magnitude
             m = 10 ** (-k)
             q, r = divmod(abs(int(x)), m)
             q += 1 if r * 2 >= m else 0     # half away from zero
@@ -213,11 +215,27 @@ def register() -> None:
         (av, am) = a
 
         def one(s):
-            try:
-                return int(ipaddress.IPv4Address(
-                    s.decode() if isinstance(s, bytes) else s))
-            except (ValueError, UnicodeDecodeError):
+            """MySQL accepts SHORT forms: '127.1' = 127.0.0.1 is NOT a
+            dotted quad but parses (the last part fills the remaining
+            bytes) — ipaddress alone would reject it."""
+            if isinstance(s, (bytes, bytearray)):
+                s = s.decode("utf-8", "replace")
+            parts = s.strip().split(".")
+            if not 1 <= len(parts) <= 4:
                 return None
+            try:
+                nums = [int(p) for p in parts]
+            except ValueError:
+                return None
+            *heads, last = nums
+            fill = 4 - len(heads)
+            if any(not 0 <= h <= 255 for h in heads) or \
+                    not 0 <= last < (1 << (8 * fill)):
+                return None
+            acc = 0
+            for h in heads:
+                acc = (acc << 8) | h
+            return (acc << (8 * fill)) | last
         res = _uf(one, 1)(np.asarray(av, object))
         bad = _nulls(res)
         return np.where(bad, 0, res).astype(np.int64), \
@@ -340,7 +358,9 @@ def register() -> None:
                     break
             v = int(num) if num else 0
             if neg:
-                v = (1 << 64) - v if v else 0   # MySQL u64 wrap
+                v = ((1 << 64) - v) % (1 << 64)     # MySQL u64 wrap
+            else:
+                v %= 1 << 64
             return oct(v)[2:].encode()
         return _uf(one, 1)(np.asarray(av, object)), am
 

@@ -139,7 +139,11 @@ def _minmax_identity(xp, dtype, is_min: bool):
     if dt.kind == "f":
         return dt.type(np.inf) if is_min else dt.type(-np.inf)
     info = np.iinfo(dt)
-    return dt.type(info.max) if is_min else dt.type(info.min)
+    # uint64's max would WRAP to -1 in the int64 state carries
+    # (_canon_state); values are guarded < 2^63 (device feed guard), so
+    # int64 max is a valid MIN identity for unsigned columns
+    hi = min(info.max, np.iinfo(np.int64).max)
+    return dt.type(hi) if is_min else dt.type(info.min)
 
 
 # ---------------------------------------------------------------------------
