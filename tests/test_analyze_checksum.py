@@ -172,3 +172,108 @@ def test_analyze_and_checksum_over_network():
         assert r2["total_kvs"] == 300 and r2["checksum"] != 0
     finally:
         node.stop()
+
+
+def test_analyze_device_parity():
+    """Device ANALYZE (one jnp.sort per column) must match the host
+    numpy histograms exactly: bounds, cumulative counts, null/distinct."""
+    import numpy as np
+
+    from tikv_tpu.copr.analyze import AnalyzeReq, analyze_columns
+    from tikv_tpu.copr.endpoint import Endpoint
+    from tikv_tpu.datatype import Column, EvalType, FieldType
+    from tikv_tpu.device import DeviceRunner
+    from tikv_tpu.executors.columnar import ColumnarTable
+    from tikv_tpu.testing.fixture import Table, TableColumn
+
+    rng = np.random.default_rng(5)
+    n = 50_000
+    table = Table(8950, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("v", 2, FieldType.long()),
+        TableColumn("r", 3, FieldType.double()),
+        TableColumn("s", 4, FieldType.var_char()),
+    ))
+    v = rng.integers(-10**6, 10**6, n).astype(np.int64)
+    r = rng.normal(0, 100, n)
+    vvalid = (np.arange(n) % 7) != 2
+    strs = np.array([b"s%03d" % (i % 50) for i in range(n)], object)
+    snap = ColumnarTable.from_arrays(table, np.arange(n, dtype=np.int64), {
+        "v": Column(EvalType.INT, v, vvalid),
+        "r": Column(EvalType.REAL, r, np.ones(n, bool)),
+        "s": Column(EvalType.BYTES, strs, np.ones(n, bool)),
+    })
+    # single-device mesh (the analyze sort path is single-chip; the
+    # 8-CPU conftest mesh would return None → host)
+    import jax
+
+    from tikv_tpu.parallel.mesh import make_mesh
+    runner = DeviceRunner(mesh=make_mesh(jax.devices()[:1]))
+    assert runner._single
+    ep = Endpoint(lambda req: snap, device_runner=runner,
+                  device_row_threshold=1000)
+    from tikv_tpu.testing.dag import DagSelect
+    dag = DagSelect.from_table(table).build()
+    areq = AnalyzeReq(dag.executors[0], dag.ranges, buckets=32)
+    got = ep.handle_analyze(areq, storage=snap)["columns"]
+    # host oracle over the same batch
+    batch = snap.scan_columns(areq.scan, tuple(areq.ranges))
+    want = analyze_columns(batch, areq.scan.columns, 32)
+    assert len(got) == len(want)
+    assert got[1].total == n and got[1].distinct > 40_000  # non-vacuous
+    for g, w in zip(got, want):
+        assert g.col_id == w.col_id and g.total == w.total
+        assert g.null_count == w.null_count
+        assert g.distinct == w.distinct
+        assert len(g.buckets) == len(w.buckets)
+        for (gb, gc), (wb, wc) in zip(g.buckets, w.buckets):
+            assert gc == wc
+            if isinstance(wb, float):
+                assert gb == pytest.approx(wb)
+            else:
+                assert gb == wb
+
+
+def test_analyze_device_nan_parity():
+    """REAL columns containing NaN: device stats must match the host
+    (NaN sorts last, every NaN counts distinct — +inf padding would
+    leak into the valid prefix)."""
+    import jax
+    import numpy as np
+
+    from tikv_tpu.copr.analyze import AnalyzeReq, analyze_columns
+    from tikv_tpu.copr.endpoint import Endpoint
+    from tikv_tpu.datatype import Column, EvalType, FieldType
+    from tikv_tpu.device import DeviceRunner
+    from tikv_tpu.executors.columnar import ColumnarTable
+    from tikv_tpu.parallel.mesh import make_mesh
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import Table, TableColumn
+
+    rng = np.random.default_rng(9)
+    n = 10_000
+    r = rng.normal(0, 10, n)
+    r[::97] = np.nan                    # valid NaN rows
+    valid = (np.arange(n) % 11) != 3    # plus SQL NULLs
+    table = Table(8955, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("r", 2, FieldType.double()),
+    ))
+    snap = ColumnarTable.from_arrays(table, np.arange(n, dtype=np.int64),
+                                     {"r": Column(EvalType.REAL, r, valid)})
+    runner = DeviceRunner(mesh=make_mesh(jax.devices()[:1]))
+    ep = Endpoint(lambda req: snap, device_runner=runner,
+                  device_row_threshold=100)
+    dag = DagSelect.from_table(table).build()
+    areq = AnalyzeReq(dag.executors[0], dag.ranges, buckets=16)
+    got = ep.handle_analyze(areq, storage=snap)["columns"][1]
+    batch = snap.scan_columns(areq.scan, tuple(areq.ranges))
+    want = analyze_columns(batch, areq.scan.columns, 16)[1]
+    assert got.null_count == want.null_count
+    assert got.distinct == want.distinct
+    assert len(got.buckets) == len(want.buckets)
+    for (gb, gc), (wb, wc) in zip(got.buckets, want.buckets):
+        assert gc == wc
+        assert (np.isnan(gb) and np.isnan(wb)) or gb == pytest.approx(wb)

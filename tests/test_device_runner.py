@@ -422,3 +422,31 @@ def test_datetime_topn_microsecond_precision(runner):
     assert [r[1] for r in dev.rows()] == \
         sorted(t.tolist(), reverse=True)[:10]
     assert host.rows() == dev.rows()
+
+
+def test_np_only_sigs_decline_device(runner):
+    """Time extractors (raw-numpy bodies) must keep the plan on host —
+    tracing them under jit would crash the request."""
+    table, snap = make_time_snapshot(seed=35)
+    sel = DagSelect.from_table(table, ["id", "k", "t", "d"])
+    dag = sel.where(Expr.call(
+        "EqInt", Expr.call("Year", sel.col("t")),
+        Expr.const(2001, EvalType.INT))) \
+        .aggregate([sel.col("k")], [("count_star", None)]).build()
+    assert not runner.supports(dag)
+    # endpoint routing still answers correctly (host path)
+    host = BatchExecutorsRunner(dag, snap).handle_request()
+    assert sum(r[0] for r in host.rows()) > 0
+
+
+def test_xp_control_sigs_ride_device(runner):
+    """IfInt/Coalesce are pure-xp: still admitted to device plans."""
+    table, snap = make_snapshot(6_000, seed=36)
+    sel = DagSelect.from_table(table, ["id", "k", "v"])
+    dag = sel.aggregate([], [("sum", Expr.call(
+        "IfInt", Expr.call("GtInt", sel.col("v"),
+                           Expr.const(0, EvalType.INT)),
+        sel.col("v"), Expr.const(0, EvalType.INT)))]).build()
+    assert runner.supports(dag)
+    host, dev = run_both(runner, dag, snap)
+    assert_same(host, dev)

@@ -153,3 +153,9 @@ def test_review_regressions_2():
     # OCT beyond u64 wraps, never emits malformed text
     v, m = run("OctString", [scol([b"-18446744073709551617"])], [B])
     assert v[0] == oct((2**64 - (2**64 + 1)) % 2**64)[2:].encode()
+
+
+def test_inet_aton_strict_digits():
+    v, m = run("InetAton", [scol([b"127.+1", b"1_0.0.0.1",
+                                  b"127 .0.0.1"])], [B])
+    assert list(m) == [False, False, False]

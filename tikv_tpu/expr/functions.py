@@ -44,16 +44,27 @@ class RpnFnMeta:
     # nondeterministic 0-arity sigs (UUID, RAND) must produce one value
     # PER ROW — eval passes ``n_rows=``
     needs_rows: bool = False
+    # implementation is pure-``xp`` and traceable under jax.jit — the
+    # DEVICE GATE (_rpn_device_safe) admits only these; raw-numpy
+    # bodies (string/json/time/decimal families) crash on tracers
+    device_safe: bool = False
 
 
 FUNCTIONS: dict[str, RpnFnMeta] = {}
 
 
+_DEVICE_SAFE_DEFAULT = False
+
+
 def rpn_fn(name: str, arity: Optional[int], ret: EvalType, args: tuple,
-           needs_ctx: bool = False, needs_rows: bool = False):
+           needs_ctx: bool = False, needs_rows: bool = False,
+           device_safe: Optional[bool] = None):
+    if device_safe is None:
+        device_safe = _DEVICE_SAFE_DEFAULT
+
     def deco(fn):
         FUNCTIONS[name] = RpnFnMeta(name, arity, ret, args, fn,
-                                    needs_ctx, needs_rows)
+                                    needs_ctx, needs_rows, device_safe)
         return fn
     return deco
 
@@ -577,12 +588,18 @@ def _register_math():
         return out, am
 
 
+# the core numeric families are written against ``xp`` and trace under
+# jit — they form the device-safe sig set
+_DEVICE_SAFE_DEFAULT = True
 _register_arith()
 _register_compare()
 _register_logic()
 _register_control()
+_DEVICE_SAFE_DEFAULT = False
 _register_cast()
+_DEVICE_SAFE_DEFAULT = True
 _register_math()
+_DEVICE_SAFE_DEFAULT = False
 
 # family modules (imported late: they need the registry decorator above)
 from . import impl_json as _impl_json      # noqa: E402

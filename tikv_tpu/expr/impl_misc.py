@@ -223,10 +223,12 @@ def register() -> None:
             parts = s.strip().split(".")
             if not 1 <= len(parts) <= 4:
                 return None
-            try:
-                nums = [int(p) for p in parts]
-            except ValueError:
+            # strict decimal digits only: python int() would admit
+            # '+1', '1_0' and padded parts that MySQL rejects
+            if any(not p or not all("0" <= ch <= "9" for ch in p)
+                   for p in parts):
                 return None
+            nums = [int(p) for p in parts]
             *heads, last = nums
             fill = 4 - len(heads)
             if any(not 0 <= h <= 255 for h in heads) or \

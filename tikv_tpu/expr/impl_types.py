@@ -66,8 +66,11 @@ def register() -> None:
                         am & bm
                 continue
 
-            @rpn_fn(stem + fam, 2, I, (ty, ty))
+            @rpn_fn(stem + fam, 2, I, (ty, ty),
+                    device_safe=(ty in (T, D)))
             def _cmp(xp, a, b, _op=op, _ty=ty):
+                # Time/Duration: plain xp comparisons on packed cores —
+                # traceable, so these ride the device gate
                 (av, am), (bv, bm) = a, b
                 return _ibool(xp, _cmp_vals(_ty, xp, av, bv, _op)), am & bm
 
