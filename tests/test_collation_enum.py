@@ -253,3 +253,24 @@ def test_explicit_call_collation_beats_columns():
                   Expr.const(b"a", B))
     v, m = eval_rpn(build_rpn(e), [a], 1, np)
     assert list(v) == [1]
+
+
+def test_like_honors_collation():
+    """LIKE under a ci collation matches case-insensitively (binary
+    stays exact)."""
+    a = scol([b"Hello World", b"HELLO x"])
+    pat = Expr.const(b"hello%", B)
+    esc = Expr.const(92, I)
+    e = Expr.call("LikeSig", Expr.column(0, B), pat, esc)
+    v, m = eval_rpn(build_rpn(e), [a], 2, np)
+    assert list(v) == [0, 0]            # binary: no match
+    e = Expr.call("LikeSig", Expr.column(0, B, collation=CI), pat, esc)
+    v, m = eval_rpn(build_rpn(e), [a], 2, np)
+    assert list(v) == [1, 1]
+    # unicode case folding
+    e = Expr.call("LikeSig",
+                  Expr.column(0, B, collation=CI),
+                  Expr.const("éCOLE%".encode(), B), esc)
+    v, m = eval_rpn(build_rpn(e),
+                    [scol(["École de Paris".encode()])], 1, np)
+    assert list(v) == [1]

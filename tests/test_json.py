@@ -281,3 +281,43 @@ def test_json_valid_const_broadcasts():
                   Expr.const({"k": 2}, EvalType.JSON))
     v, m = eval_rpn(build_rpn(e), [docs], 3, np)
     assert np.broadcast_to(v, (3,)).tolist() == [1, 1, 1]
+
+
+def test_json_search():
+    doc = {"a": "abc", "b": {"c": "abd"}, "l": ["xbc", 5]}
+    assert mj.search(doc, b"one", b"ab%") == "$.a"
+    assert sorted(mj.search(doc, b"all", b"ab_")) == ["$.a", "$.b.c"]
+    assert mj.search(doc, b"all", b"%bc%") == ["$.a", "$.l[0]"]
+    assert mj.search(doc, b"one", b"zz") is mj.NOT_FOUND
+    # MySQL autowrap: exactly one match under 'all' is a BARE path
+    assert mj.search(doc, b"all", b"abc") == "$.a"
+    # concrete scope path restricts the search
+    assert mj.search(doc, b"all", b"ab%",
+                     scope_paths=(b"$.b",)) == "$.b.c"
+    import pytest as _pt
+    with _pt.raises(ValueError):
+        mj.search(doc, b"all", b"ab%", scope_paths=(b"$.*",))
+    v, m = run_sig("JsonSearchSig",
+                   [jcol([doc, doc]), jcol([b"one", b"all"]),
+                    jcol([b"ab%", b"zz"])], [J, B, B])
+    assert v[0] == "$.a" and list(m) == [True, False]
+
+
+def test_json_array_append():
+    doc = {"a": [1, 2], "b": 3}
+    assert mj.array_append(doc, [(b"$.a", 9)]) == \
+        {"a": [1, 2, 9], "b": 3}
+    assert mj.array_append(doc, [(b"$.b", 9)]) == \
+        {"a": [1, 2], "b": [3, 9]}        # scalar wraps
+    assert mj.array_append(doc, [(b"$.zz", 9)]) == doc  # absent: no-op
+    assert doc == {"a": [1, 2], "b": 3}   # input untouched
+    v, m = run_sig("JsonArrayAppendSig",
+                   [jcol([doc]), jcol([b"$.a"]), jcol([7])], [J, B, J])
+    assert v[0] == {"a": [1, 2, 7], "b": 3}
+
+
+def test_json_storage_size_and_pretty():
+    v, m = run_sig("JsonStorageSizeSig", [jcol([{"a": 1}])], [J])
+    assert int(v[0]) == len(b'{"a": 1}')
+    v, m = run_sig("JsonPrettySig", [jcol([{"a": [1]}])], [J])
+    assert v[0] == b'{\n  "a": [\n    1\n  ]\n}'

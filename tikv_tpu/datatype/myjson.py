@@ -389,3 +389,105 @@ def _remove_leg(v, legs):
         else:
             del v[leg[1]]
     return v
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=1024)
+def _like_pattern(pattern: str, escape: int):
+    """LIKE pattern -> compiled anchored regex (cached per pattern —
+    JSON_SEARCH visits thousands of string nodes with ONE pattern)."""
+    import re
+    esc = chr(escape & 0xFF)
+    out = ["^"]
+    i, n = 0, len(pattern)
+    while i < n:
+        ch = pattern[i]
+        if ch == esc and i + 1 < n:
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append("(?s:.*)")
+        elif ch == "_":
+            out.append("(?s:.)")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    out.append("$")
+    return re.compile("".join(out))
+
+
+def search(doc, one_or_all: bytes, target: bytes, escape: int = 92,
+           scope_paths=()) -> object:
+    """JSON_SEARCH: paths of STRING values LIKE ``target``; 'one' stops
+    at the first hit.  ONE match returns the bare path string, several
+    return an array (MySQL autowraps only on multiple matches); none ->
+    NOT_FOUND.  ``scope_paths`` restrict the search to concrete
+    subtrees; wildcard scopes raise ValueError (NULL at the sig layer).
+    """
+    if isinstance(one_or_all, (bytes, bytearray)):
+        one_or_all = one_or_all.decode()
+    if isinstance(target, (bytes, bytearray)):
+        target = target.decode("utf-8", "replace")
+    rx = _like_pattern(target, escape)
+    found: list = []
+
+    def walk(v, path):
+        if isinstance(v, str) and rx.match(v):
+            found.append(path)
+            if one_or_all == "one":
+                return True
+        if isinstance(v, dict):
+            for k, x in v.items():
+                key = k if k.isalnum() and not k[:1].isdigit() \
+                    else '"' + k.replace('"', '\\"') + '"'
+                if walk(x, f"{path}.{key}"):
+                    return True
+        elif isinstance(v, list):
+            for i, x in enumerate(v):
+                if walk(x, f"{path}[{i}]"):
+                    return True
+        return False
+
+    if scope_paths:
+        for sp in scope_paths:
+            legs = parse_path(sp)
+            if path_is_wild(legs):
+                raise ValueError("wildcard scope paths unsupported")
+            sub = extract(doc, [sp])
+            if sub is NOT_FOUND:
+                continue
+            prefix = (sp.decode() if isinstance(sp, (bytes, bytearray))
+                      else sp).strip()
+            if walk(sub, prefix):
+                break
+    else:
+        walk(doc, "$")
+    if not found:
+        return NOT_FOUND
+    if len(found) == 1:
+        return found[0]
+    return found
+
+
+
+def array_append(doc, pairs):
+    """JSON_ARRAY_APPEND: value at each path wraps to an array (if not
+    one already) and the new element appends (json/modifier.rs)."""
+    import copy
+    out = copy.deepcopy(doc)
+    for path, value in pairs:
+        legs = parse_path(path)
+        if path_is_wild(legs):
+            raise ValueError("wildcards not allowed")
+        target = extract(out, [path])
+        if target is NOT_FOUND:
+            continue
+        if isinstance(target, list):
+            new = target + [copy.deepcopy(value)]
+        else:
+            new = [copy.deepcopy(target), copy.deepcopy(value)]
+        out = json_set(out, [(path, new)]) if legs else new
+    return out

@@ -1544,16 +1544,18 @@ def _analyze_on_device(runner, dag, storage, n_buckets: int):
     # per-column work must overlap
     pending: dict = {}
     out_by_idx: dict = {}
+    host_cols_idx: list = []
     for i, info in enumerate(scan.columns):
         col = batch.columns[i]
         et = col.eval_type
         if et not in _DEVICE_ETS or (
                 col.values.dtype == np.uint64 and col.values.size
                 and int(col.values.max()) >= (1 << 63)):
-            # BYTES/JSON/etc or beyond-int64 cores: host numpy path
-            out_by_idx[i] = analyze_columns(
-                ColumnBatch([batch.schema[i]], [col]), [info],
-                n_buckets)[0]
+            # BYTES/JSON/etc or beyond-int64 cores: host numpy path —
+            # DEFERRED until every device column has been dispatched
+            # (a python-object sort here would serialize in front of
+            # the device work this split exists to overlap)
+            host_cols_idx.append(i)
             continue
         # stats must be EXACT: REAL keeps float64 (the f32 device column
         # resolution would collapse near-equal doubles, changing
@@ -1569,6 +1571,11 @@ def _analyze_on_device(runner, dag, storage, n_buckets: int):
         pending[i] = (info, et, kern(
             jnp.asarray(vals), jnp.asarray(valid),
             jnp.asarray(n, jnp.int64)))
+    # host-fallback columns run while the device crunches the rest
+    for i in host_cols_idx:
+        out_by_idx[i] = analyze_columns(
+            ColumnBatch([batch.schema[i]], [batch.columns[i]]),
+            [scan.columns[i]], n_buckets)[0]
     # phase 2 — ONE batched readback for every column (copy_to_host
     # issued for all before the first blocking fetch), then unpack
     fetched = runner._readback({i: dev for i, (_info, _et, dev)

@@ -53,14 +53,9 @@ class RpnFnMeta:
 FUNCTIONS: dict[str, RpnFnMeta] = {}
 
 
-_DEVICE_SAFE_DEFAULT = False
-
-
 def rpn_fn(name: str, arity: Optional[int], ret: EvalType, args: tuple,
            needs_ctx: bool = False, needs_rows: bool = False,
-           device_safe: Optional[bool] = None):
-    if device_safe is None:
-        device_safe = _DEVICE_SAFE_DEFAULT
+           device_safe: bool = False):
 
     def deco(fn):
         FUNCTIONS[name] = RpnFnMeta(name, arity, ret, args, fn,
@@ -68,6 +63,13 @@ def rpn_fn(name: str, arity: Optional[int], ret: EvalType, args: tuple,
         return fn
     return deco
 
+
+
+
+def _rpn_fn_xp(name, arity, ret, args):
+    """rpn_fn for pure-``xp`` traceable bodies: explicitly device-safe
+    at the declaration site (never inferred from registration order)."""
+    return rpn_fn(name, arity, ret, args, device_safe=True)
 
 def _bool_dtype(xp):
     return xp.int32
@@ -86,7 +88,7 @@ def _register_arith():
     I, R = EvalType.INT, EvalType.REAL
 
     def binop(name, ret, ty, op):
-        @rpn_fn(name, 2, ret, (ty, ty))
+        @_rpn_fn_xp(name, 2, ret, (ty, ty))
         def _f(xp, a, b, _op=op):
             (av, am), (bv, bm) = a, b
             return _op(xp, av, bv), am & bm
@@ -99,14 +101,14 @@ def _register_arith():
     binop("MinusReal", R, R, lambda xp, a, b: a - b)
     binop("MultiplyReal", R, R, lambda xp, a, b: a * b)
 
-    @rpn_fn("DivideReal", 2, R, (R, R))
+    @_rpn_fn_xp("DivideReal", 2, R, (R, R))
     def divide_real(xp, a, b):
         (av, am), (bv, bm) = a, b
         zero = bv == 0
         safe = xp.where(zero, xp.ones_like(bv), bv)
         return av / safe, am & bm & ~zero
 
-    @rpn_fn("IntDivideInt", 2, I, (I, I))
+    @_rpn_fn_xp("IntDivideInt", 2, I, (I, I))
     def int_divide_int(xp, a, b):
         (av, am), (bv, bm) = a, b
         zero = bv == 0
@@ -117,7 +119,7 @@ def _register_arith():
         q = xp.where((r != 0) & ((av < 0) != (bv < 0)), q + 1, q)
         return q, am & bm & ~zero
 
-    @rpn_fn("ModInt", 2, I, (I, I))
+    @_rpn_fn_xp("ModInt", 2, I, (I, I))
     def mod_int(xp, a, b):
         (av, am), (bv, bm) = a, b
         zero = bv == 0
@@ -128,7 +130,7 @@ def _register_arith():
                            av // safe + 1, av // safe)) * safe
         return m, am & bm & ~zero
 
-    @rpn_fn("ModReal", 2, R, (R, R))
+    @_rpn_fn_xp("ModReal", 2, R, (R, R))
     def mod_real(xp, a, b):
         (av, am), (bv, bm) = a, b
         zero = bv == 0
@@ -136,22 +138,22 @@ def _register_arith():
         m = av - xp.trunc(av / safe) * safe
         return m, am & bm & ~zero
 
-    @rpn_fn("UnaryMinusInt", 1, I, (I,))
+    @_rpn_fn_xp("UnaryMinusInt", 1, I, (I,))
     def unary_minus_int(xp, a):
         (av, am) = a
         return -av, am
 
-    @rpn_fn("UnaryMinusReal", 1, R, (R,))
+    @_rpn_fn_xp("UnaryMinusReal", 1, R, (R,))
     def unary_minus_real(xp, a):
         (av, am) = a
         return -av, am
 
-    @rpn_fn("AbsInt", 1, I, (I,))
+    @_rpn_fn_xp("AbsInt", 1, I, (I,))
     def abs_int(xp, a):
         (av, am) = a
         return xp.abs(av), am
 
-    @rpn_fn("AbsReal", 1, R, (R,))
+    @_rpn_fn_xp("AbsReal", 1, R, (R,))
     def abs_real(xp, a):
         (av, am) = a
         return xp.abs(av), am
@@ -173,13 +175,13 @@ def _register_compare():
     }
     for stem, op in cmps.items():
         for suffix, ty in (("Int", I), ("Real", R)):
-            @rpn_fn(stem + suffix, 2, I, (ty, ty))
+            @_rpn_fn_xp(stem + suffix, 2, I, (ty, ty))
             def _f(xp, a, b, _op=op):
                 (av, am), (bv, bm) = a, b
                 return _ibool(xp, _op(xp, av, bv)), am & bm
 
     for suffix, ty in (("Int", I), ("Real", R)):
-        @rpn_fn("NullEq" + suffix, 2, I, (ty, ty))
+        @_rpn_fn_xp("NullEq" + suffix, 2, I, (ty, ty))
         def null_eq(xp, a, b):
             (av, am), (bv, bm) = a, b
             both_null = ~am & ~bm
@@ -188,7 +190,7 @@ def _register_compare():
             return _ibool(xp, both_null | eq), ones
 
     for suffix, ty in (("Int", I), ("Real", R)):
-        @rpn_fn("GreatestInt" if ty is I else "GreatestReal", None, ty, (ty,))
+        @_rpn_fn_xp("GreatestInt" if ty is I else "GreatestReal", None, ty, (ty,))
         def greatest(xp, *pairs):
             vals = [p[0] for p in pairs]
             masks = [p[1] for p in pairs]
@@ -200,7 +202,7 @@ def _register_compare():
                 valid = valid & m
             return out, valid
 
-        @rpn_fn("LeastInt" if ty is I else "LeastReal", None, ty, (ty,))
+        @_rpn_fn_xp("LeastInt" if ty is I else "LeastReal", None, ty, (ty,))
         def least(xp, *pairs):
             vals = [p[0] for p in pairs]
             masks = [p[1] for p in pairs]
@@ -213,7 +215,7 @@ def _register_compare():
             return out, valid
 
     for suffix, ty in (("Int", I), ("Real", R)):
-        @rpn_fn("In" + suffix, None, I, (ty,))
+        @_rpn_fn_xp("In" + suffix, None, I, (ty,))
         def in_list(xp, *pairs):
             # pairs[0] is the probe; the rest the list. MySQL IN: NULL if no
             # match and any list element (or the probe) is NULL.
@@ -236,7 +238,7 @@ def _register_compare():
 def _register_logic():
     I, R = EvalType.INT, EvalType.REAL
 
-    @rpn_fn("LogicalAnd", 2, I, (I, I))
+    @_rpn_fn_xp("LogicalAnd", 2, I, (I, I))
     def logical_and(xp, a, b):
         (av, am), (bv, bm) = a, b
         a_false = am & (av == 0)
@@ -245,7 +247,7 @@ def _register_logic():
         valid = (am & bm) | a_false | b_false
         return value, valid
 
-    @rpn_fn("LogicalOr", 2, I, (I, I))
+    @_rpn_fn_xp("LogicalOr", 2, I, (I, I))
     def logical_or(xp, a, b):
         (av, am), (bv, bm) = a, b
         a_true = am & (av != 0)
@@ -254,76 +256,76 @@ def _register_logic():
         valid = (am & bm) | a_true | b_true
         return value, valid
 
-    @rpn_fn("LogicalXor", 2, I, (I, I))
+    @_rpn_fn_xp("LogicalXor", 2, I, (I, I))
     def logical_xor(xp, a, b):
         (av, am), (bv, bm) = a, b
         return _ibool(xp, (av != 0) ^ (bv != 0)), am & bm
 
-    @rpn_fn("UnaryNotInt", 1, I, (I,))
+    @_rpn_fn_xp("UnaryNotInt", 1, I, (I,))
     def unary_not_int(xp, a):
         (av, am) = a
         return _ibool(xp, av == 0), am
 
-    @rpn_fn("UnaryNotReal", 1, I, (R,))
+    @_rpn_fn_xp("UnaryNotReal", 1, I, (R,))
     def unary_not_real(xp, a):
         (av, am) = a
         return _ibool(xp, av == 0), am
 
     for suffix, ty in (("Int", I), ("Real", R)):
-        @rpn_fn("IsNull" + suffix, 1, I, (ty,))
+        @_rpn_fn_xp("IsNull" + suffix, 1, I, (ty,))
         def is_null(xp, a):
             (av, am) = a
             return _ibool(xp, ~am), xp.ones_like(am)
 
-    @rpn_fn("IntIsTrue", 1, I, (I,))
+    @_rpn_fn_xp("IntIsTrue", 1, I, (I,))
     def int_is_true(xp, a):
         (av, am) = a
         return _ibool(xp, am & (av != 0)), xp.ones_like(am)
 
-    @rpn_fn("IntIsFalse", 1, I, (I,))
+    @_rpn_fn_xp("IntIsFalse", 1, I, (I,))
     def int_is_false(xp, a):
         (av, am) = a
         return _ibool(xp, am & (av == 0)), xp.ones_like(am)
 
-    @rpn_fn("RealIsTrue", 1, I, (R,))
+    @_rpn_fn_xp("RealIsTrue", 1, I, (R,))
     def real_is_true(xp, a):
         (av, am) = a
         return _ibool(xp, am & (av != 0)), xp.ones_like(am)
 
-    @rpn_fn("RealIsFalse", 1, I, (R,))
+    @_rpn_fn_xp("RealIsFalse", 1, I, (R,))
     def real_is_false(xp, a):
         (av, am) = a
         return _ibool(xp, am & (av == 0)), xp.ones_like(am)
 
     # Bit ops — always-valid int semantics (impl_op.rs bit_and etc.)
-    @rpn_fn("BitAndSig", 2, I, (I, I))
+    @_rpn_fn_xp("BitAndSig", 2, I, (I, I))
     def bit_and(xp, a, b):
         (av, am), (bv, bm) = a, b
         return av & bv, am & bm
 
-    @rpn_fn("BitOrSig", 2, I, (I, I))
+    @_rpn_fn_xp("BitOrSig", 2, I, (I, I))
     def bit_or(xp, a, b):
         (av, am), (bv, bm) = a, b
         return av | bv, am & bm
 
-    @rpn_fn("BitXorSig", 2, I, (I, I))
+    @_rpn_fn_xp("BitXorSig", 2, I, (I, I))
     def bit_xor(xp, a, b):
         (av, am), (bv, bm) = a, b
         return av ^ bv, am & bm
 
-    @rpn_fn("BitNegSig", 1, I, (I,))
+    @_rpn_fn_xp("BitNegSig", 1, I, (I,))
     def bit_neg(xp, a):
         (av, am) = a
         return ~av, am
 
-    @rpn_fn("LeftShift", 2, I, (I, I))
+    @_rpn_fn_xp("LeftShift", 2, I, (I, I))
     def left_shift(xp, a, b):
         (av, am), (bv, bm) = a, b
         big = (bv < 0) | (bv >= 64)
         safe = xp.where(big, xp.zeros_like(bv), bv)
         return xp.where(big, xp.zeros_like(av), av << safe), am & bm
 
-    @rpn_fn("RightShift", 2, I, (I, I))
+    @_rpn_fn_xp("RightShift", 2, I, (I, I))
     def right_shift(xp, a, b):
         (av, am), (bv, bm) = a, b
         big = (bv < 0) | (bv >= 64)
@@ -338,18 +340,18 @@ def _register_logic():
 def _register_control():
     I, R = EvalType.INT, EvalType.REAL
     for suffix, ty in (("Int", I), ("Real", R)):
-        @rpn_fn("If" + suffix, 3, ty, (I, ty, ty))
+        @_rpn_fn_xp("If" + suffix, 3, ty, (I, ty, ty))
         def if_fn(xp, c, t, f):
             (cv, cm), (tv, tm), (fv, fm) = c, t, f
             cond = cm & (cv != 0)
             return xp.where(cond, tv, fv), xp.where(cond, tm, fm)
 
-        @rpn_fn("IfNull" + suffix, 2, ty, (ty, ty))
+        @_rpn_fn_xp("IfNull" + suffix, 2, ty, (ty, ty))
         def if_null(xp, a, b):
             (av, am), (bv, bm) = a, b
             return xp.where(am, av, bv), am | bm
 
-        @rpn_fn("CaseWhen" + suffix, None, ty, (ty,))
+        @_rpn_fn_xp("CaseWhen" + suffix, None, ty, (ty,))
         def case_when(xp, *pairs):
             # pairs: cond1, res1, cond2, res2, ..., [else]. First true cond wins.
             n = len(pairs)
@@ -366,7 +368,7 @@ def _register_control():
                 out_m = xp.where(hit, rm, out_m)
             return out_v, out_m
 
-        @rpn_fn("Coalesce" + suffix, None, ty, (ty,))
+        @_rpn_fn_xp("Coalesce" + suffix, None, ty, (ty,))
         def coalesce(xp, *pairs):
             out_v, out_m = pairs[-1]
             for (v, m) in reversed(pairs[:-1]):
@@ -382,21 +384,21 @@ def _register_control():
 def _register_cast():
     I, R = EvalType.INT, EvalType.REAL
 
-    @rpn_fn("CastIntAsInt", 1, I, (I,))
+    @_rpn_fn_xp("CastIntAsInt", 1, I, (I,))
     def cast_int_int(xp, a):
         return a
 
-    @rpn_fn("CastRealAsReal", 1, R, (R,))
+    @_rpn_fn_xp("CastRealAsReal", 1, R, (R,))
     def cast_real_real(xp, a):
         return a
 
-    @rpn_fn("CastIntAsReal", 1, R, (I,))
+    @_rpn_fn_xp("CastIntAsReal", 1, R, (I,))
     def cast_int_real(xp, a):
         (av, am) = a
         dt = "float32" if xp.__name__.startswith("jax") else "float64"
         return av.astype(dt), am
 
-    @rpn_fn("CastRealAsInt", 1, I, (R,))
+    @_rpn_fn_xp("CastRealAsInt", 1, I, (R,))
     def cast_real_int(xp, a):
         # MySQL rounds half away from zero on cast.
         (av, am) = a
@@ -492,7 +494,7 @@ def _register_math():
     I, R = EvalType.INT, EvalType.REAL
 
     def unary_real(name, op, domain=None):
-        @rpn_fn(name, 1, R, (R,))
+        @_rpn_fn_xp(name, 1, R, (R,))
         def _f(xp, a, _op=op, _dom=domain):
             (av, am) = a
             if _dom is not None:
@@ -520,12 +522,12 @@ def _register_math():
     unary_real("Radians", lambda xp, v: v * (3.141592653589793 / 180.0))
     unary_real("Degrees", lambda xp, v: v * (180.0 / 3.141592653589793))
 
-    @rpn_fn("Atan2Args", 2, R, (R, R))
+    @_rpn_fn_xp("Atan2Args", 2, R, (R, R))
     def atan2(xp, a, b):
         (av, am), (bv, bm) = a, b
         return xp.arctan2(av, bv), am & bm
 
-    @rpn_fn("Pow", 2, R, (R, R))
+    @_rpn_fn_xp("Pow", 2, R, (R, R))
     def pow_(xp, a, b):
         (av, am), (bv, bm) = a, b
         # guard 0^negative and negative^fractional
@@ -533,40 +535,40 @@ def _register_math():
         safe_a = xp.where(bad, xp.ones_like(av), av)
         return xp.power(safe_a, bv), am & bm & ~bad
 
-    @rpn_fn("Pi", 0, R, ())
+    @_rpn_fn_xp("Pi", 0, R, ())
     def pi(xp):
         one = xp.ones((), dtype=bool)
         return xp.asarray(3.141592653589793), one
 
-    @rpn_fn("SignReal", 1, I, (R,))
+    @_rpn_fn_xp("SignReal", 1, I, (R,))
     def sign(xp, a):
         (av, am) = a
         return xp.sign(av).astype(_bool_dtype(xp)), am
 
-    @rpn_fn("SignInt", 1, I, (I,))
+    @_rpn_fn_xp("SignInt", 1, I, (I,))
     def sign_int(xp, a):
         (av, am) = a
         return xp.sign(av), am
 
-    @rpn_fn("CeilIntToInt", 1, I, (I,))
+    @_rpn_fn_xp("CeilIntToInt", 1, I, (I,))
     def ceil_int(xp, a):
         return a
 
-    @rpn_fn("FloorIntToInt", 1, I, (I,))
+    @_rpn_fn_xp("FloorIntToInt", 1, I, (I,))
     def floor_int(xp, a):
         return a
 
-    @rpn_fn("RoundInt", 1, I, (I,))
+    @_rpn_fn_xp("RoundInt", 1, I, (I,))
     def round_int(xp, a):
         return a
 
-    @rpn_fn("TruncateReal", 2, R, (R, I))
+    @_rpn_fn_xp("TruncateReal", 2, R, (R, I))
     def truncate_real(xp, a, d):
         (av, am), (dv, dm) = a, d
         scale = xp.power(10.0, dv.astype(av.dtype))
         return xp.trunc(av * scale) / scale, am & dm
 
-    @rpn_fn("TruncateInt", 2, I, (I, I))
+    @_rpn_fn_xp("TruncateInt", 2, I, (I, I))
     def truncate_int(xp, a, d):
         (av, am), (dv, dm) = a, d
         neg = xp.where(dv < 0, -dv, xp.zeros_like(dv))
@@ -577,7 +579,7 @@ def _register_math():
         q = xp.where((av < 0) & (q * p != av), q + 1, q)
         return xp.where(dv < 0, q * p, av), am & dm
 
-    @rpn_fn("CRC32", 1, I, (EvalType.BYTES,))
+    @_rpn_fn_xp("CRC32", 1, I, (EvalType.BYTES,))
     def crc32(xp, a):
         # host-only (bytes); handled by the numpy path in eval.py
         import zlib
@@ -588,18 +590,12 @@ def _register_math():
         return out, am
 
 
-# the core numeric families are written against ``xp`` and trace under
-# jit — they form the device-safe sig set
-_DEVICE_SAFE_DEFAULT = True
 _register_arith()
 _register_compare()
 _register_logic()
 _register_control()
-_DEVICE_SAFE_DEFAULT = False
 _register_cast()
-_DEVICE_SAFE_DEFAULT = True
 _register_math()
-_DEVICE_SAFE_DEFAULT = False
 
 # family modules (imported late: they need the registry decorator above)
 from . import impl_json as _impl_json      # noqa: E402

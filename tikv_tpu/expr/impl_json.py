@@ -251,6 +251,73 @@ def register() -> None:
             out[i] = mj.json_remove(dv[i], [v[i] for v, _m in rows])
         return out, ok
 
+    @rpn_fn("JsonSearchSig", None, J, (J, B))
+    def json_search(xp, doc, one_or_all, target, *rest):
+        """JSON_SEARCH(doc, 'one'|'all', pattern[, escape[, path...]])
+        → path string / array of paths / NULL.  Scope paths restrict
+        the search; wildcard scopes yield NULL (unsupported)."""
+        n = _n_of((doc, one_or_all, target) + rest)
+        dv, dm = _rows(doc, n)
+        ov, om = _rows(one_or_all, n)
+        tv, tm = _rows(target, n)
+        esc_rows = _rows(rest[0], n) if rest else None
+        scope_rows = [_rows(p, n) for p in rest[1:]]
+        out = np.empty(n, dtype=object)
+        ok = np.asarray(dm, bool) & np.asarray(om, bool) & \
+            np.asarray(tm, bool)
+        for i in range(n):
+            if not ok[i]:
+                continue
+            esc = 92
+            if esc_rows is not None and esc_rows[1][i] and esc_rows[0][i]:
+                e = esc_rows[0][i]
+                esc = e[0] if isinstance(e, (bytes, bytearray)) else int(e)
+            scopes = tuple(pv[i] for pv, pm in scope_rows if pm[i])
+            try:
+                got = mj.search(dv[i], ov[i], tv[i], esc, scopes)
+            except ValueError:      # wildcard scope
+                ok[i] = False
+                continue
+            if got is mj.NOT_FOUND:
+                ok[i] = False
+            else:
+                out[i] = got
+        return out, ok
+
+    @rpn_fn("JsonArrayAppendSig", None, J, (J, B, J))
+    def json_array_append(xp, doc, *rest):
+        assert len(rest) % 2 == 0, "path/value pairs required"
+        n = _n_of((doc,) + rest)
+        dv, dm = _rows(doc, n)
+        rows = [_rows(p, n) for p in rest]
+        out = np.empty(n, dtype=object)
+        ok = np.asarray(dm, bool).copy()
+        path_masks = [rows[k][1] for k in range(0, len(rows), 2)]
+        for i in range(n):
+            if not ok[i] or not all(m[i] for m in path_masks):
+                ok[i] = False
+                continue
+            pairs = [(rows[k][0][i], rows[k + 1][0][i]
+                      if rows[k + 1][1][i] else None)
+                     for k in range(0, len(rows), 2)]
+            out[i] = mj.array_append(dv[i], pairs)
+        return out, ok
+
+    @rpn_fn("JsonStorageSizeSig", 1, I, (J,))
+    def json_storage_size(xp, a):
+        (av, am) = a
+        return np.frompyfunc(lambda v: len(mj.dumps(v)), 1, 1)(
+            _obj(av)).astype(np.int64), am
+
+    @rpn_fn("JsonPrettySig", 1, B, (J,))
+    def json_pretty(xp, a):
+        import json as _json
+        (av, am) = a
+        return np.frompyfunc(
+            lambda v: _json.dumps(v, indent=2,
+                                  ensure_ascii=False).encode(),
+            1, 1)(_obj(av)), am
+
     # ---- casts (impl_cast.rs json arms) ----
 
     @rpn_fn("CastJsonAsJson", 1, J, (J,))

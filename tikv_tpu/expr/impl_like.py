@@ -21,8 +21,14 @@ I, B = EvalType.INT, EvalType.BYTES
 
 
 @functools.lru_cache(maxsize=4096)
-def _like_regex(pattern: bytes, escape: int):
-    """MySQL LIKE pattern → compiled bytes regex (anchored)."""
+def _like_regex(pattern: bytes, escape: int, ci: bool = False):
+    """MySQL LIKE pattern → compiled bytes regex (anchored).
+
+    ``ci``: the comparison collation is case-insensitive (general_ci
+    family) — LIKE then matches unicode case-folded (impl_like.rs is
+    generic over the Collator the same way).  Pattern compiles over
+    str for unicode-correct IGNORECASE; the matcher decodes targets.
+    """
     esc = escape & 0xFF
     out = [b"^"]
     i = 0
@@ -41,6 +47,9 @@ def _like_regex(pattern: bytes, escape: int):
             out.append(re.escape(pattern[i:i + 1]))
         i += 1
     out.append(b"$")
+    if ci:
+        return re.compile(b"".join(out).decode("utf-8", "replace"),
+                          re.IGNORECASE)
     return re.compile(b"".join(out))
 
 
@@ -79,12 +88,20 @@ def _obj(a):
 
 
 def register() -> None:
-    @rpn_fn("LikeSig", 3, I, (B, B, I))
-    def like(xp, target, pattern, escape):
+    @rpn_fn("LikeSig", 3, I, (B, B, I), needs_ctx=True)
+    def like(xp, target, pattern, escape, ctx=(63, ())):
+        from ..datatype import collation as coll
         (tv, tm), (pv, pm), (ev, em) = target, pattern, escape
-        out = _uf(lambda t, p, e: 1 if _like_regex(p, int(e)).match(t)
-                  else 0, 3)(_obj(tv), _obj(pv),
-                             np.asarray(ev, dtype=np.int64))
+        ci = coll.normalize_id(ctx[0]) in coll._GENERAL_CI
+
+        def one(t, p, e):
+            rx = _like_regex(p, int(e), ci)
+            if ci:
+                t = t.decode("utf-8", "replace") \
+                    if isinstance(t, (bytes, bytearray)) else t
+            return 1 if rx.match(t) else 0
+        out = _uf(one, 3)(_obj(tv), _obj(pv),
+                          np.asarray(ev, dtype=np.int64))
         return out.astype(np.int64), \
             np.asarray(tm, bool) & np.asarray(pm, bool) & \
             np.asarray(em, bool)
