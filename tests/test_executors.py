@@ -355,3 +355,120 @@ def test_hash_agg_uint64_keys_above_2_63():
                   key=lambda r: r[1])
     assert [r[1] for r in rows] == [(1 << 63) + i for i in range(7)]
     assert sum(r[0] for r in rows) == n
+
+
+def test_stream_agg_emits_incrementally():
+    """Sorted-input stream agg: completed groups flow out per batch and
+    the retained state stays O(1) groups (stream_aggr_executor.rs)."""
+    from tikv_tpu.datatype import Column
+    from tikv_tpu.executors.aggregation import BatchStreamAggExecutor
+    from tikv_tpu.executors.columnar import ColumnarTable
+    from tikv_tpu.testing.fixture import Table, TableColumn
+    from tikv_tpu.datatype import FieldType as FT
+
+    n, groups = 50_000, 500
+    k = np.repeat(np.arange(groups, dtype=np.int64), n // groups)
+    v = np.arange(n, dtype=np.int64)
+    table = Table(8990, (
+        TableColumn("id", 1, FT.long(not_null=True), is_pk_handle=True),
+        TableColumn("k", 2, FT.long()),
+        TableColumn("v", 3, FT.long()),
+    ))
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"k": Column(EvalType.INT, k, np.ones(n, bool)),
+         "v": Column(EvalType.INT, v, np.ones(n, bool))})
+    sel = DagSelect.from_table(table, ["id", "k", "v"])
+    dag = sel.aggregate([sel.col("k")],
+                        [("sum", sel.col("v")), ("count_star", None)],
+                        ).build()
+    from tikv_tpu.copr.dag import AggregationDesc
+    agg_desc = next(d for d in dag.executors
+                    if isinstance(d, AggregationDesc))
+    from dataclasses import replace as _replace
+    dag = type(dag)(tuple(_replace(d, streamed=True)
+                          if isinstance(d, AggregationDesc) else d
+                          for d in dag.executors), dag.ranges,
+                    dag.start_ts, dag.output_offsets, dag.encode_type)
+    from tikv_tpu.executors.runner import build_executors
+    ex = build_executors(dag, snap)
+    assert isinstance(ex, BatchStreamAggExecutor)
+    chunks = []
+    emitted_before_drain = 0
+    max_retained = 0
+    while True:
+        r = ex.next_batch(1024)
+        if r.batch.num_rows:
+            chunks.append(r.batch)
+            if not r.is_drained:
+                emitted_before_drain += r.batch.num_rows
+        max_retained = max(max_retained, len(ex._enc.keys))
+        if r.is_drained:
+            break
+    # groups streamed out before drain, and state stayed tiny
+    assert emitted_before_drain > groups // 2
+    assert max_retained <= 40       # << 500 groups
+    # full result parity with the (unstreamed) hash agg
+    rows = []
+    for b in chunks:
+        rows.extend(b.rows())
+    from tikv_tpu.executors.runner import BatchExecutorsRunner
+    sel2 = DagSelect.from_table(table, ["id", "k", "v"])
+    want = BatchExecutorsRunner(
+        sel2.aggregate([sel2.col("k")],
+                       [("sum", sel2.col("v")), ("count_star", None)]
+                       ).build(), snap).handle_request().rows()
+    assert sorted(rows, key=lambda r: r[-1]) == \
+        sorted(want, key=lambda r: r[-1])
+
+
+def test_stream_agg_desc_and_null_group_order():
+    """Regression: the retained group is the LAST ROW's, not the
+    highest-valued key — descending-sorted and NULL-first inputs must
+    not split any group across emissions."""
+    from dataclasses import replace as _replace
+    from tikv_tpu.copr.dag import AggregationDesc
+    from tikv_tpu.datatype import Column, FieldType as FT
+    from tikv_tpu.executors.columnar import ColumnarTable
+    from tikv_tpu.executors.runner import build_executors
+    from tikv_tpu.testing.fixture import Table, TableColumn
+
+    for order in ("desc", "null_first"):
+        n, per = 120, 3
+        if order == "desc":
+            k = np.repeat(np.arange(n // per, 0, -1,
+                                    dtype=np.int64), per)
+            kvalid = np.ones(n, bool)
+        else:
+            k = np.repeat(np.arange(n // per, dtype=np.int64), per)
+            kvalid = np.ones(n, bool)
+            kvalid[:per] = False        # NULL group sorted first
+        v = np.ones(n, np.int64)
+        table = Table(8991, (
+            TableColumn("id", 1, FT.long(not_null=True),
+                        is_pk_handle=True),
+            TableColumn("k", 2, FT.long()),
+            TableColumn("v", 3, FT.long()),
+        ))
+        snap = ColumnarTable.from_arrays(
+            table, np.arange(n, dtype=np.int64),
+            {"k": Column(EvalType.INT, k, kvalid),
+             "v": Column(EvalType.INT, v, np.ones(n, bool))})
+        sel = DagSelect.from_table(table, ["id", "k", "v"])
+        dag = sel.aggregate([sel.col("k")],
+                            [("sum", sel.col("v"))]).build()
+        dag = type(dag)(tuple(_replace(d, streamed=True)
+                              if isinstance(d, AggregationDesc) else d
+                              for d in dag.executors), dag.ranges,
+                        dag.start_ts, dag.output_offsets,
+                        dag.encode_type)
+        ex = build_executors(dag, snap)
+        rows = []
+        while True:
+            r = ex.next_batch(8)        # tiny batches force boundaries
+            rows.extend(r.batch.rows())
+            if r.is_drained:
+                break
+        keys = [r[-1] for r in rows]
+        assert len(keys) == len(set(keys)), f"{order}: split groups"
+        assert all(s == per for s, _k in rows), rows[:4]

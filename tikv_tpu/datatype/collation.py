@@ -131,3 +131,28 @@ def parse_set(text: bytes, elems, collation: int = BINARY) -> int:
             if k == pk:
                 mask |= 1 << i
     return mask
+
+
+def like_regex_src(pattern: str, escape: int) -> str:
+    """MySQL LIKE pattern → anchored regex SOURCE (str mode) — the ONE
+    translation shared by expr/impl_like.py (ci branch) and
+    myjson.search, so escape/%/_ semantics can never drift."""
+    import re as _re
+    esc = chr(escape & 0xFF)
+    out = ["^"]
+    i, n = 0, len(pattern)
+    while i < n:
+        ch = pattern[i]
+        if ch == esc and i + 1 < n:
+            out.append(_re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append("(?s:.*)")
+        elif ch == "_":
+            out.append("(?s:.)")
+        else:
+            out.append(_re.escape(ch))
+        i += 1
+    out.append("$")
+    return "".join(out)

@@ -396,27 +396,12 @@ import functools
 
 @functools.lru_cache(maxsize=1024)
 def _like_pattern(pattern: str, escape: int):
-    """LIKE pattern -> compiled anchored regex (cached per pattern —
-    JSON_SEARCH visits thousands of string nodes with ONE pattern)."""
+    """Compiled LIKE matcher (cached per pattern — JSON_SEARCH visits
+    thousands of string nodes with ONE pattern).  Translation shared
+    with impl_like via collation.like_regex_src."""
     import re
-    esc = chr(escape & 0xFF)
-    out = ["^"]
-    i, n = 0, len(pattern)
-    while i < n:
-        ch = pattern[i]
-        if ch == esc and i + 1 < n:
-            out.append(re.escape(pattern[i + 1]))
-            i += 2
-            continue
-        if ch == "%":
-            out.append("(?s:.*)")
-        elif ch == "_":
-            out.append("(?s:.)")
-        else:
-            out.append(re.escape(ch))
-        i += 1
-    out.append("$")
-    return re.compile("".join(out))
+    from .collation import like_regex_src
+    return re.compile(like_regex_src(pattern, escape))
 
 
 def search(doc, one_or_all: bytes, target: bytes, escape: int = 92,

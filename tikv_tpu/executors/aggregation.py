@@ -114,6 +114,23 @@ class _AggState:
             self.bits = np.concatenate(
                 [self.bits, np.full(extra, self.bit_ident, np.int64)])
 
+    def keep_only(self, idx: int) -> None:
+        """Retain ONLY group ``idx`` (stream agg emitted the rest)."""
+        sl = slice(idx, idx + 1)
+        self.count = self.count[sl].copy()
+        if self.sum is not None:
+            self.sum = self.sum[sl].copy()
+        if self.kind in ("min", "max"):
+            self.vals = self.vals[sl] if self.obj \
+                else self.vals[sl].copy()
+        if self.kind == "first":
+            self.first_vals = self.first_vals[sl]
+            self.first_set = self.first_set[sl]
+        if self.kind in _agg.VAR_KINDS:
+            self.sumsq = self.sumsq[sl].copy()
+        if self.kind in _agg.BIT_KINDS:
+            self.bits = self.bits[sl].copy()
+
     def update(self, gids: np.ndarray, values, validity):
         """Scatter one batch into group states. gids: int group id per row."""
         kind = self.kind
@@ -350,6 +367,13 @@ class _HashAggBase(TimedExecutor):
             return
         gids = self._enc.gids(batch) if self._desc.group_by else \
             np.zeros(n, dtype=np.int64)
+        if n:
+            # the group still RECEIVING rows (stream agg's retained
+            # group) is the last row's — NOT enc.keys[-1]: the int fast
+            # paths assign batch-local ids in VALUE order, so for
+            # descending or NULL-first sorted input the newest gid is
+            # not the in-progress one
+            self._last_gid = int(gids[-1])
         if not self._desc.group_by and not self._enc.keys:
             self._enc.keys.append(())
         n_groups = len(self._enc.keys)
@@ -418,12 +442,44 @@ class BatchSimpleAggExecutor(_HashAggBase):
 
 
 class BatchStreamAggExecutor(_HashAggBase):
-    """Reference: stream_aggr_executor.rs — input sorted by group key;
-    groups complete when the key changes, so memory is O(1) groups.
+    """Reference: stream_aggr_executor.rs — input sorted by group key:
+    every group except the one still receiving rows is COMPLETE at each
+    batch boundary, so completed groups stream out per batch and the
+    retained state is O(1) groups (what makes paged/streamed responses
+    memory-bounded over arbitrarily many groups).
 
-    Host implementation reuses the hash machinery but flushes completed
-    groups per batch (correct for sorted input; asserts are on the plan
-    builder, as in the reference)."""
+    Sortedness is the plan builder's contract (as in the reference); an
+    unsorted feed would re-open an emitted group and produce duplicate
+    key rows downstream."""
 
-    # For round 1 the pipeline result is identical to hash agg (all groups
-    # emitted at drain); streaming emission arrives with the paging support.
+    def _flush_completed(self) -> ColumnBatch:
+        """Emit every group EXCEPT the one the last row belongs to,
+        then rebase state onto that single in-progress group."""
+        keep = self._last_gid
+        n = len(self._enc.keys)
+        done = np.array([g for g in range(n) if g != keep],
+                        dtype=np.int64)
+        out = self._emit().take(done)
+        kept_key = self._enc.keys[keep]
+        for st in self._states:
+            st.keep_only(keep)
+        self._enc.keys = [kept_key]
+        self._enc.index = {kept_key: 0}
+        self._last_gid = 0
+        return out
+
+    def _next_batch(self, scan_rows: int) -> BatchExecuteResult:
+        if self._done:
+            return BatchExecuteResult(ColumnBatch.empty(self._schema),
+                                      True)
+        r = self._child.next_batch(scan_rows)
+        self._update(r.batch)
+        n_groups = len(self._enc.keys)
+        if r.is_drained:
+            self._done = True
+            return BatchExecuteResult(self._emit(), True, r.warnings)
+        if n_groups > 1:
+            return BatchExecuteResult(self._flush_completed(), False,
+                                      r.warnings)
+        return BatchExecuteResult(ColumnBatch.empty(self._schema),
+                                  False, r.warnings)

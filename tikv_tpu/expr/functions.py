@@ -392,13 +392,13 @@ def _register_cast():
     def cast_real_real(xp, a):
         return a
 
-    @_rpn_fn_xp("CastIntAsReal", 1, R, (I,))
+    @rpn_fn("CastIntAsReal", 1, R, (I,))
     def cast_int_real(xp, a):
         (av, am) = a
         dt = "float32" if xp.__name__.startswith("jax") else "float64"
         return av.astype(dt), am
 
-    @_rpn_fn_xp("CastRealAsInt", 1, I, (R,))
+    @rpn_fn("CastRealAsInt", 1, I, (R,))
     def cast_real_int(xp, a):
         # MySQL rounds half away from zero on cast.
         (av, am) = a
@@ -579,7 +579,7 @@ def _register_math():
         q = xp.where((av < 0) & (q * p != av), q + 1, q)
         return xp.where(dv < 0, q * p, av), am & dm
 
-    @_rpn_fn_xp("CRC32", 1, I, (EvalType.BYTES,))
+    @rpn_fn("CRC32", 1, I, (EvalType.BYTES,))
     def crc32(xp, a):
         # host-only (bytes); handled by the numpy path in eval.py
         import zlib

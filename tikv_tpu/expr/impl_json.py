@@ -272,7 +272,10 @@ def register() -> None:
             if esc_rows is not None and esc_rows[1][i] and esc_rows[0][i]:
                 e = esc_rows[0][i]
                 esc = e[0] if isinstance(e, (bytes, bytearray)) else int(e)
-            scopes = tuple(pv[i] for pv, pm in scope_rows if pm[i])
+            if any(not pm[i] for _pv, pm in scope_rows):
+                ok[i] = False   # MySQL: NULL path argument → NULL
+                continue
+            scopes = tuple(pv[i] for pv, _pm in scope_rows)
             try:
                 got = mj.search(dv[i], ov[i], tv[i], esc, scopes)
             except ValueError:      # wildcard scope

@@ -48,8 +48,12 @@ def _like_regex(pattern: bytes, escape: int, ci: bool = False):
         i += 1
     out.append(b"$")
     if ci:
-        return re.compile(b"".join(out).decode("utf-8", "replace"),
-                          re.IGNORECASE)
+        # the str-mode translation is shared with JSON_SEARCH
+        # (datatype/collation.like_regex_src) — one LIKE compiler
+        from ..datatype.collation import like_regex_src
+        return re.compile(
+            like_regex_src(pattern.decode("utf-8", "replace"), escape),
+            re.IGNORECASE)
     return re.compile(b"".join(out))
 
 
