@@ -164,6 +164,90 @@ def test_encrypted_wal_torn_tail_recovery(tmp_path):
     eng2.close()
 
 
+def test_torn_tail_rotates_encrypted_wal(tmp_path):
+    """Recovery of an encrypted WAL with a torn tail must NOT keep
+    appending under the segment's old (key, iv): keystream bytes at
+    [good, old_size) already encrypted the discarded tail, so reuse is
+    a CTR two-time pad against a pre-truncation disk image (ADVICE r4).
+    The surviving records roll forward into a fresh run + WAL
+    generation instead, and the torn segment is dropped."""
+    from tikv_tpu.engine.disk import DiskEngine
+    from tikv_tpu.engine.traits import CF_DEFAULT
+
+    eng = DiskEngine(str(tmp_path / "d"), encryption=_mgr(tmp_path))
+    wb = eng.write_batch()
+    wb.put_cf(CF_DEFAULT, b"a", b"1" * 64)
+    eng.write(wb)
+    wb = eng.write_batch()
+    wb.put_cf(CF_DEFAULT, b"b", b"2" * 64)
+    eng.write(wb)
+    eng.close()
+    wal = max(p for p in (tmp_path / "d").iterdir()
+              if p.name.startswith("wal-"))
+    ct_before = wal.read_bytes()
+    # tear the second record mid-payload
+    wal.write_bytes(ct_before[:-8])
+    eng2 = DiskEngine(str(tmp_path / "d"), encryption=_mgr(tmp_path))
+    assert eng2.get_value_cf(CF_DEFAULT, b"a") == b"1" * 64
+    assert eng2.get_value_cf(CF_DEFAULT, b"b") is None    # torn record
+    # the torn segment is gone; the live WAL is a NEW generation with
+    # its own fresh key — no byte of the old keystream is ever reused
+    assert not wal.exists()
+    new_wal = max(p for p in (tmp_path / "d").iterdir()
+                  if p.name.startswith("wal-"))
+    assert new_wal.name > wal.name
+    # appends + another restart still round-trip
+    wb = eng2.write_batch()
+    wb.put_cf(CF_DEFAULT, b"c", b"3" * 64)
+    eng2.write(wb)
+    eng2.close()
+    eng3 = DiskEngine(str(tmp_path / "d"), encryption=_mgr(tmp_path))
+    assert eng3.get_value_cf(CF_DEFAULT, b"a") == b"1" * 64
+    assert eng3.get_value_cf(CF_DEFAULT, b"b") is None
+    assert eng3.get_value_cf(CF_DEFAULT, b"c") == b"3" * 64
+    eng3.close()
+
+
+def test_torn_tail_rotation_crash_window_is_safe(tmp_path, monkeypatch):
+    """A crash DURING the recovery-time rotation (between the key-dict
+    persist and any file rename) must not lose the committed prefix:
+    the old WAL + old key stay valid until the new artifacts land."""
+    from tikv_tpu.engine.disk import DiskEngine
+    from tikv_tpu.engine.traits import CF_DEFAULT
+
+    eng = DiskEngine(str(tmp_path / "d"), encryption=_mgr(tmp_path))
+    wb = eng.write_batch()
+    wb.put_cf(CF_DEFAULT, b"a", b"1" * 64)
+    eng.write(wb)
+    wb = eng.write_batch()
+    wb.put_cf(CF_DEFAULT, b"b", b"2" * 64)
+    eng.write(wb)
+    eng.close()
+    wal = max(p for p in (tmp_path / "d").iterdir()
+              if p.name.startswith("wal-"))
+    wal.write_bytes(wal.read_bytes()[:-8])
+    # crash at the atomic-rename of the rotation's run flush: the tmp
+    # file was written and the run's (key, iv) persisted, but the
+    # rename never happens
+    real_replace = os.replace
+
+    def boom(src, dst):
+        if "/d/" in str(dst).replace("\\", "/") and \
+                os.path.basename(str(dst)).startswith("sst-"):
+            raise OSError("simulated crash at rename")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        DiskEngine(str(tmp_path / "d"), encryption=_mgr(tmp_path))
+    monkeypatch.setattr(os, "replace", real_replace)
+    # next recovery still sees the committed record
+    eng2 = DiskEngine(str(tmp_path / "d"), encryption=_mgr(tmp_path))
+    assert eng2.get_value_cf(CF_DEFAULT, b"a") == b"1" * 64
+    assert eng2.get_value_cf(CF_DEFAULT, b"b") is None
+    eng2.close()
+
+
 def test_encrypted_engine_lost_dict_fails_loudly(tmp_path):
     """Opening encrypted files without their dictionary entries must
     REFUSE, never fabricate keys — a fabricated key decrypts to garbage

@@ -472,3 +472,13 @@ def test_stream_agg_desc_and_null_group_order():
         keys = [r[-1] for r in rows]
         assert len(keys) == len(set(keys)), f"{order}: split groups"
         assert all(s == per for s, _k in rows), rows[:4]
+        # emission order == first-seen input order (ADVICE r4: value-
+        # ordered fast-path ids must not reverse DESC/NULL-first input;
+        # an ordered consumer merging per-region partials depends on it)
+        seen, want_order = set(), []
+        for kk, ok in zip(k.tolist(), kvalid.tolist()):
+            key = kk if ok else None
+            if key not in seen:
+                seen.add(key)
+                want_order.append(key)
+        assert keys == want_order, f"{order}: emission order"

@@ -174,8 +174,21 @@ class DiskEngine(MemoryEngine):
                     f"sorted run {self._run_path(g)} is corrupt; its "
                     "WAL was already dropped — cannot skip it")
             self._gen = g
-        self._replay_wal(self._wal_path(self._gen))
+        torn_enc = self._replay_wal(self._wal_path(self._gen))
         self._open_wal(self._wal_path(self._gen), append=True)
+        if torn_enc:
+            # encrypted WAL with a torn tail: appending in place would
+            # reuse CTR keystream bytes at [good, old_size) that already
+            # encrypted the discarded tail (two-time pad vs a
+            # pre-truncation disk image), and re-encrypting the prefix
+            # under a fresh key has a crash window where old ciphertext
+            # meets the new key (silent total WAL loss).  Instead roll
+            # the surviving records — already replayed into the dirty
+            # delta — forward through a normal flush: the run write is
+            # atomic under a NEW file name, the WAL rotates to a fresh
+            # generation/key, and the torn segment dies with its old key
+            # intact until both renames land.
+            self._flush_locked()
         # sweep files a crash mid-flush/compaction may have left behind
         keep_runs = set(self._runs)
         for name in os.listdir(self.path):
@@ -274,7 +287,9 @@ class DiskEngine(MemoryEngine):
             data_cf.vals = vals
         return True
 
-    def _replay_wal(self, path: str) -> None:
+    def _replay_wal(self, path: str) -> bool:
+        """Replay committed records; → True when an ENCRYPTED segment
+        has a torn tail (caller must rotate, see _recover)."""
         import io
         try:
             if self._enc is not None:
@@ -285,7 +300,7 @@ class DiskEngine(MemoryEngine):
             else:
                 f = open(path, "rb")
         except OSError:
-            return
+            return False
         with f:
             good = 0
             while True:
@@ -308,8 +323,14 @@ class DiskEngine(MemoryEngine):
                 good = f.tell()
         # drop the torn tail so later appends don't interleave with it
         if os.path.exists(path) and good < os.path.getsize(path):
+            if self._enc is not None:
+                # do NOT touch the segment here — the caller rotates it
+                # out via a flush (keystream-reuse + crash-window
+                # rationale at the _recover call site)
+                return True
             with open(path, "r+b") as f:
                 f.truncate(good)
+        return False
 
     def _open_wal(self, path: str, append: bool) -> None:
         if self._enc is not None:

@@ -24,7 +24,8 @@ from ..ops import agg as _agg
 from .interface import BatchExecuteResult, TimedExecutor
 
 
-def _agg_ret_ft(kind: str, arg_et: Optional[EvalType]) -> FieldType:
+def _agg_ret_ft(kind: str, arg_et: Optional[EvalType],
+                elems: tuple = ()) -> FieldType:
     if kind in ("count", "count_star"):
         return FieldType.long(not_null=True)
     if kind in _agg.BIT_KINDS:
@@ -34,6 +35,20 @@ def _agg_ret_ft(kind: str, arg_et: Optional[EvalType]) -> FieldType:
     if arg_et is EvalType.DECIMAL and kind not in _agg.VAR_KINDS:
         # MySQL SUM/AVG/MIN/MAX over DECIMAL stay DECIMAL
         return FieldType.new_decimal()
+    if kind in ("min", "max", "first"):
+        # order-preserving aggregates return the argument's original
+        # field type (reference: AggrFnDefinitionParser keeps the arg
+        # FieldType for min/max) — without this, clients would see the
+        # raw packed u64 time core typed as BIGINT
+        from .. import datatype as _dt
+        if arg_et is EvalType.DATETIME:
+            return FieldType(tp=_dt.FieldTypeTp.DATETIME)
+        if arg_et is EvalType.DURATION:
+            return FieldType(tp=_dt.FieldTypeTp.DURATION)
+        if arg_et is EvalType.ENUM:
+            return FieldType.enum(elems)
+        if arg_et is EvalType.SET:
+            return FieldType.set_(elems)
     if kind == "avg" or kind in _agg.VAR_KINDS:
         return FieldType.double()
     if arg_et is EvalType.REAL:
@@ -41,6 +56,17 @@ def _agg_ret_ft(kind: str, arg_et: Optional[EvalType]) -> FieldType:
     if arg_et is EvalType.BYTES:
         return FieldType.var_char()
     return FieldType.long()
+
+
+def _arg_elems(e) -> tuple:
+    """First non-empty enum/set name table in an agg-arg expr tree."""
+    stack = [e]
+    while stack:
+        n = stack.pop()
+        if n.elems:
+            return tuple(n.elems)
+        stack.extend(n.children)
+    return ()
 
 
 class _AggState:
@@ -212,7 +238,13 @@ class _AggState:
             if self.obj:
                 return Column.from_list(self.et, self.vals[:n_groups])
             vals = np.where(validity, self.vals[:n_groups], 0)
-            et = EvalType.REAL if vals.dtype == np.float64 else EvalType.INT
+            if self.et in (EvalType.DATETIME, EvalType.DURATION,
+                           EvalType.ENUM, EvalType.SET):
+                et = self.et     # keep the argument's eval type
+            elif vals.dtype == np.float64:
+                et = EvalType.REAL
+            else:
+                et = EvalType.INT
             return Column(et, vals.astype(self.vals.dtype), validity)
         if kind == "first":
             et = self.et or EvalType.INT
@@ -231,8 +263,29 @@ class _AggState:
         raise ValueError(kind)
 
 
+def _appearance_order(inverse: np.ndarray, local_keys: list, n: int):
+    """Remap batch-local ids to first-seen input order.
+
+    The int/float fast paths below produce ids in VALUE order (that is
+    what makes them sort-free/cheap); the reference's hashmaps assign
+    ids in insertion = input order (fast_hash_aggr_executor.rs), and
+    stream agg / partition TopN emission order depends on it — a
+    DESC-sorted or NULL-first input must stream groups out in input
+    order, not reversed.  O(n + k log k)."""
+    k = len(local_keys)
+    if k <= 1:
+        return inverse, local_keys
+    first_pos = np.full(k, n, dtype=np.int64)
+    np.minimum.at(first_pos, inverse, np.arange(n, dtype=np.int64))
+    order = np.argsort(first_pos, kind="stable")
+    rank = np.empty(k, dtype=np.int64)
+    rank[order] = np.arange(k, dtype=np.int64)
+    return rank[inverse], [local_keys[j] for j in order]
+
+
 def _encode_gids(enc: GroupKeyEncoder, batch: ColumnBatch) -> np.ndarray:
-    """Map each row to a global group id (assigning new ids)."""
+    """Map each row to a global group id (assigning new ids in
+    first-seen input order)."""
     n = batch.num_rows
     cols = [(c.values, c.validity) for c in batch.columns]
     key_cols = []
@@ -241,6 +294,7 @@ def _encode_gids(enc: GroupKeyEncoder, batch: ColumnBatch) -> np.ndarray:
         key_cols.append((np.broadcast_to(v, (n,)),
                          np.broadcast_to(ok, (n,))))
     # batch-local dictionary encode: single int key fast path
+    value_ordered = False
     if len(key_cols) == 1 and key_cols[0][0].dtype.kind in "iu":
         v, ok = key_cols[0]
         any_null = not ok.all()
@@ -271,6 +325,7 @@ def _encode_gids(enc: GroupKeyEncoder, batch: ColumnBatch) -> np.ndarray:
                 local_keys = [(x,) for x in uniq_vals.tolist()]
                 if any_null and seen[span]:
                     local_keys.append((None,))
+                value_ordered = True
             else:
                 # sparse domain: one sort over the valid rows only
                 uniq, inv_valid = np.unique(valid,
@@ -282,6 +337,7 @@ def _encode_gids(enc: GroupKeyEncoder, batch: ColumnBatch) -> np.ndarray:
                     local_keys.append((None,))
                 else:
                     inverse = inv_valid.astype(np.int64, copy=False)
+                value_ordered = True
     elif len(key_cols) == 1 and key_cols[0][0].dtype.kind == "f":
         v, ok = key_cols[0]
         uniq, inverse = np.unique(
@@ -289,6 +345,7 @@ def _encode_gids(enc: GroupKeyEncoder, batch: ColumnBatch) -> np.ndarray:
             axis=1, return_inverse=True)
         local_keys = [((uniq[0, j].item() if uniq[1, j] else None),)
                       for j in range(uniq.shape[1])]
+        value_ordered = True
     else:
         rows = list(zip(*[
             [vv.item() if o and hasattr(vv, "item") else (vv if o else None)
@@ -303,6 +360,8 @@ def _encode_gids(enc: GroupKeyEncoder, batch: ColumnBatch) -> np.ndarray:
                 uniq_map[key] = j
                 local_keys.append(key)
             inverse[i] = j
+    if value_ordered:
+        inverse, local_keys = _appearance_order(inverse, local_keys, n)
     # local id -> global id
     l2g = np.empty(len(local_keys), dtype=np.int64)
     for j, key in enumerate(local_keys):
@@ -354,8 +413,10 @@ class _HashAggBase(TimedExecutor):
                 else FieldType.var_char() if et is EvalType.BYTES
                 else FieldType.new_decimal() if et is EvalType.DECIMAL
                 else FieldType.long())
-        self._schema = [_agg_ret_ft(a.kind, et)
-                        for a, et in zip(desc.aggs, arg_ets)] + group_fts
+        self._schema = [
+            _agg_ret_ft(a.kind, et,
+                        _arg_elems(a.arg) if a.arg is not None else ())
+            for a, et in zip(desc.aggs, arg_ets)] + group_fts
 
     @property
     def schema(self) -> list[FieldType]:
@@ -369,10 +430,9 @@ class _HashAggBase(TimedExecutor):
             np.zeros(n, dtype=np.int64)
         if n:
             # the group still RECEIVING rows (stream agg's retained
-            # group) is the last row's — NOT enc.keys[-1]: the int fast
-            # paths assign batch-local ids in VALUE order, so for
-            # descending or NULL-first sorted input the newest gid is
-            # not the in-progress one
+            # group) is the last row's; with appearance-order ids this
+            # equals keys[-1] for sorted input, but gids[-1] stays
+            # correct even for unsorted feeds
             self._last_gid = int(gids[-1])
         if not self._desc.group_by and not self._enc.keys:
             self._enc.keys.append(())
