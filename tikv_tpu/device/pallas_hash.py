@@ -6,35 +6,55 @@ two platform costs it cannot remove:
 1. XLA materializes ``dot_general`` operands in HBM at fusion
    boundaries, so the generated one-hot planes (~136 B/row) round-trip
    through HBM — measured ~23 us per 2^16-row block, 40+ ms per 100M-row
-   request against a ~1.2 ms feed-read roofline.
+   request against the feed-read roofline.
 2. ``lax.scan`` over a large xs feed costs ~31 us per step on this
    runtime, another ~100 ms at 2^15-row chunks.
 
 This kernel fuses one-hot generation, the MXU contraction, and the
 accumulator into one ``pallas_call``: planes are generated in VMEM and
 consumed immediately (never touching HBM), and the sequential grid
-replaces the scan (~17 ms total at 100M rows, vs ~150 ms for the XLA
-path).
+replaces the scan.
 
-Layout notes (all empirically forced by Mosaic on v5e):
+Design (r5 — all choices measured on v5e at 100M rows):
 
+- **The MXU contraction is the binding constraint, not HBM.**  Pure-dot
+  probes (operand generation stripped to ~2 VPU ops/cell) run
+  9.4-15 G rows/s depending on output shape; streaming reads alone hit
+  ~800 GB/s.  An exact scatter-by-matmul consumes one int8 K-element per
+  row, so kernel time ~= rows / dot-rate regardless of byte width.
+- **Tight slot grid.**  Rows with no destination (row-mask off,
+  predicate false, key out of range) point their one-hot column at a
+  sentinel ``hi`` row that does not exist (``idx = HI*LO``): the column
+  is all-zero and the row contributes nothing — no scrap slot, and for
+  a provably non-NULL key no NULL slot either, so 1024 groups fit
+  exactly in HI=32 sublanes (was 40 with scrap+NULL: 20% more one-hot
+  generation and dot).
+- **Per-plane dots, no concatenation.**  The weight planes
+  (mask / ok / value-byte) each dot against the shared ``A`` one-hot and
+  accumulate into their lane slice of the packed output; concatenating
+  them first costs a (P*LO, B) VMEM copy per block (~1 ms/100M rows).
+- **BLOCK = 2^18.**  Grid-step fixed cost is ~10 us on this runtime;
+  halving the step count from 2^17 blocks saves ~4 ms per 100M rows.
+  int8 operands with int32 accumulation are exact at any block size
+  (products <= 127, per-dot sums <= 127*2^18 << 2^31), unlike bf16/f32
+  whose 2^24 mantissa bounds the contraction at 2^17 rows.
 - Everything is **lane-major**: 1-D row vectors are natively (1, B), so
-  the one-hots are built TRANSPOSED — ``A8T (HI, B)``, ``W8T (P8*LO, B)``
-  — with major-dim broadcasts (``x[None, :]``; minor-dim ``[:, None]``
-  insertion is unsupported for non-32-bit types), and the contraction is
-  an NT-form ``dot_general`` over the lane axis.
-- Comparisons/selects run in int32 (int8 compares and int8 iota are
-  unsupported), with one astype(int8) per operand.
+  one-hots are built TRANSPOSED — ``A (HI, B)``, planes ``(LO, B)`` —
+  with major-dim broadcasts, and the contraction is an NT-form
+  ``dot_general`` over the lane axis.  Comparisons/selects run in int32
+  (int8 compares and int8 iota are unsupported), one astype(int8) per
+  operand.  The kernel call runs under ``jax.enable_x64(False)`` — with
+  x64 on, Python ints in index maps trace as i64 and Mosaic rejects the
+  module.
 - The accumulator is an int32 pair (alo, ahi): per-block partials are
-  exact in int32 (|cell| <= 127*B), and ``x == (x >> 16 << 16) + (x &
-  0xFFFF)`` makes the pair reconstruction exact in int64 on the host.
-  int64 is unavailable inside Mosaic kernels.
-- The kernel call runs under ``jax.enable_x64(False)`` — with x64 on,
-  Python ints in index maps trace as i64 and Mosaic rejects the module.
+  exact in int32, and ``x == (x >> 16 << 16) + (x & 0xFFFF)`` makes the
+  pair reconstruction exact in int64 on the host (int64 is unavailable
+  inside Mosaic kernels).
 
 The packed output (2, HI, P8*LO) matches twolevel_partial's layout, so
 the host-side unpack (kernels.twolevel_unpack / states_from_matmul) is
-shared with the XLA path.
+shared with the XLA path; when the tight grid has fewer than
+``capacity + 2`` slots the caller zero-pads the NULL/scrap rows.
 
 Reference for the role this kernel plays: the fast hash-agg executor
 (components/tidb_query_executors/src/fast_hash_aggr.rs) — BASELINE
@@ -51,17 +71,42 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..expr.eval import eval_rpn
+from ..expr.rpn import RpnColumnRef
 
-# Rows per grid step.  Swept on v5e at 100M rows: 2^17 beats 2^15 (108ms),
-# 2^16 (102ms) and 2^18 (101ms, VMEM pressure) at 87ms end-to-end.
-BLOCK = 1 << 17
+# Rows per grid step.  Swept on v5e at 100M rows (r5): 2^18 beats 2^17
+# by ~3.5 ms/pass (fewer ~10 us grid steps) and 2^19 regresses (VMEM
+# pressure breaks double-buffering).
+BLOCK = 1 << 18
 
-# HI = slots/LO sublanes in the A operand; cap keeps the (HI, B) one-hot
-# intermediates inside VMEM.  Above this the XLA two-level path serves
-# (up to its own 2^20 ceiling).
-MAX_SLOTS = 1 << 13
+# Low radix of the slot factorization: slot = hi*LO + lo.  32 balances
+# the A one-hot (slots/LO sublane rows, the costlier operand to
+# generate) against plane width (measured: LO=16 doubles A-gen cost for
+# a ~2x slower kernel; LO=64 pushes multi-plane outputs past one lane
+# tile).
+LO = 32
+
+# Slot-span cap: A is (slots/LO, BLOCK) int8 in VMEM — 4096 slots is
+# a 32 MB A operand at BLOCK=2^18, leaving headroom for the weight
+# planes under the ~110 MB VMEM budget.  Above this the XLA two-level
+# path serves (up to its own 2^20 ceiling).
+MAX_SLOTS = 1 << 12
 
 _i32 = jnp.int32
+
+
+def key_never_null(plan) -> bool:
+    """True when the group key provably cannot be NULL: a bare column
+    reference over a feed column with no validity plane.  (The
+    ``supported`` gate already requires every feed column be
+    non-nullable; expression keys keep a NULL slot because a function
+    may introduce NULL, e.g. out-of-domain casts.)"""
+    nodes = plan.key_rpn.nodes
+    return len(nodes) == 1 and isinstance(nodes[0], RpnColumnRef)
+
+
+def n_slots(plan, capacity: int) -> int:
+    """Slots the kernel actually materializes (tight grid)."""
+    return capacity + (0 if key_never_null(plan) else 1)
 
 
 def supported(plan, feed, dtypes, pf: int, capacity: int,
@@ -70,12 +115,11 @@ def supported(plan, feed, dtypes, pf: int, capacity: int,
 
     int32 feed columns only (int64 is unsupported in Mosaic), no NULL
     validity planes (they would need int8 plane inputs), int byte-plane
-    aggregates only (pf == 0), and a slot span the (HI, B) one-hot can
-    hold in VMEM.
+    aggregates only (pf == 0), and a slot span whose one-hot fits VMEM.
     """
     if not single_device or pf != 0:
         return False
-    if capacity + 2 > MAX_SLOTS:
+    if n_slots(plan, capacity) > MAX_SLOTS:
         return False
     if any(feed["null_flags"]):
         return False
@@ -90,19 +134,23 @@ def build(plan, layouts, p8: int, capacity: int, n_pad: int,
           n_cols: int):
     """Build the pallas_call for one (plan, feed-shape) pair.
 
-    Returns ``call(scal_i32[2], *flat) -> (2, HI, p8*LO) int32`` where
-    ``scal = [n_rows, key_base]``.
+    Returns ``(run, LO, HI)`` with ``run(n, base, flat) ->
+    (2, HI, p8*LO) int32`` packed accumulator pair.
     """
-    LO = 32
-    slots = capacity + 2
+    nullable = not key_never_null(plan)
+    slots = capacity + (1 if nullable else 0)
     hi_n = -(-slots // LO)
     HI = ((hi_n + 7) // 8) * 8
     W = p8 * LO
     B = BLOCK
     nblk = n_pad // B
+    # the sentinel hi value for rows with no destination slot: outside
+    # [0, HI), so the row's one-hot column is all-zero
+    SENT = HI * LO
     sel_rpns = plan.sel_rpns
     key_rpn = plan.key_rpn
     agg_rpns = plan.agg_rpns
+    lobits = LO.bit_length() - 1
 
     def kernel(sref, *refs):
         out_ref = refs[n_cols]
@@ -130,48 +178,63 @@ def build(plan, layouts, p8: int, capacity: int, n_pad: int,
         kv, km = eval_rpn(key_rpn, pairs, B, jnp)
         kv = jnp.broadcast_to(kv, (B,)).astype(_i32)
         km = jnp.broadcast_to(km, (B,))
-        idx = kv - base
-        in_range = (idx >= _i32(0)) & (idx < _i32(capacity))
-        # slot layout (ops/agg.hash_agg_tile): [0, capacity) groups,
-        # capacity = NULL-key slot, capacity+1 = scrap (masked-out rows;
-        # also out-of-range keys, which the caller's span precheck rules
-        # out)
-        idx = jnp.where(mask & km & in_range, idx, _i32(capacity + 1))
-        idx = jnp.where(mask & ~km, _i32(capacity), idx)
-        hi_ = idx // _i32(LO)
-        lo_ = idx - hi_ * _i32(LO)
+        rel = kv - base
+        in_range = (rel >= _i32(0)) & (rel < _i32(capacity))
+        # slot layout: [0, capacity) groups, capacity = NULL-key slot
+        # (only materialized for expression keys); rows with no slot —
+        # masked out, out-of-range, or NULL under a non-null key — aim
+        # at SENT: hi = HI, matching no one-hot row, so the whole
+        # column is zero and the row vanishes from every plane.
+        if nullable:
+            idx = jnp.where(mask & km & in_range, rel, _i32(SENT))
+            idx = jnp.where(mask & ~km, _i32(capacity), idx)
+        else:
+            idx = jnp.where(mask & km & in_range, rel, _i32(SENT))
+        hi_ = idx >> lobits
+        lo_ = idx & _i32(LO - 1)
 
         hi_iota = lax.broadcasted_iota(_i32, (HI, B), 0)
         lo_iota = lax.broadcasted_iota(_i32, (LO, B), 0)
-        A8T = jnp.where(hi_[None, :] == hi_iota, _i32(1),
-                        _i32(0)).astype(jnp.int8)
-        OLT = lo_[None, :] == lo_iota
-
-        m32 = jnp.where(mask, _i32(1), _i32(0))
+        A8 = jnp.where(hi_[None, :] == hi_iota, _i32(1),
+                       _i32(0)).astype(jnp.int8)
+        cmp = lo_[None, :] == lo_iota
         zero = jnp.zeros((LO, B), _i32)
-        w_planes = [jnp.where(OLT, m32[None, :], zero)]   # plane 0 = mask
+        dn = (((1,), (1,)), ((), ()))
+
+        def accum(p, plane_i32):
+            prod = lax.dot_general(A8, plane_i32.astype(jnp.int8), dn,
+                                   preferred_element_type=_i32)
+            sl = slice(p * LO, (p + 1) * LO)
+            alo[:, sl] += prod & _i32(0xFFFF)
+            ahi[:, sl] += prod >> 16
+
+        # plane 0 = slot-presence counts; rows without a slot are
+        # already dropped by their zero A column, so no mask multiply
+        accum(0, jnp.where(cmp, _i32(1), zero))
+        p = 1
         for lay, rpn in zip(layouts, agg_rpns):
             if lay.kind == "count_star":
                 continue
             v, ok = eval_rpn(rpn, pairs, B, jnp)
             v = jnp.broadcast_to(v, (B,)).astype(_i32)
-            ok32 = jnp.where(jnp.broadcast_to(ok, (B,)) & mask,
-                             _i32(1), _i32(0))
-            if lay.ok_plane != 0:
-                w_planes.append(jnp.where(OLT, ok32[None, :], zero))
+            okb = jnp.broadcast_to(ok, (B,))
+            aliased = lay.ok_plane == 0
+            if not aliased:
+                ok32 = jnp.where(okb, _i32(1), _i32(0))
+                accum(p, jnp.where(cmp, ok32[None, :], zero))
+                p += 1
             if lay.byte_planes:
                 nb = lay.nb
                 biased = v + _i32(1 << (8 * nb - 1))
-                for k in range(nb):
-                    byte = ((biased >> (8 * k)) & _i32(0xFF)) - _i32(128)
-                    byte = byte * ok32
-                    w_planes.append(jnp.where(OLT, byte[None, :], zero))
-        W8T = jnp.concatenate(w_planes, axis=0).astype(jnp.int8)
-
-        prod = lax.dot_general(A8T, W8T, (((1,), (1,)), ((), ())),
-                               preferred_element_type=_i32)
-        alo[:] += prod & _i32(0xFFFF)
-        ahi[:] += prod >> 16
+                if not aliased:
+                    # NULL argument on a live row: bytes must not leak
+                    biased = biased * ok32
+                for b in range(nb):
+                    byte = ((biased >> (8 * b)) & _i32(0xFF)) - _i32(128)
+                    if not aliased:
+                        byte = jnp.where(okb, byte, _i32(0))
+                    accum(p, jnp.where(cmp, byte[None, :], zero))
+                    p += 1
 
         @pl.when(i == nblk - 1)
         def _():
@@ -192,7 +255,7 @@ def build(plan, layouts, p8: int, capacity: int, n_pad: int,
         out_shape=jax.ShapeDtypeStruct((2, HI, W), _i32),
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 << 20),
+            vmem_limit_bytes=110 << 20),
     )
 
     scal_cache: dict = {}

@@ -1343,7 +1343,14 @@ class DeviceRunner:
                     self._kernel_cache[key] = False
                 return None
         S = pallas_hash.unpack_to_int64(packed)
-        S8 = twolevel_unpack(S, p8, LO, slots, xp=np)
+        # the tight slot grid (no scrap slot; NULL slot only for
+        # expression keys) may hold fewer than capacity+2 rows: the
+        # dropped slots are zero by construction (nothing ever
+        # scatters there), so zero-pad back to the shared layout
+        have = min(slots, S.shape[0] * LO)
+        S8 = twolevel_unpack(S, p8, LO, have, xp=np)
+        if have < slots:
+            S8 = np.pad(S8, ((0, 0), (0, slots - have)))
         present, states = states_from_matmul(layouts, plan.specs, S8,
                                              None, xp=np)
         return {"present": present, "overflow": False, "states": states}
