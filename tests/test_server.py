@@ -336,3 +336,53 @@ def test_region_cache_build_does_not_block_other_hits():
         assert not t.is_alive()
     finally:
         rc.build_region_columnar = orig
+
+
+def test_per_request_tracker_details(cluster):
+    """Every read RPC returns TimeDetail/ScanDetail built by the
+    per-request tracker (components/tracker/src/lib.rs:16,32-40):
+    wall/wait attribution plus phase decomposition, consistent with the
+    reported total."""
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import encode_table_row, int_table
+
+    c = cluster["client"]
+    table = int_table(2, table_id=9077)
+    muts = []
+    for h in range(300):
+        key, value = encode_table_row(table, h, {"c0": h % 3, "c1": h})
+        muts.append(("put", key, value))
+    c.txn_write(muts)
+
+    sel = DagSelect.from_table(table, ["id", "c0", "c1"])
+    dag = sel.aggregate([sel.col("c0")],
+                        [("count_star", None)]).build(start_ts=c.tso())
+    resp = c.coprocessor(dag)
+    td, sd = resp["time_detail"], resp["scan_detail"]
+    # totals: wait + process == total; every phase fits in the total
+    assert td["total_rpc_wall_ms"] > 0
+    assert td["wait_wall_ms"] >= 0
+    assert abs(td["wait_wall_ms"] + td["process_wall_ms"]
+               - td["total_rpc_wall_ms"]) < 0.01
+    phases = td["phases_ms"]
+    assert "snapshot" in phases and "columnar_cache" in phases
+    assert sum(phases.values()) <= td["total_rpc_wall_ms"] + 0.01
+    # first query at this data version built the columnar cache
+    assert td["labels"]["copr_cache"] in ("build", "hit")
+    assert td["labels"]["backend"] == resp["backend"]
+    if resp["backend"] == "device":
+        assert "device_dispatch" in phases or "host_exec" in phases
+    # the scan covered every row once
+    assert sd["processed_versions"] == 300
+
+    # warm repeat: cache hit labeled, still consistent
+    dag2 = sel.aggregate([sel.col("c0")],
+                         [("count_star", None)]).build(start_ts=c.tso())
+    resp2 = c.coprocessor(dag2)
+    assert resp2["time_detail"]["labels"]["copr_cache"] == "hit"
+
+    # point read: kv_read phase + 1 processed version
+    key, value = encode_table_row(table, 1, {"c0": 1, "c1": 1})
+    r = c._call_leader(key, "KvGet", {"key": key, "version": c.tso()})
+    assert "kv_read" in r["time_detail"]["phases_ms"]
+    assert r["scan_detail"]["processed_versions"] == 1

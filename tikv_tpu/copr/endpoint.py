@@ -157,30 +157,36 @@ class Endpoint:
         with GLOBAL_RECORDER.attach(tag):
             storage = self._snapshot_provider(req)
             backend = self._pick_backend(req, storage)
+            from ..utils import tracker
+            tracker.label("backend", backend)
             if req.paging_size > 0:
                 backend = "host"    # pages are a host-pipeline contract
                 from ..executors.runner import BatchExecutorsRunner
-                result = BatchExecutorsRunner(
-                    req.dag, storage,
-                    resume_token=req.resume_token).handle_request(
-                        max_rows=req.paging_size)
+                with tracker.phase("host_exec"):
+                    result = BatchExecutorsRunner(
+                        req.dag, storage,
+                        resume_token=req.resume_token).handle_request(
+                            max_rows=req.paging_size)
             elif backend == "device":
                 result = self._device_runner.handle_request(req.dag,
                                                             storage)
             else:
                 from ..executors.runner import BatchExecutorsRunner
-                result = BatchExecutorsRunner(req.dag,
-                                              storage).handle_request()
+                with tracker.phase("host_exec"):
+                    result = BatchExecutorsRunner(
+                        req.dag, storage).handle_request()
             from ..resource_metering import scanned_rows
             if backend == "device" and not result.exec_summaries:
                 # the device feed always scans the whole snapshot; its
                 # results carry no per-operator summaries
                 est = getattr(storage, "estimated_rows", None)
                 n = est() if callable(est) else None
-                GLOBAL_RECORDER.record_read_keys(
-                    n if n is not None else result.batch.num_rows)
+                n_scanned = n if n is not None else result.batch.num_rows
+                GLOBAL_RECORDER.record_read_keys(n_scanned)
             else:
-                GLOBAL_RECORDER.record_read_keys(scanned_rows(result))
+                n_scanned = scanned_rows(result)
+                GLOBAL_RECORDER.record_read_keys(n_scanned)
+            tracker.add_scan(n_scanned)
         elapsed = time.perf_counter_ns() - t0
         m.COPR_REQ_COUNTER.labels(backend).inc()
         m.COPR_REQ_DURATION.labels(backend).observe(elapsed / 1e9)

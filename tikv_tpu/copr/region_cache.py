@@ -297,6 +297,8 @@ class RegionColumnarCache:
                         self.hits += 1
                         from ..utils.metrics import COPR_CACHE_COUNTER
                         COPR_CACHE_COUNTER.labels("hit").inc()
+                        from ..utils import tracker
+                        tracker.label("copr_cache", "hit")
                         ent = got
                         break
                 if ent is not None:
@@ -312,8 +314,11 @@ class RegionColumnarCache:
                 wait_ev.wait()
                 continue        # re-check: the builder's entry may serve us
             try:
-                tbl, safe_ts, locks = build_region_columnar(
-                    snap, scan.table_id, scan.columns, dag.start_ts)
+                from ..utils import tracker
+                tracker.label("copr_cache", "build")
+                with tracker.phase("columnar_build"):
+                    tbl, safe_ts, locks = build_region_columnar(
+                        snap, scan.table_id, scan.columns, dag.start_ts)
                 ent = MvccColumnarSnapshot(tbl, dag.start_ts, safe_ts,
                                            locks)
                 with self._lock:

@@ -417,8 +417,13 @@ class DeviceRunner:
             except TypeError:       # not weak-referenceable
                 cache = None
         if cache is not None and feed_key in cache:
+            from ..utils import tracker
+            tracker.label("device_feed", "hit")
             return cache[feed_key]
-        feed = self._build_flat(host_cols(), n)
+        from ..utils import tracker
+        tracker.label("device_feed", "upload")
+        with tracker.phase("feed_upload"):
+            feed = self._build_flat(host_cols(), n)
         if cache is not None:
             cache[feed_key] = feed
         return feed
@@ -916,14 +921,16 @@ class DeviceRunner:
         r2's sequential per-array fetches paid that 3+ times per
         request). Returns the same pytree as numpy.
         """
-        leaves, treedef = jax.tree.flatten(tree)
-        for x in leaves:
-            try:
-                x.copy_to_host_async()
-            except Exception:       # pragma: no cover - CPU arrays
-                pass
-        return jax.tree.unflatten(treedef,
-                                  [np.asarray(x) for x in leaves])
+        from ..utils import tracker
+        with tracker.phase("device_fetch"):
+            leaves, treedef = jax.tree.flatten(tree)
+            for x in leaves:
+                try:
+                    x.copy_to_host_async()
+                except Exception:   # pragma: no cover - CPU arrays
+                    pass
+            return jax.tree.unflatten(treedef,
+                                      [np.asarray(x) for x in leaves])
 
     # ------------------------------------------------------------ dispatch
 
@@ -1054,8 +1061,11 @@ class DeviceRunner:
                            self._finalize_psum_summed(),
                            feed["null_flags"], feed["n_pad"], chunk),
                 carry, len(feed["flat"])))
-        carry = kern(carry, self._cached_scalar(n, jnp.int64),
-                     self._cached_scalar(0, jnp.int64), *feed["flat"])
+        from ..utils import tracker as _tracker
+        with _tracker.phase("device_dispatch"):
+            carry = kern(carry, self._cached_scalar(n, jnp.int64),
+                         self._cached_scalar(0, jnp.int64),
+                         *feed["flat"])
         summed, stacked = self._readback(carry)
         merged = self._merge_stacked(plan.specs, summed, stacked)
         finals = finalize_simple(plan.specs, merged)
@@ -1209,7 +1219,9 @@ class DeviceRunner:
                         self._finalize_psum_summed(),
                         kern_null_flags, feed["n_pad"], chunk),
                     carry, len(kern_flat)))
-            carry = kern(carry, n_arr, aux_arr, *kern_flat)
+            from ..utils import tracker as _tracker
+            with _tracker.phase("device_dispatch"):
+                carry = kern(carry, n_arr, aux_arr, *kern_flat)
             (S8p, Sfp, ovf), _ = self._readback(carry)
             assert int(ovf) == 0, "hash agg key range overflow"
             S8 = twolevel_unpack(S8p, p8, LO, slots, xp=np)
@@ -1236,7 +1248,9 @@ class DeviceRunner:
                         self._finalize_psum_summed(),
                         kern_null_flags, feed["n_pad"], chunk),
                     carry, len(kern_flat)))
-            carry = kern(carry, n_arr, aux_arr, *kern_flat)
+            from ..utils import tracker as _tracker
+            with _tracker.phase("device_dispatch"):
+                carry = kern(carry, n_arr, aux_arr, *kern_flat)
             (summed, present_counts, ovf), stacked = self._readback(carry)
             assert int(ovf) == 0, "hash agg key range overflow"
             merged = {
@@ -1326,7 +1340,11 @@ class DeviceRunner:
         else:
             run, LO = entry
             try:
-                packed = np.asarray(run(n, base, feed["flat"]))
+                from ..utils import tracker
+                with tracker.phase("device_dispatch"):
+                    packed_dev = run(n, base, feed["flat"])
+                with tracker.phase("device_fetch"):
+                    packed = np.asarray(packed_dev)
                 self._kernel_cache.pop(("hashpl_tries", key), None)
             except Exception as e:
                 # a transient runtime failure on a cached kernel must fall

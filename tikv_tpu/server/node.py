@@ -557,16 +557,19 @@ class Node:
         # async-commit read protocol: bump max_ts, then check the
         # in-memory lock table scoped to the REQUEST's key ranges —
         # an unrelated table's in-flight prewrite must not fail this
+        from ..utils import tracker
         cm = self.storage.concurrency_manager
         cm.update_max_ts(req.dag.start_ts)
         if req.dag.ranges:
             cm.read_ranges_check(req.dag.ranges, req.dag.start_ts)
         else:
             cm.read_range_check(None, None, req.dag.start_ts)
-        snap = self.raft_kv.snapshot(SnapContext(key_hint=key_hint))
+        with tracker.phase("snapshot"):
+            snap = self.raft_kv.snapshot(SnapContext(key_hint=key_hint))
         execs = req.dag.executors
         if execs and isinstance(execs[0], TableScanDesc):
-            ent = self.copr_cache.get(snap, req.dag)
+            with tracker.phase("columnar_cache"):
+                ent = self.copr_cache.get(snap, req.dag)
             if ent is not None:
                 return ent
         return MvccScanStorage(MvccReader(snap), req.dag.start_ts)

@@ -16,6 +16,7 @@ big scans queue.
 from __future__ import annotations
 
 import threading
+import time
 
 from ..utils.metrics import (
     READ_POOL_PENDING_GAUGE,
@@ -54,7 +55,10 @@ class ReadPool:
             self._pending += 1
             self._publish_gauges()
         try:
+            from ..utils import tracker
+            t_wait = time.perf_counter_ns()
             with self._slots:
+                tracker.add_wait(time.perf_counter_ns() - t_wait)
                 with self._mu:
                     self.served += 1
                     self.running += 1

@@ -23,6 +23,7 @@ from ..storage.mvcc.reader import MvccReader
 from ..storage.txn import commands as cmds
 from ..storage.txn.actions import Mutation
 from ..storage.txn_types import encode_key
+from ..utils import tracker
 from . import wire
 
 
@@ -82,8 +83,18 @@ class KvService:
         prio = _READ_METHODS.get(method)
         t0 = time.perf_counter()
         if prio is not None:
-            resp = self._guard(
-                lambda r: self.read_pool.run(lambda: fn(r), prio), req)
+            # per-request tracker (components/tracker/src/lib.rs): every
+            # layer below attributes wall/wait/scan into it; the
+            # accumulated TimeDetail/ScanDetail return on the wire
+            tr, tok = tracker.install()
+            try:
+                resp = self._guard(
+                    lambda r: self.read_pool.run(lambda: fn(r), prio), req)
+            finally:
+                tracker.uninstall(tok)
+            if isinstance(resp, dict) and "error" not in resp:
+                resp.setdefault("time_detail", tr.time_detail())
+                resp.setdefault("scan_detail", tr.scan_detail())
         else:
             resp = self._guard(fn, req)
         nbytes = resp.get("__bytes", 0) if isinstance(resp, dict) else 0
@@ -104,20 +115,28 @@ class KvService:
     # ---------------------------------------------------------- txn KV
 
     def KvGet(self, req: dict) -> dict:
-        v = self.storage.get(req["key"], req["version"],
-                             tuple(req.get("bypass_locks", ())),
-                             replica_read=req.get("replica_read", False))
+        with tracker.phase("kv_read"):
+            v = self.storage.get(req["key"], req["version"],
+                                 tuple(req.get("bypass_locks", ())),
+                                 replica_read=req.get("replica_read",
+                                                      False))
+        if v is not None:
+            tracker.add_scan(1, len(v))
         return {"value": v, "not_found": v is None}
 
     def KvBatchGet(self, req: dict) -> dict:
-        pairs = self.storage.batch_get(req["keys"], req["version"])
+        with tracker.phase("kv_read"):
+            pairs = self.storage.batch_get(req["keys"], req["version"])
+        tracker.add_scan(len(pairs), sum(len(v) for _, v in pairs))
         return {"pairs": [{"key": k, "value": v} for k, v in pairs]}
 
     def KvScan(self, req: dict) -> dict:
-        pairs = self.storage.scan(req["start_key"],
-                                  req.get("end_key") or None,
-                                  req["limit"], req["version"],
-                                  req.get("reverse", False))
+        with tracker.phase("kv_read"):
+            pairs = self.storage.scan(req["start_key"],
+                                      req.get("end_key") or None,
+                                      req["limit"], req["version"],
+                                      req.get("reverse", False))
+        tracker.add_scan(len(pairs), sum(len(v) for _, v in pairs))
         return {"pairs": [{"key": k, "value": v} for k, v in pairs]}
 
     def KvPrewrite(self, req: dict) -> dict:
