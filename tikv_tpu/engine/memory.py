@@ -127,6 +127,10 @@ class MemoryWriteBatch:
     def delete_range_cf(self, cf: str, start: bytes, end: bytes) -> None:
         self._ops.append(("delr", cf, start, end))
 
+    def ingest_cf(self, cf: str, keys: list, vals: list) -> None:
+        """Bulk sorted-run ingest (sst_importer; see _ingest_locked)."""
+        self._ops.append(("ingest", cf, keys, vals))
+
     def put(self, key: bytes, value: bytes) -> None:
         self.put_cf(CF_DEFAULT, key, value)
 
@@ -185,8 +189,53 @@ class MemoryEngine:
                 self._put_locked(op[1], op[2], op[3])
             elif op[0] == "del":
                 self._delete_locked(op[1], op[2])
+            elif op[0] == "ingest":
+                self._ingest_locked(op[1], op[2], op[3])
             else:
                 self._delete_range(op[1], op[2], op[3])
+
+    def _ingest_locked(self, cf: str, keys: list, vals: list) -> None:
+        """Bulk-merge one pre-sorted run (the file-ingest analog of
+        RocksDB's IngestExternalFile: land a whole sorted artifact
+        without replaying per-key ops; sst_importer ingest).
+
+        Ascending bulk loads append in O(1)/key via list.extend; an
+        overlapping run falls back to a two-run sorted merge where the
+        ingested value wins ties (newest file wins, as in the LSM)."""
+        if not keys:
+            return
+        data = self._writable(cf)
+        if not data.keys or keys[0] > data.keys[-1]:
+            data.keys.extend(keys)
+            data.vals.extend(vals)
+            return
+        ok, ov = data.keys, data.vals
+        nk, nv = keys, vals
+        mk: list = []
+        mv: list = []
+        i = j = 0
+        ln, lm = len(ok), len(nk)
+        while i < ln and j < lm:
+            a, b = ok[i], nk[j]
+            if a < b:
+                mk.append(a)
+                mv.append(ov[i])
+                i += 1
+            elif a > b:
+                mk.append(b)
+                mv.append(nv[j])
+                j += 1
+            else:           # same key: ingested run wins
+                mk.append(b)
+                mv.append(nv[j])
+                i += 1
+                j += 1
+        mk.extend(ok[i:])
+        mv.extend(ov[i:])
+        mk.extend(nk[j:])
+        mv.extend(nv[j:])
+        data.keys = mk
+        data.vals = mv
 
     def get_value_cf(self, cf: str, key: bytes) -> Optional[bytes]:
         data = self._cfs[cf]

@@ -61,3 +61,4 @@ def _load():
 
 _mod = _load()
 mvcc_build_columnar = getattr(_mod, "mvcc_build_columnar", None)
+build_mvcc_sst = getattr(_mod, "build_mvcc_sst", None)

@@ -476,6 +476,9 @@ class TxnClient:
     def split(self, split_key: bytes) -> Region:
         r = self._call_leader(split_key, "SplitRegion",
                               {"split_key": split_key})
+        # the parent region's cached bounds are stale the moment the
+        # split lands — drop them so the next lookup re-resolves
+        self._invalidate_region(split_key)
         return wire.dec_region(r["right"])
 
     def add_peer(self, region_id: int, store_id: int) -> Peer:
@@ -555,8 +558,10 @@ class TxnClient:
                 return r["ingested"]
             except wire.RemoteError as e:
                 if e.kind in ("not_leader", "epoch_not_match",
-                              "region_merging", "server_is_busy"):
+                              "region_merging", "server_is_busy") or \
+                        "KeyNotInRegion" in str(e):
                     # stale routing / transient: refresh and retry
+                    # (KeyNotInRegion = cached bounds predate a split)
                     self._invalidate_region(region_key)
                     last = e
                     _time.sleep(0.05)

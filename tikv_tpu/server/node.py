@@ -655,6 +655,39 @@ class Node:
             raise box["result"]
         return len(ops)
 
+    def ingest_sst_blob(self, region_id: int, blob: bytes) -> int:
+        """Atomically land one v2 SST container with a single raft op
+        (fsm/apply.rs IngestSst): the file rides the log as one blob and
+        apply bulk-merges its sorted runs — the TPU-native analog of
+        RocksDB's IngestExternalFile, which links the file instead of
+        replaying keys.  Range check touches only each run's first/last
+        key (runs are sorted)."""
+        from ..raftstore.cmd import WriteOp
+        from ..raftstore.metapb import KeyNotInRegion
+        from ..sst_importer import read_sst_cf
+        from ..storage.txn_types import split_ts
+        cf_map = read_sst_cf(blob)      # validates checksum
+        n_total = 0
+        with self.lock:
+            peer = self.raft_store.region_peer(region_id)
+            region = peer.region
+            for _cf, (keys, _vals) in cf_map.items():
+                if not keys:
+                    continue
+                n_total += len(keys)
+                for key in (keys[0], keys[-1]):
+                    bare = split_ts(key)[0] if len(key) > 8 else key
+                    if not region.contains(bare):
+                        raise KeyNotInRegion(key, region)
+            cmd = RaftCmd(region_id, region.epoch,
+                          ops=(WriteOp("ingest", "", b"", blob),))
+            box: dict = {}
+            peer.propose(cmd, lambda r: box.__setitem__("result", r))
+        self._wait_driver(lambda: "result" in box)
+        if isinstance(box["result"], Exception):
+            raise box["result"]
+        return n_total
+
     def change_peer(self, region_id: int, change_type: str,
                     peer_meta: Peer) -> None:
         with self.lock:

@@ -598,18 +598,23 @@ class KvService:
         return {"staged": done}
 
     def ImportIngest(self, req: dict) -> dict:
-        from ..sst_importer import read_sst
+        from ..sst_importer import is_sst_v2, read_sst
         uuid = req["uuid"]
         with self._snap_lock:
             blob = self._import_staged.get(uuid)
         if blob is None:
             return {"error": {"kind": "other",
                               "message": f"no staged sst {uuid!r}"}}
-        pairs = read_sst(blob)      # ValueError on corruption → guard
         # the staged blob survives a FAILED ingest (epoch change /
         # leadership move) so the client can retry without re-uploading
         # (sst_service keeps the file the same way)
-        n = self.node.ingest_sst(req["region_id"], pairs)
+        if is_sst_v2(blob):
+            # v2 column-group container: ONE raft op carries the file,
+            # apply bulk-merges sorted runs — no per-row replay
+            n = self.node.ingest_sst_blob(req["region_id"], blob)
+        else:
+            pairs = read_sst(blob)  # ValueError on corruption → guard
+            n = self.node.ingest_sst(req["region_id"], pairs)
         with self._snap_lock:
             self._import_staged.pop(uuid, None)
         return {"ingested": n}

@@ -47,8 +47,15 @@ class SstWriter:
                 struct.pack(">I", zlib.crc32(payload) & 0xFFFFFFFF))
 
 
+_SST2_MAGIC = b"TKVSST2\n"
+
+
 def read_sst(blob: bytes) -> list:
     """→ [(cf, key, value)]; raises ValueError on a corrupt artifact."""
+    if blob.startswith(_SST2_MAGIC):
+        return [(cf, k, v)
+                for cf, (keys, vals) in read_sst_cf(blob).items()
+                for k, v in zip(keys, vals)]
     if not blob.startswith(_SST_MAGIC) or len(blob) < len(_SST_MAGIC) + 4:
         raise ValueError("bad sst magic")
     payload = blob[len(_SST_MAGIC):-4]
@@ -57,6 +64,105 @@ def read_sst(blob: bytes) -> list:
         raise ValueError("sst checksum mismatch")
     return [(cf, k, v) for cf, k, v in
             msgpack.unpackb(payload, raw=False)]
+
+
+def is_sst_v2(blob: bytes) -> bool:
+    return blob.startswith(_SST2_MAGIC)
+
+
+def read_sst_cf(blob: bytes) -> dict:
+    """v2 container → {cf: (keys list, values list)} with keys sorted.
+
+    The column-group layout keeps the ingest path free of per-row
+    Python: msgpack unpacks straight to lists of bytes, and the engine
+    bulk-merges whole sorted runs (the analog of the reference's
+    RocksDB file ingest, which links an SST without replaying ops)."""
+    if not blob.startswith(_SST2_MAGIC) or len(blob) < len(_SST2_MAGIC) + 4:
+        raise ValueError("bad sst v2 magic")
+    payload = blob[len(_SST2_MAGIC):-4]
+    (crc,) = struct.unpack(">I", blob[-4:])
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise ValueError("sst v2 checksum mismatch")
+    out = {}
+    for cf, keys, vals in msgpack.unpackb(payload, raw=False):
+        if len(keys) != len(vals):
+            raise ValueError("sst v2 cf group length mismatch")
+        out[cf] = (keys, vals)
+    return out
+
+
+def build_sst_v2(cf_map: dict) -> bytes:
+    """{cf: (sorted keys, values)} → v2 blob (pure-python fallback for
+    the native builder; same container)."""
+    body = msgpack.packb(
+        [[cf, list(keys), list(vals)]
+         for cf, (keys, vals) in sorted(cf_map.items())],
+        use_bin_type=True)
+    return _SST2_MAGIC + body + \
+        struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def fast_mvcc_table_sst(table_id: int, handles, columns,
+                        commit_ts: int, start_ts: int = 0) -> bytes:
+    """Bulk pre-timestamped MVCC SST for one int/float table chunk.
+
+    ``handles``: ascending int64 numpy array; ``columns``: list of
+    (col_id, int64-or-float64 numpy array, validity-or-None).  Uses the
+    native C++ builder when compiled (~10-20M rows/s vs ~80k rows/s for
+    the per-row Python path); falls back to mvcc_sst row encoding.
+
+    Reference: sst_importer sst_writer.rs + Lightning's native kv
+    encoder — the client builds sorted files at native speed, the
+    server ingests them without touching row codecs.
+    """
+    import numpy as np
+
+    from .native import build_mvcc_sst
+    start_ts = start_ts or commit_ts - 1
+    h = np.ascontiguousarray(np.asarray(handles, dtype=np.int64))
+    if build_mvcc_sst is not None:
+        ids, kinds, bufs, valids = [], [], [], []
+        for col_id, vals, valid in columns:
+            a = np.asarray(vals)
+            if a.dtype.kind == "f":
+                kinds.append(1)
+                a = np.ascontiguousarray(a, dtype=np.float64)
+            else:
+                kinds.append(0)
+                a = np.ascontiguousarray(a, dtype=np.int64)
+            ids.append(int(col_id))
+            bufs.append(a.tobytes())
+            valids.append(None if valid is None else
+                          np.ascontiguousarray(
+                              valid, dtype=np.uint8).tobytes())
+        return build_mvcc_sst(table_id, h.tobytes(), tuple(ids),
+                              tuple(kinds), tuple(bufs), tuple(valids),
+                              commit_ts, start_ts)
+    # interpreted fallback: per-row encode through the shared codecs
+    from .codec.keys import table_record_key
+    from .codec.row import encode_row
+    rows = []
+    col_arrs = [(int(cid), np.asarray(vals), valid)
+                for cid, vals, valid in columns]
+    for i, handle in enumerate(h.tolist()):
+        payload = {}
+        for cid, vals, valid in col_arrs:
+            if valid is not None and not valid[i]:
+                payload[cid] = None
+            elif vals.dtype.kind == "f":
+                payload[cid] = float(vals[i])
+            else:
+                payload[cid] = int(vals[i])
+        rows.append((table_record_key(table_id, handle),
+                     encode_row(payload)))
+    w = mvcc_sst(rows, commit_ts, start_ts)
+    by_cf: dict = {}
+    w._pairs.sort(key=lambda p: (p[0], p[1]))
+    for cf, k, v in w._pairs:
+        by_cf.setdefault(cf, ([], []))
+        by_cf[cf][0].append(k)
+        by_cf[cf][1].append(v)
+    return build_sst_v2(by_cf)
 
 
 def mvcc_sst(rows, commit_ts: int, start_ts: int = 0) -> SstWriter:
