@@ -14,9 +14,11 @@ Configs (BASELINE.md + r4 additions):
       overlapped dispatches (read pools overlap requests exactly this
       way; the tunnel sync floor hides under concurrency)
   6.  PRODUCTION PATH: gRPC → raft leader → MVCC snapshot → region
-      columnar cache (native C++ build) → executor, on a live
-      single-node server; cold = first query (cache build), warm =
-      cache hit (VERDICT r3 #1)
+      columnar cache (native C++ build) → DEVICE kernel → wire, on a
+      live single-node server at ≥10M rows, bulk-loaded via the native
+      ImportSST path; cold = first query (cache build + feed upload),
+      warm = HBM feed hit; per-phase TimeDetail decomposition on both
+      (VERDICT r4 #1)
 
 Latency decomposition: "device_sync_floor_ms" reports the cost of ONE
 tiny dispatch+fetch through the device transport — over a tunneled TPU
@@ -207,27 +209,37 @@ def run_pipelined(runner, dag, snap, n: int, n_threads: int = 8,
 
 
 def run_production_path(device_runner, iters: int):
-    """Config 6: the full network path on a live single-node server.
+    """Config 6: the full network path on a live single-node server,
+    THROUGH THE DEVICE (VERDICT r4 #1 — the request path IS the metric).
 
     gRPC → raft leader lease read → MVCC snapshot → RegionColumnarCache
-    (native C++ MVCC→columnar build) → vectorized executor → wire.
-    Cold = first query at a fresh data version (pays the columnar
-    build); warm = cache hit.  Load phase uses real 2PC transactions.
+    (native C++ MVCC→columnar build) → device feed upload → Pallas
+    hash-agg kernel → readback → wire.  Cold = first query at a fresh
+    data version (columnar build + feed upload); warm = HBM feed-cache
+    hit.  Load rides the native ImportSST path (C++ SST build + v2
+    file-grain raft ingest), not 2PC.  Per-phase latency decomposition
+    comes from the response's TimeDetail (per-request tracker), matching
+    src/coprocessor/endpoint.rs:546 + components/tracker/src/lib.rs.
     """
+    from tikv_tpu.codec.keys import table_record_key
     from tikv_tpu.raftstore.metapb import Store
     from tikv_tpu.server import (
         Node, PdServer, RemotePdClient, TikvServer, TxnClient,
     )
+    from tikv_tpu.sst_importer import fast_mvcc_table_sst
     from tikv_tpu.testing.dag import DagSelect
-    from tikv_tpu.testing.fixture import encode_table_row, int_table
+    from tikv_tpu.testing.fixture import int_table
 
-    n = int(os.environ.get("TIKV_TPU_BENCH_PROD_ROWS", 400_000))
+    n = int(os.environ.get("TIKV_TPU_BENCH_PROD_ROWS", 10 * (1 << 20)))
     pd_server = PdServer("127.0.0.1:0")
     pd_server.start()
     pd_addr = f"127.0.0.1:{pd_server.port}"
     node = Node("127.0.0.1:0", RemotePdClient(pd_addr),
-                device_runner=device_runner,
-                device_row_threshold=1 << 62)   # keep copr on host path
+                device_runner=device_runner)
+    # one region holds the whole table: this config measures the
+    # request path at scale, not the split machinery
+    node.config.raftstore.region_split_size_mb = 1 << 20
+    node.config.raftstore.region_max_size_mb = 1 << 20
     srv = TikvServer(node)
     node.addr = f"127.0.0.1:{srv.port}"
     node.pd.put_store(Store(node.store_id, node.addr))
@@ -235,35 +247,84 @@ def run_production_path(device_runner, iters: int):
     try:
         c = TxnClient(pd_addr)
         table = int_table(2, table_id=9900)
-        batch = 20_000
+        chunk = 1 << 20
         t0 = time.perf_counter()
-        for s in range(0, n, batch):
-            muts = [("put",) + encode_table_row(
-                table, h, {"c0": h % 1024, "c1": h % 1000})
-                for h in range(s, min(s + batch, n))]
-            c.txn_write(muts)
+        for s in range(0, n, chunk):
+            hs = np.arange(s, min(s + chunk, n), dtype=np.int64)
+            blob = fast_mvcc_table_sst(
+                table.table_id, hs,
+                [(2, hs % 1024, None), (3, hs % 1000, None)],
+                commit_ts=c.tso())
+            c.ingest_sst(blob,
+                         table_record_key(table.table_id, int(hs[0])),
+                         chunk=2 << 20)
         load_s = time.perf_counter() - t0
-        sel = DagSelect.from_table(table, ["id", "c0", "c1"])
 
         def agg_dag():
+            # fresh builder per request: DagSelect is a fluent MUTABLE
+            # builder — reusing one stacks aggregate stages (this bug
+            # made r4's config-6 warm numbers measure agg-over-agg)
+            sel = DagSelect.from_table(table, ["id", "c0", "c1"])
             return sel.aggregate(
                 [sel.col("c0")],
                 [("count_star", None), ("sum", sel.col("c1"))]
             ).build(start_ts=c.tso())
 
         t0 = time.perf_counter()
-        resp = c.coprocessor(agg_dag())
+        cold = c.coprocessor(agg_dag(), timeout=600)
         cold_ms = (time.perf_counter() - t0) * 1e3
-        assert len(resp["rows"]) == 1024
-        p50, p99, _ = measure(lambda: c.coprocessor(agg_dag()),
-                              max(4, iters // 2))
+        assert len(cold["rows"]) == 1024
+        assert sum(r[0] for r in cold["rows"]) == n
+        box = {}
+
+        def run_warm():
+            box["r"] = c.coprocessor(agg_dag(), timeout=60)
+
+        run_warm()
+        p50, p99, _ = measure(run_warm, max(4, iters // 2))
+        warm = box["r"]
+        assert sum(r[0] for r in warm["rows"]) == n   # results stay exact
+        # steady-state cold: one write bumps the data version, so the
+        # next query rebuilds the columnar cache + device feed with the
+        # kernel already compiled — the operational cache-miss cost
+        # (first-ever cold_ms above additionally pays the one-time XLA
+        # compile for this feed shape)
+        from tikv_tpu.testing.fixture import encode_table_row
+        c.txn_write([("put",) + encode_table_row(
+            table, n, {"c0": 0, "c1": 0})])
+        t0 = time.perf_counter()
+        rebuild1 = c.coprocessor(agg_dag(), timeout=600)
+        rebuild1_ms = (time.perf_counter() - t0) * 1e3
+        assert sum(r[0] for r in rebuild1["rows"]) == n + 1
+        # second cycle: the padded feed shape is bucketed (4-significant-
+        # bit block counts), so steady-state rebuilds reuse the compiled
+        # kernels; cycle 1 may cross a bucket boundary and pay a
+        # one-time XLA compile
+        c.txn_write([("put",) + encode_table_row(
+            table, n + 1, {"c0": 0, "c1": 0})])
+        t0 = time.perf_counter()
+        rebuild = c.coprocessor(agg_dag(), timeout=600)
+        rebuild_ms = (time.perf_counter() - t0) * 1e3
+        assert sum(r[0] for r in rebuild["rows"]) == n + 2
         return {
             "rows": n,
-            "backend": "grpc+mvcc+columnar_cache",
+            "backend": warm["backend"],
+            "path": "grpc+raft_lease+mvcc+columnar_cache+" +
+                    warm["backend"],
             "load_rows_per_sec": round(n / load_s, 1),
-            "cold_build_ms": round(cold_ms, 3),
+            "load_s": round(load_s, 2),
+            "cold_ms": round(cold_ms, 3),
+            "cold_phases_ms": cold.get("time_detail", {}).get(
+                "phases_ms", {}),
+            "rebuild_ms": round(rebuild_ms, 3),
+            "rebuild_phases_ms": rebuild.get("time_detail", {}).get(
+                "phases_ms", {}),
+            "rebuild_first_ms": round(rebuild1_ms, 3),
             "p50_ms": round(p50 * 1e3, 3),
             "p99_ms": round(p99 * 1e3, 3),
+            "warm_phases_ms": warm.get("time_detail", {}).get(
+                "phases_ms", {}),
+            "warm_labels": warm.get("time_detail", {}).get("labels", {}),
             "rows_per_sec": round(n / p50, 1),
         }
     finally:
@@ -354,8 +415,27 @@ def main() -> None:
     groups = int(os.environ.get("TIKV_TPU_BENCH_GROUPS", 1024))
     n4 = sz(100 * (1 << 20))
     table_p, snap_p = build_table(n4, groups)
+    dag_p = _dag_hash_agg(table_p)
     configs["4p_hash_agg_pipelined"] = run_pipelined(
-        runner, _dag_hash_agg(table_p), snap_p, n4)
+        runner, dag_p, snap_p, n4)
+    # config-4 attribution (VERDICT r4 #2): kernel-only time via an
+    # RTT-amortized launch train, plus a tracker-phased single request,
+    # so kernel vs transport vs dispatch can be told apart from the
+    # artifact alone
+    kp = runner.probe_kernel(dag_p, snap_p)
+    from tikv_tpu.utils import tracker as _tracker
+    tr, tok = _tracker.install()
+    try:
+        runner.handle_request(dag_p, snap_p)
+    finally:
+        _tracker.uninstall(tok)
+    c4 = configs["4_hash_agg"]
+    if kp is not None:
+        c4["kernel_only_ms"] = kp["kernel_ms"]
+        c4["kernel_rows_per_sec"] = round(n4 / (kp["kernel_ms"] / 1e3), 1)
+        c4["kernel_feed_gbps"] = round(
+            8 * n4 / (kp["kernel_ms"] / 1e3) / 1e9, 1)
+    c4["single_request_phases_ms"] = tr.time_detail()["phases_ms"]
     del table_p, snap_p
     gc.collect()
 

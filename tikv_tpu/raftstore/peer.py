@@ -191,6 +191,13 @@ class RaftPeer:
         self._ready_inflight = False
         # sub-region bucket boundaries (split-check pass computes them)
         self.buckets: list = []
+        # split-check bookkeeping (fsm/apply.rs size_diff_hint +
+        # SplitCheckTask): apply accumulates written bytes; the checker
+        # only re-scans the region once the delta crosses
+        # region_split_check_diff — a full region scan per tick would
+        # stall the store (and contend every lease read) at scale
+        self.approximate_size = 0
+        self.size_diff_hint = 0
         # hibernation (store/hibernate_state.rs): quiet peers stop
         # ticking; any traffic wakes them
         self._idle_ticks = 0
@@ -551,6 +558,11 @@ class RaftPeer:
 
     def _exec_write(self, wb, cmd: RaftCmd) -> dict:
         for op in cmd.ops:
+            # size_diff_hint: written bytes accumulate until the split
+            # checker consumes them (deletes count too — they change
+            # the region's size estimate in the same direction the
+            # reference's apply metrics do)
+            self.size_diff_hint += len(op.key) + len(op.value)
             if op.op == "put":
                 wb.put_cf(op.cf, data_key(op.key), op.value)
             elif op.op == "delete":

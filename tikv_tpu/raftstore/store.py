@@ -445,16 +445,35 @@ class RaftStore:
     def split_check(self, pd) -> int:
         """One split-checker pass (store/worker/split_check.rs): leader
         peers over ``region_split_size_mb`` propose a half-split with
-        PD-allocated ids.  One bulk scan per region serves both the
-        size estimate and the split key.  Returns splits proposed."""
+        PD-allocated ids.  One bulk scan per region serves the size
+        estimate, the split key, AND the bucket bounds — but a region
+        is only re-scanned once apply has accumulated
+        ``region_split_check_diff`` bytes of changes since the last
+        scan (fsm/apply.rs size_diff_hint): scanning every region every
+        pass would cost seconds per tick at bench scale and contend
+        every lease read.  Returns splits proposed."""
         threshold = int(self.config.region_split_size_mb * (1 << 20))
         if threshold <= 0:
             return 0
+        # reference default: split-size/16 (coprocessor config
+        # region_split_check_diff); bucket bounds also come from this
+        # scan, so the finer of the two granularities drives the
+        # re-check trigger.  Scales down with tiny test thresholds so
+        # small fixtures still re-check promptly.
+        bucket_bytes = int(getattr(self.config, "region_bucket_size_mb",
+                                   32) * (1 << 20))
+        gran = min(threshold, bucket_bytes) if bucket_bytes > 0 \
+            else threshold
+        check_diff = max(gran // 16, 1)
         proposed = 0
         for peer in self.peers_snapshot():
             if not peer.is_leader() or peer.merging is not None:
                 continue
+            if peer.size_diff_hint < check_diff:
+                continue
+            peer.size_diff_hint = 0
             size, entries = self._scan_region(peer)
+            peer.approximate_size = size
             peer.buckets = self._bucket_bounds(entries)
             if size < threshold:
                 continue

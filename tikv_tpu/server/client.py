@@ -189,14 +189,14 @@ class TxnClient:
         return self._store_client(leader.store_id), region
 
     def _call_leader(self, key: bytes, method: str, req: dict,
-                     retries: int = 8) -> dict:
+                     retries: int = 8, timeout: float = 10) -> dict:
         """Retry NotLeader/EpochNotMatch with fresh routing (client-go
         region cache invalidation)."""
         last: Optional[Exception] = None
         for _ in range(retries):
             client, _region = self._leader_client(key)
             try:
-                return client.call(method, req)
+                return client.call(method, req, timeout=timeout)
             except wire.RemoteError as e:
                 if e.kind in ("not_leader", "epoch_not_match",
                               "region_not_found", "region_merging"):
@@ -362,7 +362,8 @@ class TxnClient:
                     force_backend: Optional[str] = None,
                     paging_size: int = 0, resume_token=None,
                     resource_group: str = "default",
-                    request_source: str = "") -> dict:
+                    request_source: str = "",
+                    timeout: float = 10) -> dict:
         key = key_hint if key_hint is not None else \
             (dag.ranges[0].start if dag.ranges else b"")
         return self._call_leader(key, "Coprocessor", {
@@ -370,7 +371,7 @@ class TxnClient:
             "force_backend": force_backend,
             "paging_size": paging_size, "resume_token": resume_token,
             "resource_group": resource_group,
-            "request_source": request_source})
+            "request_source": request_source}, timeout=timeout)
 
     def coprocessor_paged(self, dag, paging_size: int,
                           key_hint: Optional[bytes] = None):
