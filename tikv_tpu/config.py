@@ -54,6 +54,11 @@ class RaftstoreConfig:
     store_pool_size: int = 0
     store_io_pool_size: int = 1
     region_bucket_size_mb: float = 32.0
+    # load-based splitting (split_controller.rs): a region sustaining
+    # >= split_qps_threshold reads/s for split_detect_times windows
+    # splits at the sampled-access median key; 0 disables
+    split_qps_threshold: int = 3000
+    split_detect_times: int = 3
 
 
 @dataclass

@@ -32,6 +32,9 @@ class RaftKv:
         # write-path latency inspector feeding the health controller's
         # slow score (store/async_io/write.rs:24 LatencyInspector)
         self._latency_inspector = latency_inspector
+        # read-traffic hook feeding the load-split controller
+        # (split_controller.rs: reads report their keys per region)
+        self.on_read = None
 
     def _local_drive(self, done: Callable[[], bool]) -> None:
         for _ in range(10000):
@@ -52,6 +55,8 @@ class RaftKv:
 
     def snapshot(self, ctx: SnapContext):
         peer = self._route(ctx)
+        if self.on_read is not None and ctx.key_hint:
+            self.on_read(peer.region.id, ctx.key_hint)
         if ctx.replica_read and not peer.is_leader():
             # follower read via ReadIndex (SURVEY §2.8.4): consistent at
             # the leader's commit point, zero leader load.  In the

@@ -364,6 +364,14 @@ class Node:
         self.raft_kv = RaftKv(self.raft_store, driver=self._wait_driver,
                               lock=self.lock,
                               latency_inspector=self.health.record_write)
+        # load-based splitting (split_controller.rs): hot regions shed
+        # load by splitting at the sampled-access median key
+        from ..raftstore.load_split import LoadSplitController
+        self.load_split = LoadSplitController(
+            qps_threshold=config.raftstore.split_qps_threshold,
+            detect_times=config.raftstore.split_detect_times)
+        if config.raftstore.split_qps_threshold > 0:
+            self.raft_kv.on_read = self.load_split.record_read
         from ..storage.lock_manager import LockManager
         self.storage = Storage(
             engine=self.raft_kv,
@@ -473,6 +481,8 @@ class Node:
                             self.raft_store.split_check(self.pd)
                         except Exception:
                             pass    # PD outage: retry next interval
+                due_load_splits = self.load_split.tick() \
+                    if not self.import_mode else {}
                 did = self.raft_store.drive()
                 self._wake.notify_all()
                 # periodic PD reporting (worker/pd.rs heartbeat loop)
@@ -485,6 +495,8 @@ class Node:
                 else:
                     leaders = None
             self.transport.flush()
+            for rid, samples in due_load_splits.items():
+                self._try_load_split(rid, samples)
             if leaders is not None:
                 try:
                     for region, leader, buckets in leaders:
@@ -511,6 +523,31 @@ class Node:
                     pass    # PD outages must not stall raft
             if did == 0:
                 time.sleep(self._tick_interval / 4)
+
+    def _try_load_split(self, region_id: int, samples: list) -> None:
+        """Split a hot region at the sampled-access median key
+        (split_controller.rs -> pd ask_split -> split admin cmd, same
+        flow as the size checker).  Load splits are best-effort: any
+        routing/epoch race just drops the attempt — the region stays
+        hot and the next window retries."""
+        from ..storage.txn_types import decode_key
+        try:
+            peer = self.raft_store.peers.get(region_id)
+            if peer is None or not peer.is_leader() or \
+                    peer.merging is not None:
+                return
+            region = peer.region
+            enc_key = self.load_split.split_key_for(
+                samples, region.start_key, region.end_key)
+            if enc_key is None:
+                return
+            self.split_region(region_id, decode_key(enc_key))
+            self.load_split.splits_proposed += 1
+        except Exception:   # noqa: BLE001 — next hot window retries
+            import logging
+            logging.getLogger(__name__).debug(
+                "load split of region %d failed", region_id,
+                exc_info=True)
 
     def _wait_driver(self, done) -> None:
         """RaftKv blocks here while the drive thread makes progress."""
