@@ -183,3 +183,65 @@ def test_pooled_multi_region_concurrent_writes(pooled_server):
     regions = {p.region.id
                for p in pooled_server["node"].raft_store.peers.values()}
     assert len(regions) == 2
+
+def test_apply_pool_slow_apply_does_not_stall_raft(pooled_server):
+    """fsm/apply.rs:3906: apply runs on a SECOND batch-system, so a slow
+    apply (bulk write/ingest) on one region never stalls raft on the
+    same store — a split + fresh election completes while another
+    region's apply is sleeping in a failpoint."""
+    import threading
+    import time as _t
+
+    from tikv_tpu.utils import failpoint
+
+    c = pooled_server["client"]
+    node = pooled_server["node"]
+    assert getattr(node.raft_store, "_apply_pool", None) is not None
+    c.put(b"a-seed", b"1")
+    c.split(b"m")
+    _t.sleep(0.3)               # PD learns the new region via heartbeat
+    c.put(b"z-seed", b"1")
+
+    slept = threading.Event()
+
+    def slow_apply():
+        # only the apply-pool thread sleeps (inline admin applies on
+        # the raft pollers hit this site too)
+        if threading.current_thread().name.startswith("apply-") and \
+                not slept.is_set():
+            slept.set()
+            _t.sleep(1.5)
+
+    failpoint.cfg_callback("apply::before_write", slow_apply)
+    try:
+        box = {}
+
+        def write_left():
+            t0 = _t.perf_counter()
+            c.put(b"a-slow", b"v")
+            box["dt"] = _t.perf_counter() - t0
+
+        th = threading.Thread(target=write_left)
+        th.start()
+        assert slept.wait(3.0), "apply pool never picked up the write"
+        # while that apply sleeps: another region on the SAME store
+        # splits, campaigns, and elects a leader
+        t0 = _t.perf_counter()
+        right = c.split(b"t")
+        led = False
+        deadline = _t.monotonic() + 1.2
+        while _t.monotonic() < deadline:
+            p = node.raft_store.peers.get(right.id)
+            if p is not None and p.is_leader():
+                led = True
+                break
+            _t.sleep(0.01)
+        election_s = _t.perf_counter() - t0
+        assert led, "new region did not elect during the slow apply"
+        assert "dt" not in box, "slow write finished too early"
+        th.join(5.0)
+        assert box["dt"] >= 1.0, box
+        assert c.get(b"a-slow") == b"v"
+        assert election_s < 1.2, election_s
+    finally:
+        failpoint.remove("apply::before_write")

@@ -53,6 +53,9 @@ class RaftstoreConfig:
     # raft-log writer threads (store-pool-size / store-io-pool-size)
     store_pool_size: int = 0
     store_io_pool_size: int = 1
+    # apply-pool size (reference apply-pool-size, fsm/apply.rs second
+    # batch-system); 0 = apply inline on the raft pollers
+    apply_pool_size: int = 2
     region_bucket_size_mb: float = 32.0
     # load-based splitting (split_controller.rs): a region sustaining
     # >= split_qps_threshold reads/s for split_detect_times windows

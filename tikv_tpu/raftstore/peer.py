@@ -198,6 +198,16 @@ class RaftPeer:
         # stall the store (and contend every lease read) at scale
         self.approximate_size = 0
         self.size_diff_hint = 0
+        # apply-pool decoupling (fsm/apply.rs ApplyFsm on its own
+        # batch-system): plain-write entry batches apply on a second
+        # poller pool; applied_engine tracks what the ENGINE holds —
+        # node.applied may run ahead while a batch is queued, and reads
+        # must gate on engine state, not raft bookkeeping
+        self.applied_engine = self.node.applied
+        # proposals are appended by the raft poller and consumed by
+        # whichever thread applies — their own lock keeps the apply
+        # pool off peer.mu (the whole point of the second pool)
+        self._prop_mu = _threading.Lock()
         # hibernation (store/hibernate_state.rs): quiet peers stop
         # ticking; any traffic wakes them
         self._idle_ticks = 0
@@ -281,7 +291,8 @@ class RaftPeer:
                 leave_joint=meta.get("leave", False)))
         else:
             index = self.node.propose(cmd.to_bytes())
-        self.proposals.append(Proposal(index, self.node.term, cb))
+        with self._prop_mu:
+            self.proposals.append(Proposal(index, self.node.term, cb))
         return index
 
     def local_read(self) -> Optional[RegionSnapshot]:
@@ -298,11 +309,14 @@ class RaftPeer:
         node = self.node
         if not self.is_leader() or not node.in_lease():
             return None
-        if node.storage.term(node.applied) != node.term:
+        # gate on what the ENGINE holds: with the apply pool,
+        # node.applied may run ahead of a queued batch, and a lease
+        # read must never serve a snapshot missing acked writes
+        if node.storage.term(self.applied_engine) != node.term:
             return None     # fresh leader: noop not applied yet
         snap = RegionSnapshot(self.engine.snapshot(), self.region)
         snap.data_index = self.data_index
-        snap.apply_index = node.applied
+        snap.apply_index = self.applied_engine
         return snap
 
     def replica_read(self, cb: Callable, read_ts: int = 0) -> None:
@@ -332,11 +346,14 @@ class RaftPeer:
             return
         still = []
         for index, cb in self._replica_waiting:
-            if node.applied >= index:
+            # the ReadIndex contract is "applied up to the leader's
+            # commit point IN THE ENGINE" — node.applied may run ahead
+            # of a queued apply batch
+            if self.applied_engine >= index:
                 snap = RegionSnapshot(self.engine.snapshot(),
                                       self.region)
                 snap.data_index = self.data_index
-                snap.apply_index = node.applied
+                snap.apply_index = self.applied_engine
                 cb(snap)
             else:
                 still.append((index, cb))
@@ -360,14 +377,17 @@ class RaftPeer:
                 snap.data_index = self.data_index
                 snap.apply_index = index
                 cb(snap)
-        self.proposals.append(Proposal(index, self.node.term, on_applied,
+        with self._prop_mu:
+            self.proposals.append(Proposal(index, self.node.term,
+                                           on_applied,
                                        is_read=True))
         return index
 
     # ------------------------------------------------------------- ready
 
     def handle_ready(self, async_writer=None, on_persisted=None,
-                     on_persist_failed=None) -> list[Message]:
+                     on_persist_failed=None,
+                     apply_ctx=None) -> list[Message]:
         """Persist, apply, return messages to send.  Reference:
         handle_raft_ready_append + the apply poller, collapsed.
 
@@ -406,6 +426,34 @@ class RaftPeer:
                     fail_cb=(None if on_persist_failed is None else
                              (lambda: on_persist_failed(self.region.id))))
                 break
+            if apply_ctx is not None and rd.snapshot is None and \
+                    rd.committed_entries and \
+                    all(self._is_plain_write(e)
+                        for e in rd.committed_entries):
+                # decoupled apply (fsm/apply.rs: ApplyFsm runs on its
+                # own batch-system): persist the log, queue the
+                # committed plain-write batch on the apply pool, and
+                # advance — a slow apply (bulk ingest, big writes)
+                # never stalls this poller's raft ticks or elections.
+                # Only plain writes decouple: admin/conf-change/read
+                # barriers mutate raft-side state and stay inline,
+                # ordered behind the queue by the drain below.
+                fail_point("raftlog::before_persist")
+                wb = self.engine.write_batch()
+                meta = self.node.storage.snapshot.metadata
+                self.peer_storage.persist(wb, rd.entries, rd.hard_state,
+                                          truncated=(meta.index,
+                                                     meta.term))
+                if not wb.is_empty():
+                    self.engine.write(wb)
+                apply_ctx.send(self.region.id, rd.committed_entries)
+                out.extend(rd.messages)
+                self.node.advance(rd)
+                continue
+            if apply_ctx is not None and rd.committed_entries:
+                # complex batch: every queued plain apply must land
+                # first so entries execute in commit order
+                apply_ctx.drain(self.region.id)
             wb = self.engine.write_batch()
             if rd.snapshot is not None:
                 fail_point("snapshot::before_apply")
@@ -415,6 +463,8 @@ class RaftPeer:
                 # pre-snapshot entries
                 self.data_index = max(self.data_index,
                                       rd.snapshot.metadata.index)
+                self.applied_engine = max(self.applied_engine,
+                                          rd.snapshot.metadata.index)
                 self.store.on_region_changed(self, region)
             fail_point("raftlog::before_persist")
             meta = self.node.storage.snapshot.metadata
@@ -424,6 +474,7 @@ class RaftPeer:
             if rd.committed_entries:
                 from ..utils.metrics import RAFT_APPLY_COUNTER
                 RAFT_APPLY_COUNTER.inc(len(rd.committed_entries))
+            cbs: list = []
             for entry in rd.committed_entries:
                 if not entry.data and not wb.is_empty() and \
                         self._pending_read_at(entry.index, entry.term):
@@ -442,7 +493,7 @@ class RaftPeer:
                     self.peer_storage.persist_apply(wb, entry.index - 1)
                     self.engine.write(wb)
                     wb = self.engine.write_batch()
-                self._apply_entry(wb, entry)
+                self._apply_entry(wb, entry, cbs)
             if rd.committed_entries:
                 self.peer_storage.persist_apply(
                     wb, rd.committed_entries[-1].index)
@@ -457,6 +508,12 @@ class RaftPeer:
                 for index, ops in self._pending_obs:
                     host.notify_apply_write(self.region.id, index, ops)
                 self._pending_obs.clear()
+            if rd.committed_entries:
+                self.applied_engine = rd.committed_entries[-1].index
+            # ACKs leave only now — after the engine write (see
+            # _apply_entry)
+            for prop, res in cbs:
+                prop.cb(res)
             out.extend(rd.messages)
             self.node.advance(rd)
         self._serve_replica_reads()
@@ -484,6 +541,46 @@ class RaftPeer:
             return False
         return RaftCmd.peek_admin_kind(entry.data) == "compute_hash"
 
+    @staticmethod
+    def _is_plain_write(entry) -> bool:
+        """Entries the apply pool may execute concurrently with raft
+        driving: KV writes only — no admin (mutates region/raft meta),
+        no conf change, no read barrier (serves an engine snapshot that
+        must reflect every earlier entry)."""
+        if not entry.data or entry.entry_type is EntryType.CONF_CHANGE:
+            return False
+        return RaftCmd.peek_admin_kind(entry.data) is None
+
+    def apply_plain_entries(self, entries) -> None:
+        """Apply one committed plain-write batch on the APPLY pool
+        (fsm/apply.rs ApplyDelegate::handle_raft_committed_entries).
+
+        Runs WITHOUT peer.mu: region meta is stable (admin entries
+        execute inline behind an apply-queue drain), proposals have
+        their own lock, and ``applied_engine`` advances last so reads
+        gate on durable engine state."""
+        from ..utils.failpoint import fail_point
+        from ..utils.metrics import RAFT_APPLY_COUNTER
+        RAFT_APPLY_COUNTER.inc(len(entries))
+        fail_point("apply::before_entries")
+        wb = self.engine.write_batch()
+        cbs: list = []
+        for entry in entries:
+            self._apply_entry(wb, entry, cbs)
+        self.peer_storage.persist_apply(wb, entries[-1].index)
+        fail_point("apply::before_write")
+        if not wb.is_empty():
+            self.engine.write(wb)
+        fail_point("apply::after_write")
+        if self._pending_obs:
+            host = self.store.coprocessor_host
+            for index, ops in self._pending_obs:
+                host.notify_apply_write(self.region.id, index, ops)
+            self._pending_obs.clear()
+        self.applied_engine = entries[-1].index
+        for prop, res in cbs:
+            prop.cb(res)
+
     def on_log_persisted(self, rd) -> list[Message]:
         """Async-IO completion: the log batch hit disk — now the acks
         may leave and the ready advances (write.rs persisted callback).
@@ -495,24 +592,38 @@ class RaftPeer:
     # ------------------------------------------------------------- apply
 
     def _pending_read_at(self, index: int, term: int) -> bool:
-        for p in self.proposals:
-            if p.index >= index:
-                return p.index == index and p.term == term and p.is_read
+        with self._prop_mu:
+            for p in self.proposals:
+                if p.index >= index:
+                    return p.index == index and p.term == term \
+                        and p.is_read
         return False
 
     def _take_proposal(self, index: int, term: int) -> Optional[Proposal]:
-        while self.proposals and self.proposals[0].index <= index:
-            p = self.proposals.pop(0)
-            if p.index == index and p.term == term:
-                return p
+        stale = []
+        got = None
+        with self._prop_mu:
+            while self.proposals and self.proposals[0].index <= index:
+                p = self.proposals.pop(0)
+                if p.index == index and p.term == term:
+                    got = p
+                    break
+                stale.append(p)
+        for p in stale:     # callbacks run outside the lock
             p.cb(NotLeaderError(self.region.id, self.leader_peer()))
-        return None
+        return got
 
-    def _apply_entry(self, wb, entry) -> None:
+    def _apply_entry(self, wb, entry, out_cbs: list) -> None:
+        """Execute one committed entry into ``wb``; the proposal
+        callback (the client's ACK) is APPENDED to ``out_cbs``, not
+        fired — acks must not leave before the batch's engine write
+        lands, or a concurrent lease read could miss an acked write
+        (the apply pool made that window real; the reference invokes
+        apply callbacks after the write batch commits the same way)."""
         prop = self._take_proposal(entry.index, entry.term)
         if not entry.data:
             if prop is not None:
-                prop.cb({})     # read barrier / leader noop
+                out_cbs.append((prop, {}))  # read barrier / leader noop
             return
         if entry.entry_type is EntryType.CONF_CHANGE:
             if ConfChangeV2.is_v2(entry.data):
@@ -534,7 +645,7 @@ class RaftPeer:
                 self._check_epoch_at_apply(cmd)
             except EpochNotMatch as e:
                 if prop is not None:
-                    prop.cb(e)
+                    out_cbs.append((prop, e))
                 return
             if cmd.admin is not None:
                 result = self._exec_admin(wb, cmd.admin,
@@ -549,7 +660,7 @@ class RaftPeer:
                 result = self._exec_write(wb, cmd)
                 self._pending_obs.append((entry.index, cmd.ops))
         if prop is not None:
-            prop.cb(result)
+            out_cbs.append((prop, result))
 
     def _check_epoch_at_apply(self, cmd: RaftCmd) -> None:
         region = self.region

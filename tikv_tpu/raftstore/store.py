@@ -43,6 +43,36 @@ class Transport:
         raise NotImplementedError
 
 
+class _ApplyCtx:
+    """Routing seam handed to peers' handle_ready: queue a committed
+    plain-write batch on the apply pool, or drain a region's queue so
+    complex entries (admin/conf-change/read barriers) keep commit
+    order (fsm/apply.rs: PeerFsm -> ApplyRouter -> ApplyFsm)."""
+
+    def __init__(self, store):
+        self._store = store
+
+    def send(self, region_id: int, entries) -> None:
+        st = self._store
+        if not st.apply_router.send(region_id, ("apply", entries)):
+            # mailbox missing (register race on a fresh shell peer):
+            # apply inline on this poller — nothing is queued, so
+            # same-thread execution keeps commit order
+            peer = st.peers.get(region_id)
+            if peer is not None:
+                peer.apply_plain_entries(entries)
+
+    def drain(self, region_id: int, timeout: float = 10.0) -> None:
+        import threading as _t
+        st = self._store
+        ev = _t.Event()
+        if not st.apply_router.send(region_id, ("barrier", ev)):
+            return
+        if not ev.wait(timeout):
+            raise TimeoutError(
+                f"apply queue drain stalled for region {region_id}")
+
+
 class RaftStore:
     def __init__(self, store_id: int, engine: KvEngine,
                  transport: Transport, election_tick: int = 10,
@@ -142,6 +172,8 @@ class RaftStore:
         peer.peer_storage.persist_region(wb, right)
         if self.pooled():
             self.router.register(right.id)
+            if getattr(self, "_apply_pool", None) is not None:
+                self.apply_router.register(right.id)
         if was_leader:
             # the parent's leader store campaigns the new region at once
             # so it gets a leader without waiting an election timeout
@@ -196,6 +228,8 @@ class RaftStore:
                                                      peers=()), to_peer)
                         self.peers[region_id] = peer
                         self.router.register(region_id)
+                        if getattr(self, "_apply_pool", None) is not None:
+                            self.apply_router.register(region_id)
             self._route_peer_msg(region_id,
                                  ("raft", to_peer, from_peer, msg))
             return
@@ -231,7 +265,8 @@ class RaftStore:
     # the reference keeps both shapes too (test_raftstore's node
     # simulator vs the real poll loops).
 
-    def start_pool(self, n_pollers: int = 2, n_writers: int = 1) -> None:
+    def start_pool(self, n_pollers: int = 2, n_writers: int = 1,
+                   n_appliers: int = 1) -> None:
         from .batch_system import PollerPool, Router, WriteWorkerPool
         self.router = Router()
         self.write_pool = WriteWorkerPool(self.engine, n_writers)
@@ -240,6 +275,22 @@ class RaftStore:
         self._pool = PollerPool(self.router, self._handle_fsm,
                                 name=f"store-{self.store_id}")
         self._pool.spawn(n_pollers)
+        # second batch-system for apply (fsm/apply.rs:3906 ApplyBatchSystem):
+        # plain-write entry batches execute here so a slow apply (bulk
+        # ingest, big writes) never stalls raft ticks/elections on the
+        # raft pollers
+        if n_appliers > 0:
+            self.apply_router = Router()
+            for region_id in self.peers:
+                self.apply_router.register(region_id)
+            self._apply_pool = PollerPool(
+                self.apply_router, self._handle_apply_fsm,
+                name=f"apply-{self.store_id}")
+            self._apply_pool.spawn(n_appliers)
+            self._apply_ctx = _ApplyCtx(self)
+        else:
+            self._apply_pool = None
+            self._apply_ctx = None
 
     def stop_pool(self) -> None:
         pool = getattr(self, "_pool", None)
@@ -247,6 +298,11 @@ class RaftStore:
             pool.shutdown()
             self.write_pool.shutdown()
             self._pool = None
+        apool = getattr(self, "_apply_pool", None)
+        if apool is not None:
+            apool.shutdown()
+            self._apply_pool = None
+            self._apply_ctx = None
 
     def pooled(self) -> bool:
         return getattr(self, "_pool", None) is not None
@@ -300,11 +356,39 @@ class RaftStore:
         self._send_all(peer, peer.handle_ready(
             async_writer=self.write_pool,
             on_persisted=self._on_persisted,
-            on_persist_failed=self._on_persist_failed))
+            on_persist_failed=self._on_persist_failed,
+            apply_ctx=getattr(self, "_apply_ctx", None)))
         if peer.pending_destroy:
             self.destroy_peer(region_id)
             self.router.close(region_id)
+            apool = getattr(self, "_apply_pool", None)
+            if apool is not None:
+                self.apply_router.close(region_id)
         self.transport.flush()
+
+    def _handle_apply_fsm(self, region_id: int, msgs) -> None:
+        """Apply-pool handler: committed plain-write batches + drain
+        barriers, FIFO per region (the mailbox IS the commit order)."""
+        peer = self.peers.get(region_id)
+        applied_any = False
+        for m in msgs:
+            kind = m[0]
+            if kind == "apply":
+                if peer is not None:
+                    try:
+                        peer.apply_plain_entries(m[1])
+                        applied_any = True
+                    except Exception:   # noqa: BLE001 — poison guard
+                        import logging
+                        logging.getLogger(__name__).exception(
+                            "apply batch failed for region %d",
+                            region_id)
+            elif kind == "barrier":
+                m[1].set()
+        if applied_any:
+            # kick the raft FSM: replica reads waiting on
+            # applied_engine are served from its next handle_ready
+            self.router.send(region_id, ("applied",))
 
     def _on_persisted(self, region_id: int, rd) -> None:
         # runs on a writer thread: route back through the mailbox so the

@@ -199,7 +199,10 @@ class TxnClient:
                 return client.call(method, req, timeout=timeout)
             except wire.RemoteError as e:
                 if e.kind in ("not_leader", "epoch_not_match",
-                              "region_not_found", "region_merging"):
+                              "region_not_found", "region_merging") or \
+                        "KeyNotInRegion" in str(e):
+                    # KeyNotInRegion: a server-initiated split (size or
+                    # load checker) landed after we cached the bounds
                     last = e
                     self._invalidate_region(key)
                     time.sleep(0.05)

@@ -447,7 +447,8 @@ class Node:
             # raft-log writers; the drive thread degrades to the tick /
             # heartbeat / split-check pacemaker
             self.raft_store.start_pool(
-                pool, max(1, self.config.raftstore.store_io_pool_size))
+                pool, max(1, self.config.raftstore.store_io_pool_size),
+                self.config.raftstore.apply_pool_size)
         self._thread = threading.Thread(target=self._drive_loop,
                                         daemon=True, name="raft-drive")
         self._thread.start()
