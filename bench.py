@@ -248,6 +248,10 @@ def run_production_path(device_runner, iters: int):
         c = TxnClient(pd_addr)
         table = int_table(2, table_id=9900)
         chunk = 1 << 20
+        # import mode suspends split/bucket re-scans during the bulk
+        # load (sst_importer import_mode.rs) — otherwise every ingested
+        # chunk triggers a full-region size scan
+        c.import_switch_mode(node.store_id, True)
         t0 = time.perf_counter()
         for s in range(0, n, chunk):
             hs = np.arange(s, min(s + chunk, n), dtype=np.int64)
@@ -259,6 +263,7 @@ def run_production_path(device_runner, iters: int):
                          table_record_key(table.table_id, int(hs[0])),
                          chunk=2 << 20)
         load_s = time.perf_counter() - t0
+        c.import_switch_mode(node.store_id, False)
 
         def agg_dag():
             # fresh builder per request: DagSelect is a fluent MUTABLE

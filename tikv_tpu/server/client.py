@@ -541,9 +541,12 @@ class TxnClient:
         return self._store_client(store_id).call("Status", {})
 
     def ingest_sst(self, sst_blob: bytes, region_key: bytes,
-                   chunk: int = 256 * 1024) -> int:
+                   chunk: int = 256 * 1024,
+                   timeout: float = 120) -> int:
         """Bulk load one built SST onto the region owning ``region_key``
-        (upload chunks → ingest; src/import/sst_service.rs flow)."""
+        (upload chunks → ingest; src/import/sst_service.rs flow).
+        ``timeout`` covers the ingest RPC — the raft propose + apply of
+        a multi-million-row file takes seconds, not the default 10."""
         import time as _time
         import uuid as _uuid
         last = None
@@ -557,8 +560,9 @@ class TxnClient:
                     sc.call("ImportUpload", {
                         "uuid": uuid, "seq": seq, "total": total,
                         "data": sst_blob[seq * chunk:(seq + 1) * chunk]})
-                r = sc.call("ImportIngest", {"uuid": uuid,
-                                             "region_id": region.id})
+                r = sc.call("ImportIngest",
+                            {"uuid": uuid, "region_id": region.id},
+                            timeout=timeout)
                 return r["ingested"]
             except wire.RemoteError as e:
                 if e.kind in ("not_leader", "epoch_not_match",
