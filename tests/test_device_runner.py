@@ -450,3 +450,50 @@ def test_xp_control_sigs_ride_device(runner):
     assert runner.supports(dag)
     host, dev = run_both(runner, dag, snap)
     assert_same(host, dev)
+
+
+def test_partial_range_hash_agg_tile_detection():
+    """A hash-agg request covering a strict row subset goes down the
+    bucket-tile path (region feed reused, kernel spans per bucket —
+    SURVEY §5.7 "region → chip, bucket → tile"). On the CPU mesh the
+    Pallas kernel is unavailable, so the tile path must fall back to
+    the HOST pipeline with the ORIGINAL ranges — results must match
+    the ranged host run exactly, never the whole region."""
+    import numpy as np
+
+    from tikv_tpu.codec.keys import table_record_key
+    from tikv_tpu.datatype import Column, EvalType
+    from tikv_tpu.device.runner import DeviceRunner
+    from tikv_tpu.executors.columnar import ColumnarTable
+    from tikv_tpu.executors.ranges import KeyRange
+    from tikv_tpu.executors.runner import BatchExecutorsRunner
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import int_table
+
+    n = 4096
+    table = int_table(2, table_id=9551)
+    hs = np.arange(n, dtype=np.int64)
+    snap = ColumnarTable.from_arrays(
+        table, hs,
+        {"c0": Column(EvalType.INT, hs % 13, np.ones(n, bool)),
+         "c1": Column(EvalType.INT, hs * 2, np.ones(n, bool))})
+    sel = DagSelect.from_table(table, ["id", "c0", "c1"])
+    dag = sel.aggregate([sel.col("c0")],
+                        [("count_star", None),
+                         ("sum", sel.col("c1"))]).build()
+    # restrict to handles [256, 1024)
+    sub = KeyRange(table_record_key(table.table_id, 256),
+                   table_record_key(table.table_id, 1024))
+    dag_sub = type(dag)(dag.executors, (sub,), dag.start_ts,
+                        dag.output_offsets, dag.encode_type)
+    # span mapping resolves the strict subset
+    assert snap.row_slices((sub,)) == [(256, 1024)]
+
+    runner = DeviceRunner()     # CPU mesh in tests
+    got = sorted(runner.handle_request(dag_sub, snap).rows())
+    want = sorted(BatchExecutorsRunner(dag_sub, snap)
+                  .handle_request().rows())
+    assert got == want
+    # sanity: the subset differs from the full-region answer
+    full = sorted(runner.handle_request(dag, snap).rows())
+    assert got != full

@@ -249,6 +249,11 @@ class MvccColumnarSnapshot:
     def count_rows(self, ranges) -> int:
         return self._tbl.count_rows(ranges)
 
+    def row_slices(self, ranges) -> list:
+        """Row-index spans covered by ``ranges`` — the device runner's
+        bucket-tile mapping (request ranges → feed row spans)."""
+        return self._tbl._range_slices(ranges)
+
     def estimated_rows(self) -> int:
         return len(self._tbl)
 

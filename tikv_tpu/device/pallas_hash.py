@@ -130,12 +130,22 @@ def supported(plan, feed, dtypes, pf: int, capacity: int,
     return True
 
 
-def build(plan, layouts, p8: int, capacity: int, n_pad: int,
+def build(plan, layouts, p8: int, capacity: int, nblk: int,
           n_cols: int):
-    """Build the pallas_call for one (plan, feed-shape) pair.
+    """Build the pallas_call for one (plan, grid-span) pair.
 
-    Returns ``(run, LO, HI)`` with ``run(n, base, flat) ->
-    (2, HI, p8*LO) int32`` packed accumulator pair.
+    ``nblk`` is the GRID SPAN in blocks, not the whole feed: the
+    "region → chip, bucket → tile" mapping (SURVEY §5.7, pd_client
+    buckets) dispatches one kernel per covered bucket span — the
+    scalar-prefetched block offset shifts the input index map, so a
+    request over one bucket of a 100M-row region costs one bucket's
+    blocks, and disjoint spans' packed partials merge by addition
+    exactly like psum partials.
+
+    Returns ``(run, LO, HI)`` with
+    ``run(row_lo, row_hi, base, blk0, flat) -> (2, HI, p8*LO) int32``
+    packed accumulator pair covering absolute rows
+    [row_lo, row_hi) ⊆ [blk0*BLOCK, (blk0+nblk)*BLOCK).
     """
     nullable = not key_never_null(plan)
     slots = capacity + (1 if nullable else 0)
@@ -143,7 +153,6 @@ def build(plan, layouts, p8: int, capacity: int, n_pad: int,
     HI = ((hi_n + 7) // 8) * 8
     W = p8 * LO
     B = BLOCK
-    nblk = n_pad // B
     # the sentinel hi value for rows with no destination slot: outside
     # [0, HI), so the row's one-hot column is all-zero
     SENT = HI * LO
@@ -162,11 +171,14 @@ def build(plan, layouts, p8: int, capacity: int, n_pad: int,
             alo[:] = jnp.zeros_like(alo)
             ahi[:] = jnp.zeros_like(ahi)
 
-        n_rows = sref[0]
-        base = sref[1]
-        row0 = i * _i32(B)
+        row_lo = sref[0]
+        row_hi = sref[1]
+        base = sref[2]
+        blk0 = sref[3]
+        row0 = (i + blk0) * _i32(B)
         riota = lax.broadcasted_iota(_i32, (1, B), 1)[0]
-        row_mask = (row0 + riota) < n_rows
+        rows = row0 + riota
+        row_mask = (rows >= row_lo) & (rows < row_hi)
 
         # columns are all-valid (gated): validity == row_mask
         pairs = [(refs[c][:], row_mask) for c in range(n_cols)]
@@ -244,7 +256,7 @@ def build(plan, layouts, p8: int, capacity: int, n_pad: int,
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nblk,),
-        in_specs=[pl.BlockSpec((B,), lambda i, s: (i,))
+        in_specs=[pl.BlockSpec((B,), lambda i, s: (i + s[3],))
                   for _ in range(n_cols)],
         out_specs=pl.BlockSpec((2, HI, W), lambda i, s: (0, 0, 0)),
         scratch_shapes=[pltpu.VMEM((HI, W), _i32),
@@ -260,13 +272,15 @@ def build(plan, layouts, p8: int, capacity: int, n_pad: int,
 
     scal_cache: dict = {}
 
-    def run(n: int, base: int, flat):
+    def run(row_lo: int, row_hi: int, base: int, blk0: int, flat):
         # a fresh scalar H2D on every request adds ~30 ms to the fetch
-        # through the tunnel; the (n, base) pair is constant per feed
-        scal = scal_cache.get((n, base))
+        # through the tunnel; the scalar tuple is constant per
+        # (feed, tile)
+        key = (row_lo, row_hi, base, blk0)
+        scal = scal_cache.get(key)
         if scal is None:
-            scal = jnp.asarray(np.asarray([n, base], np.int32))
-            scal_cache[(n, base)] = scal
+            scal = jnp.asarray(np.asarray(key, np.int32))
+            scal_cache[key] = scal
         with jax.enable_x64(False):
             return call(scal, *flat)
 

@@ -961,13 +961,35 @@ class DeviceRunner:
         if plan is None:
             raise RuntimeError("plan not supported by device backend")
 
+        # bucket tiling (SURVEY §5.7 "region → chip, bucket → tile";
+        # pd_client buckets): a hash-agg request covering a strict
+        # subset of the region's rows reuses the WHOLE-region HBM feed
+        # and dispatches the kernel only over the covering block spans;
+        # disjoint spans' packed partials add like psum partials.
+        tile_spans = None
+        orig_dag = dag
+        if self._single and plan.kind == "hash_agg" and dag.ranges \
+                and hasattr(storage, "row_slices"):
+            try:
+                spans = storage.row_slices(dag.ranges)
+                n_all = storage.estimated_rows()
+            except Exception:   # noqa: BLE001 — storage without spans
+                spans, n_all = None, 0
+            covered = sum(j - i for i, j in spans) if spans else 0
+            if spans and 0 < covered < n_all:
+                tile_spans = tuple(spans)
+                # feed/meta keyed WITHOUT ranges: every tiled request
+                # over this snapshot shares one region feed
+                dag = DAGRequest(dag.executors, (), dag.start_ts,
+                                 dag.output_offsets, dag.encode_type)
+
         # keyed on the full plan: hash_bounds/arg_nbytes depend on the
         # key/arg expressions, not just on which columns are shipped
         meta_key = (dag.plan_key(), dag.ranges)
         meta = self._request_meta(storage, meta_key)
         if meta.get("force_host"):
             from ..executors.runner import BatchExecutorsRunner
-            return BatchExecutorsRunner(dag, storage).handle_request()
+            return BatchExecutorsRunner(orig_dag, storage).handle_request()
 
         memo: dict = {}
 
@@ -986,7 +1008,7 @@ class DeviceRunner:
             meta["n_rows"] = n
         if n == 0:
             from ..executors.runner import BatchExecutorsRunner
-            return BatchExecutorsRunner(dag, storage).handle_request()
+            return BatchExecutorsRunner(orig_dag, storage).handle_request()
 
         def host_cols():
             """Device-dtype numpy column pairs.
@@ -1030,7 +1052,8 @@ class DeviceRunner:
                 result = self._run_simple(dag, plan, dtypes, n, feed)
             elif plan.kind == "hash_agg":
                 result = self._run_hash(dag, plan, host_cols, dtypes, n,
-                                        feed, meta)
+                                        feed, meta,
+                                        tile_spans=tile_spans)
             elif plan.kind == "topn":
                 result = self._run_topn(dag, plan, host_cols, dtypes, n,
                                         get_batch, feed)
@@ -1039,7 +1062,7 @@ class DeviceRunner:
                                             feed)
         except _FallbackToHost:
             from ..executors.runner import BatchExecutorsRunner
-            return BatchExecutorsRunner(dag, storage).handle_request()
+            return BatchExecutorsRunner(orig_dag, storage).handle_request()
 
         if dag.output_offsets is not None:
             b = result.batch
@@ -1069,7 +1092,8 @@ class DeviceRunner:
                     entry = val
         if entry is None:
             return None
-        run, _LO = entry
+        runs_by_nb, _LO = entry
+        run = runs_by_nb[max(runs_by_nb)]      # the full-feed span
         meta = self._request_meta(storage, (dag.plan_key(), dag.ranges))
         if "hash_bounds" not in meta or "n_rows" not in meta:
             return None
@@ -1085,10 +1109,11 @@ class DeviceRunner:
             return None
         if feed is None:
             return None
-        out = run(n, base, feed["flat"])
+        out = run(0, n, base, 0, feed["flat"])
         np.asarray(out)                         # sync
         t0 = _time.perf_counter()
-        outs = [run(n, base, feed["flat"]) for _ in range(launches)]
+        outs = [run(0, n, base, 0, feed["flat"])
+                for _ in range(launches)]
         outs[-1].block_until_ready()
         per = (_time.perf_counter() - t0) / launches
         return {"kernel_ms": round(per * 1e3, 3), "launches": launches}
@@ -1193,7 +1218,8 @@ class DeviceRunner:
         meta["sparse_slots"] = got
         return got
 
-    def _run_hash(self, dag, plan, host_cols, dtypes, n, feed, meta):
+    def _run_hash(self, dag, plan, host_cols, dtypes, n, feed, meta,
+                  tile_spans=None):
         from .kernels import (
             build_layouts,
             matmul_supported,
@@ -1264,7 +1290,12 @@ class DeviceRunner:
         if layouts is not None and not sparse:
             merged = self._try_pallas_hash(dag, plan, feed, dtypes, n,
                                            base, capacity, layouts, p8, pf,
-                                           arg_nbytes, arg_ok_is_mask)
+                                           arg_nbytes, arg_ok_is_mask,
+                                           spans=tile_spans)
+        if merged is None and tile_spans is not None:
+            # bucket tiles exist only on the fused-kernel path; the
+            # host pipeline serves the original ranged request instead
+            raise _FallbackToHost("bucket tiles need the pallas kernel")
         if merged is not None:
             pass
         elif layouts is not None and twolevel_lo(p8, pf) is not None:
@@ -1340,9 +1371,28 @@ class DeviceRunner:
         cols.append(Column.from_list(EvalType.INT, keys))
         return self._result(dag, schema, cols)
 
+    def _bucket_blocks(self, blocks: int) -> int:
+        """Round a grid span up to a 4-significant-bit block count —
+        the compile-class grid shared with _pad_rows."""
+        if blocks > 8:
+            s = blocks.bit_length() - 4
+            k = -(-blocks // (1 << s))
+            if k > 15:
+                s += 1
+                k = -(-blocks // (1 << s))
+            blocks = k << s
+        return max(1, blocks)
+
     def _try_pallas_hash(self, dag, plan, feed, dtypes, n, base, capacity,
-                         layouts, p8, pf, arg_nbytes, arg_ok_is_mask):
+                         layouts, p8, pf, arg_nbytes, arg_ok_is_mask,
+                         spans=None):
         """Fused Pallas fast path for the direct-index hash agg.
+
+        ``spans``: row intervals to aggregate (bucket tiles); None =
+        the whole feed.  Each span dispatches the kernel over its
+        covering grid blocks (bucketed for compile-class reuse, block
+        offset via prefetch scalar) and the packed partials ADD —
+        psum-partial merge semantics.
 
         Returns the merged-states dict (same shape the XLA paths
         produce) or None when the plan/feed/platform is outside the
@@ -1359,18 +1409,47 @@ class DeviceRunner:
                                      self._single):
             return None
         slots = capacity + 2
-        key = ("hashpl", dag.plan_key(), feed["n_pad"], tuple(dtypes),
+        B = pallas_hash.BLOCK
+        total_blocks = feed["n_pad"] // B
+        tiles = []          # (row_lo, row_hi, blk0, span_blocks)
+        for lo, hi in (spans if spans is not None else ((0, n),)):
+            hi = min(hi, n)
+            if hi <= lo:
+                continue
+            blk0 = lo // B
+            nb = self._bucket_blocks(-(-hi // B) - blk0)
+            nb = min(nb, total_blocks)
+            if blk0 + nb > total_blocks:
+                blk0 = total_blocks - nb    # shift left; rows mask exactly
+            tiles.append((lo, hi, blk0, nb))
+        if not tiles:
+            return None
+
+        def dispatch(runs_by_nb):
+            packed = None
+            for lo, hi, blk0, nb in tiles:
+                part = np.asarray(
+                    runs_by_nb[nb](lo, hi, base, blk0, feed["flat"]))
+                packed = part if packed is None else packed + part
+            return packed
+
+        key = ("hashpl", dag.plan_key(),
+               tuple(sorted({t[3] for t in tiles})), tuple(dtypes),
                capacity, arg_nbytes, tuple(arg_ok_is_mask))
         entry = self._kernel_cache.get(key)
         if entry is False:
             return None
         if entry is None:
             try:
-                run, LO, HI = pallas_hash.build(
-                    plan, layouts, p8, capacity, feed["n_pad"],
-                    len(plan.used_cols))
+                runs_by_nb = {}
+                LO = None
+                for nb in sorted({t[3] for t in tiles}):
+                    run, LO, HI = pallas_hash.build(
+                        plan, layouts, p8, capacity, nb,
+                        len(plan.used_cols))
+                    runs_by_nb[nb] = run
                 # compile + validate now so Mosaic rejections fall back
-                packed = np.asarray(run(n, base, feed["flat"]))
+                packed = dispatch(runs_by_nb)
             except Exception as e:
                 # never silently: a swallowed genuine bug here would
                 # disguise itself as the slower XLA path
@@ -1399,19 +1478,23 @@ class DeviceRunner:
                         "%r: %s: %s", key[1], name, e)
                     self._kernel_cache[key] = False
                 return None
-            entry = (run, LO)
+            entry = (runs_by_nb, LO)
             self._kernel_cache[key] = entry
             # success clears the transient strike count — three isolated
             # hiccups over a process lifetime must not kill the fast path
             self._kernel_cache.pop(("hashpl_tries", key), None)
         else:
-            run, LO = entry
+            runs_by_nb, LO = entry
             try:
                 from ..utils import tracker
                 with tracker.phase("device_dispatch"):
-                    packed_dev = run(n, base, feed["flat"])
+                    parts = [runs_by_nb[nb](lo, hi, base, blk0,
+                                            feed["flat"])
+                             for lo, hi, blk0, nb in tiles]
                 with tracker.phase("device_fetch"):
-                    packed = np.asarray(packed_dev)
+                    packed = np.asarray(parts[0])
+                    for part in parts[1:]:
+                        packed = packed + np.asarray(part)
                 self._kernel_cache.pop(("hashpl_tries", key), None)
             except Exception as e:
                 # a transient runtime failure on a cached kernel must fall

@@ -119,6 +119,10 @@ class ColumnarTable:
     def _range_slices(self, ranges: Sequence[KeyRange]) -> list[tuple[int, int]]:
         out = []
         n = len(self.handles)
+        if not ranges:
+            # no ranges = the whole snapshot (the device runner's
+            # bucket-tile path keys its region feed this way)
+            return [(0, n)] if n else []
         for r in ranges:
             lo, hi = handle_bounds(r, self.table.table_id)
             i = n if lo > _I64_MAX else \
@@ -132,6 +136,10 @@ class ColumnarTable:
 
     def count_rows(self, ranges: Sequence[KeyRange]) -> int:
         return sum(j - i for i, j in self._range_slices(ranges))
+
+    def row_slices(self, ranges: Sequence[KeyRange]) -> list:
+        """Public seam for the device runner's bucket-tile mapping."""
+        return self._range_slices(ranges)
 
     def _ones(self, n: int) -> np.ndarray:
         """Cached all-true validity, grown monotonically and sliced —
