@@ -289,3 +289,49 @@ def test_remote_failpoint_via_status_server_drives_wal_crash(tmp_path):
     assert re.get_value_cf("default", b"x") == b"y"
     re.close()
     assert failpoint.hits("wal::torn_write") >= 1
+
+
+def test_snapshot_ready_drains_queued_apply_batch_first():
+    """A snapshot-bearing ready must drain the apply queue BEFORE
+    apply_snapshot: a queued pre-snapshot write batch applied after the
+    install would clobber post-snapshot data and regress the apply
+    state (regression: the drain was gated on committed_entries only)."""
+    from tikv_tpu.raft.raw_node import Ready
+    from tikv_tpu.raftstore.peer_storage import data_key
+
+    c = make_cluster(1)
+    c.must_put(b"sa", b"1")
+    peer = c.leader_peer(1)
+    snap = peer.node.storage.snapshot_for_send()
+    engine = c.engines[1]
+    events = []
+
+    class Ctx:
+        def drain(self, rid):
+            events.append("drain")
+            # the in-flight pre-snapshot batch lands during the drain
+            wb = engine.write_batch()
+            wb.put_cf("default", data_key(b"stale"), b"old")
+            engine.write(wb)
+
+        def send(self, rid, entries):
+            raise AssertionError("no batches queued in this test")
+
+    real_apply = peer.peer_storage.apply_snapshot
+
+    def spy_apply(wb, s):
+        events.append("apply_snapshot")
+        return real_apply(wb, s)
+
+    peer.peer_storage.apply_snapshot = spy_apply
+    seq = [Ready(snapshot=snap)]
+    peer.node.has_ready = lambda: bool(seq)
+    peer.node.ready = lambda: seq.pop()
+    peer.node.advance = lambda rd: None
+    peer.handle_ready(apply_ctx=Ctx())
+    assert events == ["drain", "apply_snapshot"], \
+        "snapshot apply must be ordered after the apply-queue drain"
+    # the stale queued write was erased by the snapshot install, not
+    # replayed over it
+    assert engine.get_value_cf("default", data_key(b"stale")) is None
+    assert c.get_on_store(1, b"sa") == b"1"

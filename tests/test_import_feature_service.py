@@ -359,3 +359,34 @@ def test_disk_engine_ingest_wal_recovery(tmp_path):
     eng3 = DiskEngine(str(tmp_path / "d"))
     assert eng3.get_value_cf(CF_DEFAULT, b"k250") == b"v250"
     eng3.close()
+
+
+def test_malformed_v2_blob_rejected():
+    """Out-of-order or duplicate keys in a v2 container must be refused
+    before the blob reaches the raft log (satellite: ingest_sst_blob
+    trusted client-sorted runs)."""
+    from tikv_tpu.sst_importer import build_sst_v2, read_sst_cf
+
+    good = build_sst_v2({"write": ([b"a", b"b", b"c"],
+                                   [b"1", b"2", b"3"])})
+    assert set(read_sst_cf(good)) == {"write"}
+    # out-of-order
+    bad_order = build_sst_v2({"write": ([b"b", b"a"], [b"2", b"1"])})
+    with pytest.raises(ValueError, match="ascending"):
+        read_sst_cf(bad_order)
+    # duplicates
+    bad_dup = build_sst_v2({"write": ([b"a", b"a"], [b"1", b"2"])})
+    with pytest.raises(ValueError, match="ascending"):
+        read_sst_cf(bad_dup)
+
+
+def test_ingest_rejects_malformed_v2_blob_over_rpc(cluster):
+    """End-to-end: the import service refuses a malformed v2 container
+    at upload→ingest time; nothing lands in the region."""
+    from tikv_tpu.server import wire
+    from tikv_tpu.sst_importer import build_sst_v2
+
+    client = cluster["client"]
+    bad = build_sst_v2({"write": ([b"xq2", b"xq1"], [b"2", b"1"])})
+    with pytest.raises(wire.RemoteError):
+        client.ingest_sst(bad, b"q1", timeout=10)

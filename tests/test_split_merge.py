@@ -278,3 +278,24 @@ def test_load_based_split_on_hot_region():
     finally:
         srv.stop()
         pd_server.stop()
+
+
+def test_load_split_late_tick_scales_qps_floor():
+    """tick() only guarantees at-least window_s; a late roll must
+    compute QPS over the ACTUAL elapsed time or a slow store loop makes
+    cold regions look hot (regression: nominal window_s was used)."""
+    from tikv_tpu.raftstore.load_split import LoadSplitController
+
+    lc = LoadSplitController(qps_threshold=100, detect_times=1,
+                             window_s=1.0)
+    t0 = 1000.0
+    lc._last_roll = t0
+    for _ in range(150):
+        lc.record_read(1, b"k%d" % _)
+    # 3s-late tick: 150 reads over 3s = 50 QPS — NOT hot
+    assert lc.tick(now=t0 + 3.0) == {}
+    # on-time window at the same count IS hot: 150 reads in ~1s
+    for _ in range(150):
+        lc.record_read(1, b"k%d" % _)
+    ready = lc.tick(now=t0 + 4.0)
+    assert 1 in ready

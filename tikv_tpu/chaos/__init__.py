@@ -1,0 +1,31 @@
+"""Deterministic chaos harness.
+
+Reference: the role of tests/failpoints/cases/ + Jepsen-style nemesis
+drivers — seeded fault schedules (partition, leader isolation,
+crash-restart at failpoint crash boundaries, message delay/reorder/
+duplication, disk stalls) applied against the in-process cluster while
+a bank-transfer + coprocessor workload runs, then invariant checks
+(balance conservation through MVCC, ComputeHash/VerifyHash replica
+agreement, no lost acknowledged writes, raft log/apply monotonicity).
+
+Everything is driven by seeded ``random.Random`` instances: the same
+seed reproduces the same schedule, the same workload op stream, and the
+same message scrambling decisions.
+"""
+
+from .invariants import (        # noqa: F401
+    InvariantViolation,
+    RaftStateTracker,
+    check_conservation,
+    check_no_lost_acks,
+    check_replica_consistency,
+)
+from .nemesis import (           # noqa: F401
+    CRASH_SITES,
+    FAULT_KINDS,
+    Fault,
+    Nemesis,
+    generate_schedule,
+    stabilize,
+)
+from .workload import BankWorkload      # noqa: F401

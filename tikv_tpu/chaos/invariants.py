@@ -1,0 +1,97 @@
+"""Crash-recovery invariant checks for chaos schedules.
+
+Four families, mirroring what the reference proves across its
+tests/failpoints tree:
+
+1. balance conservation — any MVCC read of the bank table sums to the
+   initial total (the workload asserts it on every successful copr
+   read; ``check_conservation`` asserts it per-key against the serial
+   model after healing);
+2. no lost acknowledged writes — every transfer whose Commit returned
+   is readable at exactly its commit_ts after any crash-restart;
+3. replica agreement — ComputeHash/VerifyHash across every replica of
+   the region (a diverged replica raises InconsistentRegion out of the
+   drive loop);
+4. raft state monotonicity — per (store, region): applied/commit/term
+   never regress across observations (taken at healed, quiesced
+   points), and applied ≤ commit ≤ last_index.
+"""
+
+from __future__ import annotations
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+class RaftStateTracker:
+    """Observes per-peer raft progress at quiesced points and rejects
+    any regression between observations."""
+
+    def __init__(self):
+        self._seen: dict = {}
+
+    def observe(self, cluster) -> None:
+        for sid, store in cluster.stores.items():
+            for rid, peer in store.peers.items():
+                node = peer.node
+                applied = node.applied
+                commit = node.commit
+                last = node.storage.last_index()
+                term = node.term
+                if not (applied <= commit <= last):
+                    raise InvariantViolation(
+                        f"store {sid} region {rid}: applied {applied} "
+                        f"<= commit {commit} <= last {last} violated")
+                prev = self._seen.get((sid, rid))
+                if prev is not None:
+                    p_applied, p_commit, p_term = prev
+                    if applied < p_applied or commit < p_commit or \
+                            term < p_term:
+                        raise InvariantViolation(
+                            f"store {sid} region {rid} regressed: "
+                            f"applied {p_applied}->{applied}, commit "
+                            f"{p_commit}->{commit}, term "
+                            f"{p_term}->{term}")
+                self._seen[(sid, rid)] = (applied, commit, term)
+
+
+def check_conservation(workload) -> None:
+    """Per-key model equality + total conservation through MVCC reads
+    on the current leader.  Call after heal + resolve_indeterminate —
+    every surviving lock has been settled, so reads cannot block."""
+    st = workload._storage()
+    ts = workload._tso()
+    total = 0
+    for handle, key in enumerate(workload.keys):
+        raw = st.get(key, ts)
+        if raw is None:
+            raise InvariantViolation(f"account {handle} vanished")
+        bal = workload._balance(raw)
+        want = workload.balances[handle]
+        if bal != want:
+            raise InvariantViolation(
+                f"account {handle}: engine {bal} != model {want}")
+        total += bal
+    if total != workload.expected_total:
+        raise InvariantViolation(
+            f"sum {total} != expected {workload.expected_total}")
+
+
+def check_no_lost_acks(workload) -> None:
+    """Every acknowledged transfer is readable at exactly its
+    commit_ts — acked writes survive crashes, partitions, restarts."""
+    st = workload._storage()
+    for rec in workload.acked:
+        for key, value in rec["pairs"]:
+            got = st.get(key, rec["commit_ts"])
+            if got != value:
+                raise InvariantViolation(
+                    f"acked write at ts {rec['commit_ts']} lost for "
+                    f"{key!r}: engine {got!r} != acked {value!r}")
+
+
+def check_replica_consistency(cluster, region_id: int = 1) -> int:
+    """ComputeHash on the leader, VerifyHash applied by every replica;
+    a diverged replica raises InconsistentRegion.  → the digest."""
+    return cluster.check_consistency(region_id)

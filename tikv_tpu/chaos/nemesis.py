@@ -1,0 +1,209 @@
+"""Nemesis — seeded fault schedules against the in-process cluster.
+
+Reference shape: Jepsen's nemesis process + the reference's
+tests/failpoints/cases/ steering (fail::cfg from the test body).  A
+``Fault`` is pure data; ``generate_schedule(seed, ...)`` derives a
+reproducible fault sequence from one RNG; ``Nemesis`` applies a fault
+to a ``testing.cluster.Cluster`` (transport filters, failpoint actions,
+crash-restart via FailpointPanic at a crash boundary) and heals it.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Sequence
+
+from ..engine.traits import CF_DEFAULT
+from ..raftstore.cmd import RaftCmd, WriteOp
+from ..utils import failpoint
+from ..utils.failpoint import FailpointPanic
+
+FAULT_KINDS = ("partition", "asym_partition", "leader_isolate",
+               "crash_restart", "msg_chaos", "disk_stall")
+
+# crash boundaries: a ``panic`` here unwinds out of the drive loop like
+# a process kill at that point of the write path (the same boundaries
+# the reference's failpoint cases crash at)
+CRASH_SITES = ("apply::before_write", "apply::after_write",
+               "raftlog::before_persist")
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str
+    params: tuple = ()      # sorted (key, value) pairs — hashable
+
+    def param(self, key, default=None):
+        return dict(self.params).get(key, default)
+
+
+def _mk(kind: str, **params) -> Fault:
+    return Fault(kind, tuple(sorted(params.items())))
+
+
+def generate_schedule(seed: int, steps: int,
+                      kinds: Sequence[str] = FAULT_KINDS,
+                      n_stores: int = 3) -> list[Fault]:
+    """Derive a reproducible fault schedule from one seed."""
+    rng = random.Random(seed)
+    stores = list(range(1, n_stores + 1))
+    out: list[Fault] = []
+    for _ in range(steps):
+        kind = rng.choice(tuple(kinds))
+        if kind in ("partition", "asym_partition"):
+            shuffled = stores[:]
+            rng.shuffle(shuffled)
+            cut = rng.randint(1, n_stores - 1)
+            out.append(_mk(kind, group_a=tuple(sorted(shuffled[:cut])),
+                           group_b=tuple(sorted(shuffled[cut:]))))
+        elif kind == "leader_isolate":
+            out.append(_mk(kind))       # leader resolved at apply time
+        elif kind == "crash_restart":
+            out.append(_mk(kind, store=rng.choice(stores),
+                           site=rng.choice(CRASH_SITES)))
+        elif kind == "msg_chaos":
+            out.append(_mk(kind,
+                           delay_p=round(rng.uniform(0.05, 0.3), 2),
+                           dup_p=round(rng.uniform(0.0, 0.15), 2),
+                           reorder=True))
+        elif kind == "disk_stall":
+            out.append(_mk(kind, ms=rng.choice((2, 5, 10))))
+        else:   # pragma: no cover
+            raise ValueError(kind)
+    return out
+
+
+class Nemesis:
+    """Applies/heals one fault at a time against a Cluster."""
+
+    def __init__(self, cluster, seed: int = 0, region_id: int = 1):
+        self.cluster = cluster
+        self.region_id = region_id
+        self.rng = random.Random(seed)
+        self._heals: list = []
+        self._probe_n = 0
+        self.crashes = 0        # crash boundaries actually hit
+
+    # ------------------------------------------------------------- apply
+
+    def apply(self, fault: Fault) -> None:
+        getattr(self, f"_apply_{fault.kind}")(fault)
+
+    def heal(self) -> None:
+        while self._heals:
+            self._heals.pop()()
+
+    def _apply_partition(self, fault: Fault) -> None:
+        filt = self.cluster.partition(fault.param("group_a"),
+                                      fault.param("group_b"))
+        self._heals.append(lambda: self.cluster.heal(filt))
+
+    def _apply_asym_partition(self, fault: Fault) -> None:
+        filt = self.cluster.partition_oneway(fault.param("group_a"),
+                                             fault.param("group_b"))
+        self._heals.append(lambda: self.cluster.heal(filt))
+
+    def _apply_leader_isolate(self, fault: Fault) -> None:
+        sid = self.cluster.leader_store(self.region_id)
+        if sid is None:
+            sid = self.rng.choice(sorted(self.cluster.stores))
+        filt = self.cluster.isolate_store(sid)
+        self._heals.append(lambda: self.cluster.heal(filt))
+
+    def _apply_msg_chaos(self, fault: Fault) -> None:
+        t = self.cluster.transport
+        t.set_chaos(self.rng, delay_p=fault.param("delay_p", 0.0),
+                    dup_p=fault.param("dup_p", 0.0),
+                    reorder=fault.param("reorder", False))
+        self._heals.append(t.clear_chaos)
+
+    def _apply_disk_stall(self, fault: Fault) -> None:
+        ms = fault.param("ms", 5)
+        # the WAL site stalls DiskEngine-backed stores at the fsync
+        # boundary; the apply site stalls the engine write for
+        # MemoryEngine clusters — both model a slow device, healed
+        # together
+        failpoint.cfg("wal::fsync_stall", f"sleep({ms})")
+        failpoint.cfg("apply::before_write", f"sleep({ms})")
+        self._heals.append(lambda: (failpoint.remove("wal::fsync_stall"),
+                                    failpoint.remove("apply::before_write")))
+
+    # -- crash-restart: FailpointPanic at a crash boundary, then the
+    #    store is recreated over its surviving engine (the process-kill
+    #    + restart cycle of the reference's failpoint crash cases).
+
+    def _apply_crash_restart(self, fault: Fault) -> None:
+        c = self.cluster
+        victim = fault.param("store")
+        site = fault.param("site", CRASH_SITES[0])
+        if victim not in c.stores:
+            return
+        self._probe_write()
+        crashed = False
+        for _ in range(15):
+            # healthy stores drive with the site unarmed...
+            for sid in list(c.stores):
+                if sid != victim:
+                    try:
+                        c.stores[sid].drive()
+                    except FailpointPanic:  # pragma: no cover - scoped off
+                        pass
+            c.transport.route_all()
+            # ...then the victim drives with the crash site armed, so
+            # the panic fires inside ITS apply/persist path only
+            failpoint.cfg(site, "panic")
+            try:
+                if victim in c.stores:
+                    c.stores[victim].drive()
+            except FailpointPanic:
+                crashed = True
+            finally:
+                failpoint.remove(site)
+            c.transport.route_all()
+            if crashed:
+                break
+        if crashed:
+            self.crashes += 1
+        # even if the boundary was never reached (no traffic routed to
+        # the victim under the current fault mix) the schedule still
+        # crash-restarts it — a kill needs no cooperation
+        c.restart_store(victim)
+
+    def _probe_write(self) -> None:
+        """Nudge a write through region ``region_id`` so the crash
+        boundary sees traffic (proposed fire-and-forget; the nemesis
+        drives routing itself)."""
+        c = self.cluster
+        peer = c.leader_peer(self.region_id)
+        if peer is None:
+            return
+        self._probe_n += 1
+        key = b"zz~nemesis~%06d" % self._probe_n
+        cmd = RaftCmd(peer.region.id, peer.region.epoch,
+                      (WriteOp("put", CF_DEFAULT, key, b"probe"),))
+        try:
+            peer.propose(cmd, lambda r: None)
+        except Exception:   # noqa: BLE001 — no leader right now is fine
+            pass
+
+
+def stabilize(cluster, region_id: int = 1, rounds: int = 80) -> None:
+    """Drive a healed cluster until a leader exists, the transport has
+    drained, and every replica of ``region_id`` applied to the same
+    index — the quiesced point invariant checks observe at."""
+    for _ in range(rounds):
+        try:
+            cluster.pump(max_rounds=100)
+        except RuntimeError:
+            pass
+        lead = cluster.leader_store(region_id)
+        if lead is not None and not cluster.transport.queue:
+            applied = {p.node.applied
+                       for s in cluster.stores.values()
+                       for rid, p in s.peers.items() if rid == region_id}
+            if len(applied) == 1:
+                return
+        for store in list(cluster.stores.values()):
+            store.tick()
+    raise TimeoutError(f"cluster did not stabilize for {region_id}")

@@ -1,0 +1,281 @@
+"""Bank-transfer + coprocessor workload for chaos schedules.
+
+The classic Jepsen bank test shape over the full stack: accounts are
+rows of a fixture table; transfers are Percolator 2PC transactions
+(Prewrite → Commit) through the txn scheduler over RaftKv, so every
+operation crosses gRPC-shaped routing, raft consensus, MVCC, and the
+engine.  The workload keeps a serial model plus an op journal:
+
+- ``acked``: transfers whose Commit returned — these MUST survive any
+  fault (the no-lost-acknowledged-writes invariant);
+- ``indeterminate``: transfers that errored mid-2PC — the commit may or
+  may not have landed; ``resolve_indeterminate`` settles them through
+  CheckTxnStatus/ResolveLockLite/Rollback exactly like a client-go
+  resolver, folding resolved commits back into the model.
+
+Coprocessor reads run SUM(balance) through the same
+BatchExecutorsRunner pipeline the copr endpoint uses — any successful
+read, even mid-fault, must observe the conserved total.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..codec.row import decode_row, encode_row
+from ..codec.keys import table_record_key
+from ..copr.storage_impl import MvccScanStorage
+from ..executors.runner import BatchExecutorsRunner
+from ..kv.engine import SnapContext
+from ..raftstore import RaftKv
+from ..storage import Storage
+from ..storage.mvcc.errors import KeyIsLocked
+from ..storage.mvcc.reader import MvccReader
+from ..storage.txn import commands as cmds
+from ..storage.txn.actions import Mutation
+from ..testing.dag import DagSelect
+from ..testing.fixture import int_table
+
+BALANCE_COL_ID = 2      # int_table: id (pk, col 1) + c0 (col 2)
+
+
+class BankWorkload:
+    def __init__(self, cluster, n_accounts: int = 8,
+                 init_balance: int = 100, seed: int = 0,
+                 region_id: int = 1, table_id: int = 7001,
+                 driver_rounds: int = 20):
+        self.c = cluster
+        self.rng = random.Random(seed)
+        self.n_accounts = n_accounts
+        self.init_balance = init_balance
+        self.region_id = region_id
+        self.table = int_table(1, table_id=table_id)
+        self.keys = [table_record_key(table_id, h)
+                     for h in range(n_accounts)]
+        self.balances = {h: init_balance for h in range(n_accounts)}
+        self.expected_total = n_accounts * init_balance
+        self._driver_rounds = driver_rounds
+        # journals
+        self.acked: list[dict] = []
+        self.indeterminate: list[dict] = []
+        self.aborted = 0
+        self.copr_reads = 0
+
+    # ---------------------------------------------------------- plumbing
+
+    def _driver(self, done) -> None:
+        """Bounded cluster pump for RaftKv waits: under an active fault
+        an op must fail fast (TimeoutError → indeterminate), not hang."""
+        c = self.c
+        for _ in range(self._driver_rounds):
+            if done():
+                return
+            try:
+                c.pump(max_rounds=40)
+            except RuntimeError:        # still turbulent, keep driving
+                pass
+            if done():
+                return
+            for store in list(c.stores.values()):
+                store.tick()
+        raise TimeoutError("chaos workload driver budget exhausted")
+
+    def _leader_sid(self) -> Optional[int]:
+        return self.c.leader_store(self.region_id)
+
+    def _storage(self) -> Storage:
+        """Fresh facade over the CURRENT leader store (stores are
+        replaced on crash-restart, so never cache across ops)."""
+        sid = self._leader_sid()
+        if sid is None:
+            from ..raftstore.metapb import NotLeaderError
+            raise NotLeaderError(self.region_id)
+        kv = RaftKv(self.c.stores[sid], driver=self._driver)
+        return Storage(kv)
+
+    @staticmethod
+    def _row(balance: int) -> bytes:
+        return encode_row({BALANCE_COL_ID: balance})
+
+    @staticmethod
+    def _balance(raw: bytes) -> int:
+        return int(decode_row(raw)[BALANCE_COL_ID])
+
+    def _tso(self) -> int:
+        return self.c.pd.tso()
+
+    # ------------------------------------------------------------- setup
+
+    def init_data(self) -> None:
+        st = self._storage()
+        muts = [Mutation("put", k, self._row(self.init_balance))
+                for k in self.keys]
+        start = self._tso()
+        st.sched_txn_command(cmds.Prewrite(muts, self.keys[0], start))
+        st.sched_txn_command(cmds.Commit(list(self.keys), start,
+                                         self._tso()))
+
+    # --------------------------------------------------------------- ops
+
+    def run_ops(self, n: int) -> None:
+        for _ in range(n):
+            if self.rng.random() < 0.25:
+                self.copr_query()
+            else:
+                self.transfer()
+
+    def op_stream(self, n: int) -> list[tuple]:
+        """The DECISIONS the next n ops would make (for determinism
+        assertions) — consumes the rng the same way run_ops does."""
+        out = []
+        for _ in range(n):
+            if self.rng.random() < 0.25:
+                out.append(("copr",))
+            else:
+                a, b = self.rng.sample(range(self.n_accounts), 2)
+                out.append(("transfer", a, b,
+                            self.rng.randint(1, 5)))
+        return out
+
+    def transfer(self) -> bool:
+        a, b = self.rng.sample(range(self.n_accounts), 2)
+        amt = self.rng.randint(1, 5)
+        try:
+            st = self._storage()
+            ts = self._tso()
+            bal_a = self._read_balance(st, a, ts)
+            bal_b = self._read_balance(st, b, ts)
+        except Exception:   # noqa: BLE001 — routing/lock/timeout: abort
+            self.aborted += 1
+            return False
+        ka, kb = self.keys[a], self.keys[b]
+        va, vb = self._row(bal_a - amt), self._row(bal_b + amt)
+        start_ts = self._tso()
+        # the model tracks DELTAS, not the absolute balances this txn
+        # wrote: a commit whose ack was lost may be settled long after
+        # later transfers touched the same accounts, and replaying its
+        # stale absolutes would regress the model (deltas commute; the
+        # engine-side lock protects the read-modify-write itself)
+        rec = {"start_ts": start_ts, "primary": ka, "keys": [ka, kb],
+               "pairs": [(ka, va), (kb, vb)],
+               "deltas": {a: -amt, b: +amt},
+               "commit_possible": False}
+        try:
+            st.sched_txn_command(cmds.Prewrite(
+                [Mutation("put", ka, va), Mutation("put", kb, vb)],
+                ka, start_ts))
+        except KeyIsLocked:
+            self.aborted += 1       # blocked by an unresolved txn
+            return False
+        except Exception:   # noqa: BLE001 — locks may or may not exist
+            self.indeterminate.append(rec)
+            return False
+        commit_ts = self._tso()
+        rec["commit_ts"] = commit_ts
+        rec["commit_possible"] = True
+        try:
+            st.sched_txn_command(cmds.Commit([ka, kb], start_ts,
+                                             commit_ts))
+        except Exception:   # noqa: BLE001 — the indeterminate window
+            self.indeterminate.append(rec)
+            return False
+        self.acked.append(rec)
+        self._apply_deltas(rec)
+        return True
+
+    def _apply_deltas(self, rec: dict) -> None:
+        for handle, delta in rec["deltas"].items():
+            self.balances[handle] += delta
+
+    def _read_balance(self, st: Storage, handle: int, ts: int) -> int:
+        key = self.keys[handle]
+        try:
+            raw = st.get(key, ts)
+        except KeyIsLocked as e:
+            # our own earlier indeterminate txn still holds the lock:
+            # settle it, then retry once
+            self._resolve_by_start_ts(st, e.lock.start_ts)
+            raw = st.get(key, ts)
+        assert raw is not None, f"account {handle} missing"
+        return self._balance(raw)
+
+    # -------------------------------------------------------- resolution
+
+    def _resolve_by_start_ts(self, st: Storage, start_ts: int) -> None:
+        for rec in self.indeterminate:
+            if rec["start_ts"] == start_ts:
+                self._resolve_one(st, rec)
+                self.indeterminate.remove(rec)
+                return
+        # not ours / already settled: protective rollback of the lock
+        raise KeyError(f"unknown lock owner start_ts={start_ts}")
+
+    def _resolve_one(self, st: Storage, rec: dict) -> None:
+        """Settle one indeterminate txn (client-go resolver protocol)."""
+        start_ts = rec["start_ts"]
+        if rec["commit_possible"]:
+            now = self._tso()
+            r = st.sched_txn_command(cmds.CheckTxnStatus(
+                rec["primary"], start_ts, caller_start_ts=now,
+                current_ts=now))
+            if r["status"] == "committed":
+                st.sched_txn_command(cmds.ResolveLockLite(
+                    start_ts, r["ts"], rec["keys"]))
+                rec["commit_ts"] = r["ts"]
+                self.acked.append(rec)
+                self._apply_deltas(rec)
+                return
+            if r["status"] in ("rolled_back", "ttl_expired"):
+                st.sched_txn_command(cmds.ResolveLockLite(
+                    start_ts, 0, rec["keys"]))
+                return
+            # still "locked": the commit never landed (we are the only
+            # client and nothing is in flight) — roll it back
+        st.sched_txn_command(cmds.Rollback(rec["keys"], start_ts))
+
+    def resolve_indeterminate(self) -> int:
+        """Settle every indeterminate txn; → number settled.  Call on a
+        healed, quiesced cluster (nothing may be in flight)."""
+        settled = 0
+        remaining = []
+        for rec in self.indeterminate:
+            try:
+                st = self._storage()
+                self._resolve_one(st, rec)
+                settled += 1
+            except Exception:   # noqa: BLE001 — retried next round
+                remaining.append(rec)
+        self.indeterminate = remaining
+        return settled
+
+    # -------------------------------------------------------- copr reads
+
+    def copr_query(self) -> Optional[int]:
+        """SUM(balance) through the coprocessor executor pipeline over a
+        consistent leader snapshot; → total or None when the read could
+        not complete under the active fault.  A non-None result is
+        checked against conservation on the spot: any committed
+        snapshot must show the conserved total."""
+        from ..storage.txn_types import encode_key
+        sid = self._leader_sid()
+        if sid is None:
+            return None
+        ts = self._tso()
+        try:
+            kv = RaftKv(self.c.stores[sid], driver=self._driver)
+            snap = kv.snapshot(SnapContext(
+                key_hint=encode_key(self.keys[0])))
+            sel = DagSelect.from_table(self.table)
+            dag = sel.sum(sel.col("c0")).build(start_ts=ts)
+            res = BatchExecutorsRunner(
+                dag, MvccScanStorage(MvccReader(snap), ts)
+            ).handle_request()
+            total = int(res.rows()[0][0])
+        except Exception:   # noqa: BLE001 — turbulence: no read served
+            return None
+        self.copr_reads += 1
+        assert total == self.expected_total, \
+            f"copr SUM saw {total}, expected {self.expected_total} " \
+            "(balance conservation violated)"
+        return total

@@ -159,6 +159,12 @@ class Endpoint:
             backend = self._pick_backend(req, storage)
             from ..utils import tracker
             tracker.label("backend", backend)
+            def host_exec():
+                from ..executors.runner import BatchExecutorsRunner
+                with tracker.phase("host_exec"):
+                    return BatchExecutorsRunner(
+                        req.dag, storage).handle_request()
+
             if req.paging_size > 0:
                 backend = "host"    # pages are a host-pipeline contract
                 from ..executors.runner import BatchExecutorsRunner
@@ -168,13 +174,26 @@ class Endpoint:
                         resume_token=req.resume_token).handle_request(
                             max_rows=req.paging_size)
             elif backend == "device":
-                result = self._device_runner.handle_request(req.dag,
-                                                            storage)
+                try:
+                    result = self._device_runner.handle_request(req.dag,
+                                                                storage)
+                except Exception:
+                    # a device fault (dispatch failure, runtime error,
+                    # unreachable accelerator) degrades the query to the
+                    # host pipeline instead of failing it; only an
+                    # explicit force_backend="device" (parity tests)
+                    # surfaces the fault
+                    if req.force_backend == "device":
+                        raise
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "device backend failed; degrading to host",
+                        exc_info=True)
+                    backend = "host"
+                    tracker.label("backend", "host")
+                    result = host_exec()
             else:
-                from ..executors.runner import BatchExecutorsRunner
-                with tracker.phase("host_exec"):
-                    result = BatchExecutorsRunner(
-                        req.dag, storage).handle_request()
+                result = host_exec()
             from ..resource_metering import scanned_rows
             if backend == "device" and not result.exec_summaries:
                 # the device feed always scans the whole snapshot; its

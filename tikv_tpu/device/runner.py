@@ -82,6 +82,17 @@ _CHUNK_TOPN = 1 << 23
 
 class _FallbackToHost(Exception):
     """Raised when a runtime property (not the plan) forces the host path."""
+
+
+def _fp_degrade(name: str) -> None:
+    """Failpoint site that degrades to the host backend: a fired
+    ``return`` action raises _FallbackToHost, so an injected device
+    fault (or a real one steered in tests) downgrades the query instead
+    of failing it — the runner's existing fallback machinery catches it.
+    """
+    from ..utils.failpoint import fail_point
+    if fail_point(name) is not None:
+        raise _FallbackToHost(name)
 #  DATETIME (packed u64 core — the bit layout is order-preserving) and
 #  DURATION (i64 ns) are device-native dense columns: comparisons, topN
 #  and min/max/count ride the same kernels as INT.  Years >= 8192 pack
@@ -444,6 +455,7 @@ class DeviceRunner:
             return cache[feed_key]
         from ..utils import tracker
         tracker.label("device_feed", "upload")
+        _fp_degrade("device::before_feed_upload")
         with tracker.phase("feed_upload"):
             feed = self._build_flat(host_cols(), n)
         if cache is not None:
@@ -944,6 +956,7 @@ class DeviceRunner:
         request). Returns the same pytree as numpy.
         """
         from ..utils import tracker
+        _fp_degrade("device::before_fetch")
         with tracker.phase("device_fetch"):
             leaves, treedef = jax.tree.flatten(tree)
             for x in leaves:
@@ -1040,6 +1053,7 @@ class DeviceRunner:
             return meta["host_cols"]
 
         try:
+            _fp_degrade("device::before_dispatch")
             if "dtypes" not in meta:
                 host_cols()
             dtypes = meta["dtypes"]
@@ -1700,7 +1714,16 @@ class _AnalyzeKernels:
 
 def _analyze_on_device(runner, dag, storage, n_buckets: int):
     """DeviceRunner.handle_analyze body (module-level to keep the class
-    focused on DAG execution)."""
+    focused on DAG execution).  Returning None routes the request to
+    the host analyze path — including when a device::* failpoint fires
+    inside the dispatch/fetch (the degrade contract)."""
+    try:
+        return _analyze_on_device_impl(runner, dag, storage, n_buckets)
+    except _FallbackToHost:
+        return None
+
+
+def _analyze_on_device_impl(runner, dag, storage, n_buckets: int):
     from ..copr.analyze import ColumnStats, analyze_columns
     if not runner._single:
         # a global sort across shards needs an all-to-all; stats merge

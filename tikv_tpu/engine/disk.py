@@ -152,6 +152,8 @@ class DiskEngine(MemoryEngine):
         return os.path.join(self.path, f"wal-{gen:012d}")
 
     def _recover(self) -> None:
+        from ..utils.failpoint import fail_point
+        fail_point("recover::before_scan")
         base_gens, run_gens = [], []
         for name in os.listdir(self.path):
             if name.endswith(".tmp"):
@@ -187,6 +189,7 @@ class DiskEngine(MemoryEngine):
                     f"sorted run {self._run_path(g)} is corrupt; its "
                     "WAL was already dropped — cannot skip it")
             self._gen = g
+        fail_point("recover::before_wal_replay")
         torn_enc = self._replay_wal(self._wal_path(self._gen))
         self._open_wal(self._wal_path(self._gen), append=True)
         if torn_enc:
@@ -388,9 +391,13 @@ class DiskEngine(MemoryEngine):
                 os.fsync(self._wal.fileno())
                 raise FailpointPanic("wal::torn_write")
             self._wal.write(payload)
+            # a sleep action here models a stalled fsync (slow disk):
+            # the write path blocks exactly where the OS would block it
+            fail_point("wal::fsync_stall")
             self._wal.flush()
             if self._sync:
                 os.fsync(self._wal.fileno())
+            fail_point("wal::after_append")
             self._wal_bytes += 8 + len(payload)
             self._write_locked(batch)
             self._record_dirty(batch._ops)
@@ -459,6 +466,9 @@ class DiskEngine(MemoryEngine):
         parts.append(_RUN_FOOTER)
         self._write_file_atomic(self._run_path(new_gen),
                                 b"".join(parts))
+        # crash window: the run is durable but the WAL has not rotated —
+        # recovery must tolerate replaying the old WAL over the new run
+        fail_point("flush::before_rotate")
         self._runs.append(new_gen)
         for cf in self._cf_names:
             self._dirty[cf] = {}
@@ -516,6 +526,9 @@ class DiskEngine(MemoryEngine):
                 parts.append(v)
         parts.append(_CKPT_FOOTER)
         self._write_file_atomic(self._ckpt_path(gen), b"".join(parts))
+        # crash window: new base durable, superseded runs not yet gone —
+        # recovery must prefer the newest base and sweep stale runs
+        fail_point("compact::after_write")
         # drop everything the new base covers; ONE dict persist for the
         # whole batch of key removals
         removed = []
@@ -539,6 +552,8 @@ class DiskEngine(MemoryEngine):
             self._enc.remove_files(removed)
 
     def close(self) -> None:
+        from ..utils.failpoint import fail_point
+        fail_point("engine::before_close")
         with self._mu:
             if self._wal is not None:
                 self._wal.flush()

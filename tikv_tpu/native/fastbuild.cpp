@@ -618,6 +618,7 @@ PyObject* build_mvcc_sst(PyObject*, PyObject* args) {
   Py_ssize_t n = hlen / 8;
   const int64_t* handles = reinterpret_cast<const int64_t*>(hp);
   Py_ssize_t ncols = PySequence_Size(ids_o);
+  if (ncols > 0xFFFF) return fail("too many columns");   /* map16 limit */
   std::vector<int64_t> ids(ncols);
   std::vector<int> kinds(ncols);
   std::vector<const uint8_t*> bufs(ncols);
@@ -665,7 +666,15 @@ PyObject* build_mvcc_sst(PyObject*, PyObject* args) {
     mc_encode(&enc, reinterpret_cast<const uint8_t*>(ukey.data()),
               (Py_ssize_t)ukey.size());
     payload.clear();
-    payload.push_back((char)(0x80 | (ncols & 0x0F)));
+    if (ncols <= 15) {
+      payload.push_back((char)(0x80 | (ncols & 0x0F)));
+    } else {
+      /* fixmap tops out at 15 entries; wider rows take map16 (0xDE),
+         which mp_map_len and msgpack both decode */
+      payload.push_back((char)0xDE);
+      payload.push_back((char)((ncols >> 8) & 0xFF));
+      payload.push_back((char)(ncols & 0xFF));
+    }
     for (Py_ssize_t c = 0; c < ncols; c++) {
       mp_put_int(&payload, ids[c]);
       if (valid[c] && !valid[c][i]) {

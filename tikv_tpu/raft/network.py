@@ -8,8 +8,11 @@ filters) — the fixture style SURVEY.md §4 calls out as load-bearing.
 
 from __future__ import annotations
 
+import random
+
 from typing import Callable, Optional, Sequence
 
+from ..utils.failpoint import fail_point
 from .messages import Message
 from .raw_node import RawNode, Ready
 from .storage import MemoryRaftStorage
@@ -26,6 +29,8 @@ class RaftNetwork:
         # transport_simulate.rs Filter trait
         self.filters: list[Callable[[Message], bool]] = []
         self._inbox: list[Message] = []
+        # deterministic source for failpoint-driven reorder/duplicate
+        self._chaos_rng = random.Random(seed)
         for nid in ids:
             storage = MemoryRaftStorage(voters=tuple(ids))
             self.nodes[nid] = RawNode(nid, storage, election_tick,
@@ -64,7 +69,17 @@ class RaftNetwork:
             for e in rd.committed_entries:
                 self._apply(nid, e)
             for m in rd.messages:
-                if all(f(m) for f in self.filters):
+                if not all(f(m) for f in self.filters):
+                    continue
+                # message-level fault sites (transport_simulate.rs
+                # DropPacket/Delay/OutOfOrder filters as failpoints):
+                # a fired "return" action drops / duplicates; "sleep"
+                # on send_delay stalls the sender inline
+                if fail_point("transport::drop_send") is not None:
+                    continue
+                fail_point("transport::send_delay")
+                self._inbox.append(m)
+                if fail_point("transport::dup_send") is not None:
                     self._inbox.append(m)
             node.advance(rd)
 
@@ -83,7 +98,12 @@ class RaftNetwork:
         for nid in self.nodes:
             self._drain_node(nid)
         while self._inbox:
+            if len(self._inbox) > 1 and \
+                    fail_point("transport::reorder") is not None:
+                self._chaos_rng.shuffle(self._inbox)
             m = self._inbox.pop(0)
+            if fail_point("transport::drop_recv") is not None:
+                continue
             if m.to in self.nodes:
                 self.nodes[m.to].step(m)
                 self._drain_node(m.to)

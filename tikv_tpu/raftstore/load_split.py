@@ -84,13 +84,22 @@ class LoadSplitController:
 
     # ------------------------------------------------------------- window
 
-    def _roll_window(self) -> dict[int, list[bytes]]:
+    def _roll_window(self, elapsed_s: Optional[float] = None
+                     ) -> dict[int, list[bytes]]:
         """Close the current window → {region_id: samples} for regions
-        hot for >= detect_times consecutive windows."""
+        hot for >= detect_times consecutive windows.
+
+        ``elapsed_s`` is the ACTUAL wall time the window covered —
+        tick() only guarantees at-least ``window_s``, and a late tick
+        (stalled store loop, test fixture driving coarsely) that rolled
+        with the nominal width would overestimate QPS and fire spurious
+        load splits."""
+        if elapsed_s is None:
+            elapsed_s = self.window_s
         ready: dict[int, list[bytes]] = {}
         with self._mu:
             recorders, self._recorders = self._recorders, {}
-            qps_floor = self.qps_threshold * self.window_s
+            qps_floor = self.qps_threshold * max(elapsed_s, self.window_s)
             next_hot: dict[int, tuple[int, list[bytes]]] = {}
             for rid, rec in recorders.items():
                 if rec.count < qps_floor:
@@ -122,7 +131,8 @@ class LoadSplitController:
     def tick(self, now: Optional[float] = None) -> dict[int, list[bytes]]:
         """→ {region_id: samples} due for a load split this window."""
         now = time.monotonic() if now is None else now
-        if now - self._last_roll < self.window_s:
+        elapsed = now - self._last_roll
+        if elapsed < self.window_s:
             return {}
         self._last_roll = now
-        return self._roll_window()
+        return self._roll_window(elapsed)

@@ -259,6 +259,8 @@ class RaftPeer:
             return self._propose_locked(cmd, cb)
 
     def _propose_locked(self, cmd: RaftCmd, cb: Callable) -> int:
+        from ..utils.failpoint import fail_point
+        fail_point("peer::before_propose")
         if not self.is_leader():
             raise NotLeaderError(self.region.id, self.leader_peer())
         if self.merging is not None and (
@@ -306,6 +308,10 @@ class RaftPeer:
             return self._local_read_locked()
 
     def _local_read_locked(self) -> Optional[RegionSnapshot]:
+        from ..utils.failpoint import fail_point
+        # a "return" action forces the lease miss path (read barrier)
+        if fail_point("read::before_local_read") is not None:
+            return None
         node = self.node
         if not self.is_leader() or not node.in_lease():
             return None
@@ -327,6 +333,8 @@ class RaftPeer:
         lease read, no leader load.  Dropped requests (no leader yet,
         leader lease pending, message loss) are re-sent from tick() and
         expire after ~2 election timeouts."""
+        from ..utils.failpoint import fail_point
+        fail_point("read::before_replica_read")
         with self.mu:
             self._replica_read_ctx += 1
             ctx = self._replica_read_ctx
@@ -450,9 +458,13 @@ class RaftPeer:
                 out.extend(rd.messages)
                 self.node.advance(rd)
                 continue
-            if apply_ctx is not None and rd.committed_entries:
-                # complex batch: every queued plain apply must land
-                # first so entries execute in commit order
+            if apply_ctx is not None and (rd.committed_entries or
+                                          rd.snapshot is not None):
+                # complex batch OR snapshot: every queued plain apply
+                # must land first — entries for commit order, snapshots
+                # because a queued pre-snapshot write batch applied
+                # AFTER apply_snapshot would clobber post-snapshot data
+                # and regress the apply state
                 apply_ctx.drain(self.region.id)
             wb = self.engine.write_batch()
             if rd.snapshot is not None:
@@ -466,6 +478,7 @@ class RaftPeer:
                 self.applied_engine = max(self.applied_engine,
                                           rd.snapshot.metadata.index)
                 self.store.on_region_changed(self, region)
+                fail_point("snapshot::after_apply")
             fail_point("raftlog::before_persist")
             meta = self.node.storage.snapshot.metadata
             self.peer_storage.persist(wb, rd.entries, rd.hard_state,
@@ -585,6 +598,8 @@ class RaftPeer:
         """Async-IO completion: the log batch hit disk — now the acks
         may leave and the ready advances (write.rs persisted callback).
         Runs serialized with other peer work (poller mailbox)."""
+        from ..utils.failpoint import fail_point
+        fail_point("raftlog::after_persist")
         self._ready_inflight = False
         self.node.advance(rd)
         return list(rd.messages)
@@ -682,6 +697,8 @@ class RaftPeer:
                 wb.delete_range_cf(op.cf, data_key(op.key),
                                    data_key(op.value))
             elif op.op == "ingest":
+                from ..utils.failpoint import fail_point
+                fail_point("apply::before_ingest")
                 # bulk SST ingest (fsm/apply.rs IngestSst): op.value is
                 # a v2 SST container; whole sorted runs bulk-merge into
                 # the engine instead of replaying per-key ops.  Like
@@ -706,6 +723,7 @@ class RaftPeer:
             fail_point("apply::before_conf_change")
             return self._exec_change_peer(wb, admin, cc)
         if admin.kind == "compact_log":
+            fail_point("apply::before_compact_log")
             return self._exec_compact_log(wb, admin)
         if admin.kind == "prepare_merge":
             fail_point("apply::before_prepare_merge")
@@ -1007,6 +1025,8 @@ class RaftPeer:
         # lower stamp would make the receiver re-apply entries (e.g. conf
         # changes double-bumping conf_ver).  Reference: peer_storage.rs
         # do_snapshot uses the apply state's applied_index.
+        from ..utils.failpoint import fail_point
+        fail_point("snapshot::before_generate")
         applied = self.node.applied
         t = self.node.storage.term(applied)
         if t is None:

@@ -104,6 +104,8 @@ class RaftStore:
 
     def load_peers(self) -> None:
         """Restart path: recreate every peer persisted in the engine."""
+        from ..utils.failpoint import fail_point
+        fail_point("store::before_load_peers")
         it = self.engine.iterator_cf(
             CF_RAFT, REGION_PREFIX,
             REGION_PREFIX[:-1] + bytes([REGION_PREFIX[-1] + 1]))
@@ -183,6 +185,8 @@ class RaftStore:
                 self._campaign_on_create.add(right.id)
 
     def destroy_peer(self, region_id: int) -> None:
+        from ..utils.failpoint import fail_point
+        fail_point("store::before_destroy_peer")
         with self.meta_mu:
             peer = self.peers.pop(region_id, None)
         if peer is not None:
@@ -215,6 +219,11 @@ class RaftStore:
 
     def on_raft_message(self, region_id: int, to_peer: PeerMeta,
                         from_peer: PeerMeta, msg: Message) -> None:
+        from ..utils.failpoint import fail_point
+        # a "return" action models inbound message loss at this store
+        if fail_point("store::drop_raft_message") is not None:
+            return
+        fail_point("store::on_raft_message")
         if self.pooled():
             if region_id not in self.peers and \
                     msg.msg_type in (MsgType.APPEND, MsgType.HEARTBEAT,
@@ -399,7 +408,10 @@ class RaftStore:
         self.router.send(region_id, ("persist_failed",))
 
     def _send_all(self, peer: RaftPeer, msgs) -> None:
+        from ..utils.failpoint import fail_point
         for msg in msgs:
+            if fail_point("store::drop_send") is not None:
+                continue
             target = self._peer_meta(peer.region, msg.to) or \
                 peer.peer_cache.get(msg.to)
             if target is None:

@@ -189,14 +189,32 @@ class TxnClient:
         return self._store_client(leader.store_id), region
 
     def _call_leader(self, key: bytes, method: str, req: dict,
-                     retries: int = 8, timeout: float = 10) -> dict:
+                     retries: int = 8, timeout: float = 10,
+                     deadline: Optional[float] = None) -> dict:
         """Retry NotLeader/EpochNotMatch with fresh routing (client-go
-        region cache invalidation)."""
+        region cache invalidation).
+
+        Retries back off exponentially with jitter and the whole
+        operation is budgeted by ``deadline`` (default: ``timeout``) —
+        each RPC's timeout is clamped to the remaining budget, so a
+        caller's patience propagates through every hop instead of
+        multiplying by the attempt count."""
+        from ..utils.backoff import Backoff
+        from ..utils.failpoint import fail_point
+        bo = Backoff(base=0.02, cap=0.5,
+                     deadline_s=deadline if deadline is not None
+                     else timeout)
         last: Optional[Exception] = None
         for _ in range(retries):
+            if last is not None and bo.remaining() < 0.05:
+                # deadline (nearly) exhausted: surface the meaningful
+                # routing error instead of firing a sliver-timeout RPC
+                # whose bare TimeoutError would mask it
+                break
             client, _region = self._leader_client(key)
             try:
-                return client.call(method, req, timeout=timeout)
+                return client.call(method, req,
+                                   timeout=bo.rpc_timeout(timeout))
             except wire.RemoteError as e:
                 if e.kind in ("not_leader", "epoch_not_match",
                               "region_not_found", "region_merging") or \
@@ -205,7 +223,9 @@ class TxnClient:
                     # load checker) landed after we cached the bounds
                     last = e
                     self._invalidate_region(key)
-                    time.sleep(0.05)
+                    fail_point("client::before_retry")
+                    if not bo.sleep():
+                        break       # deadline exhausted
                     continue
                 raise
         raise last if last else TxnError("routing failed")
@@ -267,12 +287,15 @@ class TxnClient:
         keys (primary first group), then commit primary, then commit
         secondaries.  Returns commit_ts."""
         assert mutations
+        from ..utils.backoff import Backoff
         start_ts = self.tso()
         primary = mutations[0][1]
         # prewrite, grouped one RPC per region leader; a stale cached
-        # route (split/leader change mid-flight) re-groups and retries —
+        # route (split/leader change mid-flight) re-groups and retries
+        # under a jittered backoff with a whole-2PC deadline —
         # re-prewriting an already-locked key with the same start_ts is
         # idempotent (mvcc/actions prewrite lock-match rule)
+        bo = Backoff(base=0.02, cap=0.5, deadline_s=20.0)
         for attempt in range(8):
             groups: dict[tuple, list] = {}
             for op, key, value in mutations:
@@ -290,7 +313,8 @@ class TxnClient:
                               "region_merging") and attempt < 7:
                     for _op, key, _v in mutations:
                         self._invalidate_region(key)
-                    time.sleep(0.05)
+                    if not bo.sleep():
+                        raise
                     continue
                 raise
         # commit primary first — the txn's durability point
@@ -518,21 +542,28 @@ class TxnClient:
         return wire.dec_region(r["region"])
 
     def _call_leader_by_region(self, region: Region, method: str,
-                               req: dict, retries: int = 8) -> dict:
+                               req: dict, retries: int = 8,
+                               deadline: float = 30.0) -> dict:
+        from ..utils.backoff import Backoff
+        bo = Backoff(base=0.02, cap=0.5, deadline_s=deadline)
         last = None
         for _ in range(retries):
+            if last is not None and bo.remaining() < 0.05:
+                break       # surface `last` over a sliver-timeout RPC
             _r = self.pd.get_region_by_id(region.id) or region
             reg, leader = self.pd.get_region_with_leader(_r.start_key)
             if reg.id != region.id or leader is None:
                 leader = _r.peers[0]
             client = self._store_client(leader.store_id)
             try:
-                return client.call(method, req)
+                return client.call(method, req,
+                                   timeout=bo.rpc_timeout(10))
             except wire.RemoteError as e:
                 if e.kind in ("not_leader", "epoch_not_match",
                               "region_merging"):
                     last = e
-                    time.sleep(0.05)
+                    if not bo.sleep():
+                        break
                     continue
                 raise
         raise last if last else TxnError("routing failed")
@@ -546,9 +577,14 @@ class TxnClient:
         """Bulk load one built SST onto the region owning ``region_key``
         (upload chunks → ingest; src/import/sst_service.rs flow).
         ``timeout`` covers the ingest RPC — the raft propose + apply of
-        a multi-million-row file takes seconds, not the default 10."""
-        import time as _time
+        a multi-million-row file takes seconds, not the default 10 —
+        and doubles as the whole operation's retry deadline."""
         import uuid as _uuid
+        from ..utils.backoff import Backoff
+        # the ingest RPC keeps its FULL caller-sized timeout on every
+        # attempt (uploads must not eat its budget); the backoff
+        # deadline only bounds the whole retry loop
+        bo = Backoff(base=0.05, cap=1.0, deadline_s=timeout * 4)
         last = None
         for _attempt in range(4):
             region, leader = self._lookup_region(region_key)
@@ -572,7 +608,8 @@ class TxnClient:
                     # (KeyNotInRegion = cached bounds predate a split)
                     self._invalidate_region(region_key)
                     last = e
-                    _time.sleep(0.05)
+                    if not bo.sleep():
+                        break
                     continue
                 raise
         raise last

@@ -59,15 +59,26 @@ class _StoreConn:
     MAX_BATCH = 512
     BACKOFF_BASE = 0.1
     BACKOFF_MAX = 3.0
+    # a raft message queued longer than this is stale — its term/index
+    # have been superseded by retries; shipping it after a long backoff
+    # only wastes the reconnected channel's first batches (send
+    # deadline; the reference's Queue drops on overflow for the same
+    # staleness reason)
+    MSG_TTL = 10.0
 
     def __init__(self, store_id: int):
+        from ..utils.backoff import Backoff
         self.store_id = store_id
-        self.queue: deque = deque()
+        self.queue: deque = deque()     # (enqueue_monotonic, msg)
         self.lock = threading.Lock()
         self.channel = None
         self.addr = None
         self.fail_count = 0
         self.next_attempt = 0.0     # monotonic deadline while backing off
+        # the tight (0.8, 1.0) jitter band keeps retries decorrelated
+        # across stores while still guaranteeing exponential growth
+        self._backoff = Backoff(base=self.BACKOFF_BASE,
+                                cap=self.BACKOFF_MAX, jitter=(0.8, 1.0))
 
     def push(self, msg: dict) -> bool:
         """→ False when the queue is full (message dropped — raft
@@ -75,19 +86,24 @@ class _StoreConn:
         with self.lock:
             if len(self.queue) >= self.MAX_QUEUE:
                 return False
-            self.queue.append(msg)
+            self.queue.append((time.monotonic(), msg))
             return True
 
-    def pop_batch(self) -> list:
+    def pop_batch(self, now: float) -> tuple[list, int]:
+        """→ (batch, n_expired): drop queued messages past their send
+        deadline, then take up to MAX_BATCH of what is still fresh."""
         with self.lock:
+            expired = 0
+            while self.queue and now - self.queue[0][0] > self.MSG_TTL:
+                self.queue.popleft()
+                expired += 1
             n = min(len(self.queue), self.MAX_BATCH)
-            return [self.queue.popleft() for _ in range(n)]
+            return [self.queue.popleft()[1] for _ in range(n)], expired
 
     def on_failure(self, now: float) -> None:
         self.fail_count += 1
-        delay = min(self.BACKOFF_BASE * (2 ** (self.fail_count - 1)),
-                    self.BACKOFF_MAX)
-        self.next_attempt = now + delay
+        self._backoff.attempt = self.fail_count - 1
+        self.next_attempt = now + self._backoff.next_delay()
         # force address rediscovery: the store may have moved.  Close
         # the channel (native sockets) rather than waiting for GC.
         if self.channel is not None:
@@ -122,7 +138,16 @@ class GrpcTransport(Transport):
                 conn = self._conns[store_id] = _StoreConn(store_id)
             return conn
 
+    # per-batch RPC deadline: a hung peer must not pin the flush loop
+    # (and with it every region's outbound raft traffic) beyond this
+    SEND_DEADLINE = 5.0
+
     def send(self, to_store, region_id, to_peer, from_peer, msg) -> None:
+        from ..utils.failpoint import fail_point
+        if fail_point("transport::grpc_drop") is not None:
+            from ..utils.metrics import RAFT_MSG_DROP_COUNTER
+            RAFT_MSG_DROP_COUNTER.labels("failpoint").inc()
+            return
         ok = self._conn(to_store).push({
             "region_id": region_id,
             "to_peer": wire.enc_peer(to_peer),
@@ -133,27 +158,32 @@ class GrpcTransport(Transport):
             RAFT_MSG_DROP_COUNTER.labels("full").inc()
 
     def flush(self) -> None:
+        from ..utils.failpoint import fail_point
         now = time.monotonic()
         for conn in list(self._conns.values()):
             if not conn.queue:
                 continue
             if now < conn.next_attempt:
                 continue            # backing off; messages keep queuing
-            msgs = conn.pop_batch()
+            msgs, expired = conn.pop_batch(now)
+            if expired:
+                from ..utils.metrics import RAFT_MSG_DROP_COUNTER
+                RAFT_MSG_DROP_COUNTER.labels("expired").inc(expired)
             if not msgs:
                 continue
             try:
+                fail_point("transport::before_batch_send")
                 chan = self._channel(conn)
                 self._extract_snapshots(chan, msgs)
                 call = chan.unary_unary(
                     "/tikv.Tikv/BatchRaft",
                     request_serializer=wire.pack,
                     response_deserializer=wire.unpack)
-                call({"msgs": msgs}, timeout=5)
+                call({"msgs": msgs}, timeout=self.SEND_DEADLINE)
                 conn.on_success()
             except Exception:
                 # raft tolerates the lost batch (protocol retries); the
-                # conn backs off and re-resolves its address
+                # conn backs off (with jitter) and re-resolves its address
                 conn.on_failure(time.monotonic())
                 from ..utils.metrics import RAFT_MSG_DROP_COUNTER
                 RAFT_MSG_DROP_COUNTER.labels("send_fail").inc(len(msgs))
@@ -676,6 +706,8 @@ class Node:
         from ..raftstore.cmd import WriteOp
         from ..raftstore.metapb import KeyNotInRegion
         from ..storage.txn_types import split_ts
+        from ..utils.failpoint import fail_point
+        fail_point("ingest::before_check")
         with self.lock:
             peer = self.raft_store.region_peer(region_id)
             region = peer.region
@@ -687,6 +719,7 @@ class Node:
                         for cf, key, value in pairs)
             cmd = RaftCmd(region_id, region.epoch, ops=ops)
             box: dict = {}
+            fail_point("ingest::before_propose")
             peer.propose(cmd, lambda r: box.__setitem__("result", r))
         self._wait_driver(lambda: "result" in box)
         if isinstance(box["result"], Exception):
@@ -704,7 +737,9 @@ class Node:
         from ..raftstore.metapb import KeyNotInRegion
         from ..sst_importer import read_sst_cf
         from ..storage.txn_types import split_ts
-        cf_map = read_sst_cf(blob)      # validates checksum
+        from ..utils.failpoint import fail_point
+        fail_point("ingest::before_blob_check")
+        cf_map = read_sst_cf(blob)      # validates checksum + key order
         n_total = 0
         with self.lock:
             peer = self.raft_store.region_peer(region_id)
@@ -720,6 +755,7 @@ class Node:
             cmd = RaftCmd(region_id, region.epoch,
                           ops=(WriteOp("ingest", "", b"", blob),))
             box: dict = {}
+            fail_point("ingest::before_blob_propose")
             peer.propose(cmd, lambda r: box.__setitem__("result", r))
         self._wait_driver(lambda: "result" in box)
         if isinstance(box["result"], Exception):

@@ -87,6 +87,17 @@ def read_sst_cf(blob: bytes) -> dict:
     for cf, keys, vals in msgpack.unpackb(payload, raw=False):
         if len(keys) != len(vals):
             raise ValueError("sst v2 cf group length mismatch")
+        # the engine bulk-merges each group as a SORTED run, and apply
+        # trusts that order on every replica — a client-built blob with
+        # out-of-order or duplicate keys would silently corrupt the
+        # merged keyspace, so reject it before it reaches the raft log.
+        # C-speed checks: this runs on the apply path of every replica,
+        # and an interpreted per-key loop would stall the apply loop on
+        # multi-million-row ingests.
+        if len(keys) > 1 and (keys != sorted(keys) or
+                              len(set(keys)) != len(keys)):
+            raise ValueError(
+                f"sst v2 cf {cf!r}: keys not strictly ascending")
         out[cf] = (keys, vals)
     return out
 
@@ -135,9 +146,15 @@ def fast_mvcc_table_sst(table_id: int, handles, columns,
             valids.append(None if valid is None else
                           np.ascontiguousarray(
                               valid, dtype=np.uint8).tobytes())
-        return build_mvcc_sst(table_id, h.tobytes(), tuple(ids),
-                              tuple(kinds), tuple(bufs), tuple(valids),
-                              commit_ts, start_ts)
+        try:
+            return build_mvcc_sst(table_id, h.tobytes(), tuple(ids),
+                                  tuple(kinds), tuple(bufs), tuple(valids),
+                                  commit_ts, start_ts)
+        except ValueError as e:
+            if "too many columns" not in str(e):
+                raise       # real malformed input — don't mask it
+            # >map16 columns: fall back to the interpreted encoder
+            # (msgpack emits map32 headers natively)
     # interpreted fallback: per-row encode through the shared codecs
     from .codec.keys import table_record_key
     from .codec.row import encode_row

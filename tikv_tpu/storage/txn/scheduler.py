@@ -69,14 +69,20 @@ class TxnScheduler:
             cmd.prepare(MvccReader(self._engine.snapshot(ctx)))
         from ...utils.failpoint import fail_point
         from ...utils.metrics import SCHED_COMMANDS
-        from .commands import Prewrite
+        from .commands import Commit, Prewrite
         SCHED_COMMANDS.labels(type(cmd).__name__).inc()
+        fail_point("txn::before_latch")
         cid = self._latches.gen_cid()
         slots = self._latches.acquire(cid, cmd.write_keys())
+        fail_point("txn::after_latch")
         mem_keys = ()
         released: list = []
         try:
             fail_point("txn::before_process")
+            if isinstance(cmd, Commit):
+                # the commit boundary: a crash here leaves prewrite
+                # locks for the resolver (the 2PC indeterminate window)
+                fail_point("txn::before_commit")
             if isinstance(cmd, Prewrite) and \
                     (cmd.use_async_commit or cmd.try_one_pc):
                 # async commit step (a): publish memory locks BEFORE
@@ -99,9 +105,11 @@ class TxnScheduler:
             fail_point("txn::before_engine_write")
             if not txn.is_empty():
                 self._engine.write(ctx, WriteData.from_txn(txn))
+            fail_point("txn::after_engine_write")
             released = txn.released_keys
             return result
         finally:
+            fail_point("txn::before_release_latch")
             if mem_keys:
                 self.cm.unlock_keys(mem_keys)
             self._latches.release(cid, slots)

@@ -30,28 +30,76 @@ from ..raftstore import (
 
 
 class SimTransport:
-    """Shared in-process transport with message-level fault injection."""
+    """Shared in-process transport with message-level fault injection.
+
+    Besides boolean filters (drop/partition), a seeded ``chaos`` mode
+    enables deterministic message-level turbulence the way
+    transport_simulate.rs's Delay/OutOfOrder filters do:
+
+        transport.set_chaos(rng, delay_p=0.2, dup_p=0.1, reorder=True)
+
+    - ``reorder``: each routing round shuffles the pending queue;
+    - ``delay_p``: a message is held back one routing round;
+    - ``dup_p``: a message is delivered twice.
+
+    All randomness comes from the injected ``rng``, so a fault schedule
+    is reproducible from its seed.
+    """
 
     def __init__(self):
         self.stores: dict[int, RaftStore] = {}
         self.queue: list[tuple] = []
         # filters: fn(from_store, to_store, region_id, msg) -> deliver?
         self.filters: list[Callable] = []
+        self._chaos = None      # (rng, delay_p, dup_p, reorder)
+
+    def set_chaos(self, rng, delay_p: float = 0.0, dup_p: float = 0.0,
+                  reorder: bool = False) -> None:
+        self._chaos = (rng, delay_p, dup_p, reorder)
+
+    def clear_chaos(self) -> None:
+        self._chaos = None
 
     def send(self, to_store, region_id, to_peer, from_peer, msg) -> None:
+        from ..utils.failpoint import fail_point
+        if fail_point("sim_transport::drop_send") is not None:
+            return
         self.queue.append((to_store, region_id, to_peer, from_peer, msg))
+
+    def _deliver(self, ent) -> int:
+        from ..utils.failpoint import fail_point
+        to_store, region_id, to_peer, from_peer, msg = ent
+        if not all(f(from_peer.store_id, to_store, region_id, msg)
+                   for f in self.filters):
+            return 0
+        if fail_point("sim_transport::drop_recv") is not None:
+            return 0
+        store = self.stores.get(to_store)
+        if store is None:
+            return 0
+        store.on_raft_message(region_id, to_peer, from_peer, msg)
+        return 1
 
     def route_all(self) -> int:
         n = 0
-        while self.queue:
-            to_store, region_id, to_peer, from_peer, msg = self.queue.pop(0)
-            if not all(f(from_peer.store_id, to_store, region_id, msg)
-                       for f in self.filters):
+        if self._chaos is None:
+            while self.queue:
+                n += self._deliver(self.queue.pop(0))
+            return n
+        # chaos mode: one ROUND per call — delayed messages stay queued
+        # for the next round so the pump loop re-drives them (an
+        # unbounded in-round requeue would never terminate)
+        rng, delay_p, dup_p, reorder = self._chaos
+        pending, self.queue = self.queue, []
+        if reorder and len(pending) > 1:
+            rng.shuffle(pending)
+        for ent in pending:
+            if delay_p and rng.random() < delay_p:
+                self.queue.append(ent)
                 continue
-            store = self.stores.get(to_store)
-            if store is not None:
-                store.on_raft_message(region_id, to_peer, from_peer, msg)
-                n += 1
+            n += self._deliver(ent)
+            if dup_p and rng.random() < dup_p:
+                n += self._deliver(ent)
         return n
 
 
@@ -59,14 +107,18 @@ class Cluster:
     """N stores, one shared transport, one mock PD."""
 
     def __init__(self, n_stores: int = 3, pd: Optional[MockPd] = None,
-                 seed: int = 0):
+                 seed: int = 0, engine_factory: Optional[Callable] = None):
+        """``engine_factory(store_id) -> KvEngine`` swaps the per-store
+        engine (e.g. DiskEngine over a tmp dir for crash/stall chaos
+        schedules); default MemoryEngine."""
         self.pd = pd if pd is not None else MockPd()
         self.transport = SimTransport()
         self.stores: dict[int, RaftStore] = {}
         self.engines: dict[int, MemoryEngine] = {}
         self.kvs: dict[int, RaftKv] = {}
         for i in range(1, n_stores + 1):
-            engine = MemoryEngine()
+            engine = engine_factory(i) if engine_factory is not None \
+                else MemoryEngine()
             store = RaftStore(i, engine, self.transport, seed=seed)
             store.observers = [self._on_region_changed]
             self.engines[i] = engine
@@ -433,6 +485,40 @@ class Cluster:
         target = self.stores[to_store].region_peer(region_id)
         peer.node.transfer_leader(target.meta.id)
         self.pump()
+
+    # -- fault injection (transport_simulate.rs filter conveniences) --
+
+    def partition(self, group_a, group_b):
+        """Symmetric partition between two store groups → the filter
+        (pass to heal() to lift just this one)."""
+        a, b = set(group_a), set(group_b)
+
+        def filt(frm, to, _rid, _msg):
+            return not ((frm in a and to in b) or (frm in b and to in a))
+        self.transport.filters.append(filt)
+        return filt
+
+    def partition_oneway(self, from_group, to_group):
+        """Asymmetric partition: messages FROM from_group TO to_group
+        are dropped; the reverse direction still delivers."""
+        a, b = set(from_group), set(to_group)
+
+        def filt(frm, to, _rid, _msg):
+            return not (frm in a and to in b)
+        self.transport.filters.append(filt)
+        return filt
+
+    def isolate_store(self, store_id: int):
+        def filt(frm, to, _rid, _msg):
+            return frm != store_id and to != store_id
+        self.transport.filters.append(filt)
+        return filt
+
+    def heal(self, filt=None) -> None:
+        if filt is None:
+            self.transport.filters.clear()
+        elif filt in self.transport.filters:
+            self.transport.filters.remove(filt)
 
     def stop_store(self, store_id: int) -> None:
         self.transport.stores.pop(store_id, None)
