@@ -141,7 +141,7 @@ def test_status_server_routes():
         assert st["store_id"] == node.store_id
         # /config GET
         cfg = json.load(urllib.request.urlopen(f"{base}/config"))
-        assert cfg["coprocessor"]["device_row_threshold"] == 262144
+        assert cfg["coprocessor"]["device_row_threshold"] == 131072
         # /config POST (online change) flows into the endpoint
         req = urllib.request.Request(
             f"{base}/config", method="POST",

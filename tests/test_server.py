@@ -196,6 +196,50 @@ def test_coprocessor_device_backend_over_network(cluster):
     assert rows3[0][1] == sum(h for h in range(300) if h % 7 == 0) + 1000
 
 
+def test_concurrent_coprocessor_over_network(cluster):
+    """≥4 concurrent warm Coprocessor RPCs through the async serving
+    path (dispatch under the read-pool slot, D2H on the completion
+    pool) return the same answer as serial execution — the pipeline
+    must not silently break off-TPU (CPU smoke for bench 6c)."""
+    import concurrent.futures as cf
+
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import encode_table_row, int_table
+
+    c = cluster["client"]
+    table = int_table(2, table_id=9003)
+    muts = []
+    for h in range(400):
+        key, value = encode_table_row(table, h, {"c0": h % 5, "c1": h})
+        muts.append(("put", key, value))
+    c.txn_write(muts)
+
+    def make_dag(ts):
+        sel = DagSelect.from_table(table, ["id", "c0", "c1"])
+        return sel.aggregate(
+            [sel.col("c0")],
+            [("count_star", None),
+             ("sum", sel.col("c1"))]).build(start_ts=ts)
+
+    serial = c.coprocessor(make_dag(c.tso()))
+    assert serial["backend"] == "device", serial["backend"]
+    expect = sorted(serial["rows"])
+    assert sorted(
+        [sum(1 for h in range(400) if h % 5 == g),
+         sum(h for h in range(400) if h % 5 == g), g]
+        for g in range(5)) == expect
+
+    ts = c.tso()
+    with cf.ThreadPoolExecutor(6) as ex:
+        futs = [ex.submit(c.coprocessor, make_dag(ts)) for _ in range(6)]
+        resps = [f.result(timeout=60) for f in futs]
+    for r in resps:
+        assert r["backend"] == "device"
+        assert sorted(r["rows"]) == expect
+        # per-request attribution survives the deferred fetch
+        assert "time_detail" in r
+
+
 def test_split_and_routing_over_network(cluster):
     c = cluster["client"]
     c.put(b"srv-a", b"1")

@@ -65,8 +65,11 @@ def encode_mc_datum(v) -> bytes:
     if isinstance(v, decimal.Decimal):
         # prec must cover the scaled form (65 digits + 30 scale = 95);
         # the thread's default 28-digit context would silently collide
-        # distinct keys
-        with decimal.localcontext(prec=100):
+        # distinct keys.  (Context-object form: localcontext(prec=...)
+        # kwargs need Python 3.11+.)
+        _ctx = decimal.getcontext().copy()
+        _ctx.prec = 100
+        with decimal.localcontext(_ctx):
             scaled = int(v.scaleb(30).to_integral_value(
                 rounding=decimal.ROUND_HALF_UP))
         # saturate at the representable bound (MySQL clamps to the max
@@ -97,7 +100,9 @@ def decode_mc_datum(b: bytes, offset: int = 0):
         # scale-30 form: numerically exact, original printed scale is
         # not preserved (1.20 decodes == 1.2) — value order/equality is
         # what index keys need
-        with decimal.localcontext(prec=100):
+        _ctx = decimal.getcontext().copy()
+        _ctx.prec = 100
+        with decimal.localcontext(_ctx):
             d = decimal.Decimal(scaled).scaleb(-30).normalize()
         return d, offset + _DEC_W
     raise ValueError(f"bad datum flag {flag}")

@@ -66,7 +66,10 @@ class RaftstoreConfig:
 
 @dataclass
 class CoprocessorConfig:
-    device_row_threshold: int = 262144
+    # device routing crossover — rationale at
+    # copr/endpoint.py Endpoint.DEFAULT_DEVICE_ROW_THRESHOLD; raise to
+    # ~2^22 for tunneled (high-RTT) device transports
+    device_row_threshold: int = 131072
     region_cache_capacity: int = 8
     # paged response budget (endpoint.rs paging)
     response_page_rows: int = 1 << 20

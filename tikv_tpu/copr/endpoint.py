@@ -14,6 +14,7 @@ CHECKSUM=105) and dag/mod.rs (DagHandlerBuilder). The endpoint owns:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -79,12 +80,56 @@ class Endpoint:
     an engine snapshot per request, then TikvStorage adapts it).
     """
 
+    # Default device routing threshold (overridable per deployment via
+    # config coprocessor.device_row_threshold).  The crossover is
+    # TRANSPORT-bound, not kernel-bound: the fused direct-index kernel
+    # costs ~n / 9.4e9 s (11 µs at 100k rows — negligible), so a device
+    # request's floor is its dispatch + D2H sync round trip, ~1-2 ms on
+    # co-located chips.  The vectorized host pipeline runs ~40-130 M
+    # rows/s on agg shapes, i.e. ~1-3 ms at 2^17 rows — the break-even
+    # point — and below it the host answer arrives before the device
+    # sync would.  2^17 (was 2^18 pre-recovery: the XLA scan paths also
+    # paid per-step + fusion-boundary costs that the Pallas kernel
+    # removed, moving the crossover down ~2×).  Tunneled-TPU sessions
+    # (~100 ms RTT floor) should raise this to ~2^22 via config.
+    DEFAULT_DEVICE_ROW_THRESHOLD = 131072
+
     def __init__(self, snapshot_provider: Callable[[CopRequest], "ScanStorage"],
                  device_runner: Optional[object] = None,
-                 device_row_threshold: int = 262144):
+                 device_row_threshold: int = DEFAULT_DEVICE_ROW_THRESHOLD,
+                 completion_workers: int = 8):
         self._snapshot_provider = snapshot_provider
         self._device_runner = device_runner
         self._device_row_threshold = device_row_threshold
+        # deferred D2H fetches resolve on a small shared pool so N
+        # in-flight requests overlap their transfer waits (handle_async)
+        self._completion_workers = completion_workers
+        self._completion_pool = None
+        self._completion_mu = threading.Lock()
+        # capability probe, resolved once: plugin backends registered
+        # without the ``deferred`` kwarg stay unary (probing the
+        # signature up front keeps execution errors out of the
+        # capability decision — a TypeError raised INSIDE a run must
+        # degrade, not silently re-execute the request)
+        self._runner_deferred: Optional[bool] = None
+
+    def close(self) -> None:
+        """Release the completion pool's worker threads.  Server nodes
+        call this on stop; long-lived endpoints never need to."""
+        with self._completion_mu:
+            if self._completion_pool is not None:
+                self._completion_pool.shutdown()
+                self._completion_pool = None
+
+    def _supports_deferred(self) -> bool:
+        if self._runner_deferred is None:
+            import inspect
+            try:
+                sig = inspect.signature(self._device_runner.handle_request)
+                self._runner_deferred = "deferred" in sig.parameters
+            except (TypeError, ValueError):
+                self._runner_deferred = False
+        return self._runner_deferred
 
     def snapshot_for(self, req: CopRequest):
         """Public snapshot seam for streaming handlers that drive their
@@ -144,11 +189,38 @@ class Endpoint:
         return checksum_kv_pairs(keys, vals)
 
     def handle(self, req: CopRequest) -> CopResponse:
+        """Synchronous unary execution: dispatch + wait in one call."""
+        return self.handle_async(req).wait()
+
+    def _completion(self):
+        with self._completion_mu:
+            if self._completion_pool is None:
+                from ..server.read_pool import CompletionPool
+                self._completion_pool = CompletionPool(
+                    self._completion_workers)
+            return self._completion_pool
+
+    def handle_async(self, req: CopRequest) -> "CopDeferred":
+        """Dispatch-now / fetch-later execution (the production serving
+        path).
+
+        Device-routed requests return as soon as the kernel is
+        enqueued: the D2H fetch + host finalize run on the shared
+        completion pool, and ``wait()`` joins.  The caller (the gRPC
+        service) holds its read-pool slot only for the dispatch, so N
+        warm requests in flight overlap dispatch/compute/fetch instead
+        of serializing on the device transport's sync round trip — and
+        big scans waiting on D2H never starve point reads of read-pool
+        slots.  Host and paged requests execute inline and come back
+        already resolved; the degrade-to-host contract (any device
+        fault, unless force_backend="device") holds on both the
+        dispatch and the deferred-fetch side.
+        """
         from ..resource_metering import (
             GLOBAL_RECORDER,
             ResourceTagFactory,
         )
-        from ..utils import metrics as m
+        from ..utils import tracker
         if req.tp != REQ_TYPE_DAG:
             raise NotImplementedError(f"request type {req.tp}")
         tag = ResourceTagFactory.tag(req.resource_group,
@@ -157,8 +229,8 @@ class Endpoint:
         with GLOBAL_RECORDER.attach(tag):
             storage = self._snapshot_provider(req)
             backend = self._pick_backend(req, storage)
-            from ..utils import tracker
             tracker.label("backend", backend)
+
             def host_exec():
                 from ..executors.runner import BatchExecutorsRunner
                 with tracker.phase("host_exec"):
@@ -167,49 +239,98 @@ class Endpoint:
 
             if req.paging_size > 0:
                 backend = "host"    # pages are a host-pipeline contract
+                tracker.label("backend", "host")
                 from ..executors.runner import BatchExecutorsRunner
                 with tracker.phase("host_exec"):
                     result = BatchExecutorsRunner(
                         req.dag, storage,
                         resume_token=req.resume_token).handle_request(
                             max_rows=req.paging_size)
-            elif backend == "device":
+                return CopDeferred(self, req, storage, tag, t0, backend,
+                                   result=result)
+            if backend != "device":
+                return CopDeferred(self, req, storage, tag, t0, "host",
+                                   result=host_exec())
+            try:
+                if self._supports_deferred():
+                    out = self._device_runner.handle_request(
+                        req.dag, storage, deferred=True)
+                else:
+                    out = self._device_runner.handle_request(req.dag,
+                                                             storage)
+            except Exception:
+                # a device fault (dispatch failure, runtime error,
+                # unreachable accelerator) degrades the query to the
+                # host pipeline instead of failing it; only an explicit
+                # force_backend="device" (parity tests) surfaces it
+                if req.force_backend == "device":
+                    raise
+                import logging
+                logging.getLogger(__name__).warning(
+                    "device backend failed; degrading to host",
+                    exc_info=True)
+                tracker.label("backend", "host")
+                return CopDeferred(self, req, storage, tag, t0, "host",
+                                   result=host_exec())
+            from ..device.runner import DeferredResult
+            if not isinstance(out, DeferredResult):
+                # host fallback / zero rows / cold build: already done
+                return CopDeferred(self, req, storage, tag, t0, backend,
+                                   result=out)
+            # the request's tracker rides to the completion worker so
+            # device_fetch still lands in this request's TimeDetail
+            cur = tracker.current()
+
+            def fetch():
+                tok = tracker.adopt(cur) if cur is not None else None
                 try:
-                    result = self._device_runner.handle_request(req.dag,
-                                                                storage)
-                except Exception:
-                    # a device fault (dispatch failure, runtime error,
-                    # unreachable accelerator) degrades the query to the
-                    # host pipeline instead of failing it; only an
-                    # explicit force_backend="device" (parity tests)
-                    # surfaces the fault
-                    if req.force_backend == "device":
-                        raise
-                    import logging
-                    logging.getLogger(__name__).warning(
-                        "device backend failed; degrading to host",
-                        exc_info=True)
-                    backend = "host"
-                    tracker.label("backend", "host")
-                    result = host_exec()
-            else:
-                result = host_exec()
-            from ..resource_metering import scanned_rows
+                    with GLOBAL_RECORDER.attach(tag, requests=0):
+                        return out.result()
+                finally:
+                    if tok is not None:
+                        tracker.uninstall(tok)
+
+            fut = self._completion().submit(
+                fetch, priority="high" if out.small else "normal")
+            return CopDeferred(self, req, storage, tag, t0, backend,
+                               future=fut)
+
+    def _finish_response(self, d: "CopDeferred", result,
+                         backend: str) -> CopResponse:
+        """Shared completion tail: scanned-rows accounting + metrics."""
+        from ..resource_metering import GLOBAL_RECORDER, scanned_rows
+        from ..utils import metrics as m
+        from ..utils import tracker
+        with GLOBAL_RECORDER.attach(d.tag, requests=0):
             if backend == "device" and not result.exec_summaries:
                 # the device feed always scans the whole snapshot; its
                 # results carry no per-operator summaries
-                est = getattr(storage, "estimated_rows", None)
+                est = getattr(d.storage, "estimated_rows", None)
                 n = est() if callable(est) else None
                 n_scanned = n if n is not None else result.batch.num_rows
-                GLOBAL_RECORDER.record_read_keys(n_scanned)
             else:
                 n_scanned = scanned_rows(result)
-                GLOBAL_RECORDER.record_read_keys(n_scanned)
+            GLOBAL_RECORDER.record_read_keys(n_scanned)
             tracker.add_scan(n_scanned)
-        elapsed = time.perf_counter_ns() - t0
+        elapsed = time.perf_counter_ns() - d.t0
         m.COPR_REQ_COUNTER.labels(backend).inc()
         m.COPR_REQ_DURATION.labels(backend).observe(elapsed / 1e9)
         return CopResponse(result, elapsed, backend)
+
+    def _degrade_at_wait(self, d: "CopDeferred"):
+        """Deferred-fetch failure → host pipeline (unless forced)."""
+        from ..resource_metering import GLOBAL_RECORDER
+        from ..executors.runner import BatchExecutorsRunner
+        from ..utils import tracker
+        import logging
+        logging.getLogger(__name__).warning(
+            "deferred device fetch failed; degrading to host",
+            exc_info=True)
+        tracker.label("backend", "host")
+        with GLOBAL_RECORDER.attach(d.tag, requests=0):
+            with tracker.phase("host_exec"):
+                return BatchExecutorsRunner(
+                    d.req.dag, d.storage).handle_request()
 
     def _pick_backend(self, req: CopRequest, storage) -> str:
         if req.force_backend in ("host", "device"):
@@ -229,3 +350,53 @@ class Endpoint:
         if n is not None and n >= self._device_row_threshold:
             return "device"
         return "host"
+
+
+class CopDeferred:
+    """An in-flight coprocessor request (Endpoint.handle_async).
+
+    ``wait()`` joins the deferred device fetch (or returns the inline
+    host result), applies the endpoint's degrade-to-host policy to any
+    fetch-side failure, runs the completion accounting, and memoizes —
+    idempotent and thread-safe.
+    """
+
+    __slots__ = ("_endpoint", "req", "storage", "tag", "t0", "_backend",
+                 "_result", "_future", "_mu", "_resp")
+
+    def __init__(self, endpoint, req, storage, tag, t0, backend,
+                 result=None, future=None):
+        self._endpoint = endpoint
+        self.req = req
+        self.storage = storage
+        self.tag = tag
+        self.t0 = t0
+        self._backend = backend
+        self._result = result
+        self._future = future
+        self._mu = threading.Lock()
+        self._resp = None
+
+    @property
+    def resolved(self) -> bool:
+        return self._future is None
+
+    def wait(self) -> CopResponse:
+        with self._mu:
+            if self._resp is None:
+                backend = self._backend
+                result = self._result
+                if result is None:
+                    try:
+                        result = self._future.result()
+                    except Exception:
+                        # fetch-side fault: same contract as a dispatch
+                        # fault — degrade unless the caller forced the
+                        # device (parity tests want the raw error)
+                        if self.req.force_backend == "device":
+                            raise
+                        result = self._endpoint._degrade_at_wait(self)
+                        backend = "host"
+                self._resp = self._endpoint._finish_response(
+                    self, result, backend)
+            return self._resp

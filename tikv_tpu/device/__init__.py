@@ -1,5 +1,5 @@
 """Device (TPU) execution backend for the coprocessor layer."""
 
-from .runner import DeviceRunner
+from .runner import DeferredResult, DeviceRunner
 
-__all__ = ["DeviceRunner"]
+__all__ = ["DeviceRunner", "DeferredResult"]

@@ -29,6 +29,12 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
+try:                                    # varying-manual-axes typing
+    _pvary = lax.pvary
+except AttributeError:                  # jax 0.4.x: replication implicit
+    def _pvary(x, axes):
+        return x
+
 BLOCK_ROWS = 1 << 16
 
 
@@ -261,7 +267,7 @@ def matmul_groupby(idx, L8, Lf, slots: int, block: int = BLOCK_ROWS,
         xs = (idx_b, l8_b)
         carry = (jnp.zeros((p8, G), jnp.int64), None)
     if vary_axes:
-        carry = tuple(None if c is None else lax.pvary(c, vary_axes)
+        carry = tuple(None if c is None else _pvary(c, vary_axes)
                       for c in carry)
 
     def body(carry, xs):

@@ -15,6 +15,22 @@ accumulator into one ``pallas_call``: planes are generated in VMEM and
 consumed immediately (never touching HBM), and the sequential grid
 replaces the scan.
 
+Three slot-id modes share the kernel body (r6 — the direct-index kernel
+is the default body for every aggregation shape that qualifies):
+
+- ``dense``  — GROUP BY over a small contiguous key domain: the key
+  expression evaluates in-kernel and ``key - base`` indexes the grid
+  directly (BASELINE config 4).
+- ``sparse`` — arbitrary int64 key domains: the host dictionary-encodes
+  the keys once per snapshot (runner._sparse_slots) and the dense slot
+  ids ride as ONE extra int32 input column, so the kernel never touches
+  the (Mosaic-unsupported) int64 key values (config 4s).  Columns the
+  kernel does not evaluate (the raw key) stay out of its input set, so
+  their dtype/NULLability cannot disqualify the plan.
+- ``simple`` — no GROUP BY: a single-slot grid (every masked row aims at
+  slot 0), which turns SUM/COUNT/AVG over 50M rows into one fused
+  HBM pass (config 3).
+
 Design (r5 — all choices measured on v5e at 100M rows):
 
 - **The MXU contraction is the binding constraint, not HBM.**  Pure-dot
@@ -29,6 +45,13 @@ Design (r5 — all choices measured on v5e at 100M rows):
   a provably non-NULL key no NULL slot either, so 1024 groups fit
   exactly in HI=32 sublanes (was 40 with scrap+NULL: 20% more one-hot
   generation and dot).
+- **Dead grid blocks skip the MXU (r6).**  The feed pads to a bucketed
+  shape (runner._pad_rows: the 9/8-geometric grid bounds compile
+  classes), but the bucketing must tax only the CACHE KEY, not the
+  computed extent: blocks entirely outside [row_lo, row_hi) gate the
+  whole one-hot + dot body behind ``pl.when``, so a masked block costs
+  its input DMA and the ~10 us grid step — not the contraction that is
+  the kernel's binding constraint (up to 12.5% of pass time before).
 - **Per-plane dots, no concatenation.**  The weight planes
   (mask / ok / value-byte) each dot against the shared ``A`` one-hot and
   accumulate into their lane slice of the packed output; concatenating
@@ -91,47 +114,85 @@ LO = 32
 # path serves (up to its own 2^20 ceiling).
 MAX_SLOTS = 1 << 12
 
+MODE_DENSE = "dense"
+MODE_SPARSE = "sparse"
+MODE_SIMPLE = "simple"
+
 _i32 = jnp.int32
+
+
+def _rpn_cols(rpn) -> set:
+    return {n.col_idx for n in rpn.nodes if isinstance(n, RpnColumnRef)}
+
+
+def kernel_col_ids(plan, mode: str) -> tuple:
+    """used_cols positions whose VALUES the kernel evaluates in VMEM.
+
+    Only these columns become kernel inputs (and must therefore be int32
+    and non-nullable); a sparse GROUP BY key is consumed as precomputed
+    slot ids instead, so its raw (often int64 / nullable) column never
+    reaches the kernel.
+    """
+    ids: set = set()
+    for r in plan.sel_rpns:
+        ids |= _rpn_cols(r)
+    for r in plan.agg_rpns:
+        if r is not None:
+            ids |= _rpn_cols(r)
+    if mode == MODE_DENSE:
+        ids |= _rpn_cols(plan.key_rpn)
+    return tuple(sorted(ids))
 
 
 def key_never_null(plan) -> bool:
     """True when the group key provably cannot be NULL: a bare column
     reference over a feed column with no validity plane.  (The
-    ``supported`` gate already requires every feed column be
+    ``supported`` gate already requires every kernel-input column be
     non-nullable; expression keys keep a NULL slot because a function
     may introduce NULL, e.g. out-of-domain casts.)"""
     nodes = plan.key_rpn.nodes
     return len(nodes) == 1 and isinstance(nodes[0], RpnColumnRef)
 
 
-def n_slots(plan, capacity: int) -> int:
+def n_slots(plan, capacity: int, mode: str = MODE_DENSE) -> int:
     """Slots the kernel actually materializes (tight grid)."""
+    if mode == MODE_SIMPLE:
+        return 1
+    if mode == MODE_SPARSE:
+        # the slot encoding (runner._sparse_slots) reserves slot
+        # ``capacity`` for NULL keys; whether a given snapshot has any
+        # is data-dependent, so the slot is always materialized
+        return capacity + 1
     return capacity + (0 if key_never_null(plan) else 1)
 
 
 def supported(plan, feed, dtypes, pf: int, capacity: int,
-              single_device: bool) -> bool:
+              single_device: bool, mode: str = MODE_DENSE) -> bool:
     """Static gate for the Pallas fast path.
 
-    int32 feed columns only (int64 is unsupported in Mosaic), no NULL
-    validity planes (they would need int8 plane inputs), int byte-plane
-    aggregates only (pf == 0), and a slot span whose one-hot fits VMEM.
+    int32 kernel-input columns only (int64 is unsupported in Mosaic),
+    no NULL validity planes on kernel inputs (they would need int8
+    plane inputs), int byte-plane aggregates only (pf == 0), and a slot
+    span whose one-hot fits VMEM.  Columns outside the kernel's input
+    set (e.g. a sparse key consumed as slot ids) are exempt.
     """
     if not single_device or pf != 0:
         return False
-    if n_slots(plan, capacity) > MAX_SLOTS:
-        return False
-    if any(feed["null_flags"]):
-        return False
-    if any(dt != "int32" for dt in dtypes):
+    if n_slots(plan, capacity, mode) > MAX_SLOTS:
         return False
     if feed["n_pad"] % BLOCK != 0:
         return False
+    kcols = kernel_col_ids(plan, mode)
+    if not kcols:
+        return False        # zero-input pallas_call; XLA serves trivially
+    for i in kcols:
+        if feed["null_flags"][i] or dtypes[i] != "int32":
+            return False
     return True
 
 
 def build(plan, layouts, p8: int, capacity: int, nblk: int,
-          n_cols: int):
+          col_map, mode: str = MODE_DENSE):
     """Build the pallas_call for one (plan, grid-span) pair.
 
     ``nblk`` is the GRID SPAN in blocks, not the whole feed: the
@@ -142,13 +203,19 @@ def build(plan, layouts, p8: int, capacity: int, nblk: int,
     blocks, and disjoint spans' packed partials merge by addition
     exactly like psum partials.
 
+    ``col_map[i]``: input-ref position of used_cols[i], or -1 when the
+    column is not a kernel input (sparse keys, columns only the host
+    touches).  In ``sparse`` mode one extra int32 slot-id column rides
+    after the mapped columns.
+
     Returns ``(run, LO, HI)`` with
-    ``run(row_lo, row_hi, base, blk0, flat) -> (2, HI, p8*LO) int32``
+    ``run(row_lo, row_hi, base, blk0, cols) -> (2, HI, p8*LO) int32``
     packed accumulator pair covering absolute rows
-    [row_lo, row_hi) ⊆ [blk0*BLOCK, (blk0+nblk)*BLOCK).
+    [row_lo, row_hi) ⊆ [blk0*BLOCK, (blk0+nblk)*BLOCK); ``cols`` is the
+    already-selected input tuple (mapped columns, then slot ids when
+    sparse).
     """
-    nullable = not key_never_null(plan)
-    slots = capacity + (1 if nullable else 0)
+    slots = n_slots(plan, capacity, mode)
     hi_n = -(-slots // LO)
     HI = ((hi_n + 7) // 8) * 8
     W = p8 * LO
@@ -156,14 +223,19 @@ def build(plan, layouts, p8: int, capacity: int, nblk: int,
     # the sentinel hi value for rows with no destination slot: outside
     # [0, HI), so the row's one-hot column is all-zero
     SENT = HI * LO
+    nullable = mode != MODE_SIMPLE and (
+        mode == MODE_SPARSE or not key_never_null(plan))
     sel_rpns = plan.sel_rpns
     key_rpn = plan.key_rpn
     agg_rpns = plan.agg_rpns
     lobits = LO.bit_length() - 1
+    n_cols_in = sum(1 for p in col_map if p >= 0)
+    sparse = mode == MODE_SPARSE
+    n_in = n_cols_in + (1 if sparse else 0)
 
     def kernel(sref, *refs):
-        out_ref = refs[n_cols]
-        alo, ahi = refs[n_cols + 1], refs[n_cols + 2]
+        out_ref = refs[n_in]
+        alo, ahi = refs[n_in + 1], refs[n_in + 2]
         i = pl.program_id(0)
 
         @pl.when(i == 0)
@@ -176,77 +248,96 @@ def build(plan, layouts, p8: int, capacity: int, nblk: int,
         base = sref[2]
         blk0 = sref[3]
         row0 = (i + blk0) * _i32(B)
-        riota = lax.broadcasted_iota(_i32, (1, B), 1)[0]
-        rows = row0 + riota
-        row_mask = (rows >= row_lo) & (rows < row_hi)
 
-        # columns are all-valid (gated): validity == row_mask
-        pairs = [(refs[c][:], row_mask) for c in range(n_cols)]
-        mask = row_mask
-        for rpn in sel_rpns:
-            v, ok = eval_rpn(rpn, pairs, B, jnp)
-            mask = mask & ok & (v != 0)
+        # dead-block guard: a block entirely outside [row_lo, row_hi)
+        # (bucketed feed padding, bucketed tile spans) skips one-hot
+        # generation and the dots — the bucketing then costs only this
+        # block's DMA + grid step, never MXU time
+        @pl.when((row0 < row_hi) & (row0 + _i32(B) > row_lo))
+        def _():
+            riota = lax.broadcasted_iota(_i32, (1, B), 1)[0]
+            rows = row0 + riota
+            row_mask = (rows >= row_lo) & (rows < row_hi)
 
-        kv, km = eval_rpn(key_rpn, pairs, B, jnp)
-        kv = jnp.broadcast_to(kv, (B,)).astype(_i32)
-        km = jnp.broadcast_to(km, (B,))
-        rel = kv - base
-        in_range = (rel >= _i32(0)) & (rel < _i32(capacity))
-        # slot layout: [0, capacity) groups, capacity = NULL-key slot
-        # (only materialized for expression keys); rows with no slot —
-        # masked out, out-of-range, or NULL under a non-null key — aim
-        # at SENT: hi = HI, matching no one-hot row, so the whole
-        # column is zero and the row vanishes from every plane.
-        if nullable:
-            idx = jnp.where(mask & km & in_range, rel, _i32(SENT))
-            idx = jnp.where(mask & ~km, _i32(capacity), idx)
-        else:
-            idx = jnp.where(mask & km & in_range, rel, _i32(SENT))
-        hi_ = idx >> lobits
-        lo_ = idx & _i32(LO - 1)
+            # kernel-input columns are all-valid (gated): validity ==
+            # row_mask; unmapped columns never appear in these rpns
+            pairs = [None if p < 0 else (refs[p][:], row_mask)
+                     for p in col_map]
+            mask = row_mask
+            for rpn in sel_rpns:
+                v, ok = eval_rpn(rpn, pairs, B, jnp)
+                mask = mask & ok & (v != 0)
 
-        hi_iota = lax.broadcasted_iota(_i32, (HI, B), 0)
-        lo_iota = lax.broadcasted_iota(_i32, (LO, B), 0)
-        A8 = jnp.where(hi_[None, :] == hi_iota, _i32(1),
-                       _i32(0)).astype(jnp.int8)
-        cmp = lo_[None, :] == lo_iota
-        zero = jnp.zeros((LO, B), _i32)
-        dn = (((1,), (1,)), ((), ()))
+            if mode == MODE_SIMPLE:
+                # single-slot grid: every masked row lands in slot 0
+                idx = jnp.where(mask, _i32(0), _i32(SENT))
+            elif sparse:
+                # precomputed slot ids: [0, capacity) groups, capacity
+                # = NULL-key slot, capacity+1 = scrap/padding → SENT
+                s = refs[n_cols_in][:].astype(_i32)
+                idx = jnp.where(mask & (s < _i32(slots)), s, _i32(SENT))
+            else:
+                kv, km = eval_rpn(key_rpn, pairs, B, jnp)
+                kv = jnp.broadcast_to(kv, (B,)).astype(_i32)
+                km = jnp.broadcast_to(km, (B,))
+                rel = kv - base
+                in_range = (rel >= _i32(0)) & (rel < _i32(capacity))
+                # slot layout: [0, capacity) groups, capacity = NULL-key
+                # slot (only materialized for expression keys); rows
+                # with no slot — masked out, out-of-range, or NULL under
+                # a non-null key — aim at SENT: hi = HI, matching no
+                # one-hot row, so the whole column is zero and the row
+                # vanishes from every plane.
+                if nullable:
+                    idx = jnp.where(mask & km & in_range, rel, _i32(SENT))
+                    idx = jnp.where(mask & ~km, _i32(capacity), idx)
+                else:
+                    idx = jnp.where(mask & km & in_range, rel, _i32(SENT))
+            hi_ = idx >> lobits
+            lo_ = idx & _i32(LO - 1)
 
-        def accum(p, plane_i32):
-            prod = lax.dot_general(A8, plane_i32.astype(jnp.int8), dn,
-                                   preferred_element_type=_i32)
-            sl = slice(p * LO, (p + 1) * LO)
-            alo[:, sl] += prod & _i32(0xFFFF)
-            ahi[:, sl] += prod >> 16
+            hi_iota = lax.broadcasted_iota(_i32, (HI, B), 0)
+            lo_iota = lax.broadcasted_iota(_i32, (LO, B), 0)
+            A8 = jnp.where(hi_[None, :] == hi_iota, _i32(1),
+                           _i32(0)).astype(jnp.int8)
+            cmp = lo_[None, :] == lo_iota
+            zero = jnp.zeros((LO, B), _i32)
+            dn = (((1,), (1,)), ((), ()))
 
-        # plane 0 = slot-presence counts; rows without a slot are
-        # already dropped by their zero A column, so no mask multiply
-        accum(0, jnp.where(cmp, _i32(1), zero))
-        p = 1
-        for lay, rpn in zip(layouts, agg_rpns):
-            if lay.kind == "count_star":
-                continue
-            v, ok = eval_rpn(rpn, pairs, B, jnp)
-            v = jnp.broadcast_to(v, (B,)).astype(_i32)
-            okb = jnp.broadcast_to(ok, (B,))
-            aliased = lay.ok_plane == 0
-            if not aliased:
-                ok32 = jnp.where(okb, _i32(1), _i32(0))
-                accum(p, jnp.where(cmp, ok32[None, :], zero))
-                p += 1
-            if lay.byte_planes:
-                nb = lay.nb
-                biased = v + _i32(1 << (8 * nb - 1))
+            def accum(p, plane_i32):
+                prod = lax.dot_general(A8, plane_i32.astype(jnp.int8), dn,
+                                       preferred_element_type=_i32)
+                sl = slice(p * LO, (p + 1) * LO)
+                alo[:, sl] += prod & _i32(0xFFFF)
+                ahi[:, sl] += prod >> 16
+
+            # plane 0 = slot-presence counts; rows without a slot are
+            # already dropped by their zero A column, so no mask multiply
+            accum(0, jnp.where(cmp, _i32(1), zero))
+            p = 1
+            for lay, rpn in zip(layouts, agg_rpns):
+                if lay.kind == "count_star":
+                    continue
+                v, ok = eval_rpn(rpn, pairs, B, jnp)
+                v = jnp.broadcast_to(v, (B,)).astype(_i32)
+                okb = jnp.broadcast_to(ok, (B,))
+                aliased = lay.ok_plane == 0
                 if not aliased:
-                    # NULL argument on a live row: bytes must not leak
-                    biased = biased * ok32
-                for b in range(nb):
-                    byte = ((biased >> (8 * b)) & _i32(0xFF)) - _i32(128)
-                    if not aliased:
-                        byte = jnp.where(okb, byte, _i32(0))
-                    accum(p, jnp.where(cmp, byte[None, :], zero))
+                    ok32 = jnp.where(okb, _i32(1), _i32(0))
+                    accum(p, jnp.where(cmp, ok32[None, :], zero))
                     p += 1
+                if lay.byte_planes:
+                    nb = lay.nb
+                    biased = v + _i32(1 << (8 * nb - 1))
+                    if not aliased:
+                        # NULL argument on a live row: bytes must not leak
+                        biased = biased * ok32
+                    for b in range(nb):
+                        byte = ((biased >> (8 * b)) & _i32(0xFF)) - _i32(128)
+                        if not aliased:
+                            byte = jnp.where(okb, byte, _i32(0))
+                        accum(p, jnp.where(cmp, byte[None, :], zero))
+                        p += 1
 
         @pl.when(i == nblk - 1)
         def _():
@@ -257,7 +348,7 @@ def build(plan, layouts, p8: int, capacity: int, nblk: int,
         num_scalar_prefetch=1,
         grid=(nblk,),
         in_specs=[pl.BlockSpec((B,), lambda i, s: (i + s[3],))
-                  for _ in range(n_cols)],
+                  for _ in range(n_in)],
         out_specs=pl.BlockSpec((2, HI, W), lambda i, s: (0, 0, 0)),
         scratch_shapes=[pltpu.VMEM((HI, W), _i32),
                         pltpu.VMEM((HI, W), _i32)],
@@ -272,7 +363,7 @@ def build(plan, layouts, p8: int, capacity: int, nblk: int,
 
     scal_cache: dict = {}
 
-    def run(row_lo: int, row_hi: int, base: int, blk0: int, flat):
+    def run(row_lo: int, row_hi: int, base: int, blk0: int, cols):
         # a fresh scalar H2D on every request adds ~30 ms to the fetch
         # through the tunnel; the scalar tuple is constant per
         # (feed, tile)
@@ -282,7 +373,7 @@ def build(plan, layouts, p8: int, capacity: int, nblk: int,
             scal = jnp.asarray(np.asarray(key, np.int32))
             scal_cache[key] = scal
         with jax.enable_x64(False):
-            return call(scal, *flat)
+            return call(scal, *cols)
 
     return run, LO, HI
 

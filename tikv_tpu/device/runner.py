@@ -38,6 +38,7 @@ device dispatch.
 from __future__ import annotations
 
 import math
+import threading
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -47,6 +48,17 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:                                    # jax >= 0.5 top-level alias
+    _shard_map = jax.shard_map
+except AttributeError:                  # 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:                                    # varying-manual-axes typing
+    _pvary = lax.pvary
+except AttributeError:                  # 0.4.x: replication is implicit
+    def _pvary(x, axes):
+        return x
 
 from ..copr.dag import (
     AggregationDesc,
@@ -161,6 +173,84 @@ class _Plan:
     limit: int = 0
 
 
+def _sum_parts(parts):
+    """Merge per-tile packed partials (psum-partial semantics)."""
+    packed = np.asarray(parts[0])
+    for p in parts[1:]:
+        packed = packed + np.asarray(p)
+    return packed
+
+
+class _Pending:
+    """A dispatched device request: output pytree still on device plus
+    the host finalize that turns the fetched numpy tree into a
+    SelectResult.  ``copy_to_host_async`` is issued for every leaf at
+    construction, so the D2H transfer streams while the caller decides
+    when (and on which thread) to block — the seam the async serving
+    path pipelines on.  ``small``: the fetch is KBs (agg states), so a
+    completion pool may prioritize it over bulk candidate readbacks.
+    """
+
+    __slots__ = ("tree", "finalize", "small")
+
+    def __init__(self, tree, finalize, small: bool = True):
+        self.tree = tree
+        self.finalize = finalize
+        self.small = small
+        for x in jax.tree.leaves(tree):
+            try:
+                x.copy_to_host_async()
+            except Exception:   # pragma: no cover - CPU arrays
+                pass
+
+
+class DeferredResult:
+    """Handle for a device request whose D2H fetch + host finalize have
+    not run yet (``DeviceRunner.handle_request(..., deferred=True)``).
+
+    ``result()`` blocks on the transfer, runs the host finalize, and
+    memoizes — safe to call from any thread, exactly-once semantics.
+    The degrade contract survives deferral: a ``device::*`` failpoint
+    (or any _FallbackToHost) firing inside the deferred fetch downgrades
+    THIS request to the host pipeline instead of failing it, exactly as
+    the synchronous path does.  Any other exception propagates to the
+    caller (the endpoint applies its own degrade policy there).
+    """
+
+    __slots__ = ("_runner", "_pending", "_dag", "_storage", "_mu",
+                 "_memo", "small")
+
+    def __init__(self, runner, pending: _Pending, dag, storage):
+        self._runner = runner
+        self._pending = pending
+        self._dag = dag             # original request (host fallback)
+        self._storage = storage
+        self._mu = threading.Lock()
+        self._memo = None
+        self.small = pending.small
+
+    def result(self):
+        with self._mu:
+            if self._memo is None:
+                try:
+                    self._memo = ("ok", self._resolve())
+                except BaseException as e:      # noqa: BLE001 — memoized
+                    self._memo = ("err", e)
+            kind, val = self._memo
+        if kind == "err":
+            raise val
+        return val
+
+    def _resolve(self):
+        try:
+            r = self._runner._finish(self._pending)
+        except _FallbackToHost:
+            from ..executors.runner import BatchExecutorsRunner
+            return BatchExecutorsRunner(self._dag,
+                                        self._storage).handle_request()
+        return self._runner._apply_output_offsets(self._dag, r)
+
+
 class DeviceRunner:
     """Executes supported DAG plans on the device mesh.
 
@@ -202,6 +292,18 @@ class DeviceRunner:
             self._chunk_override = True
         self._plan_cache: dict = {}
         self._kernel_cache: dict = {}
+        # dispatch serialization: two threads launching multi-device
+        # executables concurrently can interleave their per-device
+        # enqueues and deadlock the mesh (launch-order inversion), and
+        # the cache dicts below are not thread-safe.  The lock spans
+        # enqueue AND any cold work a request needs first (feed
+        # upload, kernel build/compile) — warm requests hold it for
+        # ~µs, but a request that goes cold serializes its peers
+        # behind the rebuild; a deliberate simplicity tradeoff, since
+        # cold builds are once-per-(data version, plan).  D2H fetches —
+        # the expensive part the async serving path overlaps — always
+        # block OUTSIDE it.
+        self._dispatch_mu = threading.Lock()
         from collections import OrderedDict
         self._scalar_cache: "OrderedDict" = OrderedDict()
         # HBM-resident feed cache — the TPU-native analog of TiKV's
@@ -225,8 +327,13 @@ class DeviceRunner:
         measure far above the host path; selection-only plans materialize
         their full output through the host anyway, so the device pass
         only adds transfer cost — measured slower than the vectorized
-        host path on 10M rows (bench config 2).  force_backend="device"
-        still runs them for parity testing.
+        host path on 10M rows (bench config 2).  The fused direct-index
+        kernel (r6 default for agg shapes) does not change this split:
+        it widens the agg-side win but a selection's cost is still the
+        result transfer, which no kernel removes.  The SIZE crossover
+        lives in Endpoint.device_row_threshold (rationale there).
+        force_backend="device" still runs declined shapes for parity
+        testing.
         """
         plan = self._analyze(dag)
         return plan is not None and plan.kind in ("simple_agg", "hash_agg",
@@ -382,9 +489,12 @@ class DeviceRunner:
         # padded shape is a compile class (pallas grid + XLA scan
         # length), and live regions change size on every write — exact
         # padding would recompile the kernels on each data version.
-        # Bucketing bounds wasted rows at <12.5% (masked rows cost
-        # their scan time but contribute nothing) and bounds the
-        # number of compile classes logarithmically.
+        # Bucketing bounds the number of compile classes
+        # logarithmically and taxes ONLY the cache key, never the
+        # computed extent: blocks past the live rows skip their MXU /
+        # aggregation work (pl.when dead-block guard in pallas_hash,
+        # lax.cond guard in _mega's scan step), so the ≤12.5% padding
+        # costs DMA + grid steps, not kernel time.
         if not self._chunk_override and blocks > 8:
             # round up to a 4-significant-bit block count (k·2^s,
             # 8 ≤ k ≤ 15): keeps n_pad rich in powers of two so
@@ -612,7 +722,7 @@ class DeviceRunner:
                 # soon as local rows fold in; the scan carry type must be
                 # varying from step 0
                 summed0, stacked0 = carry
-                carry = (jax.tree.map(lambda x: lax.pvary(x, ROW_AXES),
+                carry = (jax.tree.map(lambda x: _pvary(x, ROW_AXES),
                                       summed0), stacked0)
             base0 = self._shard_index() * n_local_total
             xs = tuple(a.reshape(nblk, chunk_local) for a in flat)
@@ -627,23 +737,36 @@ class DeviceRunner:
                 s_i = x[0]
                 cols = x[1:]
                 base = base0 + s_i * chunk_local
-                row_mask = (base.astype(idt) + iota) < n_scalar.astype(idt)
-                args = []
-                fi = 0
-                for has_nulls in null_flags:
-                    v = cols[fi]
-                    fi += 1
-                    if has_nulls:
-                        m = cols[fi]
+
+                def live(c):
+                    row_mask = (base.astype(idt) + iota) < \
+                        n_scalar.astype(idt)
+                    args = []
+                    fi = 0
+                    for has_nulls in null_flags:
+                        v = cols[fi]
                         fi += 1
-                    else:
-                        m = row_mask
-                    args.append(v)
-                    args.append(m)
-                out = body(c, aux, base, *args, row_mask)
-                if emits:
-                    return out
-                return out, None
+                        if has_nulls:
+                            m = cols[fi]
+                            fi += 1
+                        else:
+                            m = row_mask
+                        args.append(v)
+                        args.append(m)
+                    out = body(c, aux, base, *args, row_mask)
+                    if emits:
+                        return out
+                    return out, None
+
+                def dead(c):
+                    # block entirely past the live rows (bucketed feed
+                    # padding): an all-masked body invocation is a
+                    # carry no-op by construction, so skip its HBM pass
+                    ys = jnp.zeros((chunk_local,), jnp.bool_) \
+                        if emits else None
+                    return c, ys
+
+                return lax.cond(base < n_scalar, live, dead, c)
 
             carry, ys = lax.scan(step, carry, (steps,) + xs)
             carry = finalize(carry)
@@ -657,7 +780,7 @@ class DeviceRunner:
             return jax.jit(local_fn)
         cs = self._carry_specs(carry_example)
         out_specs = (cs, ys_specs) if ys_specs is not None else cs
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             local_fn, mesh=self._mesh,
             in_specs=(cs, P(), P()) + (P(ROW_AXES),) * n_flat,
             out_specs=out_specs))
@@ -888,7 +1011,8 @@ class DeviceRunner:
         return jnp.where(mask, key2, excl)
 
     def _build_topn_kernel(self, plan: _Plan, n_cols: int, k: int,
-                           null_flags, n_pad: int, n_flat: int):
+                           null_flags, n_pad: int, n_flat: int,
+                           n_used: Optional[int] = None):
         """Whole-feed two-stage top-k — ONE dispatch, no scan.
 
         ``lax.top_k`` over one flat 100M-row array costs 340-530ms on v5e
@@ -896,9 +1020,18 @@ class DeviceRunner:
         runs ~3× faster. Stage 1 takes the per-segment top k over a
         (nseg, seglen) view (any global top-k row is in its segment's
         top k), stage 2 reduces the nseg·k candidates to k.
+
+        ``n_used`` (single-device): the live seglen-rounded row prefix —
+        the kernel slices the feed to it so the bucketed padding
+        (_pad_rows) taxes only the cache key, never the top_k extent
+        (an XLA prefix slice streams at HBM speed; top_k over the same
+        rows costs an order of magnitude more).
         """
         S = self._nshards()
         n_local = n_pad // S
+        trim = self._single and n_used is not None and n_used < n_local
+        if trim:
+            n_local = n_used
         seglen = math.gcd(n_local, 1 << 17)
         nseg = n_local // seglen
         kk = min(k, seglen)
@@ -906,6 +1039,8 @@ class DeviceRunner:
         idt = jnp.int32 if n_pad <= np.iinfo(np.int32).max else jnp.int64
 
         def local_fn(n_scalar, *flat):
+            if trim:
+                flat = tuple(a[:n_local] for a in flat)
             if self._single:
                 base0 = idt(0)
             else:
@@ -939,7 +1074,7 @@ class DeviceRunner:
 
         if self._single:
             return jax.jit(local_fn)
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             local_fn, mesh=self._mesh,
             in_specs=(P(),) + (P(ROW_AXES),) * n_flat,
             out_specs=(P(ROW_AXES),) * 3))
@@ -969,7 +1104,18 @@ class DeviceRunner:
 
     # ------------------------------------------------------------ dispatch
 
-    def handle_request(self, dag: DAGRequest, storage):
+    def handle_request(self, dag: DAGRequest, storage, deferred: bool = False):
+        """Execute a supported plan on the device.
+
+        ``deferred=True``: return as soon as the kernel is dispatched —
+        the result is a :class:`DeferredResult` whose ``result()`` runs
+        the D2H fetch + host finalize (on whatever thread calls it), so
+        N in-flight requests overlap dispatch/compute/fetch instead of
+        serializing on the transport round trip.  Paths that never
+        reach a device dispatch (host fallback, zero rows, cold kernel
+        builds that validate synchronously) still return a finished
+        SelectResult; callers must accept either.
+        """
         plan = self._analyze(dag)
         if plan is None:
             raise RuntimeError("plan not supported by device backend")
@@ -1061,23 +1207,40 @@ class DeviceRunner:
             feed_key = (tuple(plan.scan.columns[ci].col_id
                               for ci in plan.used_cols),
                         tuple(dtypes), dag.ranges)
-            feed = self._get_feed(storage, feed_key, host_cols, n)
-            if plan.kind == "simple_agg":
-                result = self._run_simple(dag, plan, dtypes, n, feed)
-            elif plan.kind == "hash_agg":
-                result = self._run_hash(dag, plan, host_cols, dtypes, n,
-                                        feed, meta,
-                                        tile_spans=tile_spans)
-            elif plan.kind == "topn":
-                result = self._run_topn(dag, plan, host_cols, dtypes, n,
-                                        get_batch, feed)
-            else:   # scan_sel
-                result = self._run_scan_sel(dag, plan, dtypes, n, get_batch,
-                                            feed)
+            with self._dispatch_mu:
+                feed = self._get_feed(storage, feed_key, host_cols, n)
+                if plan.kind == "simple_agg":
+                    result = self._run_simple(dag, plan, host_cols, dtypes,
+                                              n, feed, meta)
+                elif plan.kind == "hash_agg":
+                    result = self._run_hash(dag, plan, host_cols, dtypes,
+                                            n, feed, meta,
+                                            tile_spans=tile_spans)
+                elif plan.kind == "topn":
+                    result = self._run_topn(dag, plan, host_cols, dtypes,
+                                            n, get_batch, feed)
+                else:   # scan_sel
+                    result = self._run_scan_sel(dag, plan, dtypes, n,
+                                                get_batch, feed)
+            if isinstance(result, _Pending) and not deferred:
+                # synchronous callers block here; the before_fetch
+                # failpoint inside _readback still degrades to host
+                result = self._finish(result)
         except _FallbackToHost:
             from ..executors.runner import BatchExecutorsRunner
             return BatchExecutorsRunner(orig_dag, storage).handle_request()
 
+        if isinstance(result, _Pending):
+            return DeferredResult(self, result, orig_dag, storage)
+        return self._apply_output_offsets(orig_dag, result)
+
+    def _finish(self, pending: _Pending):
+        """Blocking fetch + host finalize for a dispatched request."""
+        fetched = self._readback(pending.tree)
+        return pending.finalize(fetched)
+
+    @staticmethod
+    def _apply_output_offsets(dag, result):
         if dag.output_offsets is not None:
             b = result.batch
             result.batch = ColumnBatch(
@@ -1101,17 +1264,18 @@ class DeviceRunner:
         entry = None
         for key, val in self._kernel_cache.items():
             if isinstance(key, tuple) and key and key[0] == "hashpl" \
-                    and val not in (None, False):
+                    and isinstance(val, dict):
                 if key[1] == dag.plan_key():
                     entry = val
         if entry is None:
             return None
-        runs_by_nb, _LO = entry
+        runs_by_nb = entry["runs"]
         run = runs_by_nb[max(runs_by_nb)]      # the full-feed span
         meta = self._request_meta(storage, (dag.plan_key(), dag.ranges))
-        if "hash_bounds" not in meta or "n_rows" not in meta:
+        if "n_rows" not in meta:
             return None
-        base = meta["hash_bounds"][0]
+        # simple-agg plans have no key bounds; their kernels ignore base
+        base = meta["hash_bounds"][0] if "hash_bounds" in meta else 0
         n = meta["n_rows"]
         feed = None
         try:
@@ -1123,10 +1287,16 @@ class DeviceRunner:
             return None
         if feed is None:
             return None
-        out = run(0, n, base, 0, feed["flat"])
+        cols = tuple(feed["flat"][j] for j in entry["col_sel"])
+        if entry["mode"] == "sparse":
+            got = meta.get("sparse_slots")
+            if got is None:
+                return None
+            cols += (got[3],)
+        out = run(0, n, base, 0, cols)
         np.asarray(out)                         # sync
         t0 = _time.perf_counter()
-        outs = [run(0, n, base, 0, feed["flat"])
+        outs = [run(0, n, base, 0, cols)
                 for _ in range(launches)]
         outs[-1].block_until_ready()
         per = (_time.perf_counter() - t0) / launches
@@ -1155,7 +1325,66 @@ class DeviceRunner:
 
     # -- simple agg --
 
-    def _run_simple(self, dag, plan, dtypes, n, feed):
+    def _arg_ok_is_mask(self, plan, feed) -> list:
+        """Per-agg flag: the arg's validity provably equals the row mask
+        (bare NOT NULL column ref), so its plane aliases the mask plane."""
+        out = []
+        for r in plan.agg_rpns:
+            flag = False
+            if r is not None and len(r.nodes) == 1 and \
+                    isinstance(r.nodes[0], RpnColumnRef):
+                ci = r.nodes[0].col_idx
+                flag = not feed["null_flags"][ci]
+            out.append(flag)
+        return out
+
+    def _simple_result(self, dag, plan, merged):
+        finals = finalize_simple(plan.specs, merged)
+        from ..executors.aggregation import _agg_ret_ft
+        schema, cols = [], []
+        for spec, val in zip(plan.specs, finals):
+            ft = _agg_ret_ft(spec.kind, spec.eval_type if spec.kind not in
+                             ("count", "count_star") else None)
+            schema.append(ft)
+            cols.append(Column.from_list(ft.eval_type, [val]))
+        return self._result(dag, schema, cols)
+
+    def _run_simple(self, dag, plan, host_cols, dtypes, n, feed, meta):
+        # the fused Pallas kernel serves simple aggregations too (r6):
+        # a single-slot grid turns SUM/COUNT/AVG into one direct-index
+        # pass — the XLA scan's per-step and fusion-boundary costs
+        # (pallas_hash.py module doc) taxed config 3 the same way they
+        # taxed config 4
+        from .kernels import build_layouts, matmul_supported
+        if matmul_supported(plan.specs):
+            arg_nbytes = meta.get("simple_arg_nbytes") if meta else None
+            if arg_nbytes is None:
+                arg_nbytes = self._arg_nbytes(plan, host_cols(), n)
+                if isinstance(meta, dict):
+                    meta["simple_arg_nbytes"] = arg_nbytes
+            arg_is_real = [r is not None and r.ret_type is EvalType.REAL
+                           for r in plan.agg_rpns]
+            arg_ok_is_mask = self._arg_ok_is_mask(plan, feed)
+            layouts, p8, pf = build_layouts(plan.specs, arg_is_real,
+                                            arg_nbytes, arg_ok_is_mask)
+            got = self._try_pallas(dag, plan, feed, dtypes, n, 0, 1,
+                                   layouts, p8, pf, arg_nbytes,
+                                   arg_ok_is_mask, mode="simple")
+            if got is not None:
+                kind, payload, LO = got
+
+                def from_packed(packed):
+                    _present, states = self._pallas_states(
+                        packed, LO, p8, layouts, plan.specs, 1)
+                    merged = [{k: np.asarray(v).reshape(-1)[0]
+                               for k, v in s.items()} for s in states]
+                    return self._simple_result(dag, plan, merged)
+
+                if kind == "sync":
+                    return from_packed(payload)
+                return _Pending(payload,
+                                lambda parts: from_packed(_sum_parts(parts)))
+
         chunk = self._pick_chunk(feed["n_pad"], _CHUNK_AGG)
         n_cols = len(plan.used_cols)
         key = self._kern_key("simple", dag, feed, chunk, tuple(dtypes))
@@ -1172,17 +1401,13 @@ class DeviceRunner:
             carry = kern(carry, self._cached_scalar(n, jnp.int64),
                          self._cached_scalar(0, jnp.int64),
                          *feed["flat"])
-        summed, stacked = self._readback(carry)
-        merged = self._merge_stacked(plan.specs, summed, stacked)
-        finals = finalize_simple(plan.specs, merged)
-        from ..executors.aggregation import _agg_ret_ft
-        schema, cols = [], []
-        for spec, val in zip(plan.specs, finals):
-            ft = _agg_ret_ft(spec.kind, spec.eval_type if spec.kind not in
-                             ("count", "count_star") else None)
-            schema.append(ft)
-            cols.append(Column.from_list(ft.eval_type, [val]))
-        return self._result(dag, schema, cols)
+
+        def fin(fetched):
+            summed, stacked = fetched
+            merged = self._merge_stacked(plan.specs, summed, stacked)
+            return self._simple_result(dag, plan, merged)
+
+        return _Pending(carry, fin)
 
     # -- hash agg --
 
@@ -1277,14 +1502,7 @@ class DeviceRunner:
         # a bare reference to a NOT NULL column has validity ≡ row mask —
         # alias its plane to the mask plane instead of duplicating it
         # through the matmul (cuts config-4's W operand 4→3 planes)
-        arg_ok_is_mask = []
-        for r in plan.agg_rpns:
-            flag = False
-            if r is not None and len(r.nodes) == 1 and \
-                    isinstance(r.nodes[0], RpnColumnRef):
-                ci = r.nodes[0].col_idx
-                flag = not feed["null_flags"][ci]
-            arg_ok_is_mask.append(flag)
+        arg_ok_is_mask = self._arg_ok_is_mask(plan, feed)
         layouts = p8 = pf = None
         if matmul_supported(plan.specs):
             layouts, p8, pf = build_layouts(plan.specs, arg_is_real,
@@ -1300,18 +1518,52 @@ class DeviceRunner:
         n_arr = self._cached_scalar(n, jnp.int64)
         n_cols = len(plan.used_cols)
 
-        merged = None
-        if layouts is not None and not sparse:
-            merged = self._try_pallas_hash(dag, plan, feed, dtypes, n,
-                                           base, capacity, layouts, p8, pf,
-                                           arg_nbytes, arg_ok_is_mask,
-                                           spans=tile_spans)
-        if merged is None and tile_spans is not None:
+        slot_keys = sparse_keys[0] if sparse else None
+
+        def hash_result(merged):
+            keys, results = finalize_hash(plan.specs, merged, base,
+                                          capacity, slot_keys=slot_keys)
+            from ..executors.aggregation import _agg_ret_ft
+            schema, cols = [], []
+            for spec, vals in zip(plan.specs, results):
+                ft = _agg_ret_ft(spec.kind,
+                                 spec.eval_type if spec.kind not in
+                                 ("count", "count_star") else None)
+                schema.append(ft)
+                cols.append(Column.from_list(ft.eval_type, vals))
+            schema.append(FieldType.long())
+            cols.append(Column.from_list(EvalType.INT, keys))
+            return self._result(dag, schema, cols)
+
+        got = None
+        if layouts is not None:
+            # the fused direct-index kernel is the default body for
+            # both dense and (dictionary-encoded) sparse key domains —
+            # the slot column rides as one extra int32 kernel input
+            got = self._try_pallas(dag, plan, feed, dtypes, n, base,
+                                   capacity, layouts, p8, pf,
+                                   arg_nbytes, arg_ok_is_mask,
+                                   mode="sparse" if sparse else "dense",
+                                   spans=tile_spans,
+                                   slots_dev=sparse_keys[1] if sparse
+                                   else None)
+        if got is None and tile_spans is not None:
             # bucket tiles exist only on the fused-kernel path; the
             # host pipeline serves the original ranged request instead
             raise _FallbackToHost("bucket tiles need the pallas kernel")
-        if merged is not None:
-            pass
+        if got is not None:
+            kind, payload, pl_LO = got
+
+            def from_packed(packed):
+                present, states = self._pallas_states(
+                    packed, pl_LO, p8, layouts, plan.specs, slots)
+                return hash_result({"present": present,
+                                    "overflow": False, "states": states})
+
+            if kind == "sync":
+                return from_packed(payload)
+            return _Pending(payload,
+                            lambda parts: from_packed(_sum_parts(parts)))
         elif layouts is not None and twolevel_lo(p8, pf) is not None:
             LO, HI = twolevel_dims(slots, p8, pf)
             chunk = self._pick_chunk(feed["n_pad"], self._feed_unit())
@@ -1334,14 +1586,19 @@ class DeviceRunner:
             from ..utils import tracker as _tracker
             with _tracker.phase("device_dispatch"):
                 carry = kern(carry, n_arr, aux_arr, *kern_flat)
-            (S8p, Sfp, ovf), _ = self._readback(carry)
-            assert int(ovf) == 0, "hash agg key range overflow"
-            S8 = twolevel_unpack(S8p, p8, LO, slots, xp=np)
-            Sf = twolevel_unpack(Sfp, pf, LO, slots, xp=np) if pf else None
-            present, states = states_from_matmul(layouts, plan.specs, S8,
-                                                 Sf, xp=np)
-            merged = {"present": present, "overflow": False,
-                      "states": states}
+
+            def fin_twolevel(fetched):
+                (S8p, Sfp, ovf), _ = fetched
+                assert int(ovf) == 0, "hash agg key range overflow"
+                S8 = twolevel_unpack(S8p, p8, LO, slots, xp=np)
+                Sf = twolevel_unpack(Sfp, pf, LO, slots, xp=np) \
+                    if pf else None
+                present, states = states_from_matmul(layouts, plan.specs,
+                                                     S8, Sf, xp=np)
+                return hash_result({"present": present, "overflow": False,
+                                    "states": states})
+
+            return _Pending(carry, fin_twolevel)
         else:
             chunk = self._pick_chunk(feed["n_pad"], _CHUNK_AGG)
             key = self._kern_key("hashsc", dag, feed, chunk, tuple(dtypes),
@@ -1363,27 +1620,18 @@ class DeviceRunner:
             from ..utils import tracker as _tracker
             with _tracker.phase("device_dispatch"):
                 carry = kern(carry, n_arr, aux_arr, *kern_flat)
-            (summed, present_counts, ovf), stacked = self._readback(carry)
-            assert int(ovf) == 0, "hash agg key range overflow"
-            merged = {
-                "present": present_counts > 0,
-                "overflow": False,
-                "states": self._merge_stacked(plan.specs, summed, stacked),
-            }
-        keys, results = finalize_hash(
-            plan.specs, merged, base, capacity,
-            slot_keys=sparse_keys[0] if sparse else None)
 
-        from ..executors.aggregation import _agg_ret_ft
-        schema, cols = [], []
-        for spec, vals in zip(plan.specs, results):
-            ft = _agg_ret_ft(spec.kind, spec.eval_type if spec.kind not in
-                             ("count", "count_star") else None)
-            schema.append(ft)
-            cols.append(Column.from_list(ft.eval_type, vals))
-        schema.append(FieldType.long())
-        cols.append(Column.from_list(EvalType.INT, keys))
-        return self._result(dag, schema, cols)
+            def fin_scatter(fetched):
+                (summed, present_counts, ovf), stacked = fetched
+                assert int(ovf) == 0, "hash agg key range overflow"
+                return hash_result({
+                    "present": present_counts > 0,
+                    "overflow": False,
+                    "states": self._merge_stacked(plan.specs, summed,
+                                                  stacked),
+                })
+
+            return _Pending(carry, fin_scatter)
 
     def _bucket_blocks(self, blocks: int) -> int:
         """Round a grid span up to a 4-significant-bit block count —
@@ -1397,57 +1645,105 @@ class DeviceRunner:
             blocks = k << s
         return max(1, blocks)
 
-    def _try_pallas_hash(self, dag, plan, feed, dtypes, n, base, capacity,
-                         layouts, p8, pf, arg_nbytes, arg_ok_is_mask,
-                         spans=None):
-        """Fused Pallas fast path for the direct-index hash agg.
+    @staticmethod
+    def _pallas_states(packed, LO, p8, layouts, specs, slots):
+        """Packed (2, HI, p8*LO) accumulator pair → (present, states).
+
+        The tight slot grid (no scrap slot; NULL slot only when the key
+        may be NULL) may hold fewer than ``slots`` rows: the dropped
+        slots are zero by construction (nothing ever scatters there),
+        so zero-pad back to the shared layout.
+        """
+        from . import pallas_hash
+        from .kernels import states_from_matmul, twolevel_unpack
+        S = pallas_hash.unpack_to_int64(packed)
+        have = min(slots, S.shape[0] * LO)
+        S8 = twolevel_unpack(S, p8, LO, have, xp=np)
+        if have < slots:
+            S8 = np.pad(S8, ((0, 0), (0, slots - have)))
+        return states_from_matmul(layouts, specs, S8, None, xp=np)
+
+    def _try_pallas(self, dag, plan, feed, dtypes, n, base, capacity,
+                    layouts, p8, pf, arg_nbytes, arg_ok_is_mask,
+                    mode="dense", spans=None, slots_dev=None):
+        """Fused Pallas fast path for the direct-index aggregation
+        (dense / sparse-slot / simple modes — pallas_hash module doc).
 
         ``spans``: row intervals to aggregate (bucket tiles); None =
-        the whole feed.  Each span dispatches the kernel over its
-        covering grid blocks (bucketed for compile-class reuse, block
-        offset via prefetch scalar) and the packed partials ADD —
+        the whole feed, dispatched over the ENTIRE padded grid so the
+        compile class is exactly the feed-shape cache key — the
+        dead-block guard makes the bucketed padding cost DMA only.
+        Span tiles keep bucketed block counts for compile-class reuse
+        (block offset via prefetch scalar); the packed partials ADD —
         psum-partial merge semantics.
 
-        Returns the merged-states dict (same shape the XLA paths
-        produce) or None when the plan/feed/platform is outside the
-        kernel's envelope — the caller then runs the XLA two-level path.
+        Returns None when the plan/feed/platform is outside the
+        kernel's envelope (the caller then runs an XLA path), else
+        ``(kind, payload, LO)``:
+
+        - ``("sync",  packed np.ndarray, LO)`` — first build: compile +
+          validate ran synchronously so Mosaic rejections fall back.
+        - ``("parts", [device arrays], LO)`` — warm dispatch; the
+          caller fetches and ``_sum_parts``-merges them (possibly on a
+          completion thread — the async serving path).
+
         A build or compile failure is cached so the fallback is taken
         once per plan, not per request.
         """
         from . import pallas_hash
-        from .kernels import states_from_matmul, twolevel_unpack
         dev0 = self._mesh.devices.flat[0]
         if dev0.platform == "cpu":
             return None     # Mosaic kernels need real TPU lowering
         if not pallas_hash.supported(plan, feed, dtypes, pf, capacity,
-                                     self._single):
+                                     self._single, mode):
             return None
-        slots = capacity + 2
+        sparse = mode == pallas_hash.MODE_SPARSE
         B = pallas_hash.BLOCK
         total_blocks = feed["n_pad"] // B
         tiles = []          # (row_lo, row_hi, blk0, span_blocks)
-        for lo, hi in (spans if spans is not None else ((0, n),)):
-            hi = min(hi, n)
-            if hi <= lo:
-                continue
-            blk0 = lo // B
-            nb = self._bucket_blocks(-(-hi // B) - blk0)
-            nb = min(nb, total_blocks)
-            if blk0 + nb > total_blocks:
-                blk0 = total_blocks - nb    # shift left; rows mask exactly
-            tiles.append((lo, hi, blk0, nb))
-        if not tiles:
-            return None
+        if spans is None:
+            tiles.append((0, n, 0, total_blocks))
+        else:
+            for lo, hi in spans:
+                hi = min(hi, n)
+                if hi <= lo:
+                    continue
+                blk0 = lo // B
+                nb = self._bucket_blocks(-(-hi // B) - blk0)
+                nb = min(nb, total_blocks)
+                if blk0 + nb > total_blocks:
+                    blk0 = total_blocks - nb  # shift left; rows mask exact
+                tiles.append((lo, hi, blk0, nb))
+            if not tiles:
+                return None
+
+        # kernel input selection: only columns the kernel evaluates
+        # (int32, non-null ⇒ one flat entry each) plus the sparse slot
+        # column; everything else (e.g. the raw int64 sparse key) stays
+        # host/XLA-side
+        kset = set(pallas_hash.kernel_col_ids(plan, mode))
+        col_sel, col_map, fi = [], [], 0
+        for i, has_nulls in enumerate(feed["null_flags"]):
+            if i in kset:
+                col_map.append(len(col_sel))
+                col_sel.append(fi)
+            else:
+                col_map.append(-1)
+            fi += 2 if has_nulls else 1
+        col_sel, col_map = tuple(col_sel), tuple(col_map)
+        cols = tuple(feed["flat"][j] for j in col_sel)
+        if sparse:
+            cols += (slots_dev,)
 
         def dispatch(runs_by_nb):
             packed = None
             for lo, hi, blk0, nb in tiles:
                 part = np.asarray(
-                    runs_by_nb[nb](lo, hi, base, blk0, feed["flat"]))
+                    runs_by_nb[nb](lo, hi, base, blk0, cols))
                 packed = part if packed is None else packed + part
             return packed
 
-        key = ("hashpl", dag.plan_key(),
+        key = ("hashpl", dag.plan_key(), mode,
                tuple(sorted({t[3] for t in tiles})), tuple(dtypes),
                capacity, arg_nbytes, tuple(arg_ok_is_mask))
         entry = self._kernel_cache.get(key)
@@ -1459,8 +1755,8 @@ class DeviceRunner:
                 LO = None
                 for nb in sorted({t[3] for t in tiles}):
                     run, LO, HI = pallas_hash.build(
-                        plan, layouts, p8, capacity, nb,
-                        len(plan.used_cols))
+                        plan, layouts, p8, capacity, nb, col_map,
+                        mode=mode)
                     runs_by_nb[nb] = run
                 # compile + validate now so Mosaic rejections fall back
                 packed = dispatch(runs_by_nb)
@@ -1492,50 +1788,38 @@ class DeviceRunner:
                         "%r: %s: %s", key[1], name, e)
                     self._kernel_cache[key] = False
                 return None
-            entry = (runs_by_nb, LO)
+            entry = {"runs": runs_by_nb, "LO": LO, "col_sel": col_sel,
+                     "mode": mode}
             self._kernel_cache[key] = entry
             # success clears the transient strike count — three isolated
             # hiccups over a process lifetime must not kill the fast path
             self._kernel_cache.pop(("hashpl_tries", key), None)
-        else:
-            runs_by_nb, LO = entry
-            try:
-                from ..utils import tracker
-                with tracker.phase("device_dispatch"):
-                    parts = [runs_by_nb[nb](lo, hi, base, blk0,
-                                            feed["flat"])
-                             for lo, hi, blk0, nb in tiles]
-                with tracker.phase("device_fetch"):
-                    packed = np.asarray(parts[0])
-                    for part in parts[1:]:
-                        packed = packed + np.asarray(part)
-                self._kernel_cache.pop(("hashpl_tries", key), None)
-            except Exception as e:
-                # a transient runtime failure on a cached kernel must fall
-                # back to the XLA path for THIS request, same as the
-                # build-time path — not fail the coprocessor request
-                import logging
-                logging.getLogger(__name__).warning(
-                    "pallas hash kernel runtime failure for cached plan "
-                    "%r (falling back once): %s: %s",
-                    key[1], type(e).__name__, e)
-                tries = self._kernel_cache.get(("hashpl_tries", key), 0) + 1
-                self._kernel_cache[("hashpl_tries", key)] = tries
-                if tries >= 3:
-                    self._kernel_cache[key] = False
-                return None
-        S = pallas_hash.unpack_to_int64(packed)
-        # the tight slot grid (no scrap slot; NULL slot only for
-        # expression keys) may hold fewer than capacity+2 rows: the
-        # dropped slots are zero by construction (nothing ever
-        # scatters there), so zero-pad back to the shared layout
-        have = min(slots, S.shape[0] * LO)
-        S8 = twolevel_unpack(S, p8, LO, have, xp=np)
-        if have < slots:
-            S8 = np.pad(S8, ((0, 0), (0, slots - have)))
-        present, states = states_from_matmul(layouts, plan.specs, S8,
-                                             None, xp=np)
-        return {"present": present, "overflow": False, "states": states}
+            return ("sync", packed, LO)
+        runs_by_nb, LO = entry["runs"], entry["LO"]
+        try:
+            from ..utils import tracker
+            with tracker.phase("device_dispatch"):
+                parts = [runs_by_nb[nb](lo, hi, base, blk0, cols)
+                         for lo, hi, blk0, nb in tiles]
+            self._kernel_cache.pop(("hashpl_tries", key), None)
+        except Exception as e:
+            # a transient DISPATCH failure on a cached kernel must fall
+            # back to the XLA path for THIS request, same as the
+            # build-time path — not fail the coprocessor request.  (A
+            # failure surfacing later, at the possibly-deferred fetch,
+            # degrades to the host pipeline via the DeferredResult /
+            # endpoint contract instead.)
+            import logging
+            logging.getLogger(__name__).warning(
+                "pallas hash kernel runtime failure for cached plan "
+                "%r (falling back once): %s: %s",
+                key[1], type(e).__name__, e)
+            tries = self._kernel_cache.get(("hashpl_tries", key), 0) + 1
+            self._kernel_cache[("hashpl_tries", key)] = tries
+            if tries >= 3:
+                self._kernel_cache[key] = False
+            return None
+        return ("parts", parts, LO)
 
     def _arg_nbytes(self, plan: _Plan, host_cols, n: int) -> tuple:
         """Byte-plane count per aggregate arg for the MXU int path.
@@ -1576,59 +1860,78 @@ class DeviceRunner:
                            chunk, emits=True),
                 ((), ()), len(feed["flat"]),
                 ys_specs=P(None, ROW_AXES)))
-        _, ys = kern(((), ()), self._cached_scalar(n, jnp.int64),
-                     self._cached_scalar(0, jnp.int64), *feed["flat"])
-        ys = self._readback(ys)
-        nblk = feed["n_pad"] // chunk
-        full = ys.reshape(nblk, S, chunk // S).transpose(1, 0, 2) \
-            .reshape(feed["n_pad"])[:n]
-        out = get_batch().filter(full)
-        return self._result(dag, out.schema, out.columns)
+        from ..utils import tracker as _tracker
+        with _tracker.phase("device_dispatch"):
+            _, ys = kern(((), ()), self._cached_scalar(n, jnp.int64),
+                         self._cached_scalar(0, jnp.int64), *feed["flat"])
+
+        def fin(fetched):
+            nblk = feed["n_pad"] // chunk
+            full = fetched.reshape(nblk, S, chunk // S).transpose(1, 0, 2) \
+                .reshape(feed["n_pad"])[:n]
+            out = get_batch().filter(full)
+            return self._result(dag, out.schema, out.columns)
+
+        return _Pending(ys, fin, small=False)
 
     # -- top-n --
 
     def _run_topn(self, dag, plan, host_cols, dtypes, n, get_batch, feed):
         k = plan.limit
-        key = self._kern_key("topn", dag, feed, 0, tuple(dtypes), k)
+        n_used = None
+        if self._single:
+            seg = math.gcd(feed["n_pad"], 1 << 17)
+            n_used = min(feed["n_pad"], -(-n // seg) * seg)
+        key = self._kern_key("topn", dag, feed, 0, tuple(dtypes), k,
+                             n_used)
         kern = self._shard_kernel(
             key, lambda: self._build_topn_kernel(
                 plan, len(plan.used_cols), k, feed["null_flags"],
-                feed["n_pad"], len(feed["flat"])))
-        ys = kern(self._cached_scalar(n, jnp.int64), *feed["flat"])
-        gidx_s, mask_s, ok_s = self._readback(ys)
-        gidx = gidx_s.reshape(-1)
-        mask = mask_s.reshape(-1)
-        ok = ok_s.reshape(-1)
-        sel = mask & (gidx < n)
-        gidx, ok = gidx[sel], ok[sel]
-        # exact host ordering over <= k * n_chunks * n_shards candidates:
-        # evaluate the order expression only on the gathered candidate rows
-        # (plan rpns are remapped onto host_cols positions)
-        cand_cols = [(v[gidx], m[gidx]) for v, m in host_cols()]
-        ov, _om = eval_rpn(plan.order_rpn, cand_cols, len(gidx), np)
-        ov = np.broadcast_to(ov, (len(gidx),))
-        if plan.order_rpn.ret_type in (EvalType.INT, EvalType.DATETIME,
-                                       EvalType.DURATION):
-            # exact int ordering (no f64 collapse above 2^53 — a packed
-            # DATETIME core at ~2^61 loses sub-millisecond bits in f64);
-            # NULL is the smallest value, so asc → NULL first, desc →
-            # NULL last.  Clamp to min+2 so negation cannot overflow.
-            # DATETIME u64 cores are < 2^63 (feed guard) so the int64
-            # view is order-preserving.
-            lo, hi = np.iinfo(np.int64).min, np.iinfo(np.int64).max
-            vals = np.maximum(np.asarray(ov).astype(np.int64), lo + 2)
-            if plan.order_desc:
-                key = np.where(ok, -vals, hi)
+                feed["n_pad"], len(feed["flat"]), n_used=n_used))
+        from ..utils import tracker as _tracker
+        with _tracker.phase("device_dispatch"):
+            ys = kern(self._cached_scalar(n, jnp.int64), *feed["flat"])
+
+        def fin(fetched):
+            gidx_s, mask_s, ok_s = fetched
+            gidx = gidx_s.reshape(-1)
+            mask = mask_s.reshape(-1)
+            ok = ok_s.reshape(-1)
+            sel = mask & (gidx < n)
+            gidx, okk = gidx[sel], ok[sel]
+            # exact host ordering over <= k * n_chunks * n_shards
+            # candidates: evaluate the order expression only on the
+            # gathered candidate rows (plan rpns are remapped onto
+            # host_cols positions)
+            cand_cols = [(v[gidx], m[gidx]) for v, m in host_cols()]
+            ov, _om = eval_rpn(plan.order_rpn, cand_cols, len(gidx), np)
+            ov = np.broadcast_to(ov, (len(gidx),))
+            if plan.order_rpn.ret_type in (EvalType.INT, EvalType.DATETIME,
+                                           EvalType.DURATION):
+                # exact int ordering (no f64 collapse above 2^53 — a
+                # packed DATETIME core at ~2^61 loses sub-millisecond
+                # bits in f64); NULL is the smallest value, so asc →
+                # NULL first, desc → NULL last.  Clamp to min+2 so
+                # negation cannot overflow.  DATETIME u64 cores are
+                # < 2^63 (feed guard) so the int64 view is
+                # order-preserving.
+                lo, hi = np.iinfo(np.int64).min, np.iinfo(np.int64).max
+                vals = np.maximum(np.asarray(ov).astype(np.int64), lo + 2)
+                if plan.order_desc:
+                    skey = np.where(okk, -vals, hi)
+                else:
+                    skey = np.where(okk, vals, lo)
+                order = np.lexsort((gidx, skey))
             else:
-                key = np.where(ok, vals, lo)
-            order = np.lexsort((gidx, key))
-        else:
-            vals = np.asarray(ov, dtype=np.float64)
-            keyf = np.where(ok, vals, -np.inf)      # NULL smallest
-            order = np.lexsort((gidx, -keyf if plan.order_desc else keyf))
-        take = gidx[order[:plan.limit]]
-        out = get_batch().take(take)
-        return self._result(dag, out.schema, out.columns)
+                vals = np.asarray(ov, dtype=np.float64)
+                keyf = np.where(okk, vals, -np.inf)     # NULL smallest
+                order = np.lexsort((gidx,
+                                    -keyf if plan.order_desc else keyf))
+            take = gidx[order[:plan.limit]]
+            out = get_batch().take(take)
+            return self._result(dag, out.schema, out.columns)
+
+        return _Pending(ys, fin, small=False)
 
 
 class _AnalyzeKernels:

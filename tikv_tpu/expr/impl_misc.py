@@ -133,7 +133,10 @@ def register() -> None:
             # is an identity, and unclamped exponents overflow the
             # decimal context (InvalidOperation killing the batch)
             k = max(-30, min(30, int(k)))
-            with decimal.localcontext(prec=40):
+            # context-object form (localcontext kwargs need 3.11+)
+            _ctx = decimal.getcontext().copy()
+            _ctx.prec = 40
+            with decimal.localcontext(_ctx):
                 q = decimal.Decimal(1).scaleb(-k)
                 try:
                     return float(decimal.Decimal(repr(float(x)))

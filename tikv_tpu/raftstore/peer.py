@@ -3,15 +3,19 @@
 Reference: components/raftstore/src/store/peer.rs (Peer: propose :3612,
 handle_raft_ready_append :2565) and fsm/apply.rs (exec_raft_cmd
 :1370-1740 — write commands, and admin commands: split :1692,
-change peer, compact log).  The reference splits raft-ready handling and
-apply onto separate pollers connected by channels (SURVEY.md §2.8 item 3);
-here both run in the store's drive loop — the pipeline split returns when
-the native runtime lands.
+change peer, compact log).  Like the reference, raft-ready handling and
+apply run on SEPARATE pollers (SURVEY.md §2.8 item 3): the store's
+batch-system poller drives ready/append and hands committed entries to
+a second apply batch-system (batch_system.py, wired in store.py — the
+fsm/apply.rs analog); a synchronous single-threaded drive mode remains
+for tests and the in-process cluster harness.
 
-Read path: reads are proposed as read-barrier entries through the log
-(the unoptimized ReadIndex).  Lease-based local reads
-(store/worker/read.rs LocalReader) are a later-round optimization;
-correctness never depends on them.
+Read path, fastest first: leader LEASE local reads
+(store/worker/read.rs LocalReader — ``local_read`` here, served by
+raftkv.py without a proposal or log barrier while the lease holds),
+then ReadIndex barriers (``propose_read`` / ``replica_read`` for
+followers), which remain the correctness backstop whenever the lease
+cannot vouch.
 """
 
 from __future__ import annotations

@@ -59,9 +59,10 @@ class Recorder:
     # -- attribution ----------------------------------------------------
 
     class _Scope:
-        def __init__(self, rec: "Recorder", tag: str):
+        def __init__(self, rec: "Recorder", tag: str, requests: int = 1):
             self._rec = rec
             self._tag = tag
+            self._requests = requests
             self._token = None
             self._t0 = 0.0
 
@@ -73,11 +74,16 @@ class Recorder:
         def __exit__(self, *exc):
             dt = time.thread_time() - self._t0
             _CURRENT_TAG.reset(self._token)
-            self._rec.record(self._tag, cpu_secs=dt, requests=1)
+            self._rec.record(self._tag, cpu_secs=dt,
+                             requests=self._requests)
             return False
 
-    def attach(self, tag: str) -> "_Scope":
-        return Recorder._Scope(self, tag)
+    def attach(self, tag: str, requests: int = 1) -> "_Scope":
+        """Scope attribution to ``tag``.  ``requests=0``: a follow-up
+        scope of an already-counted request (the async coprocessor path
+        attaches once per stage — dispatch, deferred fetch, completion —
+        but the request must count once)."""
+        return Recorder._Scope(self, tag, requests)
 
     @staticmethod
     def current_tag():

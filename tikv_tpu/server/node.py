@@ -488,6 +488,11 @@ class Node:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.raft_store.stop_pool()
+        # retire the endpoint's completion-pool workers (nodes restarted
+        # in-process — chaos cycles, tests — must not leak a pool each)
+        close = getattr(self.endpoint, "close", None)
+        if callable(close):
+            close()
 
     def _drive_loop(self) -> None:
         last_tick = time.monotonic()

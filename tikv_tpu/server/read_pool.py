@@ -84,3 +84,76 @@ class ReadPool:
         on merely-executing reads."""
         READ_POOL_RUNNING_GAUGE.set(self.running)
         READ_POOL_PENDING_GAUGE.set(max(0, self._pending - self.running))
+
+
+class CompletionPool:
+    """Small worker pool that overlaps deferred device completions.
+
+    The async coprocessor path dispatches a kernel under a ReadPool
+    slot (cheap — an enqueue), releases the slot, and hands the
+    blocking D2H fetch + host finalize here.  The workers spend their
+    time parked inside the device runtime's transfer wait (GIL
+    released), so ``workers`` concurrent fetches overlap on the wire —
+    through a tunneled TPU each sync costs a ~0.1s round trip that
+    would otherwise serialize — and heavy coprocessor traffic never
+    holds read-pool slots hostage while waiting on the transport.
+
+    Priorities mirror ReadPool's two-level scheme: ``high`` (KB-sized
+    aggregate states) drains before ``normal`` (bulk TopN/selection
+    candidate readbacks), so a cheap agg answer is never queued behind
+    a multi-MB transfer.  Results ride stdlib
+    ``concurrent.futures.Future``s (only the priority queue is custom).
+
+    ``shutdown()`` drains queued tasks and retires the workers — owners
+    that come and go (server nodes restarted in-process, per-test
+    endpoints) must call it or leak ``workers`` parked threads each.
+    """
+
+    def __init__(self, workers: int = 4):
+        self._workers = max(1, workers)
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._high: list = []
+        self._normal: list = []
+        self._started = False
+        self._shutdown = False
+        self.completed = 0
+
+    def submit(self, fn, priority: str = "normal"):
+        import concurrent.futures as cf
+        fut: "cf.Future" = cf.Future()
+        with self._mu:
+            if self._shutdown:
+                fut.set_exception(RuntimeError("completion pool is shut "
+                                               "down"))
+                return fut
+            (self._high if priority == "high" else
+             self._normal).append((fn, fut))
+            if not self._started:
+                self._started = True
+                for i in range(self._workers):
+                    threading.Thread(target=self._worker, daemon=True,
+                                     name=f"copr-completion-{i}").start()
+            self._cv.notify()
+        return fut
+
+    def shutdown(self) -> None:
+        """Stop accepting work; workers finish the queue, then exit."""
+        with self._mu:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    def _worker(self) -> None:
+        while True:
+            with self._mu:
+                while not self._high and not self._normal:
+                    if self._shutdown:
+                        return
+                    self._cv.wait()
+                fn, fut = (self._high or self._normal).pop(0)
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — ride the future
+                fut.set_exception(e)
+            with self._mu:
+                self.completed += 1

@@ -90,6 +90,16 @@ class KvService:
             try:
                 resp = self._guard(
                     lambda r: self.read_pool.run(lambda: fn(r), prio), req)
+                d = resp.pop("__deferred", None) \
+                    if isinstance(resp, dict) else None
+                if d is not None:
+                    # async copr: the read-pool slot covered only the
+                    # dispatch; the D2H fetch resolves on the endpoint's
+                    # completion pool while THIS thread parks here — N
+                    # in-flight requests overlap their device round
+                    # trips, and point reads keep getting slots
+                    resp = self._guard(
+                        lambda _r: self._enc_cop_resp(d.wait()), req)
             finally:
                 tracker.uninstall(tok)
             if isinstance(resp, dict) and "error" not in resp:
@@ -284,13 +294,18 @@ class KvService:
                 dag.executors[0], dag.ranges, dag.start_ts))
         assert tp == REQ_TYPE_DAG, tp
         dag = wire.dec_dag(req["dag"])
-        resp = self.endpoint.handle(CopRequest(
+        creq = CopRequest(
             REQ_TYPE_DAG, dag, req.get("force_backend"),
             paging_size=req.get("paging_size", 0),
             resume_token=req.get("resume_token"),
             resource_group=req.get("resource_group", "default"),
-            request_source=req.get("request_source", "")))
-        return self._enc_cop_resp(resp)
+            request_source=req.get("request_source", ""))
+        # dispatch under the read-pool slot, await outside it: handle()
+        # resolves the "__deferred" marker after the slot is released
+        d = self.endpoint.handle_async(creq)
+        if d.resolved:
+            return self._enc_cop_resp(d.wait())
+        return {"__deferred": d}
 
     def copr_stream_rpc(self, req: dict, ctx=None):
         yield from self.copr_stream(req)

@@ -84,6 +84,16 @@ def install() -> tuple[Tracker, contextvars.Token]:
     return tr, _current.set(tr)
 
 
+def adopt(tr: Tracker) -> contextvars.Token:
+    """Activate an EXISTING tracker on this thread; pair with
+    :func:`uninstall`.  The async coprocessor path hands the request's
+    tracker to a completion-pool worker so the deferred device fetch
+    still attributes into the request's TimeDetail (the installing
+    thread blocks on the deferred result meanwhile, so the two never
+    write concurrently)."""
+    return _current.set(tr)
+
+
 def uninstall(token: contextvars.Token) -> None:
     _current.reset(token)
 
