@@ -335,3 +335,41 @@ def test_snapshot_ready_drains_queued_apply_batch_first():
     # replayed over it
     assert engine.get_value_cf("default", data_key(b"stale")) is None
     assert c.get_on_store(1, b"sa") == b"1"
+
+
+# ----------------------------------------------------- site inventory
+
+
+def test_failpoint_inventory_resolves():
+    """Every site the chaos harness steers — and every family the
+    README documents — must resolve to a live ``fail_point(...)`` call
+    in the source tree, so a rename can't silently neuter a schedule
+    (the armed name would simply never fire)."""
+    import pathlib
+    import re
+
+    import tikv_tpu
+    from tikv_tpu.chaos import CRASH_SITES
+
+    root = pathlib.Path(tikv_tpu.__file__).parent
+    sites = set()
+    for p in root.rglob("*.py"):
+        text = p.read_text()
+        sites |= set(re.findall(r'fail_point\(\s*"([^"]+)"', text))
+        # device/runner.py routes its sites through _fp_degrade()
+        sites |= set(re.findall(r'_fp_degrade\(\s*"([^"]+)"', text))
+    # the mesh from PR 1 plus this PR's additions must not shrink
+    assert len(sites) >= 60, f"only {len(sites)} unique sites"
+
+    nemesis_src = (root / "chaos" / "nemesis.py").read_text()
+    referenced = set(re.findall(r'failpoint\.cfg\(\s*"([^"]+)"',
+                                nemesis_src))
+    referenced |= set(CRASH_SITES)
+    missing = referenced - sites
+    assert not missing, f"nemesis steers unknown sites: {missing}"
+
+    readme = (root.parent / "README.md").read_text()
+    documented = set(re.findall(r"`([a-z_]+)::\*`", readme))
+    live_families = {s.split("::")[0] for s in sites}
+    ghost = documented - live_families
+    assert not ghost, f"README documents dead site families: {ghost}"

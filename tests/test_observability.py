@@ -292,6 +292,49 @@ def test_resource_group_throttles_and_default_unlimited():
     assert _t.perf_counter() - t0 < 0.1
 
 
+def test_resource_group_concurrent_contention():
+    """Concurrent RU contention: a runaway analytical group BLOCKS on
+    its own bucket across threads (no starvation bypass, no double
+    spend under the race), while the default group's point reads keep
+    flowing at full speed the whole time."""
+    import threading
+    import time as _t
+
+    from tikv_tpu.utils.quota import ResourceGroupManager
+    rgm = ResourceGroupManager()
+    rgm.put_group("analytics", ru_per_sec=200, burst=10)
+    point_read_s = []
+    runaway_done = []
+
+    def runaway():
+        # 10 × (1 RU + 16KiB → 4 RU) = 50 RU per thread; 2 threads =
+        # 100 RU at 200 RU/s ⇒ the group must spend ≥ ~0.4s throttled
+        for _ in range(10):
+            rgm.charge_request("analytics", bytes_touched=16384)
+        runaway_done.append(_t.monotonic())
+
+    def point_reads():
+        t0 = _t.monotonic()
+        for _ in range(500):
+            rgm.charge_request(None)        # default group: unlimited
+        point_read_s.append(_t.monotonic() - t0)
+
+    threads = [threading.Thread(target=runaway) for _ in range(2)]
+    threads.append(threading.Thread(target=point_reads))
+    t_start = _t.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert point_read_s and point_read_s[0] < 0.5, \
+        "default point reads starved behind a runaway group"
+    g = rgm.group("analytics")
+    assert g.throttled_s > 0, "runaway group was never throttled"
+    assert g.consumed_ru >= 100
+    # the runaway group really was held to ~its refill rate
+    assert max(runaway_done) - t_start >= 0.2
+
+
 def test_resource_groups_over_status_server():
     import urllib.request
 

@@ -1,0 +1,524 @@
+"""Overload defense & tail tolerance.
+
+The control loop under test, end to end:
+
+- deadline propagation: requests carry a remaining budget; expired work
+  is shed (admission / read pool / executor batches / device dispatch /
+  completion) with a typed deadline_exceeded instead of being executed;
+- slow-store loop: the raftstore write-path inspector feeds SlowScore,
+  store heartbeats export it to PD, and the scheduler evicts leaders
+  off (and stops routing replicas onto) a browned-out store;
+- tail-tolerant reads: per-store circuit breakers and hedged point
+  reads (adaptive P95 delay → resolved-ts stale read on a follower,
+  ReadIndex replica read as fallback) over real gRPC;
+- chaos: the ``fail_slow`` nemesis (persistent per-store latency) with
+  the bank invariants, plus the brownout invariants (bounded goodput,
+  correct reads, zero late acks).
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from tikv_tpu.chaos import (
+    check_goodput,
+    check_no_late_acks,
+    check_read_correctness,
+)
+from tikv_tpu.server.read_pool import CompletionPool, ReadPool, ServerIsBusy
+from tikv_tpu.utils import deadline as dl_mod
+from tikv_tpu.utils import failpoint
+from tikv_tpu.utils.backoff import Backoff
+from tikv_tpu.utils.deadline import Deadline, DeadlineExceeded
+from tikv_tpu.utils.health import CircuitBreaker
+
+
+@pytest.fixture(autouse=True)
+def _teardown():
+    yield
+    failpoint.teardown()
+
+
+# ------------------------------------------------------- deadline units
+
+
+def test_deadline_expiry_and_wire_budget():
+    d = Deadline.after_ms(50)
+    assert not d.expired()
+    assert 0 < d.to_wire_ms() <= 50
+    d2 = Deadline.after_ms(0)
+    assert d2.expired()
+    with pytest.raises(DeadlineExceeded):
+        d2.check("admission")
+    assert d2.to_wire_ms() == 0
+
+
+def test_deadline_thread_local_plumbing():
+    assert dl_mod.current() is None
+    dl_mod.check_current("noop")        # no deadline installed: no-op
+    tok = dl_mod.install(Deadline.after_ms(0))
+    try:
+        with pytest.raises(DeadlineExceeded):
+            dl_mod.check_current("executor_batch")
+    finally:
+        dl_mod.uninstall(tok)
+    assert dl_mod.current() is None
+
+
+def test_executor_pipeline_sheds_between_batches():
+    """An expired deadline aborts the host pipeline mid-run instead of
+    letting a scan run to completion for a caller that gave up."""
+    import numpy as np
+
+    from tikv_tpu.datatype import Column, EvalType, FieldType
+    from tikv_tpu.executors.columnar import ColumnarTable
+    from tikv_tpu.executors.runner import BatchExecutorsRunner
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import Table, TableColumn
+
+    n = 1024
+    table = Table(7701, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("v", 2, FieldType.long()),
+    ))
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"v": Column(EvalType.INT, np.arange(n, dtype=np.int64),
+                     np.ones(n, bool))})
+    sel = DagSelect.from_table(table)
+    dag = sel.sum(sel.col("v")).build()
+    tok = dl_mod.install(Deadline.after_ms(0))
+    try:
+        with pytest.raises(DeadlineExceeded):
+            BatchExecutorsRunner(dag, snap).handle_request()
+    finally:
+        dl_mod.uninstall(tok)
+    # without a deadline the same plan completes
+    res = BatchExecutorsRunner(dag, snap).handle_request()
+    assert int(res.rows()[0][0]) == int(np.arange(n).sum())
+
+
+def test_endpoint_sheds_before_device_dispatch():
+    """An expired deadline must shed BEFORE the kernel is enqueued —
+    accelerator time is never spent on an unusable answer."""
+    from tikv_tpu.copr.endpoint import CopRequest, Endpoint, REQ_TYPE_DAG
+    import numpy as np
+
+    from tikv_tpu.datatype import Column, EvalType, FieldType
+    from tikv_tpu.executors.columnar import ColumnarTable
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import Table, TableColumn
+
+    n = 256
+    table = Table(7702, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("v", 2, FieldType.long()),
+    ))
+    snap = ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64),
+        {"v": Column(EvalType.INT, np.arange(n, dtype=np.int64),
+                     np.ones(n, bool))})
+    sel = DagSelect.from_table(table)
+    dag = sel.sum(sel.col("v")).build()
+
+    class RecordingRunner:
+        dispatched = 0
+
+        def supports(self, dag):
+            return True
+
+        def profitable(self, dag):
+            return True
+
+        def handle_request(self, dag, storage):
+            RecordingRunner.dispatched += 1
+            raise AssertionError("dispatched expired work")
+
+    ep = Endpoint(lambda req: snap, device_runner=RecordingRunner(),
+                  device_row_threshold=1)
+    tok = dl_mod.install(Deadline.after_ms(0))
+    try:
+        with pytest.raises(DeadlineExceeded):
+            ep.handle(CopRequest(tp=REQ_TYPE_DAG, dag=dag,
+                                 force_backend="device"))
+    finally:
+        dl_mod.uninstall(tok)
+    assert RecordingRunner.dispatched == 0
+
+
+# ------------------------------------------------------ read pool units
+
+
+def test_read_pool_deadline_shedding_and_retry_hint():
+    pool = ReadPool(max_concurrency=2, max_pending=4)
+    # expired budget: typed shed before any execution
+    with pytest.raises(DeadlineExceeded):
+        pool.run(lambda: "never", deadline=Deadline.after_ms(0))
+    # teach the pool its service time (~30ms), then offer a budget
+    # below it: predictive shed with a drain-rate hint
+    for _ in range(3):
+        pool.run(lambda: time.sleep(0.03))
+    assert pool.ema_service_time > 0.01
+    with pytest.raises(ServerIsBusy) as ei:
+        pool.run(lambda: "late", deadline=Deadline.after_ms(5))
+    assert ei.value.retry_after_ms >= 1
+    assert pool.deadline_shed == 1
+    # a comfortable budget still admits
+    assert pool.run(lambda: "ok", deadline=Deadline.after_ms(500)) == "ok"
+
+
+def test_read_pool_busy_rejection_carries_retry_after():
+    pool = ReadPool(max_concurrency=1, max_pending=1)
+    pool.run(lambda: time.sleep(0.02))      # seed the EMA
+    release = threading.Event()
+    started = threading.Event()
+
+    def slow():
+        started.set()
+        release.wait(5)
+
+    t = threading.Thread(target=lambda: pool.run(slow))
+    t.start()
+    started.wait(5)
+    with pytest.raises(ServerIsBusy) as ei:
+        pool.run(lambda: "q")
+    assert ei.value.retry_after_ms >= 1
+    release.set()
+    t.join(5)
+
+
+def test_read_pool_shutdown_drains_and_refuses():
+    pool = ReadPool(max_concurrency=2, max_pending=8)
+    release = threading.Event()
+    t = threading.Thread(target=lambda: pool.run(lambda: release.wait(5)))
+    t.start()
+    time.sleep(0.05)
+    done = {}
+
+    def closer():
+        done["idle"] = pool.shutdown(timeout=5)
+    ct = threading.Thread(target=closer)
+    ct.start()
+    time.sleep(0.05)
+    release.set()
+    ct.join(5)
+    t.join(5)
+    assert done["idle"] is True
+    with pytest.raises(ServerIsBusy):
+        pool.run(lambda: "rejected")
+
+
+def test_completion_pool_shutdown_joins_workers():
+    pool = CompletionPool(workers=3)
+    futs = [pool.submit(lambda i=i: i * i) for i in range(6)]
+    assert [f.result(5) for f in futs] == [0, 1, 4, 9, 16, 25]
+    pool.shutdown()
+    assert all(not t.is_alive() for t in pool._threads), \
+        "completion workers must be joined on shutdown"
+    assert pool.submit(lambda: 1).exception(1) is not None
+
+
+# -------------------------------------------------- breaker + backoff
+
+
+def test_circuit_breaker_trip_halfopen_recovery():
+    br = CircuitBreaker(threshold=3, cooldown_s=0.05)
+    assert br.state() == "closed" and br.allow()
+    for _ in range(3):
+        br.record_failure()
+    assert br.state() == "open"
+    assert not br.allow(), "open breaker must fail fast"
+    time.sleep(0.06)
+    assert br.state() == "half_open"
+    assert br.allow(), "half-open admits one probe"
+    assert not br.allow(), "only ONE probe at a time"
+    br.record_failure()             # probe failed: re-open
+    assert br.state() == "open" and not br.allow()
+    time.sleep(0.06)
+    assert br.allow()
+    br.record_success()             # probe succeeded: closed again
+    assert br.state() == "closed" and br.allow()
+    assert br.trips == 1
+
+
+def test_backoff_honors_server_retry_hint():
+    bo = Backoff(base=0.5, cap=2.0, deadline_s=5.0)   # huge blind delay
+    t0 = time.monotonic()
+    assert bo.sleep(hint_s=0.01)
+    dt = time.monotonic() - t0
+    assert dt < 0.1, f"hinted sleep took {dt:.3f}s — hint ignored"
+
+
+# ------------------------------------- slow-store control loop (tentpole)
+
+
+def test_slow_store_loses_leaders():
+    """SlowScore's production path: inspected engine writes on a
+    browned-out store → PD store heartbeat → scheduler transfer_leader
+    off it (and the balancer's route penalty skips it)."""
+    from tikv_tpu.testing.cluster import Cluster
+
+    c = Cluster(3)
+    c.bootstrap()
+    c.start()
+    assert c.leader_store(1) == 1
+    # small evaluation window so the score trips within a short test
+    c.stores[1].health.slow_score._window = 8
+    c.stores[1].slow_down(0.06)     # > the 50ms inspector timeout
+    for i in range(8):
+        c.must_put(b"ov-slow-%02d" % i, b"x")
+    score = c.stores[1].health.slow_score.score
+    assert score >= 10, f"slow score {score} did not trip"
+    c.heartbeat_pd()
+    assert c.pd.store_stats[1]["slow_score"] >= 10
+    assert c.pd.scheduler.slow_stores() == {1}
+    executed = c.run_pd_operators()
+    assert executed >= 1
+    assert c.leader_store(1) != 1, "slowed store kept its leader"
+    assert c.pd.scheduler.slow_evictions >= 1
+    # route penalty: the slow store is never picked as a receiver
+    c.pd.enable_balancing(replica_target=3)
+    op = c.pd.scheduler.operator_for(
+        c.stores[2].region_peer(1).region,
+        None)
+    if op is not None and op["type"] == "add_peer":
+        assert op["peer"]["store_id"] != 1
+    c.stores[1].slow_down(0.0)
+
+
+def test_fail_slow_chaos_schedule():
+    """Seeded fail_slow nemesis under the bank workload: conservation,
+    no lost acks, replica agreement, raft monotonicity all hold through
+    a persistent brownout."""
+    from test_chaos import run_schedule
+
+    w, _nem = run_schedule(606, ("fail_slow",), steps=3, ops_per_step=5)
+    assert len(w.acked) > 0, "no progress under fail_slow brownout"
+
+
+# ---------------------------------------------- network acceptance tier
+
+
+@pytest.fixture(scope="module")
+def net():
+    """One PD + three tikv-servers over loopback gRPC, region 1
+    replicated onto all three stores."""
+    from tikv_tpu.raftstore.metapb import Store
+    from tikv_tpu.server import (
+        Node,
+        PdServer,
+        RemotePdClient,
+        TikvServer,
+        TxnClient,
+    )
+
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    servers = []
+    for _ in range(3):
+        node = Node("127.0.0.1:0", RemotePdClient(pd_addr))
+        srv = TikvServer(node)
+        node.addr = f"127.0.0.1:{srv.port}"
+        node.pd.put_store(Store(node.store_id, node.addr))
+        srv.start()
+        servers.append(srv)
+    client = TxnClient(pd_addr)
+    for srv in servers[1:]:
+        client.add_peer(1, srv.node.store_id)
+    yield {"pd": pd_server, "servers": servers, "client": client,
+           "pd_addr": pd_addr}
+    for srv in servers:
+        srv.stop()
+    pd_server.stop()
+
+
+def _region1_leader(servers):
+    for srv in servers:
+        peer = srv.node.raft_store.peers.get(1)
+        if peer is not None and peer.is_leader():
+            return srv
+    raise AssertionError("no leader for region 1")
+
+
+def test_stale_read_safety_rule(net):
+    """read_ts ≤ resolved_ts is the follower-serve rule: above the
+    watermark the server answers data_is_not_ready; below it (after the
+    CheckLeader fan-out advances followers), a follower serves locally
+    with no leader round trip."""
+    from tikv_tpu.server import RemoteError
+    from tikv_tpu.storage.txn_types import compose_ts
+
+    c = net["client"]
+    c.put(b"stale-k", b"stale-v")
+    ts0 = c.tso()
+    # far-future read_ts: beyond any possible watermark
+    future = compose_ts(int(time.time() * 1000) + 60_000, 0)
+    with pytest.raises(RemoteError) as ei:
+        c.replica_get(b"stale-k", version=future, stale=True)
+    assert ei.value.kind == "data_is_not_ready"
+    # wait for the leader→follower resolved-ts fan-out to cover ts0
+    deadline = time.monotonic() + 5
+    value = None
+    while time.monotonic() < deadline:
+        try:
+            value = c.replica_get(b"stale-k", version=ts0, stale=True)
+            break
+        except RemoteError as e:
+            if e.kind != "data_is_not_ready":
+                raise
+            time.sleep(0.05)
+    assert value == b"stale-v"
+    followers = [s for s in net["servers"]
+                 if s is not _region1_leader(net["servers"])]
+    assert sum(s.node.raft_kv.stale_reads for s in followers) >= 1
+
+
+def test_deadline_hedged_reads_under_fail_slow(net):
+    """The acceptance scenario: a browned-out leader (fail_slow), point
+    reads with 100ms deadlines — zero acked responses after their
+    deadline, hedging restores goodput and cuts tail latency vs the
+    same seed unhedged, and every response is correct."""
+    from tikv_tpu.server import RemoteError, TxnClient
+
+    servers = net["servers"]
+    base = net["client"]
+    keys = [b"hedge-%02d" % i for i in range(8)]
+    model = {}
+    for i, k in enumerate(keys):
+        v = b"val-%02d" % i
+        base.put(k, v)
+        model[k] = v
+    ts0 = base.tso()
+    time.sleep(0.4)     # let the resolved-ts fan-out cover ts0
+    leader = _region1_leader(servers)
+    leader.node.raft_store.slow_down(0.15)      # reads sleep past 100ms
+
+    def run_reads(client, n=30, seed=7):
+        rng = random.Random(seed)
+        out = []
+        for _ in range(n):
+            k = keys[rng.randrange(len(keys))]
+            t0 = time.monotonic()
+            ok, v = False, None
+            try:
+                v = client.get(k, version=ts0, deadline_ms=100)
+                ok = True
+            except Exception:   # noqa: BLE001 — shed/busy/timeout
+                pass
+            out.append({"key": k, "value": v, "ok": ok,
+                        "elapsed": time.monotonic() - t0,
+                        "deadline_s": 0.1})
+        return out
+
+    def p99(results):
+        lat = sorted(r["elapsed"] for r in results)
+        return lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+
+    try:
+        plain = TxnClient(net["pd_addr"])
+        res_plain = run_reads(plain)
+        hedged = TxnClient(net["pd_addr"], hedge_reads=True)
+        res_hedged = run_reads(hedged)
+    finally:
+        leader.node.raft_store.slow_down(0.0)
+
+    # 1. zero responses produced after their deadline (server-enforced;
+    #    the slack absorbs client-side wire overhead only)
+    check_no_late_acks(res_plain + res_hedged, slack_s=0.06)
+    # 2. every acked response is correct — hedged follower serves
+    #    (stale-read / ReadIndex) never violated the read guarantee
+    check_read_correctness(res_plain + res_hedged, model)
+    # 3. goodput: bounded during the brownout WITH hedging, collapsed
+    #    without it (the leader simply cannot answer inside 100ms)
+    check_goodput(res_hedged, floor=0.7)
+    plain_ok = sum(1 for r in res_plain if r["ok"])
+    assert plain_ok / len(res_plain) < 0.5, \
+        "unhedged goodput unexpectedly high — brownout not effective"
+    # 4. hedging cut the tail on the same seed
+    assert p99(res_hedged) < p99(res_plain), \
+        f"hedged P99 {p99(res_hedged):.3f}s !< plain {p99(res_plain):.3f}s"
+    assert hedged.hedges_fired > 0 and hedged.hedges_won > 0
+    # 5. the server actually shed expired work (typed, counted)
+    assert leader.node.read_pool.deadline_shed >= 1 or \
+        leader.node.read_pool.rejected >= 1
+
+    hedged.close()
+    plain.close()
+
+
+def test_circuit_breaker_over_network(net):
+    """A dead store trips the client's per-store breaker: sends fail
+    fast while open, and the half-open probe recovers once the
+    store answers again (here: a different reachable address)."""
+    from tikv_tpu.server import TxnClient
+    from tikv_tpu.utils.health import CircuitOpen
+
+    client = TxnClient(net["pd_addr"], breaker_threshold=2,
+                       breaker_cooldown_s=0.2)
+    victim = net["servers"][1].node.store_id
+    # point the client's channel at a dead port
+    from tikv_tpu.server.client import StoreClient
+    client._stores[victim] = StoreClient("127.0.0.1:1")
+    for _ in range(2):
+        with pytest.raises(Exception):
+            client._store_call(victim, "Status", {}, timeout=0.2)
+    assert client._breaker(victim).state() == "open"
+    with pytest.raises(CircuitOpen):
+        client._store_call(victim, "Status", {}, timeout=0.2)
+    time.sleep(0.25)
+    # half-open probe against the REAL address succeeds and closes it
+    client._stores[victim] = StoreClient(
+        net["servers"][1].node.addr)
+    r = client._store_call(victim, "Status", {}, timeout=2)
+    assert r["store_id"] == victim
+    assert client._breaker(victim).state() == "closed"
+    assert client.breaker_states()[victim]["trips"] == 1
+
+
+def test_health_route_exposes_score_and_breakers(net):
+    """/health: per-store slow score + trend, read-pool shedding
+    counters, per-peer transport breaker states."""
+    import json
+    import urllib.request
+
+    from tikv_tpu.server.status_server import StatusServer
+
+    srv = net["servers"][0]
+    st = StatusServer("127.0.0.1:0", node=srv.node)
+    st.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{st.port}/health", timeout=5) as r:
+            body = json.loads(r.read())
+        assert "slow_score" in body and "slow_trend" in body
+        assert "read_pool" in body and "rejected" in body["read_pool"]
+        assert "peer_breakers" in body
+        for states in body["peer_breakers"].values():
+            assert states["state"] in ("closed", "half_open", "open")
+        # the gauges back the same numbers on /metrics
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{st.port}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        assert "tikv_server_slow_score" in metrics
+        assert "tikv_server_deadline_exceeded_total" in metrics
+    finally:
+        st.stop()
+
+
+# ------------------------------------------------------- chaos soak
+
+
+@pytest.mark.slow
+def test_overload_soak_mixed_faults():
+    """Long mixed-fault soak including fail_slow — excluded from tier-1
+    (-m 'not slow'); run explicitly before releases."""
+    from test_chaos import run_schedule
+    from tikv_tpu.chaos import FAULT_KINDS
+
+    w, _ = run_schedule(1337, FAULT_KINDS, steps=10, ops_per_step=8)
+    assert len(w.acked) > 0

@@ -17,7 +17,10 @@ from .invariants import (        # noqa: F401
     InvariantViolation,
     RaftStateTracker,
     check_conservation,
+    check_goodput,
+    check_no_late_acks,
     check_no_lost_acks,
+    check_read_correctness,
     check_replica_consistency,
 )
 from .nemesis import (           # noqa: F401

@@ -95,3 +95,51 @@ def check_replica_consistency(cluster, region_id: int = 1) -> int:
     """ComputeHash on the leader, VerifyHash applied by every replica;
     a diverged replica raises InconsistentRegion.  → the digest."""
     return cluster.check_consistency(region_id)
+
+
+# ------------------------------------------- overload / tail invariants
+#
+# A deadline-bounded point-read workload records one dict per op:
+#   {"key":..., "value":..., "ok": bool, "elapsed": s, "deadline_s": s}
+# The three checks below are the brownout contract: acked responses are
+# timely (never produced from expired work), correct (hedging/stale
+# reads never violate the linearizable guarantee), and goodput does not
+# collapse while a store is merely SLOW rather than dead.
+
+
+def check_no_late_acks(results, slack_s: float = 0.0) -> None:
+    """No acknowledged response arrived after its deadline.  The server
+    sheds expired work with DeadlineExceeded; ``slack_s`` absorbs
+    client-side wire/scheduling overhead on top of the server check."""
+    for r in results:
+        if r["ok"] and r["elapsed"] > r["deadline_s"] + slack_s:
+            raise InvariantViolation(
+                f"acked read of {r['key']!r} took "
+                f"{r['elapsed'] * 1e3:.1f}ms against a "
+                f"{r['deadline_s'] * 1e3:.0f}ms deadline (+slack) — "
+                "late work was acknowledged")
+
+
+def check_read_correctness(results, model: dict) -> None:
+    """Every acknowledged read returned the model value — a hedged or
+    stale-served response that shows anything else broke the
+    linearizable-read guarantee (read_ts ≤ resolved_ts on follower
+    serves is the rule that keeps this true)."""
+    for r in results:
+        if r["ok"] and r["value"] != model[r["key"]]:
+            raise InvariantViolation(
+                f"read of {r['key']!r} returned {r['value']!r}, "
+                f"model holds {model[r['key']]!r}")
+
+
+def check_goodput(results, floor: float) -> None:
+    """The served fraction stays above ``floor`` during the brownout —
+    fail-slow must not degrade into fail-stop."""
+    if not results:
+        raise InvariantViolation("no reads attempted")
+    ok = sum(1 for r in results if r["ok"])
+    frac = ok / len(results)
+    if frac < floor:
+        raise InvariantViolation(
+            f"goodput {frac:.2%} ({ok}/{len(results)}) below the "
+            f"{floor:.0%} brownout floor")

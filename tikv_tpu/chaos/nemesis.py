@@ -20,7 +20,7 @@ from ..utils import failpoint
 from ..utils.failpoint import FailpointPanic
 
 FAULT_KINDS = ("partition", "asym_partition", "leader_isolate",
-               "crash_restart", "msg_chaos", "disk_stall")
+               "crash_restart", "msg_chaos", "disk_stall", "fail_slow")
 
 # crash boundaries: a ``panic`` here unwinds out of the drive loop like
 # a process kill at that point of the write path (the same boundaries
@@ -69,6 +69,9 @@ def generate_schedule(seed: int, steps: int,
                            reorder=True))
         elif kind == "disk_stall":
             out.append(_mk(kind, ms=rng.choice((2, 5, 10))))
+        elif kind == "fail_slow":
+            out.append(_mk(kind, store=rng.choice(stores),
+                           ms=rng.choice((10, 20, 40))))
         else:   # pragma: no cover
             raise ValueError(kind)
     return out
@@ -117,6 +120,27 @@ class Nemesis:
                     dup_p=fault.param("dup_p", 0.0),
                     reorder=fault.param("reorder", False))
         self._heals.append(t.clear_chaos)
+
+    def _apply_fail_slow(self, fault: Fault) -> None:
+        """Persistent per-store brownout — distinct from the transient
+        global ``disk_stall``: ONE store's write AND read paths gain a
+        fixed latency (RaftStore.slow_down) that persists until heal,
+        the fail-*slow* mode the slow-score control loop is built to
+        detect (a sick disk, a throttled VM, a saturated NIC)."""
+        sid = fault.param("store")
+        ms = fault.param("ms", 20)
+        store = self.cluster.stores.get(sid)
+        if store is None:
+            return
+        store.slow_down(ms / 1000.0)
+
+        def heal(sid=sid):
+            # crash_restart may have replaced the store object: always
+            # heal whatever currently answers to the id
+            cur = self.cluster.stores.get(sid)
+            if cur is not None:
+                cur.slow_down(0.0)
+        self._heals.append(heal)
 
     def _apply_disk_stall(self, fault: Fault) -> None:
         ms = fault.param("ms", 5)

@@ -251,6 +251,11 @@ class Endpoint:
             if backend != "device":
                 return CopDeferred(self, req, storage, tag, t0, "host",
                                    result=host_exec())
+            # deadline gate before the device dispatch: enqueueing a
+            # kernel for an already-expired request burns accelerator
+            # time and a completion-pool slot on an unusable answer
+            from ..utils.deadline import check_current as _dl_check
+            _dl_check("device_dispatch")
             try:
                 if self._supports_deferred():
                     out = self._device_runner.handle_request(

@@ -172,12 +172,18 @@ class BatchExecutorsRunner:
             # result as one drained page rather than looping the client
             # on page 1 forever
             max_rows = None
+        from ..utils.deadline import check_current as _dl_check
         batch_size = BATCH_INITIAL_SIZE
         chunks: list[ColumnBatch] = []
         warnings: list = []
         n_rows = 0
         drained = False
         while True:
+            # deadline gate between executor batches (endpoint.rs checks
+            # max_execution_duration the same way): a long scan whose
+            # caller has stopped waiting is abandoned mid-pipeline
+            # instead of running to completion
+            _dl_check("executor_batch")
             r = self._out.next_batch(batch_size)
             if r.batch.num_rows:
                 chunks.append(r.batch)

@@ -30,6 +30,10 @@ class SnapContext:
     key_hint: bytes = b""
     # serve from a FOLLOWER via ReadIndex (kvproto Context.replica_read)
     replica_read: bool = False
+    # serve a local engine snapshot with NO consensus round trip
+    # (kvproto Context.stale_read) — the caller must have verified
+    # read_ts ≤ the region's resolved-ts watermark first
+    stale_read: bool = False
 
 
 @dataclass

@@ -13,6 +13,15 @@ from the store with the most replicas to the store with the fewest
 (that lacks one), one step per heartbeat — add the new peer first, drop
 the old one only after the add is visible in a later heartbeat; spread
 leaders across stores holding replicas.
+
+Slow-store control loop (PD's evict-slow-store scheduler): store
+heartbeats carry the write-path slow score (utils/health.py SlowScore
+fed by the raftstore latency inspector).  A store whose score crosses
+``slow_score_threshold`` is treated as browned out — fail-*slow*, not
+fail-stop: its leaders are evicted to healthy voters (which also moves
+coprocessor/read routing off it, since reads follow leaders) and the
+balancer stops picking it as a replica receiver (route penalty).  The
+score decays once the store recovers, and normal scheduling resumes.
 """
 
 from __future__ import annotations
@@ -23,10 +32,27 @@ from typing import Optional
 class Scheduler:
     """Balancing decisions over the PD's region/store view."""
 
+    # the reference treats score >= 10 as "slow" (slow_score.rs
+    # SLOW_SCORE_THRESHOLD); 1.0 is healthy, 100.0 dead-slow
+    SLOW_SCORE_THRESHOLD = 10.0
+
     def __init__(self, pd, max_diff: int = 1):
         self._pd = pd
         self._max_diff = max_diff
         self.enabled = False
+        # slow-store leader eviction is overload DEFENSE, not load
+        # balancing: active even when the balancer is off
+        self.evict_slow_leaders = True
+        self.slow_score_threshold = self.SLOW_SCORE_THRESHOLD
+        self.slow_evictions = 0
+
+    def slow_stores(self) -> set:
+        """Stores whose latest heartbeat reports a tripped slow score."""
+        out = set()
+        for sid, stats in self._pd.store_stats.items():
+            if stats.get("slow_score", 1.0) >= self.slow_score_threshold:
+                out.add(sid)
+        return out
 
     def _replica_counts(self, regions) -> dict:
         """Replica count per store, INCLUDING planned moves: an
@@ -51,6 +77,22 @@ class Scheduler:
         """One operator step for this region's heartbeat, or None.
 
         Called with the PD lock held (from region_heartbeat)."""
+        slow = self.slow_stores() if self.evict_slow_leaders else set()
+        if slow and leader is not None and leader.store_id in slow:
+            # evict-slow-store: move leadership (and with it read/copr
+            # routing) onto a healthy VOTER before the brownout turns
+            # into timeouts.  No healthy voter → hold; a bad transfer
+            # is worse than a slow leader.
+            target = next((p for p in region.peers
+                           if p.store_id not in slow
+                           and p.store_id != leader.store_id
+                           and not p.is_learner), None)
+            if target is not None:
+                self.slow_evictions += 1
+                return {"type": "transfer_leader",
+                        "peer": {"id": target.id,
+                                 "store_id": target.store_id,
+                                 "learner": target.is_learner}}
         if not self.enabled:
             return None
         counts = self._replica_counts(self._pd._regions)
@@ -97,9 +139,12 @@ class Scheduler:
             return None     # mid-move without a recorded donor: hold
         # replica balance: most-loaded member store vs least-loaded
         # non-member store
+        # route penalty: a slow store is the FIRST donor candidate and
+        # never a receiver — data drains off a brownout, not onto it
         donors = sorted((s for s in peer_stores if s in counts),
-                        key=lambda s: -counts[s])
-        receivers = sorted((s for s in counts if s not in peer_stores),
+                        key=lambda s: (s not in slow, -counts[s]))
+        receivers = sorted((s for s in counts
+                            if s not in peer_stores and s not in slow),
                            key=lambda s: counts[s])
         if donors and receivers:
             donor, receiver = donors[0], receivers[0]

@@ -88,6 +88,20 @@ class RegionNotFound(Exception):
         self.region_id = region_id
 
 
+class DataIsNotReady(Exception):
+    """A stale read's read_ts is above this replica's resolved-ts
+    watermark (kvproto errorpb DataIsNotReady): serving it could miss a
+    commit still in flight below read_ts.  The client falls back to a
+    leader or ReadIndex replica read."""
+
+    def __init__(self, region_id: int, safe_ts: int, read_ts: int):
+        super().__init__(f"region {region_id}: read_ts {read_ts} > "
+                         f"resolved_ts {safe_ts}")
+        self.region_id = region_id
+        self.safe_ts = safe_ts
+        self.read_ts = read_ts
+
+
 class InconsistentRegion(Exception):
     """Consistency check failed: this replica's data digest differs from
     the leader's at the same applied index (the reference panics —

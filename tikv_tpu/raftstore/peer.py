@@ -301,6 +301,38 @@ class RaftPeer:
             self.proposals.append(Proposal(index, self.node.term, cb))
         return index
 
+    def _inspected_engine_write(self, wb) -> None:
+        """Write-path latency inspector (store/async_io/write.rs:24
+        LatencyInspector): every apply/persist engine write is timed
+        into the store's HealthController, so a degrading disk raises
+        the slow score long before it fails outright.  The store's
+        fail-slow injection knob (chaos nemesis) adds its delay INSIDE
+        the measured window — an injected brownout must look exactly
+        like a real one to the health loop."""
+        import time as _time
+        from ..utils.failpoint import fail_point
+        fail_point("store::write_inspect")
+        t0 = _time.perf_counter()
+        stall = getattr(self.store, "inject_write_delay_s", 0.0)
+        if stall > 0:
+            _time.sleep(stall)
+        self.engine.write(wb)
+        health = getattr(self.store, "health", None)
+        if health is not None:
+            health.record_write(_time.perf_counter() - t0)
+
+    def stale_snapshot(self) -> RegionSnapshot:
+        """Engine snapshot with NO consensus round trip — only safe for
+        reads at or below the region's resolved-ts watermark (closed
+        timestamps: no commit at ts ≤ resolved_ts can newly appear), a
+        gate the SERVICE layer enforces before calling this.  Serves
+        from any replica, leader or not (kvproto Context stale_read)."""
+        with self.mu:
+            snap = RegionSnapshot(self.engine.snapshot(), self.region)
+            snap.data_index = self.data_index
+            snap.apply_index = self.applied_engine
+            return snap
+
     def local_read(self) -> Optional[RegionSnapshot]:
         """Lease-based local read: serve an engine snapshot with NO raft
         round-trip when the leader lease is valid and this leader has
@@ -457,7 +489,7 @@ class RaftPeer:
                                           truncated=(meta.index,
                                                      meta.term))
                 if not wb.is_empty():
-                    self.engine.write(wb)
+                    self._inspected_engine_write(wb)
                 apply_ctx.send(self.region.id, rd.committed_entries)
                 out.extend(rd.messages)
                 self.node.advance(rd)
@@ -516,7 +548,7 @@ class RaftPeer:
                     wb, rd.committed_entries[-1].index)
             fail_point("apply::before_write")
             if not wb.is_empty():
-                self.engine.write(wb)
+                self._inspected_engine_write(wb)
             fail_point("apply::after_write")
             # observers run AFTER the engine write so they only ever see
             # durable state (coprocessor/mod.rs post-apply hooks)
@@ -587,7 +619,7 @@ class RaftPeer:
         self.peer_storage.persist_apply(wb, entries[-1].index)
         fail_point("apply::before_write")
         if not wb.is_empty():
-            self.engine.write(wb)
+            self._inspected_engine_write(wb)
         fail_point("apply::after_write")
         if self._pending_obs:
             host = self.store.coprocessor_host

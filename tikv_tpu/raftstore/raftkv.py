@@ -29,6 +29,7 @@ class RaftKv:
         self._lock = lock
         self.lease_reads = 0
         self.barrier_reads = 0
+        self.stale_reads = 0
         # write-path latency inspector feeding the health controller's
         # slow score (store/async_io/write.rs:24 LatencyInspector)
         self._latency_inspector = latency_inspector
@@ -54,9 +55,23 @@ class RaftKv:
     # -- kv.Engine --
 
     def snapshot(self, ctx: SnapContext):
+        # fail-slow injection (chaos): a browned-out store serves reads
+        # slowly but correctly — the shed/hedge machinery above must
+        # route around it, nothing below here misbehaves
+        stall = getattr(self.store, "inject_read_delay_s", 0.0)
+        if stall > 0:
+            import time as _time
+            _time.sleep(stall)
         peer = self._route(ctx)
         if self.on_read is not None and ctx.key_hint:
             self.on_read(peer.region.id, ctx.key_hint)
+        if ctx.stale_read:
+            # resolved-ts-gated local snapshot: correctness rests on the
+            # caller's read_ts ≤ resolved_ts check (service layer) —
+            # below the watermark no new commit can appear, so any
+            # replica's applied state answers the MVCC read exactly
+            self.stale_reads += 1
+            return peer.stale_snapshot()
         if ctx.replica_read and not peer.is_leader():
             # follower read via ReadIndex (SURVEY §2.8.4): consistent at
             # the leader's commit point, zero leader load.  In the

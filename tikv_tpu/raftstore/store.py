@@ -95,10 +95,28 @@ class RaftStore:
         # (coprocessor/mod.rs:98-594)
         from .observer import CoprocessorHost
         self.coprocessor_host = CoprocessorHost()
+        # write-path health (health_controller): every inspected engine
+        # write feeds the slow score; store heartbeats carry it to PD so
+        # scheduling steers leaders away from a fail-slow store
+        from ..utils.health import HealthController
+        self.health = HealthController(timeout_s=0.05,
+                                       store_id=store_id)
+        # fail-slow injection knobs (chaos fail_slow nemesis): persistent
+        # per-store latency added inside the inspected write path /
+        # the read snapshot path — a brownout, not an outage
+        self.inject_write_delay_s = 0.0
+        self.inject_read_delay_s = 0.0
         # guards self.peers mutations: pooled-mode pollers create/destroy
         # peers (split/merge/conf-change) while other threads iterate
         import threading as _threading
         self.meta_mu = _threading.Lock()
+
+    def slow_down(self, seconds: float) -> None:
+        """Inject persistent per-store latency (fail-slow brownout):
+        applied inside every inspected engine write and every snapshot
+        read until cleared with slow_down(0)."""
+        self.inject_write_delay_s = seconds
+        self.inject_read_delay_s = seconds
 
     # ------------------------------------------------------------- lifecycle
 

@@ -142,15 +142,44 @@ class TxnClient:
     Reads/writes route to the region leader by key; on KeyIsLocked the
     client resolves via CheckTxnStatus + ResolveLock (the reference's
     client-side lock resolution protocol).
+
+    Tail tolerance (client-go shape):
+
+    - every store's transport rides a per-store circuit breaker —
+      consecutive transport failures trip it open, a half-open probe
+      re-tests after the cooldown, and an open breaker fails sends fast
+      instead of feeding a dead/hung store its full RPC timeout;
+    - with ``hedge_reads=True``, idempotent point gets re-issue to a
+      follower replica after an adaptive P95-based delay (resolved-ts
+      stale read first, ReadIndex replica read as the fallback when the
+      watermark lags); first response wins, the loser is abandoned.
     """
 
-    def __init__(self, pd_addr: str):
+    # hedge delay bounds: never hedge inside normal jitter (floor) and
+    # never wait out most of a deadline before hedging (ceiling)
+    HEDGE_DELAY_MIN = 0.002
+    HEDGE_DELAY_MAX = 0.5
+    HEDGE_LAT_WINDOW = 128
+
+    def __init__(self, pd_addr: str, hedge_reads: bool = False,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 1.0):
         self.pd = RemotePdClient(pd_addr)
         self._stores: dict[int, StoreClient] = {}
         # client-go RegionCache analog: region routing resolved from PD
         # once and reused until a NotLeader/EpochNotMatch invalidates it
         # — without it every mutation in a batch pays a PD RPC
         self._region_cache: dict[int, tuple[Region, Peer]] = {}
+        from ..utils.health import CircuitBreaker
+        self.hedge_reads = hedge_reads
+        self._breaker_cfg = (breaker_threshold, breaker_cooldown_s)
+        self._breakers: dict[int, CircuitBreaker] = {}
+        self._hedge_pool = None
+        self._hedge_mu = threading.Lock()
+        # recent point-read latencies (seconds) → adaptive P95 delay
+        self._read_lat: list[float] = []
+        self.hedges_fired = 0
+        self.hedges_won = 0
 
     # -- routing --
 
@@ -160,6 +189,43 @@ class TxnClient:
             c = StoreClient(self.pd.get_store(store_id).address)
             self._stores[store_id] = c
         return c
+
+    # -- per-store circuit breaker (tail tolerance) --
+
+    def _breaker(self, store_id: int):
+        from ..utils.health import CircuitBreaker
+        br = self._breakers.get(store_id)
+        if br is None:
+            thresh, cool = self._breaker_cfg
+            br = self._breakers[store_id] = CircuitBreaker(
+                threshold=thresh, cooldown_s=cool)
+        return br
+
+    def breaker_states(self) -> dict:
+        return {sid: br.stats() for sid, br in self._breakers.items()}
+
+    def _store_call(self, store_id: int, method: str, req: dict,
+                    timeout: float = 10) -> dict:
+        """One RPC to one store through its circuit breaker.
+
+        Only TRANSPORT failures (timeouts, channel errors) count
+        against the breaker — a logical RemoteError proves the store
+        answered and resets it."""
+        from ..utils.health import CircuitOpen
+        br = self._breaker(store_id)
+        if not br.allow():
+            raise CircuitOpen(f"store {store_id}")
+        try:
+            r = self._store_client(store_id).call(method, req,
+                                                  timeout=timeout)
+        except wire.RemoteError:
+            br.record_success()
+            raise
+        except Exception:
+            br.record_failure()
+            raise
+        br.record_success()
+        return r
 
     def _lookup_region(self, key: bytes) -> tuple[Region, Peer]:
         # region bounds live in the ENCODED keyspace (txn_types
@@ -201,6 +267,7 @@ class TxnClient:
         multiplying by the attempt count."""
         from ..utils.backoff import Backoff
         from ..utils.failpoint import fail_point
+        from ..utils.health import CircuitOpen
         bo = Backoff(base=0.02, cap=0.5,
                      deadline_s=deadline if deadline is not None
                      else timeout)
@@ -211,11 +278,30 @@ class TxnClient:
                 # routing error instead of firing a sliver-timeout RPC
                 # whose bare TimeoutError would mask it
                 break
-            client, _region = self._leader_client(key)
+            region, leader = self._lookup_region(key)
             try:
-                return client.call(method, req,
-                                   timeout=bo.rpc_timeout(timeout))
+                return self._store_call(leader.store_id, method, req,
+                                        timeout=bo.rpc_timeout(timeout))
+            except CircuitOpen as e:
+                # this store's breaker is open: back off and re-resolve
+                # — leadership may have moved off the dead store
+                last = e
+                self._invalidate_region(key)
+                if not bo.sleep():
+                    break
+                continue
             except wire.RemoteError as e:
+                if e.kind == "server_is_busy":
+                    # overloaded, not misrouted: honor the server's
+                    # queue-depth-derived retry_after_ms over blind
+                    # exponential jitter
+                    last = e
+                    hint = e.err.get("retry_after_ms")
+                    fail_point("client::before_retry")
+                    if not bo.sleep(hint_s=hint / 1000.0
+                                    if hint else None):
+                        break
+                    continue
                 if e.kind in ("not_leader", "epoch_not_match",
                               "region_not_found", "region_merging") or \
                         "KeyNotInRegion" in str(e):
@@ -238,12 +324,33 @@ class TxnClient:
     # -- simple point API --
 
     def get(self, key: bytes, version: Optional[int] = None,
-            resolve: bool = True) -> Optional[bytes]:
+            resolve: bool = True,
+            deadline_ms: Optional[int] = None) -> Optional[bytes]:
+        """Point read.  ``deadline_ms`` budgets the WHOLE operation:
+        it rides the wire so the server sheds expired work, and the
+        client's RPC timeout is clamped to it."""
+        from ..utils.deadline import Deadline
         ts = version if version is not None else self.tso()
+        req = {"key": key, "version": ts}
+        dl = Deadline.after_ms(deadline_ms) \
+            if deadline_ms is not None else None
+        timeout = 10.0
         for _ in range(4):
+            if dl is not None:
+                # the budget covers the WHOLE get, lock-resolution
+                # retries included — each attempt carries only what
+                # remains, and an exhausted budget sheds client-side
+                dl.check("client_retry")
+                req["deadline_ms"] = dl.to_wire_ms()
+                timeout = max(0.001, dl.remaining())
             try:
-                r = self._call_leader(key, "KvGet",
-                                      {"key": key, "version": ts})
+                t0 = time.monotonic()
+                if self.hedge_reads:
+                    r = self._hedged_get(key, dict(req), timeout, dl)
+                else:
+                    r = self._call_leader(key, "KvGet", req,
+                                          timeout=timeout)
+                self._note_read_latency(time.monotonic() - t0)
                 return r.get("value")
             except wire.RemoteError as e:
                 if resolve and e.kind == "key_is_locked":
@@ -252,18 +359,141 @@ class TxnClient:
                 raise
         raise TxnError(f"unresolved lock on {key!r}")
 
+    # -- hedged reads (tail tolerance) --
+
+    def _note_read_latency(self, dt: float) -> None:
+        lat = self._read_lat
+        lat.append(dt)
+        if len(lat) > self.HEDGE_LAT_WINDOW:
+            del lat[:len(lat) - self.HEDGE_LAT_WINDOW]
+
+    def hedge_delay(self) -> float:
+        """Adaptive hedge trigger: the P95 of recent point reads — a
+        read slower than 95% of its peers is likely stuck on a slow
+        store, so a duplicate is cheap insurance."""
+        lat = sorted(self._read_lat)
+        if not lat:
+            return 0.05
+        p95 = lat[min(len(lat) - 1, int(len(lat) * 0.95))]
+        return min(self.HEDGE_DELAY_MAX, max(self.HEDGE_DELAY_MIN, p95))
+
+    def _hedged_get(self, key: bytes, req: dict, timeout: float,
+                    dl=None) -> dict:
+        """Leader read, hedged to a follower after the adaptive delay;
+        first response wins, the loser is abandoned (its reply is
+        discarded — gRPC unary calls cannot be recalled mid-flight).
+
+        The hedge is only safe because a point get at a FIXED version
+        is idempotent, and the follower path preserves linearizability:
+        a resolved-ts stale read serves only when read_ts ≤ the
+        follower's watermark, and the DataIsNotReady fallback is a
+        ReadIndex replica read (consistent at the leader's commit
+        point)."""
+        import concurrent.futures as cf
+        from ..utils.metrics import HEDGE_COUNTER
+        region, leader = self._lookup_region(key)
+        pool = self._hedge_executor()
+        f_leader = pool.submit(self._call_leader, key, "KvGet",
+                               req, 8, timeout)
+        try:
+            r = f_leader.result(timeout=self.hedge_delay())
+            HEDGE_COUNTER.labels("leader_fast").inc()
+            return r
+        except cf.TimeoutError:
+            pass
+        except wire.RemoteError as e:
+            if e.kind == "key_is_locked":
+                raise   # the follower would serve the same lock —
+                # resolution, not hedging, unblocks this read
+            # leader shed/failed FAST (busy, deadline, breaker): the
+            # follower leg below is the recovery path, not a duplicate
+        followers = [p for p in region.peers
+                     if (leader is None or p.store_id != leader.store_id)
+                     and not p.is_learner]
+        if not followers:
+            return f_leader.result(timeout=timeout + 1)
+        self.hedges_fired += 1
+        HEDGE_COUNTER.labels("fired").inc()
+        target = followers[self.hedges_fired % len(followers)]
+        freq = dict(req)
+        if dl is not None:
+            # the follower leg carries the REMAINING budget, not the
+            # original one — the hedge delay already spent part of it
+            freq["deadline_ms"] = dl.to_wire_ms()
+        f_follow = pool.submit(self._follower_get, target.store_id,
+                               freq, timeout)
+        done, _ = cf.wait({f_leader, f_follow},
+                          timeout=timeout + 1,
+                          return_when=cf.FIRST_COMPLETED)
+        # prefer whichever finished FIRST with a usable answer; an
+        # error from the early finisher falls through to (and blocks
+        # on) the still-running leg
+        order = sorted([f_leader, f_follow],
+                       key=lambda f: (f not in done, f is f_follow))
+        for fut in order:
+            try:
+                r = fut.result(timeout=timeout + 1)
+                if fut is f_follow:
+                    self.hedges_won += 1
+                    HEDGE_COUNTER.labels("follower_won").inc()
+                else:
+                    HEDGE_COUNTER.labels("leader_won").inc()
+                return r
+            except Exception:   # noqa: BLE001 — try the other leg
+                continue
+        # both legs failed: surface the leader's error (the follower
+        # error is usually the less meaningful DataIsNotReady)
+        return f_leader.result(timeout=timeout + 1)
+
+    def _follower_get(self, store_id: int, req: dict,
+                      timeout: float) -> dict:
+        """The hedge's follower leg: resolved-ts stale read first (no
+        leader involvement at all), ReadIndex replica read when the
+        follower's watermark hasn't reached read_ts yet."""
+        stale = dict(req)
+        stale["stale_read"] = True
+        try:
+            return self._store_call(store_id, "KvGet", stale,
+                                    timeout=timeout)
+        except wire.RemoteError as e:
+            if e.kind != "data_is_not_ready":
+                raise
+        replica = dict(req)
+        replica["replica_read"] = True
+        return self._store_call(store_id, "KvGet", replica,
+                                timeout=timeout)
+
+    def _hedge_executor(self):
+        import concurrent.futures as cf
+        with self._hedge_mu:
+            if self._hedge_pool is None:
+                self._hedge_pool = cf.ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="hedge")
+            return self._hedge_pool
+
+    def close(self) -> None:
+        """Release the hedge executor's threads (tests / short-lived
+        clients)."""
+        with self._hedge_mu:
+            if self._hedge_pool is not None:
+                self._hedge_pool.shutdown(wait=False)
+                self._hedge_pool = None
+
     def replica_get(self, key: bytes,
-                    version: Optional[int] = None) -> Optional[bytes]:
-        """Read from a FOLLOWER replica (replica_read) — consistent at
-        the leader's commit point, spreading read load off leaders."""
+                    version: Optional[int] = None,
+                    stale: bool = False) -> Optional[bytes]:
+        """Read from a FOLLOWER replica — consistent at the leader's
+        commit point via ReadIndex (replica_read), or, with
+        ``stale=True``, served locally under the resolved-ts watermark
+        (raises data_is_not_ready when the watermark lags read_ts)."""
         ts = version if version is not None else self.tso()
         region, leader = self._lookup_region(key)
         followers = [p for p in region.peers
                      if leader is None or p.store_id != leader.store_id]
         target = followers[0] if followers else leader
-        client = self._store_client(target.store_id)
-        r = client.call("KvGet", {"key": key, "version": ts,
-                                  "replica_read": True})
+        req = {"key": key, "version": ts}
+        req["stale_read" if stale else "replica_read"] = True
+        r = self._store_call(target.store_id, "KvGet", req)
         return r.get("value")
 
     def put(self, key: bytes, value: bytes) -> None:
@@ -390,15 +620,23 @@ class TxnClient:
                     paging_size: int = 0, resume_token=None,
                     resource_group: str = "default",
                     request_source: str = "",
-                    timeout: float = 10) -> dict:
+                    timeout: float = 10,
+                    deadline_ms: Optional[int] = None) -> dict:
         key = key_hint if key_hint is not None else \
             (dag.ranges[0].start if dag.ranges else b"")
-        return self._call_leader(key, "Coprocessor", {
+        req = {
             "tp": 103, "dag": wire.enc_dag(dag),
             "force_backend": force_backend,
             "paging_size": paging_size, "resume_token": resume_token,
             "resource_group": resource_group,
-            "request_source": request_source}, timeout=timeout)
+            "request_source": request_source}
+        if deadline_ms is not None:
+            # the endpoint checks this budget at admission, between
+            # executor batches, and before the device dispatch
+            req["deadline_ms"] = deadline_ms
+            timeout = min(timeout, deadline_ms / 1000.0)
+        return self._call_leader(key, "Coprocessor", req,
+                                 timeout=timeout)
 
     def coprocessor_paged(self, dag, paging_size: int,
                           key_hint: Optional[bytes] = None):
@@ -605,10 +843,16 @@ class TxnClient:
                               "region_merging", "server_is_busy") or \
                         "KeyNotInRegion" in str(e):
                     # stale routing / transient: refresh and retry
-                    # (KeyNotInRegion = cached bounds predate a split)
+                    # (KeyNotInRegion = cached bounds predate a split).
+                    # A busy server names its own drain time
+                    # (retry_after_ms from read-pool queue depth) —
+                    # honor it over blind exponential jitter
                     self._invalidate_region(region_key)
                     last = e
-                    if not bo.sleep():
+                    hint = e.err.get("retry_after_ms") \
+                        if e.kind == "server_is_busy" else None
+                    if not bo.sleep(hint_s=hint / 1000.0
+                                    if hint else None):
                         break
                     continue
                 raise

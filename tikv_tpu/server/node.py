@@ -113,10 +113,28 @@ class _StoreConn:
                 pass
         self.channel = None
         self.addr = None
+        self._publish_breaker()
 
     def on_success(self) -> None:
         self.fail_count = 0
         self.next_attempt = 0.0
+        self._publish_breaker()
+
+    def breaker_state(self) -> str:
+        """The conn's backoff state read as a circuit breaker: closed
+        (healthy), open (cooling off after failures), half_open (past
+        the cooldown — the next flush is the probe)."""
+        if self.fail_count == 0:
+            return "closed"
+        if time.monotonic() < self.next_attempt:
+            return "open"
+        return "half_open"
+
+    def _publish_breaker(self) -> None:
+        from ..utils.metrics import PEER_BREAKER_GAUGE
+        PEER_BREAKER_GAUGE.labels(self.store_id).set(
+            {"closed": 0, "half_open": 1, "open": 2}[
+                self.breaker_state()])
 
 
 class GrpcTransport(Transport):
@@ -137,6 +155,15 @@ class GrpcTransport(Transport):
             if conn is None:
                 conn = self._conns[store_id] = _StoreConn(store_id)
             return conn
+
+    def breaker_states(self) -> dict:
+        """Per-peer-store transport breaker view (/health route)."""
+        with self._lock:
+            conns = list(self._conns.values())
+        return {c.store_id: {"state": c.breaker_state(),
+                             "consecutive_failures": c.fail_count,
+                             "queued": len(c.queue)}
+                for c in conns}
 
     # per-batch RPC deadline: a hung peer must not pin the flush loop
     # (and with it every region's outbound raft traffic) beyond this
@@ -378,10 +405,16 @@ class Node:
         # gc) so online raftstore changes take effect without restart
         self.raft_store.config = config.raftstore
         self.raft_store.observers = [self._report_region]
-        from ..utils.health import HealthController
         from ..utils.quota import ResourceGroupManager
-        self.health = HealthController()
+        # ONE health controller per store (health_controller crate): the
+        # raftstore's per-write inspector and RaftKv's whole-command
+        # inspector feed the same slow score, and the store heartbeat
+        # exports it to PD for slow-store scheduling
+        self.health = self.raft_store.health
         self.resource_groups = ResourceGroupManager()
+        # leader→follower resolved-ts fan-out (CheckLeader) state
+        self._rts_clients: dict = {}
+        self._rts_fanout_busy = threading.Lock()
         # bulk-load import mode (sst_importer import_mode.rs): split
         # checks pause while set
         self.import_mode = False
@@ -488,11 +521,21 @@ class Node:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.raft_store.stop_pool()
-        # retire the endpoint's completion-pool workers (nodes restarted
-        # in-process — chaos cycles, tests — must not leak a pool each)
+        # idle-drain both request pools: stop admitting reads and wait
+        # for in-flight ones, then retire (and JOIN) the endpoint's
+        # completion-pool workers — nodes restarted in-process (chaos
+        # cycles, per-test servers) must not leak threads each stop
+        self.read_pool.shutdown()
         close = getattr(self.endpoint, "close", None)
         if callable(close):
             close()
+        # the resolved-ts fan-out's cached channels hold real sockets
+        for c in self._rts_clients.values():
+            try:
+                c._chan.close()
+            except Exception:   # noqa: BLE001 — already broken
+                pass
+        self._rts_clients.clear()
 
     def _drive_loop(self) -> None:
         last_tick = time.monotonic()
@@ -525,7 +568,7 @@ class Node:
                 if now - last_hb >= self._tick_interval * 10:
                     last_hb = now
                     leaders = [(p.region, Peer(p.meta.id, self.store_id),
-                                list(p.buckets))
+                                list(p.buckets), p.applied_engine)
                                for p in self.raft_store.peers.values()
                                if p.is_leader()]
                 else:
@@ -535,7 +578,7 @@ class Node:
                 self._try_load_split(rid, samples)
             if leaders is not None:
                 try:
-                    for region, leader, buckets in leaders:
+                    for region, leader, buckets, _ai in leaders:
                         op = self.pd.region_heartbeat(region, leader,
                                                       buckets=buckets)
                         if op:
@@ -553,12 +596,65 @@ class Node:
                     # worker updates max_ts for exactly this reason)
                     ts = self.pd.tso()
                     self.storage.concurrency_manager.update_max_ts(ts)
-                    self.resolved_ts.advance_all(
-                        ts, [r.id for r, _l, _b in leaders])
+                    advanced = self.resolved_ts.advance_all(
+                        ts, [r.id for r, _l, _b, _ai in leaders])
+                    self._fanout_resolved_ts(leaders, advanced)
                 except Exception:
                     pass    # PD outages must not stall raft
             if did == 0:
                 time.sleep(self._tick_interval / 4)
+
+    def _fanout_resolved_ts(self, leaders, advanced: dict) -> None:
+        """Push leader watermarks to follower stores (CheckLeader —
+        resolved_ts/advance.rs fan-out) so followers can serve
+        resolved-ts-gated stale reads.  Best-effort on a background
+        thread: a dead peer store must not stall the drive loop's
+        ticks (its timeout would outlast an election timeout)."""
+        per_store: dict[int, list] = {}
+        for region, _leader, _buckets, _applied_at_hb in leaders:
+            rts = advanced.get(region.id, 0)
+            if rts <= 0:
+                continue
+            # read the apply index NOW, after advance_all: a commit
+            # that applied between the heartbeat snapshot and the
+            # watermark computation has commit_ts < rts — pairing rts
+            # with the older index would let a follower that lacks
+            # that commit pass the gate and serve a stale read
+            # missing it.  A fresher index only raises the bar.
+            peer = self.raft_store.peers.get(region.id)
+            if peer is None:
+                continue
+            applied = peer.applied_engine
+            for p in region.peers:
+                if p.store_id == self.store_id:
+                    continue
+                per_store.setdefault(p.store_id, []).append(
+                    {"region_id": region.id, "resolved_ts": rts,
+                     "applied_index": applied})
+        if not per_store:
+            return
+        if not self._rts_fanout_busy.acquire(blocking=False):
+            return      # previous fan-out still in flight: skip a beat
+
+        def run():
+            from .client import StoreClient
+            try:
+                for sid, regions in per_store.items():
+                    try:
+                        addr = self.pd.get_store(sid).address
+                        c = self._rts_clients.get(addr)
+                        if c is None:
+                            c = self._rts_clients[addr] = \
+                                StoreClient(addr)
+                        c.call("CheckLeader", {"regions": regions},
+                               timeout=1)
+                    except Exception:   # noqa: BLE001 — next beat
+                        pass
+            finally:
+                self._rts_fanout_busy.release()
+
+        threading.Thread(target=run, daemon=True,
+                         name="rts-fanout").start()
 
     def _try_load_split(self, region_id: int, samples: list) -> None:
         """Split a hot region at the sampled-access median key

@@ -11,6 +11,15 @@ pending watermark trips — the reference's running-threshold behavior.
 Priorities: ``high`` (point reads) bypasses the pending watermark the
 way the reference's priority scheduling keeps small reads flowing while
 big scans queue.
+
+Overload defense on top of the watermark:
+
+- a ``ServerIsBusy`` rejection carries ``retry_after_ms`` derived from
+  the queue depth and the EWMA service time, so clients back off by the
+  pool's actual drain rate instead of blind exponential jitter;
+- deadline-aware shedding: a request whose remaining budget is below
+  the EWMA service time is rejected at admission — it would only burn a
+  slot producing an answer nobody can use (fail fast, not fail late).
 """
 
 from __future__ import annotations
@@ -18,40 +27,91 @@ from __future__ import annotations
 import threading
 import time
 
+from ..utils.deadline import Deadline, DeadlineExceeded
 from ..utils.metrics import (
+    DEADLINE_SHED_COUNTER,
+    READ_POOL_EMA_GAUGE,
     READ_POOL_PENDING_GAUGE,
     READ_POOL_RUNNING_GAUGE,
 )
 
 
 class ServerIsBusy(Exception):
-    def __init__(self, reason: str = "read pool saturated"):
+    def __init__(self, reason: str = "read pool saturated",
+                 retry_after_ms: int = 0):
         super().__init__(reason)
         self.reason = reason
+        # queue-depth-derived backoff hint (0 = none); rides the wire
+        self.retry_after_ms = retry_after_ms
 
 
 class ReadPool:
+    # EWMA smoothing for service time: ~5 samples of memory — fast
+    # enough to follow a brownout, slow enough to ignore one outlier
+    EMA_ALPHA = 0.2
+
     def __init__(self, max_concurrency: int = 8, max_pending: int = 64):
         self._slots = threading.Semaphore(max_concurrency)
         self._mu = threading.Lock()
+        self._max_concurrency = max_concurrency
         self._max_pending = max_pending
         self._pending = 0
+        self._closed = False
+        self._idle = threading.Condition(self._mu)
         self.served = 0
         self.rejected = 0
+        self.deadline_shed = 0
         self.running = 0
         self.running_peak = 0
+        self.ema_service_time = 0.0
 
-    def run(self, fn, priority: str = "normal"):
+    def retry_after_ms(self) -> int:
+        """Backoff hint for a busy rejection: how long the CURRENT
+        queue takes to drain at the observed service rate."""
+        with self._mu:
+            return self._retry_after_ms_locked()
+
+    def _retry_after_ms_locked(self) -> int:
+        waiting = max(0, self._pending - self.running) + 1
+        ema = self.ema_service_time
+        if ema <= 0:
+            return 0
+        return max(1, int(1000.0 * ema * waiting / self._max_concurrency))
+
+    def run(self, fn, priority: str = "normal",
+            deadline: "Deadline | None" = None):
         """Execute ``fn`` under the pool's concurrency cap.
 
         Raises ServerIsBusy when the pending watermark is exceeded
-        (normal priority only — high-priority point reads always admit).
+        (normal priority only — high-priority point reads always admit)
+        and DeadlineExceeded / ServerIsBusy when ``deadline`` is already
+        expired / below the EWMA service time (deadline-aware shedding;
+        applies to every priority — an unservable point read is still
+        unservable).
         """
+        if deadline is not None:
+            deadline.check("read_pool")      # expired: typed shed
+            rem = deadline.remaining()
+            with self._mu:
+                ema = self.ema_service_time
+            if ema > 0 and rem < ema:
+                with self._mu:
+                    self.deadline_shed += 1
+                    self.rejected += 1
+                DEADLINE_SHED_COUNTER.labels("read_pool_predict").inc()
+                raise ServerIsBusy(
+                    f"remaining budget {rem * 1e3:.1f}ms < ema service "
+                    f"time {ema * 1e3:.1f}ms",
+                    retry_after_ms=self.retry_after_ms())
         with self._mu:
+            if self._closed:
+                raise ServerIsBusy("read pool shut down")
             if priority != "high" and self._pending >= self._max_pending:
                 self.rejected += 1
                 raise ServerIsBusy(
-                    f"{self._pending} reads pending (max {self._max_pending})")
+                    f"{self._pending} reads pending (max "
+                    f"{self._max_pending})",
+                    retry_after_ms=self._retry_after_ms_locked())
             self._pending += 1
             self._publish_gauges()
         try:
@@ -67,16 +127,39 @@ class ReadPool:
                     self.running_peak = max(self.running_peak,
                                             self.running)
                     self._publish_gauges()
+                t0 = time.perf_counter()
                 try:
                     return fn()
                 finally:
+                    dt = time.perf_counter() - t0
                     with self._mu:
                         self.running -= 1
+                        self.ema_service_time = dt if \
+                            self.ema_service_time == 0.0 else \
+                            (self.EMA_ALPHA * dt + (1 - self.EMA_ALPHA)
+                             * self.ema_service_time)
+                        READ_POOL_EMA_GAUGE.set(self.ema_service_time)
                         self._publish_gauges()
         finally:
             with self._mu:
                 self._pending -= 1
                 self._publish_gauges()
+                if self._pending == 0:
+                    self._idle.notify_all()
+
+    def shutdown(self, timeout: float = 5.0) -> bool:
+        """Stop admitting and wait for in-flight reads to drain (node
+        stop(): restarted-in-process nodes must not leave reads running
+        against a torn-down storage stack).  → True when idle."""
+        deadline = time.monotonic() + timeout
+        with self._mu:
+            self._closed = True
+            while self._pending > 0:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                self._idle.wait(rem)
+        return True
 
     def _publish_gauges(self) -> None:
         """Caller holds the lock.  'pending' exposes tasks WAITING for
@@ -84,6 +167,15 @@ class ReadPool:
         on merely-executing reads."""
         READ_POOL_RUNNING_GAUGE.set(self.running)
         READ_POOL_PENDING_GAUGE.set(max(0, self._pending - self.running))
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"running": self.running,
+                    "pending": max(0, self._pending - self.running),
+                    "served": self.served, "rejected": self.rejected,
+                    "deadline_shed": self.deadline_shed,
+                    "ema_service_time_ms":
+                        round(self.ema_service_time * 1e3, 3)}
 
 
 class CompletionPool:
@@ -104,9 +196,10 @@ class CompletionPool:
     a multi-MB transfer.  Results ride stdlib
     ``concurrent.futures.Future``s (only the priority queue is custom).
 
-    ``shutdown()`` drains queued tasks and retires the workers — owners
-    that come and go (server nodes restarted in-process, per-test
-    endpoints) must call it or leak ``workers`` parked threads each.
+    ``shutdown()`` drains queued tasks, retires the workers, and JOINS
+    them — owners that come and go (server nodes restarted in-process,
+    per-test endpoints) must call it or leak ``workers`` parked threads
+    each.
     """
 
     def __init__(self, workers: int = 4):
@@ -115,6 +208,7 @@ class CompletionPool:
         self._cv = threading.Condition(self._mu)
         self._high: list = []
         self._normal: list = []
+        self._threads: list = []
         self._started = False
         self._shutdown = False
         self.completed = 0
@@ -132,16 +226,22 @@ class CompletionPool:
             if not self._started:
                 self._started = True
                 for i in range(self._workers):
-                    threading.Thread(target=self._worker, daemon=True,
-                                     name=f"copr-completion-{i}").start()
+                    t = threading.Thread(target=self._worker, daemon=True,
+                                         name=f"copr-completion-{i}")
+                    self._threads.append(t)
+                    t.start()
             self._cv.notify()
         return fut
 
-    def shutdown(self) -> None:
-        """Stop accepting work; workers finish the queue, then exit."""
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Stop accepting work; workers finish the queue, then exit —
+        joined here so a stop() caller observes zero leaked threads."""
         with self._mu:
             self._shutdown = True
             self._cv.notify_all()
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout)
 
     def _worker(self) -> None:
         while True:

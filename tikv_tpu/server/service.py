@@ -65,7 +65,9 @@ class KvService:
             return {"error": wire.enc_error(e)}
 
     def handle(self, method: str, req: dict) -> dict:
+        from ..utils import deadline as dl_mod
         from ..utils import metrics as m
+        from ..utils.deadline import Deadline, DeadlineExceeded
         if self.paused:
             # ServiceEvent.PAUSE_GRPC (components/service): reject
             # instead of queueing — clients back off and retry
@@ -74,39 +76,72 @@ class KvService:
         fn = getattr(self, method, None)
         if fn is None:
             return {"error": {"kind": "unimplemented", "method": method}}
+        # deadline admission (overload defense): the request carries its
+        # REMAINING budget at send time; work that is dead on arrival is
+        # shed before touching the read pool or the resource bucket
+        dl = None
+        budget = req.get("deadline_ms") if isinstance(req, dict) else None
+        if budget is not None:
+            dl = Deadline.after_ms(budget)
+            try:
+                dl.check("admission")
+            except DeadlineExceeded as e:
+                m.GRPC_MSG_COUNTER.labels(method, "err").inc()
+                return {"error": wire.enc_error(e)}
         # resource-control admission: the group's token bucket throttles
         # BEFORE the request runs (resource_control ResourceLimiter);
         # a second charge after the response covers the bytes touched
-        group = req.get("resource_group") if isinstance(req, dict)             else None
+        group = req.get("resource_group") if isinstance(req, dict) \
+            else None
         rgm = self.node.resource_groups
         rgm.charge_request(group)
         prio = _READ_METHODS.get(method)
         t0 = time.perf_counter()
-        if prio is not None:
-            # per-request tracker (components/tracker/src/lib.rs): every
-            # layer below attributes wall/wait/scan into it; the
-            # accumulated TimeDetail/ScanDetail return on the wire
-            tr, tok = tracker.install()
-            try:
-                resp = self._guard(
-                    lambda r: self.read_pool.run(lambda: fn(r), prio), req)
-                d = resp.pop("__deferred", None) \
-                    if isinstance(resp, dict) else None
-                if d is not None:
-                    # async copr: the read-pool slot covered only the
-                    # dispatch; the D2H fetch resolves on the endpoint's
-                    # completion pool while THIS thread parks here — N
-                    # in-flight requests overlap their device round
-                    # trips, and point reads keep getting slots
+        # the deadline rides a thread-local so the executor pipeline
+        # (between batches) and the device dispatch path can shed
+        # without a parameter through every layer
+        dl_tok = dl_mod.install(dl) if dl is not None else None
+        try:
+            if prio is not None:
+                # per-request tracker (components/tracker/src/lib.rs):
+                # every layer below attributes wall/wait/scan into it;
+                # the accumulated TimeDetail/ScanDetail return on the
+                # wire
+                tr, tok = tracker.install()
+                try:
                     resp = self._guard(
-                        lambda _r: self._enc_cop_resp(d.wait()), req)
-            finally:
-                tracker.uninstall(tok)
-            if isinstance(resp, dict) and "error" not in resp:
-                resp.setdefault("time_detail", tr.time_detail())
-                resp.setdefault("scan_detail", tr.scan_detail())
-        else:
-            resp = self._guard(fn, req)
+                        lambda r: self.read_pool.run(
+                            lambda: fn(r), prio, deadline=dl), req)
+                    d = resp.pop("__deferred", None) \
+                        if isinstance(resp, dict) else None
+                    if d is not None:
+                        # async copr: the read-pool slot covered only
+                        # the dispatch; the D2H fetch resolves on the
+                        # endpoint's completion pool while THIS thread
+                        # parks here — N in-flight requests overlap
+                        # their device round trips, and point reads
+                        # keep getting slots
+                        resp = self._guard(
+                            lambda _r: self._enc_cop_resp(d.wait()), req)
+                finally:
+                    tracker.uninstall(tok)
+                if isinstance(resp, dict) and "error" not in resp:
+                    resp.setdefault("time_detail", tr.time_detail())
+                    resp.setdefault("scan_detail", tr.scan_detail())
+            else:
+                resp = self._guard(fn, req)
+        finally:
+            if dl is not None:
+                dl_mod.uninstall(dl_tok)
+        if dl is not None and dl.expired() and \
+                isinstance(resp, dict) and not resp.get("error"):
+            # the work finished but its deadline passed mid-flight: an
+            # acknowledged response must NEVER come from already-expired
+            # work — the caller has stopped waiting; ship the typed
+            # error instead of a late answer
+            m.DEADLINE_SHED_COUNTER.labels("completion").inc()
+            resp = {"error": wire.enc_error(DeadlineExceeded(
+                "completion", overrun_ms=-dl.remaining() * 1e3))}
         nbytes = resp.get("__bytes", 0) if isinstance(resp, dict) else 0
         if not nbytes and isinstance(resp, dict):
             v = resp.get("value")
@@ -125,11 +160,27 @@ class KvService:
     # ---------------------------------------------------------- txn KV
 
     def KvGet(self, req: dict) -> dict:
+        stale = req.get("stale_read", False)
+        if stale:
+            # the stale-read safety rule: a follower may serve locally
+            # ONLY when read_ts ≤ its resolved-ts watermark — below it
+            # no new commit can appear, so the applied state answers
+            # the MVCC read exactly; above it, DataIsNotReady tells the
+            # client to fall back to the leader / ReadIndex path
+            from ..raftstore.metapb import DataIsNotReady
+            from ..storage.txn_types import encode_key
+            peer = self.node.raft_store.peer_by_key(
+                encode_key(req["key"]))
+            rts = self.node.resolved_ts.resolver(
+                peer.region.id).resolved_ts
+            if req["version"] > rts:
+                raise DataIsNotReady(peer.region.id, rts, req["version"])
         with tracker.phase("kv_read"):
             v = self.storage.get(req["key"], req["version"],
                                  tuple(req.get("bypass_locks", ())),
                                  replica_read=req.get("replica_read",
-                                                      False))
+                                                      False),
+                                 stale_read=stale)
         if v is not None:
             tracker.add_scan(1, len(v))
         return {"value": v, "not_found": v is None}
@@ -583,6 +634,25 @@ class KvService:
 
     def Status(self, req: dict) -> dict:
         return self.node.status()
+
+    def CheckLeader(self, req: dict) -> dict:
+        """Leader→follower resolved-ts propagation (components/
+        resolved_ts/advance.rs check-leader fan-out): the leader pushes
+        its published watermark plus the apply index it was computed at;
+        this follower advances a region's resolver only once its OWN
+        apply has caught up to that index (every commit the watermark
+        covers is in its applied state) and never higher than the
+        leader's value or its own pending locks — a lagging replica
+        never over-promises."""
+        out = {}
+        for ent in req.get("regions", ()):
+            rid, rts = ent["region_id"], ent["resolved_ts"]
+            peer = self.node.raft_store.peers.get(rid)
+            if peer is None or \
+                    peer.applied_engine < ent.get("applied_index", 0):
+                continue
+            out[rid] = self.node.resolved_ts.resolver(rid).advance(rts)
+        return {"advanced": out}
 
     # ---------------------------------------------- ImportSST service
     #

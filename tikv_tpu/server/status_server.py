@@ -59,6 +59,22 @@ class StatusServer:
                 elif path == "/status":
                     st = outer._node.status() if outer._node else {}
                     self._json(200, st)
+                elif path == "/health":
+                    # overload-defense rollup: slow score/trend, the
+                    # read pool's shedding counters, and the per-peer
+                    # transport breaker states
+                    node = outer._node
+                    if node is None:
+                        self._json(200, {"healthy": True})
+                        return
+                    body = dict(node.health.stats())
+                    rp = getattr(node, "read_pool", None)
+                    if rp is not None and hasattr(rp, "stats"):
+                        body["read_pool"] = rp.stats()
+                    tp = getattr(node, "transport", None)
+                    if tp is not None and hasattr(tp, "breaker_states"):
+                        body["peer_breakers"] = tp.breaker_states()
+                    self._json(200, body)
                 elif path == "/config":
                     if outer._controller is None:
                         self._json(404, {"error": "no config controller"})

@@ -138,7 +138,20 @@ def _enc_error_body(e: Exception) -> dict:
         return {"kind": "region_not_found", "region_id": e.region_id}
     from .read_pool import ServerIsBusy
     if isinstance(e, ServerIsBusy):
-        return {"kind": "server_is_busy", "reason": e.reason}
+        out = {"kind": "server_is_busy", "reason": e.reason}
+        if getattr(e, "retry_after_ms", 0):
+            # queue-depth-derived backoff hint: clients sleep THIS
+            # long instead of blind exponential jitter
+            out["retry_after_ms"] = e.retry_after_ms
+        return out
+    from ..utils.deadline import DeadlineExceeded
+    if isinstance(e, DeadlineExceeded):
+        return {"kind": "deadline_exceeded", "stage": e.stage,
+                "overrun_ms": round(e.overrun_ms, 3)}
+    from ..raftstore.metapb import DataIsNotReady
+    if isinstance(e, DataIsNotReady):
+        return {"kind": "data_is_not_ready", "region_id": e.region_id,
+                "safe_ts": e.safe_ts, "read_ts": e.read_ts}
     return {"kind": "other", "message": str(e)}
 
 

@@ -108,14 +108,16 @@ class Storage:
     # async-commit read protocol (mod.rs:626 + concurrency_manager)
 
     def get(self, key: bytes, read_ts: int,
-            bypass_locks=(), replica_read: bool = False) -> Optional[bytes]:
+            bypass_locks=(), replica_read: bool = False,
+            stale_read: bool = False) -> Optional[bytes]:
         from .txn_types import encode_key
         cm = self.concurrency_manager
         cm.update_max_ts(read_ts)
         cm.read_key_check(key, read_ts, bypass_locks)
         reader = MvccReader(self._engine.snapshot(
             SnapContext(read_ts=read_ts, key_hint=encode_key(key),
-                        replica_read=replica_read)))
+                        replica_read=replica_read,
+                        stale_read=stale_read)))
         return reader.get(key, read_ts, bypass_locks)
 
     def batch_get(self, keys: Sequence[bytes], read_ts: int,

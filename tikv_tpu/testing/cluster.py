@@ -157,13 +157,21 @@ class Cluster:
         raise RuntimeError("cluster did not quiesce")
 
     def heartbeat_pd(self) -> None:
-        """Leader peers report to PD (worker/pd.rs heartbeat loop)."""
+        """Leader peers report to PD (worker/pd.rs heartbeat loop);
+        store heartbeats carry the write-path slow score so PD's
+        slow-store scheduling sees a browned-out store."""
         for sid, store in self.stores.items():
+            n_leaders = 0
             for peer in store.peers.values():
                 if peer.is_leader():
+                    n_leaders += 1
                     self.pd.region_heartbeat(
                         peer.region, Peer(peer.meta.id, sid),
                         buckets=list(peer.buckets))
+            health = getattr(store, "health", None)
+            if health is not None:
+                self.pd.store_heartbeat(
+                    sid, {"region_count": n_leaders, **health.stats()})
 
     def tick_all(self, times: int = 1) -> None:
         for _ in range(times):

@@ -65,12 +65,21 @@ class Backoff:
         lo, hi = self.jitter
         return window * lo + self._rng.uniform(0, window * (hi - lo))
 
-    def sleep(self) -> bool:
+    def sleep(self, hint_s: Optional[float] = None) -> bool:
         """Back off once.  → False when the deadline is exhausted (the
-        caller should raise its last error instead of sleeping)."""
+        caller should raise its last error instead of sleeping).
+
+        ``hint_s``: a server-supplied retry-after (the ``retry_after_ms``
+        a busy read pool derives from its queue depth).  When given it
+        replaces the blind exponential delay — the server knows its own
+        drain rate better than our jitter schedule does — with a small
+        jitter on top so hinted retriers still decorrelate."""
         from .failpoint import fail_point
         fail_point("backoff::before_sleep")
-        delay = self.next_delay()
+        if hint_s is not None and hint_s > 0:
+            delay = hint_s * (1.0 + 0.1 * self._rng.random())
+        else:
+            delay = self.next_delay()
         rem = self.remaining()
         if rem <= 0:
             return False

@@ -1,0 +1,94 @@
+"""Request deadline propagation — the overload-defense clock.
+
+Reference: client-go budgets every request with a deadline that every
+nested RPC inherits (the same plumbing utils/backoff.py uses for retry
+schedules); kv.rs checks ``max_execution_duration`` at admission and
+the coprocessor checks it between batches.  The rule enforced here is
+fail-*fast*, not fail-late: work whose deadline has already expired is
+shed with a typed ``DeadlineExceeded`` instead of being executed, and a
+response that would land after its deadline is converted to the same
+error — an acknowledged response NEVER comes from already-expired work.
+
+The deadline travels on the wire as ``deadline_ms`` (the REMAINING
+budget at send time, not an absolute timestamp — wall clocks across
+stores need not agree).  Server-side it becomes an absolute monotonic
+point at admission and rides a thread-local so the executor pipeline
+and the device dispatch path can check it without threading a parameter
+through every layer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class DeadlineExceeded(Exception):
+    """Typed overload/shed error — stable ``deadline_exceeded`` on the
+    wire.  ``stage`` names where the work was shed (admission /
+    read_pool / executor / device_dispatch / completion)."""
+
+    def __init__(self, stage: str = "admission",
+                 overrun_ms: float = 0.0):
+        super().__init__(f"deadline exceeded at {stage} "
+                         f"(overrun {overrun_ms:.1f}ms)")
+        self.stage = stage
+        self.overrun_ms = overrun_ms
+
+
+class Deadline:
+    """An absolute time budget (monotonic clock)."""
+
+    __slots__ = ("_at",)
+
+    def __init__(self, budget_s: float):
+        self._at = time.monotonic() + budget_s
+
+    @classmethod
+    def after_ms(cls, ms: float) -> "Deadline":
+        return cls(ms / 1000.0)
+
+    def remaining(self) -> float:
+        return self._at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def check(self, stage: str) -> None:
+        rem = self.remaining()
+        if rem <= 0:
+            from .metrics import DEADLINE_SHED_COUNTER
+            DEADLINE_SHED_COUNTER.labels(stage).inc()
+            raise DeadlineExceeded(stage, overrun_ms=-rem * 1e3)
+
+    def to_wire_ms(self) -> int:
+        """Remaining budget for the next hop (≥ 0)."""
+        return max(0, int(self.remaining() * 1000))
+
+
+_local = threading.local()
+
+
+def install(d: Optional[Deadline]):
+    """Make ``d`` the current thread's deadline; returns a token for
+    uninstall() (deadlines nest across batch_commands sub-handlers)."""
+    prev = getattr(_local, "deadline", None)
+    _local.deadline = d
+    return prev
+
+
+def uninstall(token) -> None:
+    _local.deadline = token
+
+
+def current() -> Optional[Deadline]:
+    return getattr(_local, "deadline", None)
+
+
+def check_current(stage: str) -> None:
+    """Shed the calling work unit if the installed deadline expired.
+    No-op when no deadline is installed (internal/background work)."""
+    d = getattr(_local, "deadline", None)
+    if d is not None:
+        d.check(stage)

@@ -30,6 +30,9 @@ _CODES = {
     # server
     "ServerIsBusy": "KV:Server:IsBusy",
     "TimeoutError": "KV:Server:Timeout",
+    "DeadlineExceeded": "KV:Server:DeadlineExceeded",
+    "DataIsNotReady": "KV:Raftstore:DataIsNotReady",
+    "CircuitOpen": "KV:Client:CircuitOpen",
     # engine
     "CorruptionError": "KV:Engine:Corruption",
 }

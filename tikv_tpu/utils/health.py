@@ -76,9 +76,12 @@ class SlowTrend:
 
 class HealthController:
     """Store health rollup fed by the write path, reported to PD in
-    store heartbeats (worker/pd.rs) and exposed at /status."""
+    store heartbeats (worker/pd.rs) and exposed at /status + /health."""
 
-    def __init__(self, timeout_s: float = 0.1):
+    def __init__(self, timeout_s: float = 0.1, store_id: int = 0):
+        # store_id labels the process-global gauges: co-resident nodes
+        # (in-process clusters, tests) must not overwrite each other
+        self.store_id = store_id
         self.slow_score = SlowScore(timeout_s=timeout_s)
         self.slow_trend = SlowTrend()
 
@@ -87,6 +90,83 @@ class HealthController:
         self.slow_trend.record(duration_s)
 
     def stats(self) -> dict:
-        return {"slow_score": round(self.slow_score.score, 2),
-                "slow_trend": round(self.slow_trend.ratio(), 3),
+        from .metrics import SLOW_SCORE_GAUGE, SLOW_TREND_GAUGE
+        score = self.slow_score.score
+        trend = self.slow_trend.ratio()
+        SLOW_SCORE_GAUGE.labels(self.store_id).set(score)
+        SLOW_TREND_GAUGE.labels(self.store_id).set(trend)
+        return {"slow_score": round(score, 2),
+                "slow_trend": round(trend, 3),
                 "healthy": self.slow_score.healthy()}
+
+
+class CircuitOpen(Exception):
+    """A send was refused because the target's breaker is open."""
+
+    def __init__(self, target):
+        super().__init__(f"circuit open for {target}")
+        self.target = target
+
+
+class CircuitBreaker:
+    """Per-target transport circuit breaker.
+
+    Consecutive transport failures trip the breaker OPEN; after
+    ``cooldown_s`` it goes HALF-OPEN and admits exactly ONE probe at a
+    time — a success closes it, a failure re-opens (with the cooldown
+    restarting).  Logical errors from a responsive server must NOT be
+    recorded as failures: a NotLeader reply proves the store is alive.
+    """
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 1.0):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._mu = threading.Lock()
+        self._fails = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self.trips = 0
+
+    def state(self) -> str:
+        import time
+        with self._mu:
+            if self._fails < self.threshold:
+                return "closed"
+            if time.monotonic() - self._opened_at < self.cooldown_s:
+                return "open"
+            return "half_open"
+
+    def allow(self) -> bool:
+        """→ True when a send may proceed.  In half-open, only one
+        probe is admitted until it reports success/failure."""
+        import time
+        with self._mu:
+            if self._fails < self.threshold:
+                return True
+            if time.monotonic() - self._opened_at < self.cooldown_s:
+                return False
+            if self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            return True
+
+    def record_success(self) -> None:
+        with self._mu:
+            self._fails = 0
+            self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        import time
+        with self._mu:
+            self._fails += 1
+            self._probe_inflight = False
+            if self._fails >= self.threshold:
+                # trip, or re-open after a failed half-open probe — the
+                # cooldown restarts either way
+                self._opened_at = time.monotonic()
+                if self._fails == self.threshold:
+                    self.trips += 1
+
+    def stats(self) -> dict:
+        return {"state": self.state(), "consecutive_failures": self._fails,
+                "trips": self.trips}

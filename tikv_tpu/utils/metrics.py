@@ -284,6 +284,29 @@ COPR_REQ_DURATION = REGISTRY.histogram(
 COPR_CACHE_COUNTER = REGISTRY.counter(
     "tikv_coprocessor_region_cache_total",
     "region columnar cache lookups", labels=("result",))
+READ_POOL_EMA_GAUGE = REGISTRY.gauge(
+    "tikv_unified_read_pool_ema_service_seconds",
+    "EWMA of read-pool task service time (deadline shedding input)")
+DEADLINE_SHED_COUNTER = REGISTRY.counter(
+    "tikv_server_deadline_exceeded_total",
+    "requests shed because their deadline expired, by pipeline stage",
+    labels=("stage",))
+SLOW_SCORE_GAUGE = REGISTRY.gauge(
+    "tikv_server_slow_score",
+    "store slow score (1 healthy .. 100 dead-slow), PD heartbeat input",
+    labels=("store",))
+SLOW_TREND_GAUGE = REGISTRY.gauge(
+    "tikv_server_slow_trend_ratio",
+    "short/long window write latency ratio (>1 = degrading)",
+    labels=("store",))
+PEER_BREAKER_GAUGE = REGISTRY.gauge(
+    "tikv_server_peer_breaker_state",
+    "per-peer-store transport breaker (0 closed, 1 half-open, 2 open)",
+    labels=("peer_store",))
+HEDGE_COUNTER = REGISTRY.counter(
+    "tikv_client_hedged_reads_total",
+    "hedged point reads by outcome (fired / follower_won / leader_won)",
+    labels=("outcome",))
 SCHED_COMMANDS = REGISTRY.counter(
     "tikv_scheduler_commands_total", "txn scheduler commands",
     labels=("type",))
