@@ -1,0 +1,359 @@
+"""Incremental columnar cache maintenance (copr/region_cache.py +
+copr/delta.py): a delta-patched snapshot must be bit-identical to a
+full rebuild after any interleaving of inserts / updates / deletes /
+rollbacks, including lock-conflict parity, compaction, and the
+fallback-to-rebuild paths.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tikv_tpu.codec.keys import table_record_key
+from tikv_tpu.codec.row import encode_row
+from tikv_tpu.copr.delta import DeltaSink, decode_entry_ops
+from tikv_tpu.copr.region_cache import (
+    RegionColumnarCache,
+    _LineState,
+    build_region_columnar,
+)
+from tikv_tpu.kv.engine import SnapContext
+from tikv_tpu.raftstore import RaftKv
+from tikv_tpu.storage import Storage
+from tikv_tpu.storage.mvcc.errors import KeyIsLocked
+from tikv_tpu.storage.txn import commands as cmds
+from tikv_tpu.storage.txn.actions import Mutation
+from tikv_tpu.testing.cluster import Cluster
+from tikv_tpu.testing.dag import DagSelect
+from tikv_tpu.testing.fixture import int_table
+
+
+@pytest.fixture
+def rig():
+    c = Cluster(n_stores=1)
+    c.bootstrap()
+    c.start()
+    sink = DeltaSink(max_entries=4096, max_rows=1 << 16)
+    c.stores[1].coprocessor_host.register(sink)
+    cache = RegionColumnarCache(capacity=4, delta_source=sink)
+    table = int_table(2, table_id=7700)
+    return {"c": c, "sink": sink, "cache": cache, "table": table}
+
+
+def _row_key(table, h):
+    return table_record_key(table.table_id, h)
+
+
+def _mut(table, h, payload):
+    return ("put", _row_key(table, h), encode_row(payload))
+
+
+def _snap(c):
+    return c.kvs[1].snapshot(SnapContext(region_id=1))
+
+
+def _dag(c, table, ts=None):
+    return DagSelect.from_table(table, ["id", "c0", "c1"]).build(
+        start_ts=ts if ts is not None else c.pd.tso())
+
+
+def _storage(c):
+    return Storage(RaftKv(c.stores[1], driver=c._drive_until))
+
+
+def _logical(ent_or_tbl, table, dag):
+    """(handles, values, validity per col) via a full-range scan."""
+    scan = dag.executors[0]
+    src = ent_or_tbl if hasattr(ent_or_tbl, "scan_columns") else None
+    batch = src.scan_columns(scan, dag.ranges)
+    return [(c.values.tolist(), c.validity.tolist())
+            for c in batch.columns]
+
+
+def _assert_parity(c, cache, table, rig_snap=None):
+    """Delta-maintained snapshot == fresh full rebuild, bit for bit."""
+    ts = c.pd.tso()
+    dag = _dag(c, table, ts)
+    snap = _snap(c)
+    ent = cache.get(snap, dag)
+    scan = dag.executors[0]
+    tbl, safe_ts, locks = build_region_columnar(
+        snap, table.table_id, scan.columns, ts)
+    assert ent.safe_ts == safe_ts, (ent.safe_ts, safe_ts)
+    assert tuple(ent.blocking_locks) == tuple(locks)
+    got = ent.scan_columns(scan, dag.ranges)
+    want = tbl.scan_columns(scan, dag.ranges)
+    assert got.num_rows == want.num_rows
+    for gc, wc in zip(got.columns, want.columns):
+        assert gc.values.tolist() == wc.values.tolist()
+        assert gc.validity.tolist() == wc.validity.tolist()
+    assert ent.estimated_rows() == len(tbl)
+    return ent
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_delta_append_patches_without_rebuild(rig):
+    c, cache, table = rig["c"], rig["cache"], rig["table"]
+    c.txn_write([_mut(table, h, {2: h % 5, 3: h * 10})
+                 for h in range(40)])
+    ent0 = _assert_parity(c, cache, table)
+    assert cache.misses == 1 and cache.deltas == 0
+
+    c.txn_write([_mut(table, 40, {2: 1, 3: 400})])
+    ent1 = _assert_parity(c, cache, table)
+    assert cache.deltas == 1 and cache.misses == 1, \
+        "a point append must patch, not rebuild"
+    # stable lineage identity: the device feed cache anchors on it
+    assert ent1.feed_lineage is ent0.feed_lineage
+    assert ent1.feed_lineage.version == 1
+    # the old published snapshot still serves its own version
+    assert ent0.estimated_rows() == 40
+    assert ent1.estimated_rows() == 41
+
+
+def test_delta_update_delete_and_revive(rig):
+    c, cache, table = rig["c"], rig["cache"], rig["table"]
+    c.txn_write([_mut(table, h, {2: h, 3: h}) for h in range(20)])
+    _assert_parity(c, cache, table)
+    # positional update
+    c.txn_write([_mut(table, 7, {2: 70, 3: 700})])
+    ent = _assert_parity(c, cache, table)
+    assert cache.deltas == 1
+    # delete → tombstone (no rebuild)
+    c.txn_write([("delete", _row_key(table, 3), None)])
+    ent = _assert_parity(c, cache, table)
+    assert cache.deltas == 2 and cache.misses == 1
+    assert ent.estimated_rows() == 19
+    # re-insert the deleted handle → revives the tombstoned slot
+    c.txn_write([_mut(table, 3, {2: 33, 3: 333})])
+    ent = _assert_parity(c, cache, table)
+    assert ent.estimated_rows() == 20
+    assert cache.misses == 1
+
+
+def test_mid_insert_repacks_and_stays_exact(rig):
+    c, cache, table = rig["c"], rig["cache"], rig["table"]
+    c.txn_write([_mut(table, h, {2: h, 3: h}) for h in range(0, 40, 2)])
+    _assert_parity(c, cache, table)
+    c.txn_write([_mut(table, 7, {2: 7, 3: 7})])    # between 6 and 8
+    ent = _assert_parity(c, cache, table)
+    assert cache.deltas == 1 and cache.misses == 1
+    assert 7 in ent._tbl.handles.tolist()
+
+
+def test_lock_conflict_parity_under_delta(rig):
+    c, cache, table = rig["c"], rig["cache"], rig["table"]
+    c.txn_write([_mut(table, h, {2: h, 3: h}) for h in range(10)])
+    _assert_parity(c, cache, table)
+    # a blocking prewrite arrives THROUGH the delta path
+    st = _storage(c)
+    key = _row_key(table, 4)
+    lock_ts = c.pd.tso()
+    st.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", key, encode_row({2: 1, 3: 1}))], key, lock_ts))
+    dag = _dag(c, table)
+    snap = _snap(c)
+    with pytest.raises(KeyIsLocked):
+        cache.get(snap, dag)
+    # commit resolves the lock; the delta path clears it and serves
+    st.sched_txn_command(cmds.Commit([key], lock_ts, c.pd.tso()))
+    _assert_parity(c, cache, table)
+
+
+def test_rollback_advances_safe_ts_like_a_rebuild(rig):
+    c, cache, table = rig["c"], rig["cache"], rig["table"]
+    c.txn_write([_mut(table, h, {2: h, 3: h}) for h in range(8)])
+    _assert_parity(c, cache, table)
+    st = _storage(c)
+    key = _row_key(table, 2)
+    lock_ts = c.pd.tso()
+    st.sched_txn_command(cmds.Prewrite(
+        [Mutation("put", key, encode_row({2: 9, 3: 9}))], key, lock_ts))
+    st.sched_txn_command(cmds.Rollback([key], lock_ts))
+    ent = _assert_parity(c, cache, table)   # includes safe_ts parity
+    assert cache.misses == 1, "rollback must ride the delta path"
+
+
+def test_slack_exhaustion_compacts(rig, monkeypatch):
+    monkeypatch.setattr(_LineState, "SLACK_MIN", 4)
+    c, cache, table = rig["c"], rig["cache"], rig["table"]
+    c.txn_write([_mut(table, h, {2: h, 3: h}) for h in range(10)])
+    _assert_parity(c, cache, table)
+    for start in range(10, 40, 3):
+        c.txn_write([_mut(table, h, {2: h, 3: h})
+                     for h in range(start, start + 3)])
+        _assert_parity(c, cache, table)
+    assert cache.misses == 1, "growth must compact in place, not rebuild"
+    assert cache.compactions >= 1
+
+
+def test_tombstone_ratio_triggers_compaction(rig):
+    c, cache, table = rig["c"], rig["cache"], rig["table"]
+    cache._compact_ratio = 0.2
+    c.txn_write([_mut(table, h, {2: h, 3: h}) for h in range(20)])
+    _assert_parity(c, cache, table)
+    for h in range(0, 10, 2):
+        c.txn_write([("delete", _row_key(table, h), None)])
+        ent = _assert_parity(c, cache, table)
+    assert cache.compactions >= 1
+    assert ent._tbl.alive is None, "compaction must clear the mask"
+    assert cache.misses == 1
+
+
+def test_delta_log_overflow_falls_back_to_rebuild(rig):
+    c, table = rig["c"], rig["table"]
+    sink = DeltaSink(max_entries=2, max_rows=1 << 16)
+    c.stores[1].coprocessor_host.register(sink)
+    cache = RegionColumnarCache(capacity=4, delta_source=sink)
+    c.txn_write([_mut(table, h, {2: h, 3: h}) for h in range(10)])
+    dag = _dag(c, table)
+    cache.get(_snap(c), dag)
+    for h in range(10, 16):     # 6 entries through a 2-entry log
+        c.txn_write([_mut(table, h, {2: h, 3: h})])
+    _ent = cache.get(_snap(c), _dag(c, table))
+    assert cache.rebuilds == 1 and cache.deltas == 0
+    # and the rebuilt line bridges again afterwards
+    c.txn_write([_mut(table, 99, {2: 9, 3: 9})])
+    cache.get(_snap(c), _dag(c, table))
+    assert cache.deltas == 1
+
+
+def test_out_of_envelope_ops_poison_coverage():
+    class Op:
+        def __init__(self, op, cf, key=b"k", value=b""):
+            self.op, self.cf, self.key, self.value = op, cf, key, value
+
+    assert decode_entry_ops([Op("delete_range", "write")]) is None
+    assert decode_entry_ops([Op("ingest", "default")]) is None
+    assert decode_entry_ops([Op("delete", "write")]) is None
+    # CF_DEFAULT traffic alone is inert
+    rows, locks = decode_entry_ops([Op("put", "default"),
+                                    Op("delete", "default")])
+    assert rows == [] and locks == []
+
+
+def test_epoch_change_falls_back_to_fresh_line(rig):
+    c, cache, table = rig["c"], rig["cache"], rig["table"]
+    c.txn_write([_mut(table, h, {2: h, 3: h}) for h in range(30)])
+    _assert_parity(c, cache, table)
+    from tikv_tpu.storage.txn_types import encode_key
+    c.split_region(1, encode_key(_row_key(table, 15)))
+    # region 1 now covers only the low half; its epoch bumped → the old
+    # line's key never matches again, a fresh build serves correctly
+    ts = c.pd.tso()
+    dag = _dag(c, table, ts)
+    snap = _snap(c)
+    ent = cache.get(snap, dag)
+    scan = dag.executors[0]
+    tbl, safe_ts, _locks = build_region_columnar(
+        snap, table.table_id, scan.columns, ts)
+    assert ent.estimated_rows() == len(tbl) == 15
+    assert cache.misses == 2 and cache.deltas == 0
+
+
+def test_big_value_delta_fetches_default_cf(rig):
+    """Rows whose payload spills to CF_DEFAULT (> SHORT_VALUE_MAX_LEN)
+    arrive through the delta path with short_value=None — the patcher
+    must fetch the spilled payload from the snapshot it bridges to."""
+    from tikv_tpu.testing.fixture import product_table
+    c, cache = rig["c"], rig["cache"]
+    table = product_table()
+
+    def prow(h, name: bytes, count: int):
+        return ("put", _row_key(table, h),
+                encode_row({2: name, 3: count}))
+
+    def check():
+        ts = c.pd.tso()
+        dag = DagSelect.from_table(
+            table, ["id", "name", "count"]).build(start_ts=ts)
+        snap = _snap(c)
+        ent = cache.get(snap, dag)
+        scan = dag.executors[0]
+        tbl, safe_ts, _ = build_region_columnar(
+            snap, table.table_id, scan.columns, ts)
+        got = ent.scan_columns(scan, dag.ranges)
+        want = tbl.scan_columns(scan, dag.ranges)
+        assert got.num_rows == want.num_rows
+        for gc, wc in zip(got.columns, want.columns):
+            assert gc.values.tolist() == wc.values.tolist()
+        assert ent.safe_ts == safe_ts
+        return ent
+
+    c.txn_write([prow(h, b"n%d" % h, h) for h in range(10)])
+    check()
+    big = b"x" * 600                            # > SHORT_VALUE_MAX_LEN
+    c.txn_write([prow(10, big, 10)])            # spilled append
+    c.txn_write([prow(3, big + b"y", 33)])      # spilled update
+    ent = check()
+    assert cache.deltas >= 1 and cache.misses == 1
+    assert ent._tbl.columns[2].values[3] == big + b"y"
+
+
+# ------------------------------------------------------------ property
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_delta_vs_rebuild_randomized(rig, monkeypatch, seed):
+    """>= 200 randomized rounds (2 seeds x 100): random interleavings of
+    multi-row inserts/updates/deletes plus rollbacks, under forced
+    small slack (growth/compaction) and an aggressive tombstone ratio.
+    Every round's delta-maintained view must be bit-identical to a
+    fresh rebuild."""
+    monkeypatch.setattr(_LineState, "SLACK_MIN", 8)
+    c, cache, table = rig["c"], rig["cache"], rig["table"]
+    cache._compact_ratio = 0.3
+    rng = np.random.default_rng(seed)
+    live: set = set()
+
+    # seed rows + first build
+    first = [int(h) for h in rng.choice(200, size=30, replace=False)]
+    c.txn_write([_mut(table, h, {2: h % 7, 3: h}) for h in first])
+    live.update(first)
+    _assert_parity(c, cache, table)
+
+    st = _storage(c)
+    for _round in range(100):
+        muts = []
+        kind = rng.random()
+        if kind < 0.45 or not live:
+            # insert burst: mix of appends (above max) and mid-inserts
+            base = max(live) + 1 if live and rng.random() < 0.5 else 0
+            for _ in range(int(rng.integers(1, 4))):
+                h = int(base + rng.integers(0, 300))
+                if h not in live:
+                    muts.append(_mut(table, h, {2: h % 7, 3: h}))
+                    live.add(h)
+        elif kind < 0.7:
+            for h in rng.choice(sorted(live),
+                                size=min(len(live),
+                                         int(rng.integers(1, 4))),
+                                replace=False):
+                v = int(rng.integers(0, 1000))
+                muts.append(_mut(table, int(h), {2: v % 7, 3: v}))
+        elif kind < 0.9:
+            for h in rng.choice(sorted(live),
+                                size=min(len(live),
+                                         int(rng.integers(1, 3))),
+                                replace=False):
+                muts.append(("delete", _row_key(table, int(h)), None))
+                live.discard(int(h))
+        else:
+            # prewrite + rollback: no visible change, safe_ts advances
+            h = int(rng.choice(sorted(live)))
+            key = _row_key(table, h)
+            ts = c.pd.tso()
+            st.sched_txn_command(cmds.Prewrite(
+                [Mutation("put", key, encode_row({2: 0, 3: 0}))],
+                key, ts))
+            st.sched_txn_command(cmds.Rollback([key], ts))
+        if muts:
+            c.txn_write(muts)
+        ent = _assert_parity(c, cache, table)
+        assert ent.estimated_rows() == len(live)
+    # the overwhelming majority of rounds must ride the delta path
+    assert cache.deltas >= 80, (cache.deltas, cache.misses,
+                                cache.rebuilds)

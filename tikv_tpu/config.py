@@ -73,6 +73,14 @@ class CoprocessorConfig:
     region_cache_capacity: int = 8
     # paged response budget (endpoint.rs paging)
     response_page_rows: int = 1 << 20
+    # incremental columnar cache maintenance (copr/region_cache.py):
+    # per-region committed-write delta log bounds — a data-version gap
+    # wider than the retained log rebuilds instead of patching
+    delta_log_entries: int = 1024
+    delta_log_rows: int = 1 << 16
+    # compact a delta-maintained line when pending delete tombstones
+    # exceed this fraction of its rows
+    tombstone_compact_ratio: float = 0.25
 
 
 @dataclass
@@ -152,6 +160,7 @@ _ONLINE_FIELDS = {
     "coprocessor.device_row_threshold",
     "coprocessor.region_cache_capacity",
     "coprocessor.response_page_rows",
+    "coprocessor.tombstone_compact_ratio",
     "readpool.concurrency",
 }
 

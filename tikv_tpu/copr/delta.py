@@ -1,0 +1,252 @@
+"""Committed-write delta feed for incremental columnar cache maintenance.
+
+Reference precedents: the region cache engine keeps hot ranges
+query-ready across writes by OBSERVING the apply path instead of
+re-scanning (components/region_cache_memory_engine/src/write_batch.rs —
+RegionCacheWriteBatch mirrors every engine write into the in-memory
+engine), and CDC's observer turns applied raft entries back into logical
+row events (components/cdc/src/observer.rs).  Here the two combine: a
+:class:`DeltaSink` registers with the raftstore's CoprocessorHost and
+turns each applied data entry's raw WriteOps into logical row/lock
+deltas, logged per region in apply order.  ``RegionColumnarCache``
+consumes the log to patch a cached ``ColumnarTable`` forward across a
+``data_index`` gap instead of discarding it (copr/region_cache.py).
+
+Delta protocol (one record per committed CF_WRITE version):
+
+- ``RowDelta(kind="put")``    — a committed row version at ``commit_ts``;
+  the payload is ``short_value`` when inlined, else it lives in
+  CF_DEFAULT at ``(enc_key, start_ts)`` (the patcher fetches it from the
+  snapshot it is bridging toward);
+- ``RowDelta(kind="delete")`` — a delete tombstone at ``commit_ts``;
+- ``RowDelta(kind="advance")``— a ROLLBACK/LOCK write record: no visible
+  data change, but it advances the region's ``safe_ts`` watermark
+  exactly as a full rebuild would observe it;
+- ``LockDelta``               — CF_LOCK put/delete; ``lock`` is the new
+  blocking lock or None (released / replaced by a non-blocking type).
+
+Coverage contract: a cache line at data version I may be bridged to J
+iff ``deltas_between(region, I, J)`` returns non-None — the log then
+holds EVERY data write in (I, J].  Anything that breaks that guarantee
+(log overflow, an op outside the envelope such as delete_range / SST
+ingest / CF_WRITE deletes from GC, a snapshot apply replacing region
+data wholesale) poisons coverage so the cache falls back to a rebuild.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from ..engine.traits import CF_DEFAULT, CF_LOCK, CF_WRITE
+from ..raftstore.observer import Observer
+from ..storage.txn_types import (
+    Lock,
+    LockType,
+    Write,
+    WriteType,
+    decode_key,
+    split_ts,
+)
+
+
+@dataclass(frozen=True)
+class RowDelta:
+    """One committed CF_WRITE version, in apply order."""
+
+    enc_key: bytes              # txn-encoded user key (no ts suffix)
+    user_key: bytes
+    commit_ts: int
+    start_ts: int
+    kind: str                   # put | delete | advance
+    short_value: Optional[bytes] = None
+
+
+@dataclass(frozen=True)
+class LockDelta:
+    """One CF_LOCK transition; ``lock`` None = no blocking lock left."""
+
+    user_key: bytes
+    lock: Optional[Lock] = None
+
+
+def decode_entry_ops(ops: Sequence):
+    """Raw applied WriteOps of ONE entry → (row_deltas, lock_deltas).
+
+    Returns None when any op falls outside the delta envelope
+    (delete_range, SST ingest, CF_WRITE deletes) — the caller must
+    poison coverage and force the consumer back to a full rebuild.
+    """
+    rows: list[RowDelta] = []
+    locks: list[LockDelta] = []
+    try:
+        for op in ops:
+            if op.op == "put":
+                if op.cf == CF_WRITE:
+                    enc, commit_ts = split_ts(op.key)
+                    w = Write.from_bytes(op.value)
+                    if w.write_type is WriteType.PUT:
+                        kind = "put"
+                    elif w.write_type is WriteType.DELETE:
+                        kind = "delete"
+                    else:       # ROLLBACK / LOCK: safe_ts watermark only
+                        kind = "advance"
+                    rows.append(RowDelta(enc, decode_key(enc), commit_ts,
+                                         w.start_ts, kind, w.short_value))
+                elif op.cf == CF_LOCK:
+                    lock = Lock.from_bytes(op.value)
+                    blocking = lock.lock_type in (LockType.PUT,
+                                                  LockType.DELETE)
+                    locks.append(LockDelta(decode_key(op.key),
+                                           lock if blocking else None))
+                elif op.cf == CF_DEFAULT:
+                    pass        # big value: fetched from the snapshot
+                else:
+                    return None
+            elif op.op == "delete":
+                if op.cf == CF_LOCK:
+                    locks.append(LockDelta(decode_key(op.key), None))
+                elif op.cf == CF_DEFAULT:
+                    pass        # value GC rides behind a CF_WRITE delete
+                else:
+                    # CF_WRITE deletes (GC / rollback collapse) can in
+                    # principle drop the NEWEST version — out of envelope
+                    return None
+            else:               # delete_range / ingest
+                return None
+    except Exception:           # noqa: BLE001 — undecodable op: poison
+        return None
+    return rows, locks
+
+
+class _RegionLog:
+    __slots__ = ("log", "covered_from", "rows")
+
+    def __init__(self):
+        # (index, tuple[RowDelta], tuple[LockDelta]) in apply order
+        self.log: deque = deque()
+        # a bridge from version I is sound iff I >= covered_from; None =
+        # coverage unknown (poisoned) until the next applied data write
+        self.covered_from: Optional[int] = None
+        self.rows = 0           # total RowDelta records retained
+
+
+class DeltaSink(Observer):
+    """Per-region committed-write delta log fed by the apply path.
+
+    Thread-safe: the apply pool / drive thread appends via observer
+    callbacks; coprocessor handler threads read via
+    :meth:`deltas_between`.  Bounded by ``max_entries`` applied entries
+    and ``max_rows`` row deltas per region — overflow drops the oldest
+    entries and advances ``covered_from`` so a stale line rebuilds
+    instead of silently skipping writes.
+    """
+
+    def __init__(self, max_entries: int = 1024, max_rows: int = 1 << 16,
+                 max_regions: int = 512):
+        self.max_entries = max_entries
+        self.max_rows = max_rows
+        # destroyed/merged-away regions get no teardown callback, so the
+        # region map is an LRU: cold regions (no applied write recently)
+        # evict wholesale — a revived one just rebuilds once
+        self.max_regions = max_regions
+        from collections import OrderedDict as _OD
+        self._regions: "_OD[int, _RegionLog]" = _OD()
+        self._mu = threading.Lock()
+
+    # -- observer events ------------------------------------------------
+
+    def on_apply_write(self, region_id: int, index: int,
+                       ops: Sequence) -> None:
+        dec = decode_entry_ops(ops)
+        with self._mu:
+            st = self._regions.setdefault(region_id, _RegionLog())
+            if dec is None:
+                # out-of-envelope entry: everything at or before it is
+                # unbridgeable, later writes re-cover from here
+                st.log.clear()
+                st.rows = 0
+                st.covered_from = index
+                self._export_depth(region_id, st)
+                return
+            rows, locks = dec
+            if st.covered_from is None:
+                # first write after process start / a wholesale data
+                # replacement: the state at index-1 is exactly what any
+                # snapshot stamped below this entry reflects
+                st.covered_from = index - 1
+            st.log.append((index, tuple(rows), tuple(locks)))
+            st.rows += len(rows)
+            while len(st.log) > self.max_entries or \
+                    st.rows > self.max_rows:
+                old_index, old_rows, _ = st.log.popleft()
+                st.rows -= len(old_rows)
+                st.covered_from = old_index
+            self._regions.move_to_end(region_id)
+            while len(self._regions) > self.max_regions:
+                dead_id, _st = self._regions.popitem(last=False)
+                self._drop_gauges(dead_id)
+            self._export_depth(region_id, st)
+
+    def on_data_replaced(self, region_id: int, index: int) -> None:
+        """Region data replaced wholesale (snapshot apply): nothing
+        logged before this covers the new state."""
+        with self._mu:
+            st = self._regions.setdefault(region_id, _RegionLog())
+            st.log.clear()
+            st.rows = 0
+            st.covered_from = index
+            self._export_depth(region_id, st)
+
+    # -- consumer API ---------------------------------------------------
+
+    def deltas_between(self, region_id: int, from_index: int,
+                       to_index: int):
+        """Row/lock deltas of every data write in (from_index, to_index]
+        in apply order, or None when coverage cannot be proven."""
+        with self._mu:
+            st = self._regions.get(region_id)
+            if st is None or st.covered_from is None or \
+                    from_index < st.covered_from:
+                return None
+            rows: list = []
+            locks: list = []
+            top = None
+            for index, r, lk in st.log:
+                if from_index < index <= to_index:
+                    rows.extend(r)
+                    locks.extend(lk)
+                    top = index
+            if to_index > from_index and top != to_index:
+                # the target version's own entry is missing (e.g. the
+                # stamp came from a path the sink never saw)
+                return None
+            return rows, locks
+
+    def depth(self, region_id: int) -> int:
+        with self._mu:
+            st = self._regions.get(region_id)
+            return len(st.log) if st is not None else 0
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "regions": len(self._regions),
+                "entries": sum(len(st.log)
+                               for st in self._regions.values()),
+                "rows": sum(st.rows for st in self._regions.values()),
+            }
+
+    @staticmethod
+    def _export_depth(region_id: int, st: _RegionLog) -> None:
+        from ..utils.metrics import COPR_DELTA_LOG_DEPTH
+        COPR_DELTA_LOG_DEPTH.labels(str(region_id)).set(len(st.log))
+
+    @staticmethod
+    def _drop_gauges(region_id: int) -> None:
+        from ..utils.metrics import COPR_DELTA_LOG_DEPTH, \
+            COPR_TOMBSTONE_RATIO
+        COPR_DELTA_LOG_DEPTH.remove(str(region_id))
+        COPR_TOMBSTONE_RATIO.remove(str(region_id))

@@ -2,32 +2,56 @@
 
 Reference precedents: the in-memory region cache engine layered over the
 persistent store (components/region_cache_memory_engine/src/lib.rs —
-RangeCacheMemoryEngine) and the coprocessor response cache keyed by
-region epoch / apply state (src/coprocessor/cache.rs).  The TikvStorage
-adapter (src/coprocessor/dag/storage_impl.rs:36-77) hands the executor
-pipeline MVCC-resolved rows; here the same resolution happens ONCE per
-region data version and materializes *columnar* arrays, so both the host
+RangeCacheMemoryEngine, whose write batch MIRRORS applied writes into
+the cached range instead of invalidating it) and the coprocessor
+response cache keyed by region epoch / apply state
+(src/coprocessor/cache.rs).  The TikvStorage adapter
+(src/coprocessor/dag/storage_impl.rs:36-77) hands the executor pipeline
+MVCC-resolved rows; here the same resolution happens ONCE per region
+data version and materializes *columnar* arrays, so both the host
 vectorized path and the TPU device runner consume dense tiles instead of
 a per-row Python decode loop (SURVEY.md §7 "Decode on the hot path").
 
-Cache key = (region id, epoch version, data_index, table id, columns):
-``data_index`` is the last applied data-mutating raft entry
-(raftstore/peer.py stamps it on every RegionSnapshot), so any write to
-the region invalidates; read barriers do not.  Entry reuse across
-read_ts values is safe when ``read_ts >= safe_ts`` (max commit_ts of any
-version in range at build time) for BOTH the build and the request —
-then both see the newest committed version of every key.
+Cache lines are keyed (region id, epoch version, table id, columns) and
+stamped with ``data_index`` — the last applied data-mutating raft entry
+(raftstore/peer.py stamps it on every RegionSnapshot; read barriers and
+leader noops do not bump it).  A write no longer discards the line:
+**incremental view maintenance** patches it forward.  The raft apply
+path publishes each applied entry's committed write deltas to a
+registered :class:`~tikv_tpu.copr.delta.DeltaSink`; on a ``data_index``
+gap the cache replays them onto the cached ``ColumnarTable`` —
 
-Pending blocking locks do NOT affect the committed version set, so the
-build proceeds under them and records them; each request then checks
-only the locks inside ITS key ranges against its read_ts (matching the
-row scanner's range-scoped conflict semantics) and raises KeyIsLocked
-exactly when the row path would.
+- new handles append into reserved slack capacity (in place: published
+  snapshots view only their own row prefix),
+- existing rows update positionally (copy-on-write of the column
+  buffers, so in-flight scans of the previous snapshot never tear),
+- deletes tombstone via an alive-mask (copy-on-write of the mask),
+- ``safe_ts`` advances over every new CF_WRITE version (ROLLBACK/LOCK
+  records included, matching what a rebuild would observe) and
+  ``blocking_locks`` refresh from CF_LOCK transitions,
 
-The returned ``MvccColumnarSnapshot`` has stable object identity for a
-given data version, which is exactly what the device runner's HBM feed
-cache keys on (device/runner.py _feed_cache) — repeat queries skip both
-decode and H2D transfer.
+and the line compacts (drops tombstones, restores slack) when the
+tombstone ratio crosses ``compact_ratio`` or slack runs out.  Fallback
+to a full rebuild happens on epoch change (key miss), schema mismatch
+(key miss), delta-log overflow / coverage loss, out-of-envelope ops
+(delete_range, SST ingest, GC write-CF deletes), oversized delta
+batches, or wholesale data replacement (snapshot apply).
+
+Entry reuse across read_ts values is safe when ``read_ts >= safe_ts``
+for BOTH the build and the request — then both see the newest committed
+version of every key.  Pending blocking locks do NOT affect the
+committed version set, so builds and patches proceed under them and
+record them; each request then checks only the locks inside ITS key
+ranges against its read_ts (matching the row scanner's range-scoped
+conflict semantics) and raises KeyIsLocked exactly when the row path
+would.
+
+Each line owns a :class:`FeedLineage` — a patch journal with stable
+object identity across delta generations.  The device runner keys its
+HBM feed cache on it (device/runner.py _feed_cache) and replays the
+journal's dirty row spans with chunked ``device_put`` +
+``dynamic_update_slice`` instead of re-uploading the whole feed, so a
+point write costs a tile patch, not a cold feed.
 """
 
 from __future__ import annotations
@@ -35,6 +59,8 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 from typing import Optional, Sequence
+
+import numpy as np
 
 from ..codec import decode_record_handle, decode_row
 from ..codec.keys import table_record_range
@@ -46,6 +72,7 @@ from ..storage.mvcc.reader import _PAST_VERSIONS, MvccReader, \
 from ..storage.txn_types import (
     Lock,
     LockType,
+    append_ts,
     decode_key,
     encode_key,
     split_ts,
@@ -120,7 +147,6 @@ def _build_native(snap, table_id: int, col_infos: Sequence, read_ts: int):
         # tags): the interpreted path is the behavioral reference
         return None
 
-    import numpy as np
     n = out["n"]
     handles = np.frombuffer(out["handles"], dtype=np.int64)
     columns: dict = {}
@@ -129,32 +155,84 @@ def _build_native(snap, table_id: int, col_infos: Sequence, read_ts: int):
     for col_id, kind, payload, validity in out["cols"]:
         valid = np.frombuffer(validity, dtype=np.bool_)
         if kind == 2:
+            # one C-level pass into the object array; the builder sets a
+            # bytes payload exactly where validity is set, so the NULL
+            # backfill is a vectorized masked store, not a Python loop
             values = np.empty(n, dtype=object)
-            for i, b in enumerate(payload):
-                values[i] = b if b is not None else b""
+            values[:] = payload
+            if not valid.all():
+                values[~valid] = b""
         else:
             values = np.frombuffer(payload, dtype=np_dtypes[kind])
         et = next(info.field_type.eval_type for info in col_infos
                   if not info.is_pk_handle and info.col_id == col_id)
         col = Column(et, values, valid)
         columns[col_id] = col
-        by_id[col_id] = (kind, payload, col)
-    # big values (> SHORT_VALUE_MAX_LEN) live in CF_DEFAULT: patch rows
-    for row, start_ts, user_key in out["need_default"]:
-        from ..storage.txn_types import append_ts
-        v = snap.get_value_cf(CF_DEFAULT,
-                              append_ts(encode_key(user_key), start_ts))
-        assert v is not None, \
-            f"default CF missing for {user_key!r}@{start_ts}"
-        payload_row = decode_row(v)
-        for col_id, (kind, payload, col) in by_id.items():
-            pv = payload_row.get(col_id)
-            if pv is None:
+        by_id[col_id] = col
+    # big values (> SHORT_VALUE_MAX_LEN) live in CF_DEFAULT: batch the
+    # lookups (one bulk range fetch when the spill set is large, point
+    # gets otherwise) and scatter per COLUMN with fancy indexing instead
+    # of a per-row × per-column Python dict loop
+    need = out["need_default"]
+    if need:
+        fetched = _fetch_default_values(snap, table_id, need)
+        if fetched is None:
+            return None     # a spilled value vanished: rebuild row path
+        per_col: dict = {cid: ([], []) for cid in by_id}
+        for (row, _start_ts, _user_key), raw in zip(need, fetched):
+            payload_row = decode_row(raw)
+            for col_id, pv in payload_row.items():
+                slot = per_col.get(col_id)
+                if slot is not None and pv is not None:
+                    slot[0].append(row)
+                    slot[1].append(pv)
+        for col_id, (rows_idx, vals_list) in per_col.items():
+            if not rows_idx:
                 continue
-            col.values[row] = pv
-            col.validity[row] = True
+            col = by_id[col_id]
+            idx = np.asarray(rows_idx, dtype=np.int64)
+            if col.values.dtype == object:
+                for i, v in zip(rows_idx, vals_list):
+                    col.values[i] = v
+            else:
+                col.values[idx] = np.asarray(vals_list,
+                                             dtype=col.values.dtype)
+            col.validity[idx] = True
     tbl = ColumnarTable(_TableShim(table_id), handles, columns)
     return tbl, out["safe_ts"]
+
+
+def _fetch_default_values(snap, table_id: int, need):
+    """CF_DEFAULT payloads for the native builder's spill rows.
+
+    ``need``: [(row, start_ts, user_key)].  Small sets use point gets;
+    large sets do ONE bulk range fetch over the table's CF_DEFAULT slice
+    and index it — the per-row get path was the measured hot spot on
+    spill-heavy schemas.  Returns a list aligned with ``need`` or None
+    when any payload is missing.
+    """
+    out = []
+    rng = getattr(snap, "range_cf", None)
+    if len(need) >= 32 and rng is not None:
+        lo, hi = table_record_range(table_id)
+        got = rng(CF_DEFAULT, encode_key(lo), encode_key(hi))
+        if got is not None:
+            keys, vals, skip = got
+            by_key = {bytes(k[skip:]) if skip else bytes(k): v
+                      for k, v in zip(keys, vals)}
+            for _row, start_ts, user_key in need:
+                v = by_key.get(append_ts(encode_key(user_key), start_ts))
+                if v is None:
+                    return None
+                out.append(v)
+            return out
+    for _row, start_ts, user_key in need:
+        v = snap.get_value_cf(CF_DEFAULT,
+                              append_ts(encode_key(user_key), start_ts))
+        if v is None:
+            return None
+        out.append(v)
+    return out
 
 
 def build_region_columnar(snap, table_id: int, col_infos: Sequence,
@@ -197,7 +275,6 @@ def build_region_columnar(snap, table_id: int, col_infos: Sequence,
             rows.append(decode_row(value) if value else {})
         ok = it.seek(cur + _PAST_VERSIONS)
 
-    import numpy as np
     columns: dict = {}
     for info in col_infos:
         if info.is_pk_handle:
@@ -216,6 +293,9 @@ class MvccColumnarSnapshot:
 
     Implements the columnar scan feed (scan_columns / estimated_rows)
     consumed by executors/columnar.py and device/runner.py.
+
+    ``feed_lineage``: patch journal shared by every delta generation of
+    the same cache line — the device runner's feed-cache anchor.
     """
 
     def __init__(self, tbl: ColumnarTable, build_ts: int, safe_ts: int,
@@ -224,6 +304,15 @@ class MvccColumnarSnapshot:
         self.build_ts = build_ts
         self.safe_ts = safe_ts
         self.blocking_locks = tuple(blocking_locks)
+        self.feed_lineage = None
+        # the lineage version THIS snapshot's data reflects (a snapshot
+        # served from the line's history is older than lineage.version)
+        self.feed_version: Optional[int] = None
+        # smallest commit_ts of any LATER data delta (None = still the
+        # newest view): reads at ts BELOW it see the same visible set
+        # here as in any newer generation, so a superseded snapshot
+        # keeps serving them from the line's history under write churn
+        self.superseded_at: Optional[int] = None
 
     def valid_for(self, read_ts: int) -> bool:
         if read_ts == self.build_ts:
@@ -252,31 +341,272 @@ class MvccColumnarSnapshot:
     def row_slices(self, ranges) -> list:
         """Row-index spans covered by ``ranges`` — the device runner's
         bucket-tile mapping (request ranges → feed row spans)."""
-        return self._tbl._range_slices(ranges)
+        return self._tbl.row_slices(ranges)
 
     def estimated_rows(self) -> int:
         return len(self._tbl)
 
 
-class RegionColumnarCache:
-    """LRU of MvccColumnarSnapshot keyed by region data version.
+class FeedLineage:
+    """Bounded patch journal with stable identity across delta
+    generations of one cache line.
 
-    Thread-safe: coprocessor requests arrive on concurrent gRPC handler
-    threads; the lock also serializes duplicate builds of the same data
-    version (second requester waits and then hits).
+    The device runner weak-keys its HBM feed on this object and calls
+    :meth:`since` to learn which row spans changed between its feed's
+    version and the line's current version.  ``None`` (journal gap) or
+    any ``structural`` patch (repack, compaction, tombstones pending)
+    means the feed must re-upload from the logical view instead of
+    patching.
     """
 
-    def __init__(self, capacity: int = 8):
-        self._entries: "OrderedDict[tuple, MvccColumnarSnapshot]" = \
-            OrderedDict()
+    __slots__ = ("version", "_base", "_patches", "_max", "_mu",
+                 "__weakref__")
+
+    def __init__(self, max_patches: int = 64):
+        self.version = 0
+        self._base = 0          # version the oldest retained patch starts at
+        self._patches: list = []
+        self._max = max_patches
+        self._mu = threading.Lock()
+
+    def record(self, patch: dict) -> None:
+        with self._mu:
+            self._patches.append(patch)
+            self.version += 1
+            while len(self._patches) > self._max:
+                self._patches.pop(0)
+                self._base += 1
+
+    def since(self, version: int, until: Optional[int] = None):
+        """Patches bridging ``version`` → ``until`` (default: current),
+        oldest first, or None when the journal no longer covers that
+        span.  ``until`` pins a consumer to ITS snapshot's generation —
+        the line may advance concurrently."""
+        with self._mu:
+            top = self.version if until is None else until
+            if top > self.version or version > top or \
+                    version < self._base:
+                return None
+            return list(self._patches[version - self._base:
+                                      top - self._base])
+
+
+class _LineState:
+    """Mutable slack-capacity arrays behind one cache line.
+
+    Publish-safety invariant: rows [0, n) of every CURRENT buffer are
+    never mutated in place — positional updates and tombstones swap in
+    copied buffers (copy-on-write), appends write only into slack at
+    [n, cap).  Published snapshots hold views of the buffers current at
+    publish time, so concurrent scans never observe a torn patch.
+    """
+
+    __slots__ = ("table_id", "col_meta", "cap", "n", "n_dead", "handles",
+                 "cols", "alive", "locks", "safe_ts", "build_ts",
+                 "lineage")
+
+    SLACK_MIN = 256
+
+    def __init__(self, table_id: int, col_infos: Sequence, tbl,
+                 safe_ts: int, build_ts: int, blocking_locks):
+        self.table_id = table_id
+        # col_id -> (eval_type, default_value) for non-pk columns
+        self.col_meta = {info.col_id: (info.field_type.eval_type,
+                                       info.default_value)
+                         for info in col_infos if not info.is_pk_handle}
+        n = len(tbl.handles)
+        self.n = n
+        self.n_dead = 0
+        self.cap = n + max(self.SLACK_MIN, n >> 3)
+        self.handles = np.empty(self.cap, np.int64)
+        self.handles[:n] = tbl.handles
+        self.cols: dict = {}
+        for col_id, col in tbl.columns.items():
+            vals = np.empty(self.cap, dtype=col.values.dtype)
+            vals[:n] = col.values
+            valid = np.zeros(self.cap, np.bool_)
+            valid[:n] = col.validity
+            self.cols[col_id] = [vals, valid]
+        self.alive = None
+        self.locks = {key: lock for key, lock in blocking_locks}
+        self.safe_ts = safe_ts
+        self.build_ts = max(build_ts, safe_ts)
+        self.lineage = FeedLineage()
+
+    # -- publishing ----------------------------------------------------
+
+    def publish(self) -> MvccColumnarSnapshot:
+        n = self.n
+        columns = {cid: Column(self.col_meta[cid][0], bufs[0][:n],
+                               bufs[1][:n])
+                   for cid, bufs in self.cols.items()}
+        alive = self.alive[:n] if self.alive is not None else None
+        tbl = ColumnarTable.__new__(ColumnarTable)
+        # skip the O(n) sortedness assert of __init__: the state
+        # maintains it by construction on every patch
+        tbl.table = _TableShim(self.table_id)
+        tbl.handles = self.handles[:n]
+        tbl.columns = columns
+        tbl.alive = alive
+        tbl._n_alive = n - self.n_dead
+        snap = MvccColumnarSnapshot(
+            tbl, self.build_ts, self.safe_ts,
+            sorted(self.locks.items()))
+        snap.feed_lineage = self.lineage
+        snap.feed_version = self.lineage.version
+        return snap
+
+    # -- patch primitives ----------------------------------------------
+
+    def _pos_of(self, handle: int):
+        view = self.handles[:self.n]
+        pos = int(np.searchsorted(view, handle))
+        return pos, pos < self.n and int(view[pos]) == handle
+
+    def _payload_cols(self, payload: dict):
+        """Row payload → {col_id: (value, valid)} over the full schema
+        (an MVCC PUT replaces the whole row: absent columns revert to
+        their default/NULL)."""
+        out = {}
+        for cid, (_et, default) in self.col_meta.items():
+            v = payload.get(cid, default)
+            out[cid] = (v, v is not None)
+        return out
+
+    def _cow_columns(self) -> None:
+        for cid, bufs in self.cols.items():
+            self.cols[cid] = [bufs[0].copy(), bufs[1].copy()]
+
+    def _cow_alive(self) -> None:
+        if self.alive is None:
+            self.alive = np.ones(self.cap, np.bool_)
+        else:
+            self.alive = self.alive.copy()
+
+    def _set_row(self, pos: int, payload: dict) -> None:
+        for cid, (v, ok) in self._payload_cols(payload).items():
+            vals, valid = self.cols[cid]
+            vals[pos] = v if ok else \
+                (b"" if vals.dtype == object else 0)
+            valid[pos] = ok
+
+    def _repack(self, inserts) -> None:
+        """One vectorized pass: drop tombstones, merge ``inserts``
+        ([(handle, payload)]) at their sorted positions, restore slack.
+        Every buffer is fresh, so published snapshots are untouched."""
+        n = self.n
+        if self.alive is not None:
+            keep = self.alive[:n]
+            base_h = self.handles[:n][keep]
+        else:
+            base_h = self.handles[:n].copy()
+        ins = sorted(inserts, key=lambda kv: kv[0])
+        ins_h = np.asarray([h for h, _ in ins], dtype=np.int64)
+        pos = np.searchsorted(base_h, ins_h)
+        new_h = np.insert(base_h, pos, ins_h) if len(ins) else base_h
+        new_n = len(new_h)
+        cap = new_n + max(self.SLACK_MIN, new_n >> 3)
+        handles = np.empty(cap, np.int64)
+        handles[:new_n] = new_h
+        new_cols: dict = {}
+        for cid, (vals, valid) in self.cols.items():
+            et, default = self.col_meta[cid]
+            bv = vals[:n][keep] if self.alive is not None else vals[:n]
+            bm = valid[:n][keep] if self.alive is not None else valid[:n]
+            if len(ins):
+                iv, im = [], []
+                for _h, payload in ins:
+                    v = payload.get(cid, default)
+                    im.append(v is not None)
+                    iv.append(v if v is not None else
+                              (b"" if vals.dtype == object else 0))
+                bv = np.insert(bv, pos, np.asarray(iv, dtype=vals.dtype)
+                               if vals.dtype != object else
+                               np.fromiter(iv, dtype=object,
+                                           count=len(iv)))
+                bm = np.insert(bm, pos, np.asarray(im, dtype=np.bool_))
+            nv = np.empty(cap, dtype=vals.dtype)
+            nv[:new_n] = bv
+            nm = np.zeros(cap, np.bool_)
+            nm[:new_n] = bm
+            new_cols[cid] = [nv, nm]
+        self.handles = handles
+        self.cols = new_cols
+        self.cap = cap
+        self.n = new_n
+        self.n_dead = 0
+        self.alive = None
+
+    def tombstone_ratio(self) -> float:
+        return self.n_dead / self.n if self.n else 0.0
+
+
+def _merge_spans(positions, gap: int = 32):
+    """Sorted unique row positions → merged (lo, hi) half-open spans."""
+    spans = []
+    for p in positions:
+        if spans and p < spans[-1][1] + gap:
+            spans[-1][1] = p + 1
+        else:
+            spans.append([p, p + 1])
+    return [(lo, hi) for lo, hi in spans]
+
+
+class RegionColumnarCache:
+    """LRU of delta-maintained columnar lines keyed by
+    (region, epoch version, table, columns).
+
+    Thread-safe: coprocessor requests arrive on concurrent gRPC handler
+    threads; builds AND delta patches for one (line, data version) are
+    serialized on per-version events so a slow full-region MVCC build
+    never holds the global lock (ADVICE r2), and concurrent bridges of
+    one line serialize on the line's own mutex.
+
+    ``delta_source`` (a :class:`~tikv_tpu.copr.delta.DeltaSink`) supplies
+    committed-write deltas; without one every data-version change falls
+    back to a rebuild, which is exactly the pre-delta behavior.
+    """
+
+    def __init__(self, capacity: int = 8, delta_source=None,
+                 compact_ratio: float = 0.25,
+                 max_delta_rows: int = 1 << 16):
+        self._lines: "OrderedDict[tuple, _Line]" = OrderedDict()
         self._capacity = capacity
         self._lock = threading.Lock()
-        # key -> threading.Event for an in-flight build; waiters block on
-        # the event instead of the global lock, so a slow full-region
-        # MVCC build never serializes unrelated cache hits (ADVICE r2)
+        self._delta_source = delta_source
+        self._compact_ratio = compact_ratio
+        self._max_delta_rows = max_delta_rows
+        # (base_key, data_index) -> threading.Event for in-flight
+        # build/patch; waiters block on the event, not the global lock
         self._building: dict = {}
         self.hits = 0
-        self.misses = 0
+        self.misses = 0         # total builds (cold misses + rebuilds)
+        self.deltas = 0         # data-version gaps bridged by patching
+        self.rebuilds = 0       # gaps that fell back to a full rebuild
+        self.compactions = 0
+
+    # -- observability --------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            lines = [{
+                "region": key[0],
+                "table": key[2],
+                "data_index": line.data_index,
+                "rows": line.state.n if line.state else 0,
+                "tombstone_ratio": round(line.state.tombstone_ratio(), 4)
+                if line.state else 0.0,
+                "feed_version": line.state.lineage.version
+                if line.state else 0,
+            } for key, line in self._lines.items()]
+        out = {"hits": self.hits, "misses": self.misses,
+               "deltas": self.deltas, "rebuilds": self.rebuilds,
+               "compactions": self.compactions, "lines": lines}
+        if self._delta_source is not None:
+            out["delta_log"] = self._delta_source.stats()
+        return out
+
+    # -- lookup ---------------------------------------------------------
 
     def get(self, snap, dag) -> Optional[MvccColumnarSnapshot]:
         """Columnar snapshot for a TableScan dag over a region snapshot,
@@ -288,58 +618,384 @@ class RegionColumnarCache:
         data_index = getattr(snap, "data_index", None)
         if region is None or data_index is None:
             return None
-        key = (region.id, region.epoch.version, data_index, scan.table_id,
-               tuple((c.col_id, c.is_pk_handle, c.field_type.tp)
-                     for c in scan.columns))
+        base_key = (region.id, region.epoch.version, scan.table_id,
+                    tuple((c.col_id, c.is_pk_handle, c.field_type.tp)
+                          for c in scan.columns))
+        start_ts = dag.start_ts
+        ent = lock_src = None
         while True:
             wait_ev = None
+            line = None
             with self._lock:
-                ent = None
-                for k in (key, key + (dag.start_ts,)):
-                    got = self._entries.get(k)
-                    if got is not None and got.valid_for(dag.start_ts):
-                        self._entries.move_to_end(k)
-                        self.hits += 1
-                        from ..utils.metrics import COPR_CACHE_COUNTER
-                        COPR_CACHE_COUNTER.labels("hit").inc()
-                        from ..utils import tracker
-                        tracker.label("copr_cache", "hit")
-                        ent = got
-                        break
-                if ent is not None:
+                line = self._lines.get(base_key)
+                got = self._lookup_locked(line, data_index, start_ts)
+                if got is not None:
+                    ent, lock_src = got
+                    self._lines.move_to_end(base_key)
+                    self.hits += 1
+                    self._count("hit")
                     break
-                wait_ev = self._building.get(key)
+                bkey = (base_key, data_index)
+                wait_ev = self._building.get(bkey)
                 if wait_ev is None:
-                    # we build; others for the same key wait on the event
-                    self._building[key] = threading.Event()
-                    self.misses += 1
-                    from ..utils.metrics import COPR_CACHE_COUNTER
-                    COPR_CACHE_COUNTER.labels("miss").inc()
+                    self._building[bkey] = threading.Event()
             if wait_ev is not None:
                 wait_ev.wait()
                 continue        # re-check: the builder's entry may serve us
             try:
-                from ..utils import tracker
-                tracker.label("copr_cache", "build")
-                with tracker.phase("columnar_build"):
-                    tbl, safe_ts, locks = build_region_columnar(
-                        snap, scan.table_id, scan.columns, dag.start_ts)
-                ent = MvccColumnarSnapshot(tbl, dag.start_ts, safe_ts,
-                                           locks)
-                with self._lock:
-                    # a build at read_ts below safe_ts sees an OLD version
-                    # set — park it under an exact-ts key so it never
-                    # shadows the latest entry
-                    slot = key if dag.start_ts >= safe_ts \
-                        else key + (dag.start_ts,)
-                    self._entries[slot] = ent
-                    while len(self._entries) > self._capacity:
-                        self._entries.popitem(last=False)
+                ent, lock_src = self._materialize(
+                    snap, dag, base_key, line, data_index, start_ts)
                 break
             finally:
                 with self._lock:
-                    ev = self._building.pop(key, None)
+                    ev = self._building.pop((base_key, data_index), None)
                 if ev is not None:
                     ev.set()
-        ent.check_locks(dag.ranges, dag.start_ts)
+        lock_src.check_locks(dag.ranges, start_ts)
         return ent
+
+    def _lookup_locked(self, line, data_index: int, start_ts: int):
+        """→ (entry, lock_source) or None.  ``lock_source`` carries the
+        blocking-lock set to check the request against — the line's
+        NEWEST set when serving a superseded snapshot from history (its
+        own recorded locks are stale; the newest set is conservative:
+        any lock released since was resolved either above the read's ts
+        or via a data delta that already retired the old snapshot)."""
+        if line is None:
+            return None
+        if line.data_index == data_index and \
+                line.snap.valid_for(start_ts):
+            return line.snap, line.snap
+        # write churn: a read whose ts predates every data commit since
+        # an older generation serves that generation — same visible set,
+        # no rebuild (the data_index stamp only pins WHEN the snapshot
+        # was taken; visibility is pure ts resolution).  Only sound once
+        # the line has applied AT LEAST up to the requested version:
+        # ``superseded_at`` bounds cover applied batches only, so an
+        # unapplied gap could hide a commit at or below the read's ts.
+        if line.data_index is not None and line.data_index >= data_index:
+            for old in line.history:
+                if old.valid_for(start_ts) and (
+                        old.superseded_at is None or
+                        start_ts < old.superseded_at):
+                    return old, (line.snap if line.snap is not None
+                                 else old)
+        parked = line.parked.get((data_index, start_ts))
+        if parked is not None:
+            line.parked.move_to_end((data_index, start_ts))
+            return parked, parked
+        return None
+
+    def _count(self, result: str) -> None:
+        from ..utils import tracker
+        from ..utils.metrics import COPR_CACHE_COUNTER
+        COPR_CACHE_COUNTER.labels(result).inc()
+        tracker.label("copr_cache",
+                      {"hit": "hit", "delta": "delta"}.get(result,
+                                                           "build"))
+
+    # -- build / bridge -------------------------------------------------
+
+    def _materialize(self, snap, dag, base_key, line, data_index: int,
+                     start_ts: int):
+        from ..utils import tracker
+        scan = dag.executors[0]
+        bridged = None
+        # classify before bridging: a FAILED bridge retires line.state,
+        # and that fallback must still count as a rebuild, not a miss
+        had_state = line is not None and line.state is not None
+        if had_state and line.data_index is not None and \
+                line.data_index < data_index and \
+                self._delta_source is not None:
+            with tracker.phase("delta_apply"):
+                bridged = self._bridge(line, snap, base_key[0],
+                                       data_index)
+        if bridged is not None:
+            with self._lock:
+                if base_key in self._lines:     # may have been evicted
+                    self._lines.move_to_end(base_key)
+                self.deltas += 1
+            self._count("delta")
+            self._export_gauges(base_key[0], line)
+            if bridged.valid_for(start_ts):
+                return bridged, bridged
+            # the delta landed but this request reads below the new
+            # safe_ts — the generation it raced past may still serve it
+            # from the line's history (same visible set below the first
+            # superseding commit); locks check against the NEWEST set
+            with self._lock:
+                got = self._lookup_locked(line, data_index, start_ts)
+            if got is not None:
+                return got
+            # else: park an exact-ts build (rare: stale reader racing
+            # a fresh commit it must not see, over a gap that also
+            # contains commits it must see)
+        self.misses += 1
+        tracker.label("copr_cache", "build")
+        with tracker.phase("columnar_build"):
+            tbl, safe_ts, locks = build_region_columnar(
+                snap, scan.table_id, scan.columns, start_ts)
+        ent = MvccColumnarSnapshot(tbl, start_ts, safe_ts, locks)
+        lock_src = ent
+        with self._lock:
+            prev = self._lines.get(base_key)
+            fresh_wins = prev is None or prev.data_index is None or \
+                prev.data_index <= data_index
+            if start_ts < safe_ts or not fresh_wins:
+                # below-safe_ts builds see an OLD version set; builds
+                # raced past by a newer line serve once — both park
+                # under their exact (version, ts) so they never shadow
+                # the latest entry.  These are ts-scoped misses, NOT
+                # line rebuilds: the delta-maintained line stays.
+                result = "miss"
+                if prev is None:
+                    prev = _Line(base_key, None, None, None)
+                    self._lines[base_key] = prev
+                prev.parked[(data_index, start_ts)] = ent
+                while len(prev.parked) > 4:
+                    prev.parked.popitem(last=False)
+            else:
+                # a maintained line existed but could not be bridged —
+                # THIS is the rebuild fallback the delta path exists to
+                # avoid (log overflow / envelope / bridge failure)
+                result = "rebuild" if had_state else "miss"
+                if result == "rebuild":
+                    self.rebuilds += 1
+                state = _LineState(scan.table_id, scan.columns, tbl,
+                                   safe_ts, start_ts, locks)
+                ent = lock_src = state.publish()
+                new_line = _Line(base_key, data_index, ent, state)
+                if prev is not None:
+                    new_line.parked = prev.parked
+                self._lines[base_key] = new_line
+            self._lines.move_to_end(base_key)
+            while len(self._lines) > self._capacity:
+                self._lines.popitem(last=False)
+        self._count(result)
+        self._export_gauges(base_key[0], self._lines.get(base_key))
+        return ent, lock_src
+
+    def _export_gauges(self, region_id: int, line) -> None:
+        from ..utils.metrics import COPR_TOMBSTONE_RATIO
+        if line is not None and line.state is not None:
+            COPR_TOMBSTONE_RATIO.labels(str(region_id)).set(
+                line.state.tombstone_ratio())
+
+    # -- the delta patch ------------------------------------------------
+
+    def _bridge(self, line, snap, region_id: int, data_index: int):
+        """Bridge ``line`` forward to ``data_index``; returns the new
+        published snapshot, or None → caller falls back to rebuild.
+
+        The delta fetch happens INSIDE ``line.mu``: two threads bridging
+        the same line toward different target versions must each replay
+        exactly the gap from the line's then-current version, or a delta
+        batch would apply twice."""
+        with line.mu:
+            cur = line.data_index
+            if cur is None or cur > data_index:
+                return None
+            if cur == data_index:
+                return line.snap
+            deltas = self._delta_source.deltas_between(
+                region_id, cur, data_index)
+            if deltas is None or len(deltas[0]) > self._max_delta_rows:
+                return None
+            try:
+                published = self._apply_deltas(line.state, snap,
+                                               *deltas)
+            except Exception:   # noqa: BLE001 — any surprise: rebuild
+                import logging
+                logging.getLogger(__name__).warning(
+                    "columnar delta apply failed; falling back to "
+                    "rebuild", exc_info=True)
+                published = None
+            if published is None:
+                # the state may be part-mutated: retire it so no later
+                # bridge replays onto it (the rebuild replaces the line)
+                line.state = None
+                return None
+            published, min_data_ts = published
+            prev = line.snap
+            with self._lock:
+                if prev is not None:
+                    # the outgoing generation keeps serving reads below
+                    # the first commit that superseded it (churn path);
+                    # commit_ts order is not apply order across keys, so
+                    # EVERY older generation's bound tightens too
+                    if min_data_ts is not None:
+                        for h in (prev,) + tuple(line.history):
+                            h.superseded_at = min_data_ts if \
+                                h.superseded_at is None else \
+                                min(h.superseded_at, min_data_ts)
+                    line.history.appendleft(prev)
+                line.data_index = data_index
+                line.snap = published
+                line.parked.clear()
+            return published
+
+    def _apply_deltas(self, state: _LineState, snap, rows, locks):
+        """→ (published snapshot, min data commit_ts of the batch) or
+        None when a payload is unavailable (caller rebuilds)."""
+        lo_key, hi_key = table_record_range(state.table_id)
+        # 1. fold row deltas: safe_ts watermark + last-wins visible op
+        pending: "OrderedDict[bytes, object]" = OrderedDict()
+        min_data_ts = None
+        for d in rows:
+            if not (lo_key <= d.user_key < hi_key):
+                continue        # index keys / other tables in the region
+            if d.commit_ts > state.safe_ts:
+                state.safe_ts = d.commit_ts
+            if d.kind == "advance":
+                continue
+            if min_data_ts is None or d.commit_ts < min_data_ts:
+                min_data_ts = d.commit_ts
+            pending[d.user_key] = d
+        state.build_ts = max(state.build_ts, state.safe_ts)
+
+        # 2. resolve payloads + classify against the current rows
+        updates: list = []      # (pos, payload)
+        deletes: list = []      # pos
+        inserts: list = []      # (handle, payload)
+        revives: list = []      # (pos, payload) — tombstoned slot reused
+        for user_key, d in pending.items():
+            handle = decode_record_handle(user_key)
+            pos, present = state._pos_of(handle)
+            dead = present and state.alive is not None and \
+                not state.alive[pos]
+            if d.kind == "delete":
+                if present and not dead:
+                    deletes.append(pos)
+                continue
+            payload = self._resolve_payload(snap, d)
+            if payload is None:
+                return None     # spilled value unavailable: rebuild
+            if present:
+                (revives if dead else updates).append((pos, payload))
+            else:
+                inserts.append((handle, payload))
+
+        n0 = state.n
+        patch_spans: list = []
+        structural = False
+
+        # 3. inserts: slack append when strictly increasing past the
+        #    current max handle, else a one-pass repack (mid-insert)
+        append_only = all(
+            h > int(state.handles[n0 - 1]) for h, _ in inserts) \
+            if n0 else True
+        if inserts and (not append_only or
+                        n0 + len(inserts) > state.cap):
+            # repack folds deletes/tombstones too; positional updates
+            # must land first so the gather copies patched values
+            if updates or revives:
+                state._cow_columns()
+                for pos, payload in updates + revives:
+                    state._set_row(pos, payload)
+                if revives:
+                    state._cow_alive()
+                    for pos, _ in revives:
+                        state.alive[pos] = True
+                        state.n_dead -= 1
+            if deletes:
+                state._cow_alive()
+                for pos in deletes:
+                    state.alive[pos] = False
+                state.n_dead += len(deletes)
+            state._repack(inserts)
+            self.compactions += 1
+            structural = True
+        else:
+            if updates or revives:
+                state._cow_columns()
+                for pos, payload in updates + revives:
+                    state._set_row(pos, payload)
+                patch_spans.extend(_merge_spans(sorted(
+                    {p for p, _ in updates})))
+            if revives:
+                state._cow_alive()
+                for pos, _ in revives:
+                    state.alive[pos] = True
+                state.n_dead -= len(revives)
+                structural = True
+            if deletes:
+                state._cow_alive()
+                for pos in deletes:
+                    state.alive[pos] = False
+                state.n_dead += len(deletes)
+                structural = True
+            if inserts:
+                ins = sorted(inserts, key=lambda kv: kv[0])
+                k = len(ins)
+                state.handles[n0:n0 + k] = [h for h, _ in ins]
+                if state.alive is not None:
+                    state.alive[n0:n0 + k] = True
+                for i, (_h, payload) in enumerate(ins):
+                    state._set_row(n0 + i, payload)
+                state.n += k
+                patch_spans.append((n0, state.n))
+            # 4. compaction: tombstone ratio crossed the threshold
+            if state.alive is not None and \
+                    state.tombstone_ratio() > self._compact_ratio:
+                state._repack([])
+                self.compactions += 1
+                structural = True
+
+        if state.alive is not None and state.n_dead == 0:
+            # every tombstone was revived: drop the mask so scans are
+            # zero-copy again (the published COW mask stays with its
+            # older snapshots)
+            state.alive = None
+
+        # 5. blocking-lock refresh (range-scoped, like the build's scan)
+        for ld in locks:
+            if not (lo_key <= ld.user_key < hi_key):
+                continue
+            if ld.lock is None:
+                state.locks.pop(ld.user_key, None)
+            else:
+                state.locks[ld.user_key] = ld.lock
+
+        # 6. journal the patch for the device feed
+        if structural or state.alive is not None:
+            state.lineage.record({"structural": True, "n": state.n})
+        else:
+            spans = []
+            for lo, hi in patch_spans:
+                spans.append({
+                    "lo": lo, "hi": hi,
+                    "handles": state.handles[lo:hi].copy(),
+                    "cols": {cid: (bufs[0][lo:hi].copy(),
+                                   bufs[1][lo:hi].copy())
+                             for cid, bufs in state.cols.items()},
+                })
+            state.lineage.record({"structural": False, "n": state.n,
+                                  "spans": spans})
+        return state.publish(), min_data_ts
+
+    @staticmethod
+    def _resolve_payload(snap, d) -> Optional[dict]:
+        if d.short_value is not None:
+            return decode_row(d.short_value) if d.short_value else {}
+        v = snap.get_value_cf(CF_DEFAULT, append_ts(d.enc_key,
+                                                    d.start_ts))
+        if v is None:
+            return None
+        return decode_row(v)
+
+
+class _Line:
+    __slots__ = ("key", "data_index", "snap", "state", "parked",
+                 "history", "mu")
+
+    def __init__(self, key, data_index, snap, state):
+        self.key = key
+        self.data_index = data_index
+        self.snap = snap
+        self.state = state
+        self.parked: "OrderedDict" = OrderedDict()
+        # recently superseded generations, newest first: each serves
+        # reads below its ``superseded_at`` without a rebuild
+        from collections import deque
+        self.history: "deque" = deque(maxlen=4)
+        self.mu = threading.Lock()

@@ -96,6 +96,23 @@ class _FallbackToHost(Exception):
     """Raised when a runtime property (not the plan) forces the host path."""
 
 
+def _fits_dtype(vals: np.ndarray, valid, dt: np.dtype) -> bool:
+    """May ``vals`` be represented in the feed's established device
+    dtype?  Floats narrow exactly like a fresh astype would; ints must
+    fit the integer range (and uint64 stays below 2^63 — the same feed
+    guard that routes beyond-int64 cores to the host)."""
+    if dt.kind not in "iu":
+        return True
+    live = vals if valid is None or valid.all() else vals[valid]
+    if not live.size:
+        return True
+    lo, hi = int(live.min()), int(live.max())
+    if dt == np.dtype(np.uint64):
+        return 0 <= lo and hi < (1 << 63)
+    info = np.iinfo(dt)
+    return info.min <= lo and hi <= info.max
+
+
 def _fp_degrade(name: str) -> None:
     """Failpoint site that degrades to the host backend: a fired
     ``return`` action raises _FallbackToHost, so an injected device
@@ -179,6 +196,52 @@ def _sum_parts(parts):
     for p in parts[1:]:
         packed = packed + np.asarray(p)
     return packed
+
+
+class _GuardedMeta:
+    """Request-scoped view of the shared lineage-anchored memo.
+
+    Reads come from the shared dict only while it still reflects this
+    request's snapshot generation (``fresh()``); writes always land in
+    a request-local overlay and propagate to the shared dict only while
+    fresh — a request (or deferred finalize) racing a newer
+    generation's refresh must never repopulate the shared memo with
+    stale derived constants (hash bounds, byte-plane widths, sparse
+    recodes), which a newer request would then trust.
+    """
+
+    __slots__ = ("_meta", "_fresh", "_local")
+
+    def __init__(self, meta: dict, fresh):
+        self._meta = meta
+        self._fresh = fresh
+        self._local: dict = {}
+
+    def __contains__(self, k) -> bool:
+        return k in self._local or (self._fresh() and k in self._meta)
+
+    def get(self, k, default=None):
+        if k in self._local:
+            return self._local[k]
+        return self._meta.get(k, default) if self._fresh() else default
+
+    def __getitem__(self, k):
+        got = self.get(k, _GuardedMeta)
+        if got is _GuardedMeta:
+            raise KeyError(k)
+        return got
+
+    def __setitem__(self, k, v) -> None:
+        self._local[k] = v
+        if self._fresh():
+            self._meta[k] = v
+
+    def setdefault(self, k, v):
+        got = self.get(k, _GuardedMeta)
+        if got is not _GuardedMeta:
+            return got
+        self[k] = v
+        return v
 
 
 class _Pending:
@@ -551,26 +614,132 @@ class DeviceRunner:
         return {"flat": tuple(flat), "null_flags": tuple(flags),
                 "n_pad": n_pad}
 
-    def _get_feed(self, storage, feed_key, host_cols, n: int) -> dict:
+    @staticmethod
+    def _feed_anchor(storage):
+        """Feed/meta cache key object.  Delta-maintained snapshots carry
+        a ``feed_lineage`` whose identity is stable across patch
+        generations (copr/region_cache.py FeedLineage) — anchoring on it
+        keeps the HBM feed warm across writes; plain snapshots anchor on
+        themselves (invalidation by identity, as before)."""
+        lineage = getattr(storage, "feed_lineage", None)
+        return storage if lineage is None else lineage
+
+    def _get_feed(self, storage, feed_key, host_cols, n: int,
+                  lineage=None, used_infos=None, dtypes=None,
+                  positional: bool = False, req_v=None) -> dict:
+        from ..utils import tracker
         cache = None
         if storage is not None and feed_key is not None and \
                 hasattr(storage, "scan_columns"):
             try:
-                cache = self._feed_cache.setdefault(storage, {})
+                cache = self._feed_cache.setdefault(
+                    self._feed_anchor(storage), {})
             except TypeError:       # not weak-referenceable
                 cache = None
-        if cache is not None and feed_key in cache:
-            from ..utils import tracker
-            tracker.label("device_feed", "hit")
-            return cache[feed_key]
-        from ..utils import tracker
+        feed = cache.get(feed_key) if cache is not None else None
+        if feed is not None:
+            fv = feed.get("lineage_v")
+            if lineage is None or fv == req_v:
+                tracker.label("device_feed", "hit")
+                return feed
+            if fv is not None and fv > req_v:
+                # an older-generation read (history serve): never
+                # downgrade the shared feed — build a private one
+                cache = None
+                feed = None
+            elif positional and self._try_patch_feed(
+                    feed, lineage, used_infos, dtypes, n, req_v):
+                # the snapshot moved forward under the feed: replay only
+                # the journal's dirty row spans into HBM instead of a
+                # cold re-upload — bucketed padding keeps n_pad (the
+                # compile class) stable across small deltas
+                tracker.label("device_feed", "patch")
+                return feed
         tracker.label("device_feed", "upload")
         _fp_degrade("device::before_feed_upload")
         with tracker.phase("feed_upload"):
             feed = self._build_flat(host_cols(), n)
+        if lineage is not None:
+            feed["lineage_v"] = req_v
         if cache is not None:
             cache[feed_key] = feed
         return feed
+
+    def _try_patch_feed(self, feed, lineage, used_infos, dtypes,
+                        n: int, req_v=None) -> bool:
+        """Apply the lineage's dirty row spans to the device feed in
+        place of a cold upload.  Only sound when the patch journal
+        covers the gap with pure row patches (no repack/compaction/
+        tombstones), positions map 1:1 (full-snapshot ascending feed),
+        the padded shape is unchanged, and every patched value fits the
+        feed's established device dtypes.  Sharded feeds patch too:
+        GSPMD partitions the update and ``_dus`` pins the result back
+        to the row sharding."""
+        if used_infos is None or dtypes is None:
+            return False
+        patches = lineage.since(feed.get("lineage_v", -1), until=req_v)
+        if patches is None or any(p.get("structural") for p in patches):
+            return False
+        if patches and patches[-1]["n"] != n:
+            return False        # ranged feed: positions do not map 1:1
+        if self._pad_rows(max(n, 1)) != feed["n_pad"]:
+            return False        # row count crossed a pad bucket
+        # flat index of each used column's value plane
+        plane = []
+        fi = 0
+        for has_nulls in feed["null_flags"]:
+            plane.append(fi)
+            fi += 2 if has_nulls else 1
+        from ..utils import tracker
+        flat = list(feed["flat"])
+        with tracker.phase("feed_patch"):
+            for p in patches:
+                for span in p["spans"]:
+                    lo = span["lo"]
+                    for ci, info in enumerate(used_infos):
+                        dt = np.dtype(dtypes[ci])
+                        if info.is_pk_handle:
+                            vals = span["handles"]
+                            valid = None
+                        else:
+                            vals, valid = span["cols"][info.col_id]
+                        if not _fits_dtype(vals, valid, dt):
+                            return False
+                        if valid is not None and not valid.all() and \
+                                not feed["null_flags"][ci]:
+                            # first NULL in an all-valid column would
+                            # change the compile class: rebuild
+                            return False
+                        fi = plane[ci]
+                        flat[fi] = self._dus(
+                            flat[fi],
+                            np.ascontiguousarray(
+                                vals.astype(dt, copy=False)), lo)
+                        if feed["null_flags"][ci]:
+                            mask = valid if valid is not None else \
+                                np.ones(len(vals), np.bool_)
+                            flat[fi + 1] = self._dus(
+                                flat[fi + 1],
+                                np.ascontiguousarray(mask), lo)
+        feed["flat"] = tuple(flat)
+        feed["lineage_v"] = req_v
+        return True
+
+    def _dus(self, arr, update, lo: int):
+        """Jitted in-place-style slice update (dynamic_update_slice);
+        the start index is traced, so repeated single-row patches at
+        different positions share one compile class per update length.
+        On a sharded feed the result is pinned back to the row sharding
+        so downstream shard_map kernels see their expected layout."""
+        fn = self._kernel_cache.get("feed_patch_fn")
+        if fn is None:
+            def _upd(a, u, i):
+                return lax.dynamic_update_slice(a, u, (i,))
+            fn = self._kernel_cache["feed_patch_fn"] = jax.jit(_upd)
+        out = fn(arr, update, jnp.asarray(lo, jnp.int32))
+        if not self._single:
+            out = jax.device_put(out, self._row_sharding)
+        return out
 
     # --------------------------------------------------------------- kernels
 
@@ -1146,11 +1315,37 @@ class DeviceRunner:
         # key/arg expressions, not just on which columns are shipped
         meta_key = (dag.plan_key(), dag.ranges)
         meta = self._request_meta(storage, meta_key)
+        lineage = getattr(storage, "feed_lineage", None)
+        # the generation THIS snapshot reflects — the line may already
+        # be further ahead (or this may be a history-served older
+        # generation); every shared-memo interaction pins to it
+        req_v = getattr(storage, "feed_version", None)
+        if lineage is not None and req_v is None:
+            req_v = lineage.version
+        if lineage is not None:
+            mv = meta.get("lineage_v", req_v)
+            if mv < req_v:
+                # the memo lags this snapshot: carry what provably
+                # survives the gap, drop the rest
+                self._refresh_meta(meta, lineage, plan, mv, req_v)
+            elif mv > req_v:
+                # an older-generation read (history serve) must not
+                # consume or mutate the newer shared memo: go local
+                meta = {"lineage_v": req_v,
+                        "force_host": meta.get("force_host", False)}
+            meta.setdefault("lineage_v", req_v)
         if meta.get("force_host"):
             from ..executors.runner import BatchExecutorsRunner
             return BatchExecutorsRunner(orig_dag, storage).handle_request()
 
+        # shared-memo writes are only allowed while the memo still
+        # reflects req_v — a request (or deferred finalize) racing a
+        # newer generation's refresh must not repopulate the shared
+        # memo with stale data; stale results stay request-local
         memo: dict = {}
+
+        def memo_fresh() -> bool:
+            return req_v is None or meta.get("lineage_v") == req_v
 
         def get_batch():
             """Host ColumnBatch for this scan (built at most once; the
@@ -1160,14 +1355,48 @@ class DeviceRunner:
                 memo["batch"] = self._scan_batch(dag, plan, storage)
             return memo["batch"]
 
-        if "n_rows" in meta:
+        if "n_rows" in meta and memo_fresh():
             n = meta["n_rows"]
         else:
-            n = get_batch().num_rows
-            meta["n_rows"] = n
+            if isinstance(plan.scan, TableScanDesc) and \
+                    hasattr(storage, "count_rows") and \
+                    hasattr(storage, "scan_columns"):
+                # row count without materializing the batch — the warm
+                # delta path must not pay a full columnar gather just
+                # to re-learn n
+                n = storage.count_rows(dag.ranges)
+            else:
+                n = get_batch().num_rows
+            if memo_fresh():
+                meta["n_rows"] = n
         if n == 0:
             from ..executors.runner import BatchExecutorsRunner
             return BatchExecutorsRunner(orig_dag, storage).handle_request()
+
+        def get_dtypes() -> tuple:
+            if "dtypes" in memo:
+                return memo["dtypes"]
+            if "dtypes" in meta and memo_fresh():
+                return meta["dtypes"]
+            batch = get_batch()
+            dts = []
+            for ci in plan.used_cols:
+                col = batch.columns[ci]
+                dt = _device_dtype(col.eval_type, col.values)
+                if dt == np.dtype(np.uint64) and col.values.size \
+                        and int(col.values.max()) >= (1 << 63):
+                    # packed cores above 2^63 (year >= 8192) would
+                    # wrap in the int64 state carries.  Remember the
+                    # verdict: repeat requests must not rebuild the
+                    # preceding columns just to re-discover it.
+                    # (Conservative-sticky: safe to set cross-version.)
+                    meta["force_host"] = True
+                    raise _FallbackToHost("u64 column beyond int64")
+                dts.append(str(dt))
+            memo["dtypes"] = tuple(dts)
+            if memo_fresh():
+                meta["dtypes"] = memo["dtypes"]
+            return memo["dtypes"]
 
         def host_cols():
             """Device-dtype numpy column pairs.
@@ -1175,46 +1404,84 @@ class DeviceRunner:
             Cached for the snapshot's lifetime (in ``meta``, same policy
             as the device feed): the astype alone costs ~2s per 100M-row
             REAL column, and the TopN candidate refine reads these on
-            every request."""
-            if "host_cols" not in meta:
-                batch = get_batch()
-                cols, dts = [], []
-                for ci in plan.used_cols:
-                    col = batch.columns[ci]
-                    dt = _device_dtype(col.eval_type, col.values)
-                    if dt == np.dtype(np.uint64) and col.values.size \
-                            and int(col.values.max()) >= (1 << 63):
-                        # packed cores above 2^63 (year >= 8192) would
-                        # wrap in the int64 state carries.  Remember the
-                        # verdict: repeat requests must not rebuild the
-                        # preceding columns just to re-discover it.
-                        meta["force_host"] = True
-                        raise _FallbackToHost("u64 column beyond int64")
-                    cols.append((np.ascontiguousarray(
-                        col.values.astype(dt, copy=False)),
-                        np.ascontiguousarray(col.validity)))
-                    dts.append(str(dt))
+            every request.  Version-guarded: if the line moved on, the
+            rebuild stays request-local (``memo``)."""
+            if "host_cols" in memo:
+                return memo["host_cols"]
+            if "host_cols" in meta and memo_fresh():
+                return meta["host_cols"]
+            dts = get_dtypes()
+            batch = get_batch()
+            cols = []
+            for ci, ds in zip(plan.used_cols, dts):
+                col = batch.columns[ci]
+                cols.append((np.ascontiguousarray(
+                    col.values.astype(np.dtype(ds), copy=False)),
+                    np.ascontiguousarray(col.validity)))
+            memo["host_cols"] = cols
+            if memo_fresh():
                 meta["host_cols"] = cols
-                meta.setdefault("dtypes", tuple(dts))
-            return meta["host_cols"]
+            return cols
+
+        def host_cols_stream():
+            """Yield device-dtype pairs one column at a time, building
+            the host_cols memo incrementally: the cold feed upload
+            issues each column's (async) device_put as soon as that
+            column is converted, so the H2D transfer of column i
+            overlaps the astype of column i+1 — double-buffering the
+            tail of a columnar build instead of serializing convert-all
+            then upload-all."""
+            if "host_cols" in memo:
+                yield from memo["host_cols"]
+                return
+            if "host_cols" in meta and memo_fresh():
+                yield from meta["host_cols"]
+                return
+            dts = get_dtypes()
+            batch = get_batch()
+            built = []
+            for ci, ds in zip(plan.used_cols, dts):
+                col = batch.columns[ci]
+                pair = (np.ascontiguousarray(
+                    col.values.astype(np.dtype(ds), copy=False)),
+                    np.ascontiguousarray(col.validity))
+                built.append(pair)
+                yield pair
+            memo["host_cols"] = built
+            if memo_fresh():
+                meta["host_cols"] = built
 
         try:
             _fp_degrade("device::before_dispatch")
-            if "dtypes" not in meta:
-                host_cols()
-            dtypes = meta["dtypes"]
+            dtypes = get_dtypes()
 
             feed_key = (tuple(plan.scan.columns[ci].col_id
                               for ci in plan.used_cols),
                         tuple(dtypes), dag.ranges)
+            used_infos = [plan.scan.columns[ci] for ci in plan.used_cols]
+            # patching maps journal row positions straight onto feed
+            # rows — only sound for an ascending table scan (index
+            # scans re-sort, desc scans reverse)
+            positional = isinstance(plan.scan, TableScanDesc) and \
+                not getattr(plan.scan, "desc", False)
             with self._dispatch_mu:
-                feed = self._get_feed(storage, feed_key, host_cols, n)
+                feed = self._get_feed(storage, feed_key,
+                                      host_cols_stream, n,
+                                      lineage=lineage,
+                                      used_infos=used_infos,
+                                      dtypes=dtypes,
+                                      positional=positional,
+                                      req_v=req_v)
+                # derived kernel constants written inside the run
+                # bodies ride the guarded view: a stale-generation
+                # request keeps them request-local
+                gmeta = _GuardedMeta(meta, memo_fresh)
                 if plan.kind == "simple_agg":
                     result = self._run_simple(dag, plan, host_cols, dtypes,
-                                              n, feed, meta)
+                                              n, feed, gmeta)
                 elif plan.kind == "hash_agg":
                     result = self._run_hash(dag, plan, host_cols, dtypes,
-                                            n, feed, meta,
+                                            n, feed, gmeta,
                                             tile_spans=tile_spans)
                 elif plan.kind == "topn":
                     result = self._run_topn(dag, plan, host_cols, dtypes,
@@ -1279,7 +1546,7 @@ class DeviceRunner:
         n = meta["n_rows"]
         feed = None
         try:
-            cache = self._feed_cache.get(storage)
+            cache = self._feed_cache.get(self._feed_anchor(storage))
             for k, v in (cache or {}).items():
                 if isinstance(v, dict) and "flat" in v:
                     feed = v
@@ -1304,14 +1571,106 @@ class DeviceRunner:
 
     def _request_meta(self, storage, meta_key) -> dict:
         """Snapshot-lifetime memo for host-derived request constants
-        (device dtypes, hash key bounds, byte-plane widths)."""
+        (device dtypes, hash key bounds, byte-plane widths).  Anchored
+        on the feed lineage when the snapshot is delta-maintained, so
+        the memo survives patch generations (version-checked by
+        ``_refresh_meta``)."""
         if not hasattr(storage, "scan_columns"):
             return {}
         try:
-            per_storage = self._feed_cache.setdefault(storage, {})
+            per_storage = self._feed_cache.setdefault(
+                self._feed_anchor(storage), {})
         except TypeError:
             return {}
         return per_storage.setdefault(("meta", meta_key), {})
+
+    def _refresh_meta(self, meta: dict, lineage, plan, from_v: int,
+                      to_v: int) -> None:
+        """Roll a request memo forward across a feed-lineage gap.
+
+        Volatile fields (row count, host column copies) always drop.
+        Derived kernel constants — device dtypes, hash key bounds,
+        byte-plane widths — survive only when every dirty row provably
+        stays inside them, because each is baked into a compiled kernel
+        (capacity, plane count) or a value transform (dtype narrowing);
+        keeping a violated constant would corrupt results, dropping a
+        valid one only costs a re-derivation (still no MVCC rebuild).
+        Sparse key recodes always drop: new rows have no slot ids.
+        """
+        patches = lineage.since(from_v, until=to_v)
+        meta.pop("n_rows", None)
+        meta.pop("host_cols", None)
+        meta.pop("sparse_slots", None)
+        keep = patches is not None and \
+            not any(p.get("structural") for p in patches)
+        if keep:
+            used_infos = [plan.scan.columns[ci] for ci in plan.used_cols]
+            spans = [s for p in patches for s in p["spans"]]
+            keep = self._verify_meta_consts(meta, plan, used_infos,
+                                            spans)
+        if not keep:
+            meta.pop("dtypes", None)
+            meta.pop("hash_bounds", None)
+            meta.pop("simple_arg_nbytes", None)
+        meta["lineage_v"] = to_v
+
+    def _verify_meta_consts(self, meta, plan, used_infos, spans) -> bool:
+        from .kernels import int_planes_needed
+        dtypes = meta.get("dtypes")
+        if dtypes is not None:
+            for ci, info in enumerate(used_infos):
+                dt = np.dtype(dtypes[ci])
+                for span in spans:
+                    vals, valid = (span["handles"], None) \
+                        if info.is_pk_handle \
+                        else span["cols"][info.col_id]
+                    if not _fits_dtype(vals, valid, dt):
+                        return False
+
+        def span_pairs(span):
+            pairs = []
+            for info in used_infos:
+                if info.is_pk_handle:
+                    h = span["handles"]
+                    pairs.append((h, np.ones(len(h), np.bool_)))
+                else:
+                    pairs.append(span["cols"][info.col_id])
+            return pairs
+
+        def arg_planes_ok(arg_nbytes) -> bool:
+            for r, planes in zip(plan.agg_rpns, arg_nbytes):
+                if r is None or r.ret_type is EvalType.REAL or \
+                        len(r.nodes) != 1 or \
+                        not isinstance(r.nodes[0], RpnColumnRef):
+                    continue    # computed exprs use dtype widths: stable
+                ci = r.nodes[0].col_idx
+                for span in spans:
+                    vals, valid = span_pairs(span)[ci]
+                    live = vals if valid is None or valid.all() \
+                        else vals[valid]
+                    if live.size and int_planes_needed(
+                            int(live.min()), int(live.max())) > planes:
+                        return False
+            return True
+
+        if "hash_bounds" in meta:
+            base, width, arg_nbytes = meta["hash_bounds"]
+            for span in spans:
+                pairs = span_pairs(span)
+                m = len(span["handles"])
+                kv, km = eval_rpn(plan.key_rpn, pairs, m, np)
+                kv = np.broadcast_to(kv, (m,))
+                km = np.broadcast_to(km, (m,))
+                live = kv[km]
+                if live.size and (int(live.min()) < base or
+                                  int(live.max()) >= base + width):
+                    return False
+            if not arg_planes_ok(arg_nbytes):
+                return False
+        if "simple_arg_nbytes" in meta and \
+                not arg_planes_ok(meta["simple_arg_nbytes"]):
+            return False
+        return True
 
     def _result(self, dag, schema, columns) -> "SelectResult":
         from ..executors.runner import SelectResult
@@ -1357,10 +1716,11 @@ class DeviceRunner:
         # taxed config 4
         from .kernels import build_layouts, matmul_supported
         if matmul_supported(plan.specs):
-            arg_nbytes = meta.get("simple_arg_nbytes") if meta else None
+            arg_nbytes = meta.get("simple_arg_nbytes") \
+                if meta is not None else None
             if arg_nbytes is None:
                 arg_nbytes = self._arg_nbytes(plan, host_cols(), n)
-                if isinstance(meta, dict):
+                if meta is not None:
                     meta["simple_arg_nbytes"] = arg_nbytes
             arg_is_real = [r is not None and r.ret_type is EvalType.REAL
                            for r in plan.agg_rpns]

@@ -81,14 +81,24 @@ class ColumnarTable:
 
     ``handles`` must be sorted ascending (the physical key order of record
     keys).  ``columns`` maps col_id → Column aligned with ``handles``.
+
+    ``alive``: optional boolean mask aligned with ``handles`` — False
+    rows are delete tombstones left in place by incremental cache
+    maintenance (copr/region_cache.py) and are invisible to every
+    logical accessor (scans, counts, kv materialization).  ``None``
+    means every row is live and scans stay zero-copy views.
     """
 
-    def __init__(self, table, handles: np.ndarray, columns: dict):
+    def __init__(self, table, handles: np.ndarray, columns: dict,
+                 alive: Optional[np.ndarray] = None):
         self.table = table
         self.handles = np.asarray(handles, dtype=np.int64)
         assert np.all(self.handles[1:] > self.handles[:-1]), \
             "handles must be strictly increasing"
         self.columns = columns
+        self.alive = alive
+        self._n_alive = len(self.handles) if alive is None \
+            else int(alive.sum())
 
     @staticmethod
     def from_arrays(table, handles, named_columns: dict) -> "ColumnarTable":
@@ -109,10 +119,10 @@ class ColumnarTable:
         return ColumnarTable(table, handles, cols)
 
     def __len__(self) -> int:
-        return len(self.handles)
+        return self._n_alive
 
     def estimated_rows(self) -> int:
-        return len(self.handles)
+        return self._n_alive
 
     # -- columnar scan -------------------------------------------------------
 
@@ -135,10 +145,20 @@ class ColumnarTable:
         return out
 
     def count_rows(self, ranges: Sequence[KeyRange]) -> int:
-        return sum(j - i for i, j in self._range_slices(ranges))
+        if self.alive is None:
+            return sum(j - i for i, j in self._range_slices(ranges))
+        return sum(int(self.alive[i:j].sum())
+                   for i, j in self._range_slices(ranges))
 
     def row_slices(self, ranges: Sequence[KeyRange]) -> list:
-        """Public seam for the device runner's bucket-tile mapping."""
+        """Public seam for the device runner's bucket-tile mapping.
+
+        Spans are PHYSICAL row indices; with pending delete tombstones
+        they would include dead rows the device kernels cannot skip, so
+        the bucket-tile path is refused until the next compaction.
+        """
+        if self.alive is not None:
+            raise ValueError("row spans unavailable under tombstones")
         return self._range_slices(ranges)
 
     def _ones(self, n: int) -> np.ndarray:
@@ -163,21 +183,26 @@ class ColumnarTable:
         slices = self._range_slices(ranges)
         if desc.desc:
             slices = [(i, j) for i, j in reversed(slices)]
+        alive = self.alive
 
         def gather(values: np.ndarray, validity: np.ndarray):
-            if len(slices) == 1 and not desc.desc:
+            if alive is None and len(slices) == 1 and not desc.desc:
                 i, j = slices[0]
                 return values[i:j], validity[i:j]
             vparts, mparts = [], []
             for i, j in slices:
+                v, m = values[i:j], validity[i:j]
+                if alive is not None:
+                    keep = alive[i:j]
+                    v, m = v[keep], m[keep]
                 if desc.desc:
-                    vparts.append(values[i:j][::-1])
-                    mparts.append(validity[i:j][::-1])
-                else:
-                    vparts.append(values[i:j])
-                    mparts.append(validity[i:j])
+                    v, m = v[::-1], m[::-1]
+                vparts.append(v)
+                mparts.append(m)
             if not vparts:
                 return values[:0], validity[:0]
+            if len(vparts) == 1:
+                return vparts[0], mparts[0]
             return np.concatenate(vparts), np.concatenate(mparts)
 
         out_cols = []
@@ -189,7 +214,10 @@ class ColumnarTable:
             col = self.columns.get(info.col_id)
             if col is None:
                 # absent column → all default_value/NULL
-                n = sum(j - i for i, j in slices)
+                if alive is None:
+                    n = sum(j - i for i, j in slices)
+                else:
+                    n = sum(int(alive[i:j].sum()) for i, j in slices)
                 out_cols.append(Column.from_list(
                     info.field_type.eval_type, [info.default_value] * n))
                 continue
@@ -206,10 +234,16 @@ class ColumnarTable:
         got = cache.get(col_id)
         if got is None:
             col = self.columns[col_id]
-            nulls = ~col.validity
-            order = np.lexsort((self.handles, col.values, nulls * -1))
-            got = (col.values[order], col.validity[order],
-                   self.handles[order], int(nulls.sum()))
+            values, validity, handles = col.values, col.validity, \
+                self.handles
+            if self.alive is not None:
+                keep = self.alive
+                values, validity, handles = \
+                    values[keep], validity[keep], handles[keep]
+            nulls = ~validity
+            order = np.lexsort((handles, values, nulls * -1))
+            got = (values[order], validity[order],
+                   handles[order], int(nulls.sum()))
             # single-slice scans hand out zero-copy views of these;
             # freeze so downstream mutation can't corrupt the memo
             for a in got[:3]:
@@ -295,6 +329,8 @@ class ColumnarTable:
         else:
             indices = [i for lo, hi in self._range_slices(ranges)
                        for i in range(lo, hi)]
+        if self.alive is not None:
+            indices = [i for i in indices if self.alive[i]]
         pairs = []
         by_id = self.columns
         for i in indices:
@@ -346,7 +382,10 @@ class BatchColumnarTableScanExecutor(TimedExecutor):
                 self._hcache = False        # no resume token
             else:
                 slices = tbl._range_slices(ranges)
-                parts = [tbl.handles[i:j] for i, j in slices]
+                alive = getattr(tbl, "alive", None)
+                parts = [tbl.handles[i:j] if alive is None
+                         else tbl.handles[i:j][alive[i:j]]
+                         for i, j in slices]
                 self._hcache = parts[0] if len(parts) == 1 else (
                     np.concatenate(parts) if parts
                     else tbl.handles[:0])

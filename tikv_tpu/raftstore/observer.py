@@ -11,6 +11,10 @@ Events delivered:
 - ``on_apply_write(region_id, index, ops)``: the data WriteOps of one
   applied entry, AFTER the engine write succeeded (ops carry raw cf/
   key/value exactly as applied);
+- ``on_data_replaced(region_id, index)``: the region's data was
+  replaced wholesale at ``index`` (snapshot apply) — incremental
+  subscribers (the columnar delta log) must drop everything they
+  derived from earlier applied writes;
 - ``on_region_changed(region)``: split/merge/conf-change/snapshot;
 - ``on_role_change(region_id, is_leader)``: leadership transitions.
 """
@@ -25,6 +29,9 @@ class Observer:
 
     def on_apply_write(self, region_id: int, index: int,
                        ops: Sequence) -> None:
+        pass
+
+    def on_data_replaced(self, region_id: int, index: int) -> None:
         pass
 
     def on_region_changed(self, region) -> None:
@@ -63,6 +70,13 @@ class CoprocessorHost:
         for obs in self._observers:
             try:
                 obs.on_apply_write(region_id, index, ops)
+            except Exception:   # noqa: BLE001
+                pass
+
+    def notify_data_replaced(self, region_id: int, index: int) -> None:
+        for obs in self._observers:
+            try:
+                obs.on_data_replaced(region_id, index)
             except Exception:   # noqa: BLE001
                 pass
 

@@ -171,8 +171,15 @@ class RaftPeer:
         self.node = RawNode(peer_meta.id, ms, **raft_cfg)
         self.node.applied = max(self.node.applied, applied)
         # last applied entry that mutated data; restart conservatively
-        # re-stamps at applied (one-time cache invalidation per restart)
+        # re-stamps at applied (one-time cache invalidation per restart).
+        # data_index advances while a write batch is still being BUILT;
+        # data_index_engine advances only after the batch hits the
+        # engine — snapshots must stamp the engine-durable version or a
+        # lease read racing the apply pool could stamp a version whose
+        # rows it cannot see yet (and the columnar delta cache would
+        # then pin wrong data under that version forever)
         self.data_index = self.node.applied
+        self.data_index_engine = self.node.applied
         self.proposals: list[Proposal] = []
         self.pending_destroy = False
         # PrepareMerge in flight: the prepare entry's apply index, or
@@ -329,7 +336,7 @@ class RaftPeer:
         from any replica, leader or not (kvproto Context stale_read)."""
         with self.mu:
             snap = RegionSnapshot(self.engine.snapshot(), self.region)
-            snap.data_index = self.data_index
+            snap.data_index = self.data_index_engine
             snap.apply_index = self.applied_engine
             return snap
 
@@ -357,7 +364,7 @@ class RaftPeer:
         if node.storage.term(self.applied_engine) != node.term:
             return None     # fresh leader: noop not applied yet
         snap = RegionSnapshot(self.engine.snapshot(), self.region)
-        snap.data_index = self.data_index
+        snap.data_index = self.data_index_engine
         snap.apply_index = self.applied_engine
         return snap
 
@@ -396,7 +403,7 @@ class RaftPeer:
             if self.applied_engine >= index:
                 snap = RegionSnapshot(self.engine.snapshot(),
                                       self.region)
-                snap.data_index = self.data_index
+                snap.data_index = self.data_index_engine
                 snap.apply_index = self.applied_engine
                 cb(snap)
             else:
@@ -418,7 +425,7 @@ class RaftPeer:
                 cb(_result)
             else:
                 snap = RegionSnapshot(self.engine.snapshot(), self.region)
-                snap.data_index = self.data_index
+                snap.data_index = self.data_index_engine
                 snap.apply_index = index
                 cb(snap)
         with self._prop_mu:
@@ -508,11 +515,15 @@ class RaftPeer:
                 region = self.peer_storage.apply_snapshot(wb, rd.snapshot)
                 # a snapshot replaces all region data: stamp the data
                 # version so columnar/copr caches can never serve
-                # pre-snapshot entries
+                # pre-snapshot entries, and tell observers the data was
+                # replaced WHOLESALE — committed-write delta logs cover
+                # nothing at or before this index
                 self.data_index = max(self.data_index,
                                       rd.snapshot.metadata.index)
                 self.applied_engine = max(self.applied_engine,
                                           rd.snapshot.metadata.index)
+                self._pending_obs.append(
+                    (rd.snapshot.metadata.index, None))
                 self.store.on_region_changed(self, region)
                 fail_point("snapshot::after_apply")
             fail_point("raftlog::before_persist")
@@ -533,6 +544,7 @@ class RaftPeer:
                     # a crash here never re-applies admin commands)
                     self.peer_storage.persist_apply(wb, entry.index - 1)
                     self.engine.write(wb)
+                    self.data_index_engine = self.data_index
                     wb = self.engine.write_batch()
                 elif not wb.is_empty() and self._is_compute_hash(entry):
                     # ComputeHash digests the ENGINE state: earlier
@@ -541,6 +553,7 @@ class RaftPeer:
                     # digest different visible prefixes at one index
                     self.peer_storage.persist_apply(wb, entry.index - 1)
                     self.engine.write(wb)
+                    self.data_index_engine = self.data_index
                     wb = self.engine.write_batch()
                 self._apply_entry(wb, entry, cbs)
             if rd.committed_entries:
@@ -550,13 +563,18 @@ class RaftPeer:
             if not wb.is_empty():
                 self._inspected_engine_write(wb)
             fail_point("apply::after_write")
-            # observers run AFTER the engine write so they only ever see
-            # durable state (coprocessor/mod.rs post-apply hooks)
-            if self._pending_obs:
-                host = self.store.coprocessor_host
-                for index, ops in self._pending_obs:
-                    host.notify_apply_write(self.region.id, index, ops)
-                self._pending_obs.clear()
+            if rd.committed_entries or rd.snapshot is not None:
+                # only paths that actually applied may publish: these
+                # drained the apply pool first, so data_index is fully
+                # durable here.  A message-only ready must NOT copy a
+                # data_index the apply-pool thread bumped mid-batch —
+                # that would re-open the stale-stamp race the
+                # data_index_engine split closes (and flush the pool's
+                # pending observer events before their write lands).
+                self.data_index_engine = self.data_index
+                # observers run AFTER the engine write so they only ever
+                # see durable state (coprocessor/mod.rs post-apply hooks)
+                self._dispatch_obs()
             if rd.committed_entries:
                 self.applied_engine = rd.committed_entries[-1].index
             # ACKs leave only now — after the engine write (see
@@ -620,15 +638,26 @@ class RaftPeer:
         fail_point("apply::before_write")
         if not wb.is_empty():
             self._inspected_engine_write(wb)
+        self.data_index_engine = self.data_index
         fail_point("apply::after_write")
-        if self._pending_obs:
-            host = self.store.coprocessor_host
-            for index, ops in self._pending_obs:
-                host.notify_apply_write(self.region.id, index, ops)
-            self._pending_obs.clear()
+        self._dispatch_obs()
         self.applied_engine = entries[-1].index
         for prop, res in cbs:
             prop.cb(res)
+
+    def _dispatch_obs(self) -> None:
+        """Flush applied-entry observer events, post-engine-write.
+        ``ops is None`` marks a wholesale data replacement (snapshot
+        apply) — delta subscribers must drop their coverage."""
+        if not self._pending_obs:
+            return
+        host = self.store.coprocessor_host
+        for index, ops in self._pending_obs:
+            if ops is None:
+                host.notify_data_replaced(self.region.id, index)
+            else:
+                host.notify_apply_write(self.region.id, index, ops)
+        self._pending_obs.clear()
 
     def on_log_persisted(self, rd) -> list[Message]:
         """Async-IO completion: the log batch hit disk — now the acks

@@ -237,7 +237,19 @@ class TxnClient:
         for region, leader in self._region_cache.values():
             if region.contains(ek):
                 return region, leader
-        region, leader = self.pd.get_region_with_leader(ek)
+        # a split in flight leaves PD with a transient gap between the
+        # shrunk parent's heartbeat and the new sibling's first one —
+        # "no region" there is retryable, not fatal (client-go backs
+        # off on region_not_found the same way)
+        from ..utils.backoff import Backoff
+        bo = Backoff(base=0.02, cap=0.2, deadline_s=3.0)
+        while True:
+            try:
+                region, leader = self.pd.get_region_with_leader(ek)
+                break
+            except wire.RemoteError as e:
+                if "no region" not in str(e) or not bo.sleep():
+                    raise
         if leader is None:
             leader = region.peers[0]
         self._region_cache[region.id] = (region, leader)

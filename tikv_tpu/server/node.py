@@ -455,8 +455,19 @@ class Node:
         from .read_pool import ReadPool
         self.read_pool = ReadPool(
             max_concurrency=config.readpool.concurrency)
+        # incremental columnar cache maintenance: the apply path feeds
+        # committed-write deltas into the sink; the cache patches lines
+        # forward across data_index gaps instead of rebuilding
+        from ..copr.delta import DeltaSink
+        self.copr_delta_sink = DeltaSink(
+            max_entries=config.coprocessor.delta_log_entries,
+            max_rows=config.coprocessor.delta_log_rows)
+        self.raft_store.coprocessor_host.register(self.copr_delta_sink)
         self.copr_cache = RegionColumnarCache(
-            capacity=config.coprocessor.region_cache_capacity)
+            capacity=config.coprocessor.region_cache_capacity,
+            delta_source=self.copr_delta_sink,
+            compact_ratio=config.coprocessor.tombstone_compact_ratio,
+            max_delta_rows=config.coprocessor.delta_log_rows)
         self.endpoint = Endpoint(self._copr_snapshot,
                                  device_runner=device_runner,
                                  device_row_threshold=device_row_threshold)
@@ -469,6 +480,9 @@ class Node:
                 diff["device_row_threshold"]
         if "region_cache_capacity" in diff:
             self.copr_cache._capacity = diff["region_cache_capacity"]
+        if "tombstone_compact_ratio" in diff:
+            self.copr_cache._compact_ratio = \
+                diff["tombstone_compact_ratio"]
 
     def _read_index_check(self, read_ts: int, region) -> bool:
         """Leader-side async-commit guard for replica reads: bump

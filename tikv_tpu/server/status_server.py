@@ -74,6 +74,12 @@ class StatusServer:
                     tp = getattr(node, "transport", None)
                     if tp is not None and hasattr(tp, "breaker_states"):
                         body["peer_breakers"] = tp.breaker_states()
+                    cc = getattr(node, "copr_cache", None)
+                    if cc is not None and hasattr(cc, "stats"):
+                        # incremental columnar cache: hit/miss/delta/
+                        # rebuild counters, per-line tombstone ratio,
+                        # delta-log depth
+                        body["copr_cache"] = cc.stats()
                     self._json(200, body)
                 elif path == "/config":
                     if outer._controller is None:

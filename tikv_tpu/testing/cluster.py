@@ -252,6 +252,41 @@ class Cluster:
         kv.write(SnapContext(region_id=peer.region.id),
                  WriteData([("put", cf, key, value)]))
 
+    def txn_write(self, mutations, start_ts: int = 0,
+                  commit_ts: int = 0) -> int:
+        """Batched 2PC write helper: ONE Prewrite command carrying every
+        mutation and ONE Commit over all keys, instead of per-row
+        round trips (the reference's test_raftstore must_kv_prewrite /
+        must_kv_commit pair).  ``mutations``: [(op, key, value|None)]
+        with txn-layer user keys (e.g. encode_table_row output).
+        Returns the commit_ts."""
+        from ..raftstore import RaftKv
+        from ..storage import Storage
+        from ..storage.txn import commands as cmds
+        from ..storage.txn.actions import Mutation
+        assert mutations
+        primary = mutations[0][1]
+        sid = None
+        from ..storage.txn_types import encode_key
+        for cand, store in self.stores.items():
+            try:
+                peer = store.peer_by_key(encode_key(primary))
+            except Exception:   # noqa: BLE001 — store lacks the region
+                continue
+            if peer.is_leader():
+                sid = cand
+                break
+        assert sid is not None, "no leader for txn_write"
+        st = Storage(RaftKv(self.stores[sid], driver=self._drive_until))
+        start_ts = start_ts or self.pd.tso()
+        st.sched_txn_command(cmds.Prewrite(
+            [Mutation(op, key, value) for op, key, value in mutations],
+            primary, start_ts))
+        commit_ts = commit_ts or self.pd.tso()
+        st.sched_txn_command(cmds.Commit(
+            [key for _op, key, _v in mutations], start_ts, commit_ts))
+        return commit_ts
+
     def must_get(self, key: bytes, cf: str = CF_DEFAULT):
         from ..kv.engine import SnapContext
         kv, peer = self._leader_kv_for(key)

@@ -40,6 +40,14 @@ class _Family:
                 child = self._children.setdefault(key, self._new_child())
         return child
 
+    def remove(self, *values) -> None:
+        """Drop one label set (prometheus client remove()): callers with
+        churning label values — per-region gauges across splits/merges —
+        must retire dead series or the registry grows without bound."""
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            self._children.pop(key, None)
+
     def _default(self):
         return self.labels() if not self.label_names else None
 
@@ -283,7 +291,17 @@ COPR_REQ_DURATION = REGISTRY.histogram(
     "coprocessor request duration", labels=("backend",))
 COPR_CACHE_COUNTER = REGISTRY.counter(
     "tikv_coprocessor_region_cache_total",
-    "region columnar cache lookups", labels=("result",))
+    "region columnar cache lookups "
+    "(hit / miss / delta = patched forward / rebuild = fallback)",
+    labels=("result",))
+COPR_TOMBSTONE_RATIO = REGISTRY.gauge(
+    "tikv_coprocessor_region_cache_tombstone_ratio",
+    "pending delete tombstones / rows in a delta-maintained columnar "
+    "cache line (compaction input)", labels=("region",))
+COPR_DELTA_LOG_DEPTH = REGISTRY.gauge(
+    "tikv_coprocessor_delta_log_depth",
+    "applied entries retained in the per-region committed-write delta "
+    "log", labels=("region",))
 READ_POOL_EMA_GAUGE = REGISTRY.gauge(
     "tikv_unified_read_pool_ema_service_seconds",
     "EWMA of read-pool task service time (deadline shedding input)")
