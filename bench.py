@@ -5,6 +5,9 @@ latency, 1M→100M-row scans").
 Configs (BASELINE.md + r4 additions):
   1. table scan, 1M int64 rows, no predicate
   2. selection `v > k`, 10M rows, 10% selectivity
+  2s. selection selectivity sweep {0.1%, 1%, 10%, 50%, 99%}: the
+      late-materialization router's mask/index/compact/host crossovers,
+      with modeled D2H vs host-path bytes per point (# routing= lines)
   3. simple aggregation SUM/COUNT/AVG, 50M rows, single group
   4. fast hash agg: GROUP BY int key (1k groups) + SUM, 100M rows
   5. TopN (ORDER BY col LIMIT 1000), 100M mixed-type rows via IndexScan
@@ -556,6 +559,68 @@ def run_write_churn(device_runner, iters: int):
         pd_server.stop()
 
 
+def run_selection_sweep(runner, n: int, iters: int):
+    """Config 2s: selection selectivity sweep {0.1%, 1%, 10%, 50%, 99%}.
+
+    Per point, routing mirrors the endpoint (profitable() consults the
+    runner's per-plan selectivity EWMA), so the warm measurement shows
+    the route the production router would take: compact/index at low
+    selectivity, mask in the middle, HOST at ~99% (correct — past the
+    cutoff the shared k-row materialization dominates and the device
+    adds only its round trip).  Each point reports the route's modeled
+    D2H bytes against the host-path bytes so the router invariant
+    ("never pick a device route whose modeled D2H cost exceeds the host
+    path") is checkable from the artifact alone.
+    """
+    from tikv_tpu.device import selection as selmod
+    from tikv_tpu.executors.runner import BatchExecutorsRunner
+    from tikv_tpu.utils import tracker as _tracker
+
+    groups = int(os.environ.get("TIKV_TPU_BENCH_GROUPS", 1024))
+    table, snap = build_table(n, groups)
+    v = snap.columns[3].values
+    points = (("0.1%", 0.001), ("1%", 0.01), ("10%", 0.10),
+              ("50%", 0.50), ("99%", 0.99))
+    out = {}
+    for name, frac in points:
+        thr = int(np.quantile(v, 1.0 - frac))
+        dag = _dag_selection(table, thr)
+        k_true = int((v > thr).sum())
+
+        def one():
+            if runner is not None and runner.profitable(dag):
+                return runner.handle_request(dag, snap), "device"
+            return BatchExecutorsRunner(dag, snap).handle_request(), "host"
+
+        for _ in range(4):      # compile + feed warm + EWMA settle
+            r, _b = one()
+        assert r.batch.num_rows == k_true
+        tr, tok = _tracker.install()
+        try:
+            r, backend = one()
+        finally:
+            _tracker.uninstall(tok)
+        routing = tr.labels.get("routing", "host")
+        p50, p99, _ = measure(lambda: one(), max(3, iters // 2))
+        from tikv_tpu.parallel import num_shards
+        d2h = selmod.modeled_d2h_bytes(
+            routing, n, k_true,
+            n_shards=num_shards(runner._mesh) if runner is not None else 1)
+        host_bytes = selmod.host_path_bytes(n, k_true)
+        out[name] = {
+            "rows": n, "selected": k_true, "backend": backend,
+            "routing": routing,
+            "p50_ms": round(p50 * 1e3, 3), "p99_ms": round(p99 * 1e3, 3),
+            "rows_per_sec": round(n / p50, 1),
+            "modeled_d2h_bytes": d2h,
+            "host_path_bytes": host_bytes,
+            "d2h_within_host_budget": bool(d2h <= host_bytes),
+        }
+    del snap
+    gc.collect()
+    return out
+
+
 def device_sync_floor_ms(iters: int = 5) -> float:
     """One tiny dispatch + blocking fetch — the transport RTT floor.
 
@@ -663,6 +728,38 @@ def main() -> None:
     del table_p, snap_p
     gc.collect()
 
+    # configs 1-2 attribution: kernel-only time of the late-materialized
+    # scan/selection pass via the same RTT-amortized launch-train
+    # discipline.  Config 1's bare scan routes host by design (nothing
+    # to compute, selectivity ≡ 1), so its probe runs a predicate≡true
+    # selection over the same table — the full-feed device pass a scan
+    # WOULD pay, i.e. the floor under any device scan route.
+    for cname, nn, thr in (("1_table_scan", sz(1 << 20), -(10 ** 9)),
+                           ("2_selection", sz(10 * (1 << 20)), 800)):
+        try:
+            t_s, s_s = build_table(nn, groups)
+            kp = runner.probe_scan_kernel(
+                _dag_selection(t_s, thr), s_s)
+            if kp is not None:
+                cfg = configs[cname]
+                cfg["kernel_only_ms"] = kp["kernel_ms"]
+                cfg["kernel_rows_per_sec"] = round(
+                    nn / (kp["kernel_ms"] / 1e3), 1)
+                cfg["kernel_feed_gbps"] = round(
+                    kp["feed_bytes"] / (kp["kernel_ms"] / 1e3) / 1e9, 2)
+            del t_s, s_s
+            gc.collect()
+        except Exception as e:      # noqa: BLE001 — attribution only
+            configs[cname]["kernel_probe_error"] = \
+                f"{type(e).__name__}: {e}"
+
+    # 2s: selection selectivity sweep (routing crossover measurement)
+    try:
+        configs["2s_selection_sweep"] = run_selection_sweep(
+            runner, sz(10 * (1 << 20)), iters)
+    except Exception as e:      # noqa: BLE001 — bench must still report
+        configs["2s_selection_sweep"] = {"error": f"{type(e).__name__}: {e}"}
+
     # 6: the production path on a live server
     try:
         configs["6_production_path"] = run_production_path(runner, iters)
@@ -687,6 +784,8 @@ def main() -> None:
         "configs": configs,
     }))
     for name, c in configs.items():
+        if name == "2s_selection_sweep":
+            continue            # dedicated # routing= lines below
         if "rows_per_sec" not in c:
             print(f"# {name}: {c}", file=sys.stderr)
             continue
@@ -706,6 +805,25 @@ def main() -> None:
               file=sys.stderr)
         print(f"# kernel_rows_per_sec: {c4['kernel_rows_per_sec']:,.0f}",
               file=sys.stderr)
+    # configs 1-2 scan/selection kernel attribution
+    for cname in ("1_table_scan", "2_selection"):
+        c = configs[cname]
+        if "kernel_only_ms" in c:
+            print(f"# {cname}_kernel_only_ms: {c['kernel_only_ms']} "
+                  f"kernel_feed_gbps={c['kernel_feed_gbps']}",
+                  file=sys.stderr)
+    # selection routing crossovers — first-class lines so the
+    # mask/index/compact/host boundaries survive artifact truncation
+    sweep = configs.get("2s_selection_sweep", {})
+    for pname, pt in sweep.items():
+        if not isinstance(pt, dict) or "routing" not in pt:
+            continue
+        print(f"# routing= sel={pname} route={pt['routing']} "
+              f"backend={pt['backend']} selected={pt['selected']} "
+              f"d2h_bytes={pt['modeled_d2h_bytes']} "
+              f"host_bytes={pt['host_path_bytes']} "
+              f"within_budget={pt['d2h_within_host_budget']} "
+              f"p50={pt['p50_ms']}ms", file=sys.stderr)
     conc = configs.get("6_production_path", {}).get("concurrent")
     if conc:
         print(f"# 6c_production_concurrent: {conc['n_inflight']} in-flight "

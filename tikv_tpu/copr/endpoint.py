@@ -90,8 +90,15 @@ class Endpoint:
     # point — and below it the host answer arrives before the device
     # sync would.  2^17 (was 2^18 pre-recovery: the XLA scan paths also
     # paid per-step + fusion-boundary costs that the Pallas kernel
-    # removed, moving the crossover down ~2×).  Tunneled-TPU sessions
-    # (~100 ms RTT floor) should raise this to ~2^22 via config.
+    # removed, moving the crossover down ~2×).  The same 2^17 figure
+    # holds for late-materialized selections (device/selection.py): a
+    # warm selection's floor is also one dispatch + one compact D2H
+    # (n/8-byte mask at worst), so the break-even against the ~100 M
+    # rows/s host predicate pass lands in the same bucket — the
+    # selection-specific crossover that remains is SELECTIVITY, owned
+    # by the runner's per-plan EWMA router, not by this row count.
+    # Tunneled-TPU sessions (~100 ms RTT floor) should raise this to
+    # ~2^22 via config.
     DEFAULT_DEVICE_ROW_THRESHOLD = 131072
 
     def __init__(self, snapshot_provider: Callable[[CopRequest], "ScanStorage"],
@@ -283,7 +290,8 @@ class Endpoint:
                 return CopDeferred(self, req, storage, tag, t0, backend,
                                    result=out)
             # the request's tracker rides to the completion worker so
-            # device_fetch still lands in this request's TimeDetail
+            # d2h_wait/host_materialize still land in this request's
+            # TimeDetail
             cur = tracker.current()
 
             def fetch():

@@ -338,6 +338,16 @@ class MvccColumnarSnapshot:
     def count_rows(self, ranges) -> int:
         return self._tbl.count_rows(ranges)
 
+    def gather_rows(self, desc, ranges, rows):
+        """Late-materialization seam: vectorized alive-mask-aware take
+        of the device selection vector from THIS generation's columnar
+        view (executors/columnar.py gather_rows).  Delta-patched lines
+        are safe by construction — the device feed is lineage-anchored
+        and patched/invalidated before any selection kernel runs, and
+        the gather reads the same pinned-generation buffers the feed
+        reflects."""
+        return self._tbl.gather_rows(desc, ranges, rows)
+
     def row_slices(self, ranges) -> list:
         """Row-index spans covered by ``ranges`` — the device runner's
         bucket-tile mapping (request ranges → feed row spans)."""

@@ -188,6 +188,14 @@ class _Plan:
     order_rpn: Optional[RpnExpression] = None
     order_desc: bool = False
     limit: int = 0
+    # scan_sel only: every scan column rides the feed in a lossless
+    # device dtype, so the compact route may materialize the output on
+    # device (selection.py routing matrix)
+    compact_ok: bool = False
+    # lazy (param_rpns, values, dtypes) from selection.split_params
+    sel_params: Optional[tuple] = None
+    # lazy const-blind stat key (runner._sel_keys)
+    sel_stat_key: Optional[tuple] = None
 
 
 def _sum_parts(parts):
@@ -369,6 +377,14 @@ class DeviceRunner:
         self._dispatch_mu = threading.Lock()
         from collections import OrderedDict
         self._scalar_cache: "OrderedDict" = OrderedDict()
+        # per-plan observed-selectivity EWMAs + aggregate route counts
+        # (selection.py routing); LRU-bounded like the scalar cache
+        self._sel_mu = threading.Lock()
+        self._sel_stats: "OrderedDict" = OrderedDict()
+        self._sel_route_counts: dict = {}
+        # single-slot probe seam (probe_scan_kernel): last selection
+        # dispatch's (plan_key, kernel key, params, n)
+        self._selmask_last: Optional[tuple] = None
         # HBM-resident feed cache — the TPU-native analog of TiKV's
         # in-memory region cache engine (components/
         # region_cache_memory_engine: RangeCacheMemoryEngine layered over
@@ -387,20 +403,112 @@ class DeviceRunner:
         """Should auto-routing pick the device for this plan?
 
         Aggregations and TopN reduce on device (tiny D2H readback) and
-        measure far above the host path; selection-only plans materialize
-        their full output through the host anyway, so the device pass
-        only adds transfer cost — measured slower than the vectorized
-        host path on 10M rows (bench config 2).  The fused direct-index
-        kernel (r6 default for agg shapes) does not change this split:
-        it widens the agg-side win but a selection's cost is still the
-        result transfer, which no kernel removes.  The SIZE crossover
-        lives in Endpoint.device_row_threshold (rationale there).
+        measure far above the host path.  Selections ride the device
+        too since the late-materialization pass (selection.py): the
+        predicate evaluates over the resident HBM feed and only a
+        COMPACT selection vector crosses D2H — n/8 bytes of packed
+        bitmask, 4·K bytes of compacted indices, or K rows of compacted
+        low-width columns, whichever the router's cost model picks —
+        so a selection's transfer now scales with SELECTED rows, not
+        scanned rows.  The remaining selection→host case is
+        selectivity-driven, not structural: past ~95% observed
+        selectivity (per-plan EWMA seeded by the device-side count) the
+        shared k-row materialization dominates both paths and the host
+        pipeline answers without the dispatch round trip; periodic
+        re-probes rediscover workloads whose selectivity drifts back
+        down.  The SIZE crossover lives in
+        Endpoint.device_row_threshold (rationale there).
         force_backend="device" still runs declined shapes for parity
-        testing.
+        testing, and a forced/direct call always dispatches the real
+        kernels regardless of the EWMA.
         """
         plan = self._analyze(dag)
-        return plan is not None and plan.kind in ("simple_agg", "hash_agg",
-                                                  "topn")
+        if plan is None:
+            return False
+        if plan.kind == "scan_sel":
+            return bool(plan.sel_rpns) and \
+                self._sel_allows_device(self._sel_keys(dag, plan))
+        return plan.kind in ("simple_agg", "hash_agg", "topn")
+
+    # -- selectivity-adaptive selection routing (selection.py) --
+
+    _SEL_EWMA_ALPHA = 0.3
+    _SEL_REPROBE = 16       # host-routed plans re-try the device every N
+
+    def _sel_keys(self, dag: DAGRequest, plan: _Plan) -> tuple:
+        """(exact, shape) stat keys.  Exact = the const-inclusive plan
+        key: repeated identical queries get a precise per-threshold
+        EWMA.  Shape = the const-blind predicate structure + table: a
+        parameterized workload rotating constants (`v > ?`) still warms
+        at this level instead of minting a cold stat per value."""
+        if plan.sel_stat_key is None:
+            from .selection import shape_key
+            plan.sel_stat_key = ("shape",
+                                 getattr(plan.scan, "table_id", 0),
+                                 shape_key(plan))
+        return dag.plan_key(), plan.sel_stat_key
+
+    def _sel_stat(self, key, create: bool = True):
+        with self._sel_mu:
+            st = self._sel_stats.get(key)
+            if st is None and create:
+                st = self._sel_stats[key] = \
+                    {"ewma": None, "n_obs": 0, "probe_tick": 0}
+                while len(self._sel_stats) > 256:
+                    self._sel_stats.popitem(last=False)
+            elif st is not None:
+                self._sel_stats.move_to_end(key)
+            return st
+
+    def _sel_observe(self, keys, sel: float) -> None:
+        from ..utils import metrics as m
+        for key in keys:
+            st = self._sel_stat(key)
+            with self._sel_mu:
+                st["ewma"] = sel if st["ewma"] is None else \
+                    (self._SEL_EWMA_ALPHA * sel +
+                     (1 - self._SEL_EWMA_ALPHA) * st["ewma"])
+                st["n_obs"] += 1
+        m.DEVICE_SEL_SELECTIVITY.set(sel)
+
+    def _sel_allows_device(self, keys) -> bool:
+        from .selection import HOST_SELECTIVITY_CUTOFF
+        exact, shape = keys
+        st = self._sel_stat(exact, create=False)
+        if st is None or st["n_obs"] < 2:
+            # no exact history: the shape-level aggregate decides, at a
+            # higher confidence bar (it blends thresholds)
+            st = self._sel_stat(shape, create=False)
+            if st is None or st["n_obs"] < 4:
+                return True
+        if st["ewma"] < HOST_SELECTIVITY_CUTOFF:
+            return True
+        with self._sel_mu:
+            st["probe_tick"] += 1
+            if st["probe_tick"] >= self._SEL_REPROBE:
+                st["probe_tick"] = 0
+                return True
+        return False
+
+    def _sel_predict(self, keys) -> Optional[float]:
+        """EWMA selectivity once warm (≥3 observations; exact plan key
+        preferred, const-blind shape key as fallback), else None — a
+        None sends the request down the cold mask route."""
+        for key in keys:
+            st = self._sel_stat(key, create=False)
+            if st is not None and st["n_obs"] >= 3:
+                return st["ewma"]
+        return None
+
+    def selection_stats(self) -> dict:
+        """Routing-decision + observed-selectivity rollup (/health)."""
+        with self._sel_mu:
+            plans = [{"ewma": round(st["ewma"], 4)
+                      if st["ewma"] is not None else None,
+                      "n_obs": st["n_obs"]}
+                     for st in list(self._sel_stats.values())[-8:]]
+            routes = dict(self._sel_route_counts)
+        return {"routes": routes, "plans": plans}
 
     def _analyze(self, dag: DAGRequest) -> Optional[_Plan]:
         key = dag.plan_key()
@@ -504,6 +612,24 @@ class DeviceRunner:
 
         used = sorted(set().union(*[_rpn_col_indices(r) for r in rpns_to_check])
                       if rpns_to_check else set())
+        if plan.kind == "scan_sel" and self._single and \
+                isinstance(scan, TableScanDesc):
+            # late-materialized selection: when EVERY scan column
+            # round-trips its device dtype losslessly (value-checked int
+            # narrowing; REAL's f32 does not, unsigned BIGINT may exceed
+            # int64), ship them all so the compact route can materialize
+            # the k-row output on device and skip the host gather
+            # entirely (selection.py).  Otherwise only the predicate
+            # columns go to HBM and the mask/index routes gather on
+            # host.  Single-device only — sharded meshes never take the
+            # compact route, so widening would waste H2D/HBM there.
+            lossless = (EvalType.INT, EvalType.DATETIME, EvalType.DURATION)
+            if all(c.is_pk_handle or
+                   (c.field_type.eval_type in lossless and
+                    not c.field_type.is_unsigned)
+                   for c in scan.columns):
+                used = sorted(set(used) | set(range(len(scan.columns))))
+                plan.compact_ok = True
         mapping = {old: new for new, old in enumerate(used)}
         plan.used_cols = used
         plan.sel_rpns = [_remap_rpn(r, mapping) for r in sel_rpns]
@@ -750,12 +876,7 @@ class DeviceRunner:
             self._kernel_cache[cache_key] = kern
         return kern
 
-    def _cached_scalar(self, v, dtype):
-        """Device-resident scalar, uploaded once per value.  A fresh H2D
-        per request adds ~30ms to the next fetch through the tunnel.
-        LRU-bounded: row counts vary per snapshot, so unbounded caching
-        would leak one device buffer per distinct n on a live server."""
-        key = (int(v), str(dtype))
+    def _scalar_cache_get(self, key, v, dtype):
         cache = self._scalar_cache
         arr = cache.get(key)
         if arr is None:
@@ -766,6 +887,21 @@ class DeviceRunner:
         else:
             cache.move_to_end(key)
         return arr
+
+    def _cached_scalar(self, v, dtype):
+        """Device-resident scalar, uploaded once per value.  A fresh H2D
+        per request adds ~30ms to the next fetch through the tunnel.
+        LRU-bounded: row counts vary per snapshot, so unbounded caching
+        would leak one device buffer per distinct n on a live server."""
+        return self._scalar_cache_get((int(v), str(dtype)), v, dtype)
+
+    def _cached_param(self, v, dtype):
+        """Device-resident predicate parameter (selection.py hoisted
+        constants) — same LRU as _cached_scalar but float-capable, so a
+        repeated threshold never re-pays the scalar H2D."""
+        key = ("param", float(v) if isinstance(v, float) else int(v),
+               str(dtype))
+        return self._scalar_cache_get(key, v, dtype)
 
     def _cached_carry(self, cache_key, build):
         """Device-resident initial carry, uploaded once per kernel key.
@@ -1129,14 +1265,6 @@ class DeviceRunner:
 
         return body
 
-    def _build_mask_body(self, plan: _Plan, n_cols: int):
-        def body(carry, aux, base, *flat):
-            row_mask = flat[-1]
-            pairs = [(flat[2 * i], flat[2 * i + 1]) for i in range(n_cols)]
-            return carry, self._eval_masked(plan, pairs,
-                                            row_mask.shape[0], row_mask)
-        return body
-
     def _topn_sort_key(self, plan: _Plan, v, ok, mask):
         """Map the order expression to one descending-top_k sort key.
 
@@ -1261,7 +1389,11 @@ class DeviceRunner:
         """
         from ..utils import tracker
         _fp_degrade("device::before_fetch")
-        with tracker.phase("device_fetch"):
+        # the old monolithic "device_fetch" phase is split so a warm
+        # p50 can be attributed from the artifact alone: "d2h_wait" is
+        # the transfer + sync (here), "host_materialize" is the host
+        # finalize that follows (_finish)
+        with tracker.phase("d2h_wait"):
             leaves, treedef = jax.tree.flatten(tree)
             for x in leaves:
                 try:
@@ -1488,7 +1620,7 @@ class DeviceRunner:
                                             n, get_batch, feed)
                 else:   # scan_sel
                     result = self._run_scan_sel(dag, plan, dtypes, n,
-                                                get_batch, feed)
+                                                get_batch, feed, storage)
             if isinstance(result, _Pending) and not deferred:
                 # synchronous callers block here; the before_fetch
                 # failpoint inside _readback still degrades to host
@@ -1503,8 +1635,10 @@ class DeviceRunner:
 
     def _finish(self, pending: _Pending):
         """Blocking fetch + host finalize for a dispatched request."""
+        from ..utils import tracker
         fetched = self._readback(pending.tree)
-        return pending.finalize(fetched)
+        with tracker.phase("host_materialize"):
+            return pending.finalize(fetched)
 
     @staticmethod
     def _apply_output_offsets(dag, result):
@@ -1568,6 +1702,51 @@ class DeviceRunner:
         outs[-1].block_until_ready()
         per = (_time.perf_counter() - t0) / launches
         return {"kernel_ms": round(per * 1e3, 3), "launches": launches}
+
+    def probe_scan_kernel(self, dag, storage, launches: int = 32):
+        """Diagnostic twin of :meth:`probe_kernel` for the selection /
+        scan mask kernel: amortized kernel-only ms per full-feed
+        predicate pass via an RTT-amortized launch train, plus the feed
+        bytes the pass streams (→ bench's kernel_feed_gbps for configs
+        1-2).  → {"kernel_ms", "launches", "feed_bytes"} or None when
+        the plan has no cached selection kernel."""
+        import time as _time
+        self.handle_request(dag, storage)       # warm: feed + kernel
+        entry = getattr(self, "_selmask_last", None)
+        if entry is None or entry[0] != dag.plan_key():
+            return None
+        _pkey, skey, params, n = entry
+        kern = self._kernel_cache.get(skey)
+        plan = self._analyze(dag)
+        meta = self._request_meta(storage, (dag.plan_key(), dag.ranges))
+        dts = meta.get("dtypes")
+        if kern is None or plan is None or dts is None:
+            return None
+        # THIS plan's feed, by its exact cache key — another plan over
+        # the same snapshot may have a different column set, and timing
+        # the wrong planes would silently corrupt the attribution
+        feed_key = (tuple(plan.scan.columns[ci].col_id
+                          for ci in plan.used_cols), tuple(dts),
+                    dag.ranges)
+        try:
+            cache = self._feed_cache.get(self._feed_anchor(storage))
+        except TypeError:
+            return None
+        feed = (cache or {}).get(feed_key)
+        if feed is None:
+            return None
+        pvals = tuple(self._cached_param(v, dt) for v, dt in params)
+        n_arr = self._cached_scalar(n, jnp.int64)
+        out = kern(n_arr, *pvals, *feed["flat"])
+        jax.block_until_ready(out)              # compile + sync
+        t0 = _time.perf_counter()
+        outs = [kern(n_arr, *pvals, *feed["flat"])
+                for _ in range(launches)]
+        jax.block_until_ready(outs[-1])
+        per = (_time.perf_counter() - t0) / launches
+        feed_bytes = int(sum(a.nbytes for a in feed["flat"]))
+        return {"kernel_ms": round(per * 1e3, 3), "launches": launches,
+                "feed_bytes": feed_bytes}
 
     def _request_meta(self, storage, meta_key) -> dict:
         """Snapshot-lifetime memo for host-derived request constants
@@ -2207,32 +2386,186 @@ class DeviceRunner:
                 out.append(max(widths))
         return tuple(out)
 
-    # -- selection (mask on device, compact on host) --
+    # -- selection (late materialization: predicate on device, COMPACT
+    #    selection vector over D2H, alive-mask-aware host gather) --
 
-    def _run_scan_sel(self, dag, plan, dtypes, n, get_batch, feed):
-        chunk = self._pick_chunk(feed["n_pad"], _CHUNK_AGG)
-        S = self._nshards()
-        key = self._kern_key("mask", dag, feed, chunk, tuple(dtypes))
-        kern = self._shard_kernel(
-            key, lambda: self._wrap_mega(
-                self._mega(self._build_mask_body(plan, len(plan.used_cols)),
-                           lambda c: c, feed["null_flags"], feed["n_pad"],
-                           chunk, emits=True),
-                ((), ()), len(feed["flat"]),
-                ys_specs=P(None, ROW_AXES)))
+    def _sel_route_note(self, route: str) -> None:
+        from ..utils import metrics as m
+        from ..utils import tracker
+        tracker.label("routing", route)
+        m.DEVICE_SEL_ROUTE_COUNTER.labels(route).inc()
+        with self._sel_mu:
+            self._sel_route_counts[route] = \
+                self._sel_route_counts.get(route, 0) + 1
+
+    def _run_scan_sel(self, dag, plan, dtypes, n, get_batch, feed,
+                      storage):
+        """Device selection whose D2H volume scales with SELECTED rows.
+
+        One fused dispatch evaluates the predicates over the resident
+        feed and leaves (count, packed bitmask, bool mask) on device.
+        The router (selection.choose_route) then moves the cheapest
+        selection vector: the packed mask (n/8 bytes), compacted row
+        indices (4·K bytes, second tiny dispatch consuming the resident
+        mask), or — small k on a single device — the projected columns
+        themselves, compacted on device so the host gather is skipped.
+        NOTHING blocks under the dispatch lock: cold requests take the
+        always-correct mask route while the device-side count — a
+        scalar leaf of every route's readback — rides home with the
+        result and seeds the per-plan selectivity EWMA; warm requests
+        route by the EWMA with capacity headroom (an undersized
+        capacity surfaces as an overflow flag at fetch time and falls
+        back to the still-resident packed mask — never a truncated
+        result).
+        """
+        from . import selection as selmod
         from ..utils import tracker as _tracker
-        with _tracker.phase("device_dispatch"):
-            _, ys = kern(((), ()), self._cached_scalar(n, jnp.int64),
-                         self._cached_scalar(0, jnp.int64), *feed["flat"])
+        n_pad = feed["n_pad"]
+        n_local = n_pad // self._nshards()
+        pkey = dag.plan_key()
+        stat_keys = self._sel_keys(dag, plan)
 
-        def fin(fetched):
-            nblk = feed["n_pad"] // chunk
-            full = fetched.reshape(nblk, S, chunk // S).transpose(1, 0, 2) \
-                .reshape(feed["n_pad"])[:n]
-            out = get_batch().filter(full)
+        if plan.sel_params is None:
+            plan.sel_params = selmod.split_params(plan.sel_rpns,
+                                                  len(plan.used_cols))
+        param_rpns, param_vals, param_dts = plan.sel_params
+        # const-blind kernel key: repeated selections at differing
+        # thresholds within one n_pad bucket share ONE compile class
+        skey = ("selmask", selmod.shape_key(plan), feed["null_flags"],
+                n_pad, tuple(dtypes), param_dts)
+        kern = self._shard_kernel(skey, lambda: selmod.build_mask_kernel(
+            param_rpns, feed["null_flags"], n_pad, len(feed["flat"]),
+            len(param_dts), None if self._single else self._mesh))
+        params = tuple(self._cached_param(v, dt)
+                       for v, dt in zip(param_vals, param_dts))
+        with _tracker.phase("device_dispatch"):
+            count_dev, packed_dev, mask_dev = kern(
+                self._cached_scalar(n, jnp.int64), *params, *feed["flat"])
+        # bench attribution seam (probe_scan_kernel launch train): ONE
+        # slot, not a per-plan-key cache entry — const-inclusive keys
+        # would grow the kernel cache per distinct threshold forever
+        self._selmask_last = (pkey, skey,
+                              tuple(zip(param_vals, param_dts)), n)
+
+        pred = self._sel_predict(stat_keys)
+        if pred is None:
+            # cold: take the always-correct mask route rather than sync
+            # the count here — this runs under _dispatch_mu, and a
+            # blocking D2H would serialize every in-flight dispatch
+            # behind this kernel (the lock's contract: fetches block
+            # OUTSIDE it).  The count leaf seeds the EWMA at finalize.
+            route = selmod.ROUTE_MASK
+            cap = 0
+        else:
+            k_est = pred * n
+            cap = selmod.index_capacity(k_est * 1.5 + 64, n_local)
+            # the index comparison uses the REAL transfer — per-shard
+            # pow2 capacity × shard count — not 4·k, which understates
+            # it several-fold near the crossover
+            route = selmod.choose_route(
+                n, k_est, plan.compact_ok and self._single,
+                idx_bytes=4 * cap * self._nshards())
+        gather_ok = isinstance(plan.scan, TableScanDesc) and \
+            hasattr(storage, "gather_rows")
+
+        def gather(sel):
+            """sel: bool mask over the scan output, or ascending feed
+            positions.  The columnar snapshot's alive-mask-aware
+            vectorized take (ColumnarTable.gather_rows) serves both;
+            storages without it (row-codec fixtures) pay the batch."""
+            if gather_ok:
+                out = storage.gather_rows(plan.scan, dag.ranges, sel)
+            else:
+                b = get_batch()
+                out = b.filter(sel) if sel.dtype == np.bool_ else b.take(sel)
             return self._result(dag, out.schema, out.columns)
 
-        return _Pending(ys, fin, small=False)
+        def mask_from_packed(packed_np):
+            return np.unpackbits(packed_np)[:n].astype(np.bool_)
+
+        def observe(cnt) -> int:
+            k = int(cnt)
+            self._sel_observe(stat_keys, k / n if n else 0.0)
+            return k
+
+        def fallback_to_mask():
+            # predicted capacity undersized: the packed bitmask is
+            # still device-resident — fetch it instead (plain D2H, no
+            # dispatch lock needed)
+            self._sel_route_note("mask_fallback")
+            _tracker.label("routing", selmod.ROUTE_MASK)
+            return gather(mask_from_packed(np.asarray(packed_dev)))
+
+        if route == selmod.ROUTE_COMPACT:
+            ckey = ("selcompact", n_pad, cap, feed["null_flags"],
+                    tuple(dtypes))
+            ckern = self._shard_kernel(
+                ckey, lambda: selmod.build_compact_kernel(
+                    n_pad, cap, feed["null_flags"]))
+            with _tracker.phase("device_dispatch"):
+                outs_dev, ovf_dev = ckern(mask_dev, *feed["flat"])
+            self._sel_route_note(route)
+            scan_cols = plan.scan.columns
+
+            def fin_compact(fetched):
+                cnt, outs, ovf = fetched
+                k = observe(cnt)
+                if int(ovf):
+                    return fallback_to_mask()
+                schema, cols = [], []
+                oi = 0
+                for ci, info in enumerate(scan_cols):
+                    et = EvalType.INT if info.is_pk_handle \
+                        else info.field_type.eval_type
+                    vals = outs[oi][:k]
+                    oi += 1
+                    if feed["null_flags"][ci]:
+                        valid = outs[oi][:k].astype(np.bool_)
+                        oi += 1
+                    else:
+                        valid = np.ones(k, np.bool_)
+                    hdt = np.uint64 if et is EvalType.DATETIME else np.int64
+                    schema.append(info.field_type)
+                    cols.append(Column(et, vals.astype(hdt, copy=False),
+                                       valid))
+                return self._result(dag, schema, cols)
+
+            payload = cap * (sum(np.dtype(ds).itemsize for ds in dtypes)
+                             + sum(feed["null_flags"]))
+            return _Pending((count_dev, outs_dev, ovf_dev), fin_compact,
+                            small=payload <= (1 << 16))
+
+        if route == selmod.ROUTE_INDEX:
+            # plan-independent kernels: every selection shares them
+            ikey = ("selidx", n_pad, cap)
+            ikern = self._shard_kernel(
+                ikey, lambda: selmod.build_index_kernel(
+                    n_pad, cap, None if self._single else self._mesh))
+            with _tracker.phase("device_dispatch"):
+                idx_dev, ovf_dev = ikern(mask_dev)
+            self._sel_route_note(route)
+
+            def fin_index(fetched):
+                cnt, idx, ovf = fetched
+                observe(cnt)
+                if int(ovf):
+                    return fallback_to_mask()
+                ids = np.asarray(idx, dtype=np.int64)
+                return gather(ids[ids >= 0])
+
+            # "small" is a completion-pool priority hint for KB-class
+            # fetches; a capacity near the 3.1% crossover can be MBs
+            return _Pending((count_dev, idx_dev, ovf_dev), fin_index,
+                            small=4 * cap * self._nshards() <= (1 << 16))
+
+        self._sel_route_note(selmod.ROUTE_MASK)
+
+        def fin_mask(fetched):
+            cnt, packed = fetched
+            observe(cnt)
+            return gather(mask_from_packed(packed))
+
+        return _Pending((count_dev, packed_dev), fin_mask, small=False)
 
     # -- top-n --
 

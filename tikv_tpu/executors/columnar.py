@@ -225,6 +225,75 @@ class ColumnarTable:
             out_cols.append(Column(col.eval_type, v, m))
         return ColumnBatch([c.field_type for c in desc.columns], out_cols)
 
+    # -- late-materialized gather (device selection vector → rows) ----------
+
+    def _feed_positions(self, slices: tuple, desc: bool) -> np.ndarray:
+        """Memoized map from scan-output position → physical row index,
+        reproducing ``scan_columns``'s exact ordering (alive filtering,
+        slice order, descending reversal).  The device selection path
+        addresses rows by scan-output position, so this is the bridge
+        back to the snapshot's physical arrays."""
+        cache = getattr(self, "_feed_pos_cache", None)
+        if cache is None:
+            cache = self._feed_pos_cache = {}
+        key = (slices, desc)
+        pos = cache.get(key)
+        if pos is None:
+            parts = []
+            for i, j in (reversed(slices) if desc else slices):
+                ids = np.arange(i, j, dtype=np.int64)
+                if self.alive is not None:
+                    ids = ids[self.alive[i:j]]
+                if desc:
+                    ids = ids[::-1]
+                parts.append(ids)
+            pos = parts[0] if len(parts) == 1 else (
+                np.concatenate(parts) if parts
+                else np.empty(0, np.int64))
+            cache[key] = pos
+        return pos
+
+    def gather_rows(self, desc, ranges: Sequence[KeyRange],
+                    rows) -> ColumnBatch:
+        """Vectorized take of ``rows`` from the scan output WITHOUT
+        materializing the full scan first (the late-materialization
+        gather: the device ships a compact selection vector, the host
+        touches only the k surviving rows of the resident columnar
+        snapshot).
+
+        ``rows``: a bool mask over the scan output, or an int array of
+        ascending scan-output positions.  Alive-mask tombstones and
+        multi-range/descending scans are honored via the memoized
+        position map; the common full-range ascending no-tombstone case
+        gathers straight off the physical arrays.
+        """
+        if isinstance(desc, IndexScanDesc):
+            raise ValueError("gather_rows serves table scans; index "
+                             "scans use the sorted-view path")
+        slices = tuple(self._range_slices(ranges))
+        rows = np.asarray(rows)
+        if self.alive is None and not desc.desc and len(slices) <= 1:
+            lo = slices[0][0] if slices else 0
+            phys = (np.flatnonzero(rows) + lo) if rows.dtype == np.bool_ \
+                else rows + lo
+        else:
+            phys = self._feed_positions(slices, desc.desc)[rows]
+        out_cols = []
+        for info in desc.columns:
+            if info.is_pk_handle:
+                out_cols.append(Column(EvalType.INT, self.handles[phys],
+                                       self._ones(len(phys))))
+                continue
+            col = self.columns.get(info.col_id)
+            if col is None:
+                out_cols.append(Column.from_list(
+                    info.field_type.eval_type,
+                    [info.default_value] * len(phys)))
+                continue
+            out_cols.append(Column(col.eval_type, col.values[phys],
+                                   col.validity[phys]))
+        return ColumnBatch([c.field_type for c in desc.columns], out_cols)
+
     def _index_sorted(self, col_id: int):
         """Memoized (value, handle)-sorted view of one indexed column:
         → (svals, svalid, shandles, n_nulls).  MySQL NULLs sort first."""

@@ -468,6 +468,7 @@ class Node:
             delta_source=self.copr_delta_sink,
             compact_ratio=config.coprocessor.tombstone_compact_ratio,
             max_delta_rows=config.coprocessor.delta_log_rows)
+        self.device_runner = device_runner      # /health selection rollup
         self.endpoint = Endpoint(self._copr_snapshot,
                                  device_runner=device_runner,
                                  device_row_threshold=device_row_threshold)

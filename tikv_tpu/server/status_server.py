@@ -80,6 +80,11 @@ class StatusServer:
                         # rebuild counters, per-line tombstone ratio,
                         # delta-log depth
                         body["copr_cache"] = cc.stats()
+                    dr = getattr(node, "device_runner", None)
+                    if dr is not None and hasattr(dr, "selection_stats"):
+                        # late-materialized selection: routing-decision
+                        # counts + per-plan observed-selectivity EWMAs
+                        body["device_selection"] = dr.selection_stats()
                     self._json(200, body)
                 elif path == "/config":
                     if outer._controller is None:

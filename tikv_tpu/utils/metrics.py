@@ -325,6 +325,15 @@ HEDGE_COUNTER = REGISTRY.counter(
     "tikv_client_hedged_reads_total",
     "hedged point reads by outcome (fired / follower_won / leader_won)",
     labels=("outcome",))
+DEVICE_SEL_ROUTE_COUNTER = REGISTRY.counter(
+    "tikv_device_selection_route_total",
+    "late-materialized device selection routing decisions "
+    "(mask / index / compact / mask_fallback = capacity overflow)",
+    labels=("route",))
+DEVICE_SEL_SELECTIVITY = REGISTRY.gauge(
+    "tikv_device_selection_observed_selectivity",
+    "last device-side observed selection selectivity "
+    "(selected rows / scanned rows — the routing cost-model input)")
 SCHED_COMMANDS = REGISTRY.counter(
     "tikv_scheduler_commands_total", "txn scheduler commands",
     labels=("type",))
