@@ -536,7 +536,23 @@ def run_write_churn(device_runner, iters: int):
         assert node.copr_cache.rebuilds == rebuilds0, \
             "write churn tore down a delta-maintained line"
         cl = np.asarray(churn_lat)
+        # integrity-path overhead (device-state supervisor): one scrub
+        # pass over everything resident after the churn, plus the feed
+        # arena's accounting — tracked per PR so digest/scrub/eviction
+        # costs on the churn path are a first-class artifact
+        scrub = node.device_supervisor.scrub()
+        hbm = device_runner.hbm_stats() \
+            if hasattr(device_runner, "hbm_stats") else {}
         return {
+            "scrub_lines": scrub.get("lines", 0),
+            "scrub_planes": scrub.get("planes", 0),
+            "scrub_divergences": scrub.get("divergences", 0),
+            "scrub_ms": scrub.get("ms", 0.0),
+            "evictions": hbm.get("evictions", 0),
+            "hbm_resident_mb": round(
+                hbm.get("resident_bytes", 0) / (1 << 20), 3),
+            "hbm_budget_mb": round(
+                hbm.get("budget_bytes", 0) / (1 << 20), 3),
             "rows": n,
             "backend": warm["backend"],
             "load_rows_per_sec": round(n / load_s, 1),
@@ -846,6 +862,17 @@ def main() -> None:
         print(f"# 6w_churn: p50={cw['p50_ms']}ms p99={cw['p99_ms']}ms "
               f"writes/s={cw['churn_writes_per_sec']}", file=sys.stderr)
         print(f"# load_rows_per_sec: {cw['load_rows_per_sec']:,.0f}",
+              file=sys.stderr)
+        # device-state integrity overhead (supervisor scrub + arena):
+        # the BENCH json tracks these per PR so digest maintenance and
+        # eviction pressure on the churn path stay visible
+        print(f"# scrub= lines={cw.get('scrub_lines', 0)} "
+              f"planes={cw.get('scrub_planes', 0)} "
+              f"divergences={cw.get('scrub_divergences', 0)} "
+              f"ms={cw.get('scrub_ms', 0.0)}", file=sys.stderr)
+        print(f"# evictions= {cw.get('evictions', 0)}", file=sys.stderr)
+        print(f"# hbm_resident_mb= {cw.get('hbm_resident_mb', 0.0)} "
+              f"(budget_mb={cw.get('hbm_budget_mb', 0.0)})",
               file=sys.stderr)
 
 

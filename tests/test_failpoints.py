@@ -358,8 +358,14 @@ def test_failpoint_inventory_resolves():
         sites |= set(re.findall(r'fail_point\(\s*"([^"]+)"', text))
         # device/runner.py routes its sites through _fp_degrade()
         sites |= set(re.findall(r'_fp_degrade\(\s*"([^"]+)"', text))
-    # the mesh from PR 1 plus this PR's additions must not shrink
-    assert len(sites) >= 60, f"only {len(sites)} unique sites"
+    # the mesh from PR 1 plus later PRs' additions must not shrink
+    # (≥63 since the device-state integrity sites: device::hbm_oom
+    # budget squeeze, device::feed_corrupt resident-plane bit-flip,
+    # device::d2h_corrupt detected transfer corruption)
+    assert len(sites) >= 63, f"only {len(sites)} unique sites"
+    for dev_site in ("device::hbm_oom", "device::feed_corrupt",
+                     "device::d2h_corrupt"):
+        assert dev_site in sites, f"missing device fault site {dev_site}"
 
     nemesis_src = (root / "chaos" / "nemesis.py").read_text()
     referenced = set(re.findall(r'failpoint\.cfg\(\s*"([^"]+)"',

@@ -18,13 +18,17 @@ from .invariants import (        # noqa: F401
     RaftStateTracker,
     check_conservation,
     check_goodput,
+    check_hbm_within_budget,
     check_no_late_acks,
     check_no_lost_acks,
+    check_no_stale_epoch,
     check_read_correctness,
     check_replica_consistency,
+    check_scrub_clean,
 )
 from .nemesis import (           # noqa: F401
     CRASH_SITES,
+    DEVICE_FAULT_KINDS,
     FAULT_KINDS,
     Fault,
     Nemesis,

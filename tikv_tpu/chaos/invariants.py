@@ -132,6 +132,51 @@ def check_read_correctness(results, model: dict) -> None:
                 f"model holds {model[r['key']]!r}")
 
 
+def check_hbm_within_budget(runner) -> None:
+    """Device-state integrity: the feed arena's resident bytes never
+    exceed the configured HBM budget — admission/eviction holds under
+    churn, splits, and hbm_oom squeezes (unpinned lines evict; a feed
+    that cannot fit serves transiently and is never retained)."""
+    st = runner.hbm_stats()
+    # pinned bytes are in use by launched kernels and CANNOT be
+    # reclaimed until their fetch completes — the cap may be exceeded
+    # by at most that much, never by evictable state
+    slack = st.get("pinned_bytes", 0)
+    if st["budget_bytes"] > 0 and \
+            st["resident_bytes"] > st["budget_bytes"] + slack:
+        raise InvariantViolation(
+            f"HBM resident {st['resident_bytes']}B exceeds the "
+            f"{st['budget_bytes']}B budget "
+            f"(+{slack}B pinned slack; {st['resident_lines']} lines, "
+            f"{st['pinned_lines']} pinned)")
+
+
+def check_no_stale_epoch(node) -> None:
+    """Every resident columnar cache line belongs to a region this node
+    still hosts, at that region's CURRENT epoch — lifecycle teardown
+    (split/merge/leader loss/destroy) left no stale-epoch line behind
+    to serve a superseded key range."""
+    current = {rid: p.region.epoch.version
+               for rid, p in node.raft_store.peers.items()}
+    for ln in node.copr_cache.stats()["lines"]:
+        want = current.get(ln["region"])
+        if want is None or ln["epoch"] != want:
+            raise InvariantViolation(
+                f"stale cache line: region {ln['region']} epoch "
+                f"{ln['epoch']} (current: {want})")
+
+
+def check_scrub_clean(supervisor) -> None:
+    """A quiesced, healed system scrubs clean: every resident device
+    plane re-hashes to its recorded digest (any injected corruption was
+    caught, quarantined, and rebuilt before this point)."""
+    out = supervisor.scrub()
+    if out["divergences"]:
+        raise InvariantViolation(
+            f"scrub found {out['divergences']} diverged line(s) after "
+            f"heal: {out}")
+
+
 def check_goodput(results, floor: float) -> None:
     """The served fraction stays above ``floor`` during the brownout —
     fail-slow must not degrade into fail-stop."""

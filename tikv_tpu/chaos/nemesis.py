@@ -22,6 +22,16 @@ from ..utils.failpoint import FailpointPanic
 FAULT_KINDS = ("partition", "asym_partition", "leader_isolate",
                "crash_restart", "msg_chaos", "disk_stall", "fail_slow")
 
+# device faults (opt-in: schedules against device-serving rigs pass
+# them explicitly — the in-process raft cluster has no accelerator):
+# hbm_squeeze arms device::hbm_oom so the feed arena's effective budget
+# collapses (eviction pressure / transient feeds); feed_corrupt arms
+# device::feed_corrupt so the next scrub pass bit-flips a resident
+# plane and must catch it; d2h_corrupt arms device::d2h_corrupt so a
+# fraction of fetches surface as detected transfer corruption and
+# degrade to the host pipeline
+DEVICE_FAULT_KINDS = ("hbm_squeeze", "feed_corrupt", "d2h_corrupt")
+
 # crash boundaries: a ``panic`` here unwinds out of the drive loop like
 # a process kill at that point of the write path (the same boundaries
 # the reference's failpoint cases crash at)
@@ -72,6 +82,12 @@ def generate_schedule(seed: int, steps: int,
         elif kind == "fail_slow":
             out.append(_mk(kind, store=rng.choice(stores),
                            ms=rng.choice((10, 20, 40))))
+        elif kind == "hbm_squeeze":
+            out.append(_mk(kind, bytes=rng.choice((0, 1 << 16, 1 << 20))))
+        elif kind == "feed_corrupt":
+            out.append(_mk(kind))
+        elif kind == "d2h_corrupt":
+            out.append(_mk(kind, pct=rng.choice((25, 50, 100))))
         else:   # pragma: no cover
             raise ValueError(kind)
     return out
@@ -141,6 +157,28 @@ class Nemesis:
             if cur is not None:
                 cur.slow_down(0.0)
         self._heals.append(heal)
+
+    # -- device faults: armed via failpoints; the device-state
+    #    supervisor (budget/eviction, scrub+quarantine, degrade-to-host
+    #    fetches) must keep every served answer correct under them
+
+    def _apply_hbm_squeeze(self, fault: Fault) -> None:
+        failpoint.cfg("device::hbm_oom",
+                      f"return({fault.param('bytes', 0)})")
+        self._heals.append(lambda: failpoint.remove("device::hbm_oom"))
+
+    def _apply_feed_corrupt(self, fault: Fault) -> None:
+        # 1*return: exactly one resident plane takes the bit-flip; the
+        # scrub pass that trips it must detect + quarantine
+        failpoint.cfg("device::feed_corrupt", "1*return")
+        self._heals.append(
+            lambda: failpoint.remove("device::feed_corrupt"))
+
+    def _apply_d2h_corrupt(self, fault: Fault) -> None:
+        pct = fault.param("pct", 100)
+        failpoint.cfg("device::d2h_corrupt", f"{pct}%return")
+        self._heals.append(
+            lambda: failpoint.remove("device::d2h_corrupt"))
 
     def _apply_disk_stall(self, fault: Fault) -> None:
         ms = fault.param("ms", 5)

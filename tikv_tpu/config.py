@@ -81,6 +81,17 @@ class CoprocessorConfig:
     # compact a delta-maintained line when pending delete tombstones
     # exceed this fraction of its rows
     tombstone_compact_ratio: float = 0.25
+    # device-state integrity (device/supervisor.py): HBM budget for the
+    # runner's feed arena in MiB (0 = unlimited — accounting only) and
+    # the background scrub cadence in seconds (0 = scrub on demand).
+    # scrub_digests records per-plane content digests at feed build
+    # (one vectorized host pass per plane) and patch time (one tiny
+    # device reduction per plane) — the audit the scrubber compares
+    # against; disable to shave the cold-upload/patch overhead on
+    # deployments that never scrub
+    device_hbm_budget_mb: int = 0
+    scrub_interval_s: float = 0.0
+    scrub_digests: bool = True
 
 
 @dataclass
@@ -161,6 +172,7 @@ _ONLINE_FIELDS = {
     "coprocessor.region_cache_capacity",
     "coprocessor.response_page_rows",
     "coprocessor.tombstone_compact_ratio",
+    "coprocessor.device_hbm_budget_mb",
     "readpool.concurrency",
 }
 

@@ -122,7 +122,7 @@ def decode_entry_ops(ops: Sequence):
 
 
 class _RegionLog:
-    __slots__ = ("log", "covered_from", "rows")
+    __slots__ = ("log", "covered_from", "rows", "epoch_version")
 
     def __init__(self):
         # (index, tuple[RowDelta], tuple[LockDelta]) in apply order
@@ -131,6 +131,11 @@ class _RegionLog:
         # coverage unknown (poisoned) until the next applied data write
         self.covered_from: Optional[int] = None
         self.rows = 0           # total RowDelta records retained
+        # last region epoch VERSION observed via on_region_changed;
+        # None until the first event.  Conf changes bump conf_ver only
+        # — same version means the key range did not move, so coverage
+        # survives
+        self.epoch_version: Optional[int] = None
 
 
 class DeltaSink(Observer):
@@ -199,6 +204,43 @@ class DeltaSink(Observer):
             st.rows = 0
             st.covered_from = index
             self._export_depth(region_id, st)
+
+    def on_region_changed(self, region) -> None:
+        """Split/merge/epoch change: the region's key range moved, so
+        deltas logged against the old shape must not bridge lines built
+        against the new one.  Poison coverage (covered_from=None); the
+        next applied data write re-covers from its own index — one
+        rebuild per epoch change, never a wrong bridge.  Conf changes
+        (epoch VERSION unchanged, only conf_ver moved) keep coverage:
+        the key range did not move, and poisoning would force a full
+        rebuild of a line the lifecycle teardown deliberately kept.
+        The FIRST observed event still poisons (epoch unknown until
+        then — conservatively assume the range moved); every later
+        same-version event keeps coverage."""
+        with self._mu:
+            st = self._regions.get(region.id)
+            if st is None:
+                return
+            ver = region.epoch.version
+            if st.epoch_version == ver:
+                return          # conf change / same-shape event
+            st.epoch_version = ver
+            st.log.clear()
+            st.rows = 0
+            st.covered_from = None
+            self._export_depth(region.id, st)
+
+    def on_peer_destroyed(self, region_id: int) -> None:
+        self.drop_region(region_id)
+
+    def drop_region(self, region_id: int) -> None:
+        """Peer destroyed (merge-away / conf-change removal): the log
+        dies with it — an explicit teardown instead of waiting for the
+        LRU to age the dead region out."""
+        with self._mu:
+            st = self._regions.pop(region_id, None)
+            if st is not None:
+                self._drop_gauges(region_id)
 
     # -- consumer API ---------------------------------------------------
 

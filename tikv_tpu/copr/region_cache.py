@@ -48,10 +48,30 @@ would.
 
 Each line owns a :class:`FeedLineage` — a patch journal with stable
 object identity across delta generations.  The device runner keys its
-HBM feed cache on it (device/runner.py _feed_cache) and replays the
+HBM feed cache on it (device/runner.py feed arena) and replays the
 journal's dirty row spans with chunked ``device_put`` +
 ``dynamic_update_slice`` instead of re-uploading the whole feed, so a
 point write costs a tile patch, not a cold feed.
+
+Lines are torn down as deliberately as they are maintained (the
+device-state supervisor, device/supervisor.py):
+
+- **lifecycle invalidation** — :meth:`RegionColumnarCache.
+  invalidate_region` drops a region's lines on split/merge/epoch
+  change (superseded epochs only), leader loss, snapshot apply and
+  peer destroy, instead of letting stale-epoch lines age out of the
+  LRU;
+- **explicit feed teardown** — every retirement path (lifecycle,
+  LRU eviction, rebuild replacement, failed bridge) fires the
+  ``on_line_retired`` callback with the line's FeedLineage, which the
+  supervisor routes to ``DeviceRunner.drop_feed`` so the HBM feed and
+  its accounting die with the line — no ``gc.collect`` timing in the
+  loop;
+- **scrub audit trail** — the lineage records the per-plane content
+  digests the runner computes at feed build/patch time
+  (``feed_digests``); a background scrubber re-hashes the resident
+  device planes and quarantines any line whose planes diverge (the
+  region degrades to the host backend, then rebuilds from host truth).
 """
 
 from __future__ import annotations
@@ -370,7 +390,7 @@ class FeedLineage:
     """
 
     __slots__ = ("version", "_base", "_patches", "_max", "_mu",
-                 "__weakref__")
+                 "feed_digests", "region_hint", "__weakref__")
 
     def __init__(self, max_patches: int = 64):
         self.version = 0
@@ -378,6 +398,12 @@ class FeedLineage:
         self._patches: list = []
         self._max = max_patches
         self._mu = threading.Lock()
+        # device-state integrity bookkeeping (device/supervisor.py):
+        # the runner mirrors each feed's per-plane content digests here
+        # at build/patch time — {feed_key: (version, digest tuple)} —
+        # and region teardown uses region_hint to attribute quarantines
+        self.feed_digests: dict = {}
+        self.region_hint = None
 
     def record(self, patch: dict) -> None:
         with self._mu:
@@ -594,6 +620,20 @@ class RegionColumnarCache:
         self.deltas = 0         # data-version gaps bridged by patching
         self.rebuilds = 0       # gaps that fell back to a full rebuild
         self.compactions = 0
+        self.invalidations = 0  # lines dropped by lifecycle events
+        # epoch fence: region id -> lowest epoch version still allowed
+        # to cache.  A build racing a split can otherwise re-insert a
+        # superseded-epoch line AFTER invalidate_region already swept it
+        self._epoch_floor: dict = {}
+        # sweep-generation fence for SAME-epoch invalidations (leader
+        # loss, snapshot apply, peer destroy): a build that started
+        # before the sweep serves its answer but must not re-insert
+        self._sweep_gen: dict = {}
+        # retirement hook: called with each dropped line's FeedLineage
+        # (lifecycle invalidation, LRU eviction, rebuild replacement,
+        # failed bridge) — the device-state supervisor wires this to
+        # DeviceRunner.drop_feed so HBM teardown is explicit
+        self.on_line_retired = None
 
     # -- observability --------------------------------------------------
 
@@ -601,6 +641,7 @@ class RegionColumnarCache:
         with self._lock:
             lines = [{
                 "region": key[0],
+                "epoch": key[1],
                 "table": key[2],
                 "data_index": line.data_index,
                 "rows": line.state.n if line.state else 0,
@@ -608,13 +649,98 @@ class RegionColumnarCache:
                 if line.state else 0.0,
                 "feed_version": line.state.lineage.version
                 if line.state else 0,
+                # the lineage's digest journal (mirrored by the device
+                # runner at feed build/patch time) — the host-visible
+                # audit record per line: how many feeds carry digests
+                # and the newest generation they cover.  Snapshot the
+                # dict ONCE (C-atomic) — the runner inserts under its
+                # own lock, and iterating live would race
+                **self._digest_summary(line),
             } for key, line in self._lines.items()]
         out = {"hits": self.hits, "misses": self.misses,
                "deltas": self.deltas, "rebuilds": self.rebuilds,
-               "compactions": self.compactions, "lines": lines}
+               "compactions": self.compactions,
+               "invalidations": self.invalidations,
+               "resident_lines": len(lines), "lines": lines}
         if self._delta_source is not None:
             out["delta_log"] = self._delta_source.stats()
         return out
+
+    @staticmethod
+    def _digest_summary(line) -> dict:
+        if line.state is None:
+            return {"digest_feeds": 0, "digest_version": None}
+        vals = list(line.state.lineage.feed_digests.values())
+        return {
+            "digest_feeds": len(vals),
+            "digest_version": max((v for v, _d in vals
+                                   if v is not None), default=None),
+        }
+
+    def _publish_lines(self) -> None:
+        from ..utils.metrics import COPR_RESIDENT_LINES
+        COPR_RESIDENT_LINES.set(len(self._lines))
+
+    # -- lifecycle teardown ---------------------------------------------
+
+    def _retire(self, line) -> None:
+        """Hand the dropped line's lineage to the retirement hook (feed
+        teardown).  Never raises: teardown runs on apply/drive paths."""
+        lineage = line.state.lineage if line is not None and \
+            line.state is not None else None
+        cb = self.on_line_retired
+        if cb is not None and lineage is not None:
+            try:
+                cb(lineage)
+            except Exception:   # noqa: BLE001 — teardown is best-effort
+                import logging
+                logging.getLogger(__name__).warning(
+                    "cache line retirement hook failed", exc_info=True)
+
+    def invalidate_region(self, region_id: int,
+                          keep_epoch: Optional[int] = None) -> int:
+        """Eagerly drop ``region_id``'s lines — the lifecycle teardown
+        entry point (split/merge/epoch change pass ``keep_epoch`` =
+        the surviving epoch version; leader loss / snapshot apply /
+        peer destroy drop everything).  Superseded-epoch lines can
+        never be hit again (the key embeds the epoch), so without this
+        they would linger until LRU pressure or GC."""
+        dropped = []
+        with self._lock:
+            if keep_epoch is not None:
+                # fence in-flight builds: a pre-split snapshot's build
+                # finishing after this sweep must not resurrect a
+                # superseded-epoch line (it serves uncached instead).
+                # Re-inserting moves the key to the dict's end, so the
+                # size bound below evicts the LEAST-RECENTLY-UPDATED
+                # region's fence, never a hot one's
+                floor = max(self._epoch_floor.pop(region_id, 0),
+                            keep_epoch)
+                self._epoch_floor[region_id] = floor
+                while len(self._epoch_floor) > 4096:
+                    self._epoch_floor.pop(next(iter(self._epoch_floor)))
+            else:
+                # same-epoch sweeps (leader loss / snapshot apply /
+                # destroy) are fenced by generation: any build in
+                # flight re-checks the gen before inserting.  Split
+                # sweeps must NOT bump it — a build at the SURVIVING
+                # epoch is welcome to cache (old epochs are fenced by
+                # the floor above)
+                gen = self._sweep_gen.pop(region_id, 0) + 1
+                self._sweep_gen[region_id] = gen
+                while len(self._sweep_gen) > 4096:
+                    self._sweep_gen.pop(next(iter(self._sweep_gen)))
+            for key in list(self._lines):
+                if key[0] != region_id:
+                    continue
+                if keep_epoch is not None and key[1] == keep_epoch:
+                    continue
+                dropped.append(self._lines.pop(key))
+            self.invalidations += len(dropped)
+            self._publish_lines()
+        for line in dropped:
+            self._retire(line)
+        return len(dropped)
 
     # -- lookup ---------------------------------------------------------
 
@@ -649,12 +775,16 @@ class RegionColumnarCache:
                 wait_ev = self._building.get(bkey)
                 if wait_ev is None:
                     self._building[bkey] = threading.Event()
+                    # generation at build start: an invalidation sweep
+                    # landing while we build fences the insert
+                    gen0 = self._sweep_gen.get(base_key[0], 0)
             if wait_ev is not None:
                 wait_ev.wait()
                 continue        # re-check: the builder's entry may serve us
             try:
                 ent, lock_src = self._materialize(
-                    snap, dag, base_key, line, data_index, start_ts)
+                    snap, dag, base_key, line, data_index, start_ts,
+                    gen0)
                 break
             finally:
                 with self._lock:
@@ -707,7 +837,7 @@ class RegionColumnarCache:
     # -- build / bridge -------------------------------------------------
 
     def _materialize(self, snap, dag, base_key, line, data_index: int,
-                     start_ts: int):
+                     start_ts: int, gen0: int = 0):
         from ..utils import tracker
         scan = dag.executors[0]
         bridged = None
@@ -747,7 +877,18 @@ class RegionColumnarCache:
                 snap, scan.table_id, scan.columns, start_ts)
         ent = MvccColumnarSnapshot(tbl, start_ts, safe_ts, locks)
         lock_src = ent
+        retired: list = []
         with self._lock:
+            if base_key[1] < self._epoch_floor.get(base_key[0], 0) or \
+                    self._sweep_gen.get(base_key[0], 0) != gen0:
+                # lifecycle teardown swept this region (epoch
+                # superseded, or a same-epoch sweep — leader loss /
+                # snapshot apply / destroy — landed mid-build): the
+                # answer is exact for THIS request, but the line must
+                # not be cached — a resurrected stale line would
+                # linger unreachable until LRU pressure
+                self._count("miss")
+                return ent, lock_src
             prev = self._lines.get(base_key)
             fresh_wins = prev is None or prev.data_index is None or \
                 prev.data_index <= data_index
@@ -773,14 +914,22 @@ class RegionColumnarCache:
                     self.rebuilds += 1
                 state = _LineState(scan.table_id, scan.columns, tbl,
                                    safe_ts, start_ts, locks)
+                state.lineage.region_hint = base_key[0]
                 ent = lock_src = state.publish()
                 new_line = _Line(base_key, data_index, ent, state)
                 if prev is not None:
                     new_line.parked = prev.parked
+                    # the replaced line's lineage (and its device feed)
+                    # is dead — tear it down now, not at GC time
+                    retired.append(prev)
                 self._lines[base_key] = new_line
             self._lines.move_to_end(base_key)
             while len(self._lines) > self._capacity:
-                self._lines.popitem(last=False)
+                _k, evicted = self._lines.popitem(last=False)
+                retired.append(evicted)
+            self._publish_lines()
+        for line in retired:
+            self._retire(line)
         self._count(result)
         self._export_gauges(base_key[0], self._lines.get(base_key))
         return ent, lock_src
@@ -822,7 +971,9 @@ class RegionColumnarCache:
                 published = None
             if published is None:
                 # the state may be part-mutated: retire it so no later
-                # bridge replays onto it (the rebuild replaces the line)
+                # bridge replays onto it (the rebuild replaces the
+                # line), and drop its device feed with it
+                self._retire(line)
                 line.state = None
                 return None
             published, min_data_ts = published

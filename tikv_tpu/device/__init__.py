@@ -1,5 +1,17 @@
-"""Device (TPU) execution backend for the coprocessor layer."""
+"""Device (TPU) execution backend for the coprocessor layer.
 
-from .runner import DeferredResult, DeviceRunner
+Lazy exports (PEP 562): importing a sibling like
+``tikv_tpu.device.supervisor`` — which every server Node does for
+lifecycle teardown, device runner or not — must not drag in the
+accelerator runtime; ``DeviceRunner`` pulls jax only when first
+touched.
+"""
 
 __all__ = ["DeviceRunner", "DeferredResult"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from . import runner
+        return getattr(runner, name)
+    raise AttributeError(name)

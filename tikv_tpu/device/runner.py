@@ -85,6 +85,10 @@ from ..parallel import ROW_AXES, make_mesh, num_shards, row_sharding
 
 _BIG = np.iinfo(np.int64).max
 
+# same-width unsigned views for bit-exact digest/corruption bitcasts
+_UINT_BY_ITEMSIZE = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32,
+                     8: jnp.uint64}
+
 # scan-block granularity per kernel kind (rows per lax.scan step; the
 # feed pads to a multiple of _FEED_UNIT per shard so any of these divide)
 _FEED_BLOCK = 1 << 15
@@ -289,9 +293,10 @@ class DeferredResult:
     """
 
     __slots__ = ("_runner", "_pending", "_dag", "_storage", "_mu",
-                 "_memo", "small")
+                 "_memo", "small", "_pin_anchor")
 
-    def __init__(self, runner, pending: _Pending, dag, storage):
+    def __init__(self, runner, pending: _Pending, dag, storage,
+                 pin_anchor=None):
         self._runner = runner
         self._pending = pending
         self._dag = dag             # original request (host fallback)
@@ -299,6 +304,9 @@ class DeferredResult:
         self._mu = threading.Lock()
         self._memo = None
         self.small = pending.small
+        # feed-arena pin taken at dispatch; released exactly once when
+        # the deferred fetch resolves (eviction must not race the D2H)
+        self._pin_anchor = pin_anchor
 
     def result(self):
         with self._mu:
@@ -307,10 +315,27 @@ class DeferredResult:
                     self._memo = ("ok", self._resolve())
                 except BaseException as e:      # noqa: BLE001 — memoized
                     self._memo = ("err", e)
+                finally:
+                    if self._pin_anchor is not None:
+                        try:
+                            self._runner._arena.unpin(self._pin_anchor)
+                        except Exception:   # noqa: BLE001
+                            pass
+                        self._pin_anchor = None
             kind, val = self._memo
         if kind == "err":
             raise val
         return val
+
+    def __del__(self):
+        # backstop for an abandoned deferred (completion-pool submit
+        # failure, dropped future): the arena pin must not outlive the
+        # handle, or the line becomes unevictable under a budget
+        if getattr(self, "_pin_anchor", None) is not None:
+            try:
+                self._runner._arena.unpin(self._pin_anchor)
+            except Exception:   # noqa: BLE001 — interpreter teardown
+                pass
 
     def _resolve(self):
         try:
@@ -331,7 +356,8 @@ class DeviceRunner:
 
     def __init__(self, mesh=None, chunk_rows: Optional[int] = None,
                  max_hash_capacity: int = 1 << 20,
-                 max_topn_limit: int = 1 << 14):
+                 max_topn_limit: int = 1 << 14,
+                 hbm_budget_bytes: int = 0):
         # int64 accumulators are required for exact SUM/COUNT over 1e8
         # rows; jax defaults to 32-bit.  Values stay int32/float32 on
         # device, only accumulators widen.  (Set here, not at import, so
@@ -388,11 +414,23 @@ class DeviceRunner:
         # HBM-resident feed cache — the TPU-native analog of TiKV's
         # in-memory region cache engine (components/
         # region_cache_memory_engine: RangeCacheMemoryEngine layered over
-        # RocksDB).  Columnar snapshots are immutable, so cache entries are
-        # valid for the snapshot's lifetime; keyed weakly on the snapshot.
-        import weakref
-        self._feed_cache: "weakref.WeakKeyDictionary" = \
-            weakref.WeakKeyDictionary()
+        # RocksDB).  Owned EXPLICITLY by the feed arena (device/
+        # supervisor.py): per-anchor byte accounting, a configurable HBM
+        # budget with frequency+recency eviction, and drop_feed teardown
+        # driven by region lifecycle events — reclamation no longer
+        # depends on GC timing.
+        from .supervisor import FeedArena
+        self._arena = FeedArena(budget_bytes=hbm_budget_bytes)
+        # scrub-quarantined anchors: id(anchor) -> (anchor, reason).
+        # The next request for a quarantined anchor serves from the
+        # host pipeline (its feeds are already dropped); the one after
+        # re-uploads from host truth.  Own lock: the background scrub
+        # thread quarantines while request threads consume/drop.
+        self._quarantined: dict = {}
+        self._quar_mu = threading.Lock()
+        # record per-plane content digests at feed build/patch time so
+        # the background scrubber can audit resident planes against them
+        self.scrub_digests = True
 
     # ------------------------------------------------------------------ plan
 
@@ -731,14 +769,31 @@ class DeviceRunner:
             p[:n] = arr
             return jax.device_put(p, self._row_sharding)
 
+        from .supervisor import host_plane_digest
+        digests = [] if self.scrub_digests else None
         for v, ok in host_cols:
             flat.append(put_padded(v, v.dtype))
             has_nulls = not bool(ok.all())
             flags.append(has_nulls)
+            if digests is not None:
+                # recorded from the HOST truth at build time: the scrub
+                # later re-hashes the resident device plane and compares
+                digests.append(host_plane_digest(v, n))
             if has_nulls:
                 flat.append(put_padded(ok, np.bool_))
-        return {"flat": tuple(flat), "null_flags": tuple(flags),
+                if digests is not None:
+                    digests.append(host_plane_digest(ok, n))
+        feed = {"flat": tuple(flat), "null_flags": tuple(flags),
                 "n_pad": n_pad}
+        if digests is not None:
+            feed["digests"] = tuple(digests)
+            feed["n_live"] = n
+            # pre-register the digest kernels now (cold path) so the
+            # warm patch path's incremental digest update mints no new
+            # kernel cache entries — compile classes stay churn-stable
+            for a in feed["flat"]:
+                self._range_digest_kernel(a.dtype, a.shape[0])
+        return feed
 
     @staticmethod
     def _feed_anchor(storage):
@@ -755,13 +810,11 @@ class DeviceRunner:
                   positional: bool = False, req_v=None) -> dict:
         from ..utils import tracker
         cache = None
+        anchor = None
         if storage is not None and feed_key is not None and \
                 hasattr(storage, "scan_columns"):
-            try:
-                cache = self._feed_cache.setdefault(
-                    self._feed_anchor(storage), {})
-            except TypeError:       # not weak-referenceable
-                cache = None
+            anchor = self._feed_anchor(storage)
+            cache = self._arena.bucket(anchor)
         feed = cache.get(feed_key) if cache is not None else None
         if feed is not None:
             fv = feed.get("lineage_v")
@@ -780,6 +833,7 @@ class DeviceRunner:
                 # cold re-upload — bucketed padding keeps n_pad (the
                 # compile class) stable across small deltas
                 tracker.label("device_feed", "patch")
+                self._register_digests(lineage, feed_key, feed)
                 return feed
         tracker.label("device_feed", "upload")
         _fp_degrade("device::before_feed_upload")
@@ -789,7 +843,21 @@ class DeviceRunner:
             feed["lineage_v"] = req_v
         if cache is not None:
             cache[feed_key] = feed
+            # admission runs under the dispatch lock (this call site):
+            # the budget check may evict other, unpinned anchors
+            self._arena.admit(anchor)
+            self._register_digests(lineage, feed_key, feed)
         return feed
+
+    @staticmethod
+    def _register_digests(lineage, feed_key, feed) -> None:
+        """Mirror the feed's per-plane digests into the FeedLineage's
+        host-visible journal — the line-level audit record the
+        supervisor reports (region_cache.py FeedLineage)."""
+        if lineage is not None and feed.get("digests") is not None and \
+                hasattr(lineage, "feed_digests"):
+            lineage.feed_digests[feed_key] = (feed.get("lineage_v"),
+                                              feed["digests"])
 
     def _try_patch_feed(self, feed, lineage, used_infos, dtypes,
                         n: int, req_v=None) -> bool:
@@ -818,6 +886,9 @@ class DeviceRunner:
             fi += 2 if has_nulls else 1
         from ..utils import tracker
         flat = list(feed["flat"])
+        digests = list(feed["digests"]) \
+            if self.scrub_digests and feed.get("digests") is not None \
+            else None
         with tracker.phase("feed_patch"):
             for p in patches:
                 for span in p["spans"]:
@@ -837,19 +908,45 @@ class DeviceRunner:
                             # change the compile class: rebuild
                             return False
                         fi = plane[ci]
-                        flat[fi] = self._dus(
-                            flat[fi],
+                        flat[fi] = self._patch_plane(
+                            feed, digests, flat, fi,
                             np.ascontiguousarray(
                                 vals.astype(dt, copy=False)), lo)
                         if feed["null_flags"][ci]:
                             mask = valid if valid is not None else \
                                 np.ones(len(vals), np.bool_)
-                            flat[fi + 1] = self._dus(
-                                flat[fi + 1],
+                            flat[fi + 1] = self._patch_plane(
+                                feed, digests, flat, fi + 1,
                                 np.ascontiguousarray(mask), lo)
         feed["flat"] = tuple(flat)
         feed["lineage_v"] = req_v
+        if digests is not None:
+            feed["digests"] = tuple(digests)
+            feed["n_live"] = n
         return True
+
+    def _patch_plane(self, feed, digests, flat, fi: int,
+                     update: np.ndarray, lo: int):
+        """One plane's span patch + INCREMENTAL digest maintenance:
+        ``R' = R - H_span(old device plane) + H_span(new host data)``.
+        Never re-hashes the whole plane from device state — doing so
+        would launder any HBM corruption that landed since the last
+        scrub into the recorded digest (the recorded value must stay
+        anchored to the host-truth chain, so a pre-existing corruption
+        delta survives arithmetically and the next scrub still catches
+        it, wherever it sits relative to the patched span).  All device
+        scalars — nothing blocks under the dispatch lock."""
+        old = flat[fi]
+        new = self._dus(old, update, lo)
+        if digests is not None:
+            hi = lo + len(update)
+            rng = self._range_digest_kernel(old.dtype, old.shape[0])
+            lo_arr = jnp.asarray(lo, jnp.int64)
+            hi_arr = jnp.asarray(hi, jnp.int64)
+            d_old = rng(old, lo_arr, hi_arr)
+            d_new = rng(new, lo_arr, hi_arr)
+            digests[fi] = jnp.uint64(digests[fi]) - d_old + d_new
+        return new
 
     def _dus(self, arr, update, lo: int):
         """Jitted in-place-style slice update (dynamic_update_slice);
@@ -866,6 +963,117 @@ class DeviceRunner:
         if not self._single:
             out = jax.device_put(out, self._row_sharding)
         return out
+
+    # ------------------------------------- device-state supervision
+    #
+    # The runner side of device/supervisor.py: explicit feed teardown
+    # (drop_feed replaces GC-timed reclamation), HBM accounting, the
+    # on-device digest leaf the scrubber re-hashes resident planes
+    # with, and the quarantine gate a scrub divergence arms.
+
+    def set_hbm_budget(self, nbytes: int) -> None:
+        """Set (or clear, 0) the HBM budget and enforce it NOW — an
+        online shrink must not wait for the next feed admission to
+        sweep resident state under the new cap."""
+        self._arena.budget_bytes = int(nbytes)
+        self._arena.enforce()
+
+    def hbm_stats(self) -> dict:
+        out = self._arena.stats()
+        with self._quar_mu:
+            out["quarantined"] = len(self._quarantined)
+        return out
+
+    def arena_items(self) -> list:
+        """(anchor, bucket) snapshot for the scrubber."""
+        return self._arena.items()
+
+    def drop_feed(self, anchor, reason: str = "drop") -> int:
+        """Explicitly release every device feed and request memo
+        anchored on ``anchor`` (a FeedLineage or a snapshot).  Called
+        by region-lifecycle teardown; returns the HBM bytes released
+        from the accounting.  An armed quarantine dies with the anchor
+        too — a torn-down region must not pin the lineage (and its
+        digest scalars) in the quarantine map forever."""
+        with self._quar_mu:
+            self._quarantined.pop(id(anchor), None)
+        return self._arena.drop(anchor, reason=reason)
+
+    def quarantine(self, anchor, reason: str = "") -> None:
+        """Scrub divergence: drop the anchor's feeds now and route its
+        NEXT request to the host backend; the request after that
+        rebuilds a fresh feed from host truth (re-admission)."""
+        from ..utils.metrics import DEVICE_QUARANTINE_COUNTER
+        self._arena.drop(anchor, reason="quarantine")
+        with self._quar_mu:
+            self._quarantined[id(anchor)] = (anchor, reason)
+            # bounded: a quarantined region that is never queried again
+            # (and never torn down) must not accumulate forever
+            while len(self._quarantined) > 128:
+                self._quarantined.pop(next(iter(self._quarantined)))
+        DEVICE_QUARANTINE_COUNTER.inc()
+
+    def _consume_quarantine(self, anchor) -> bool:
+        with self._quar_mu:
+            return self._quarantined.pop(id(anchor), None) is not None
+
+    def _range_digest_kernel(self, dtype, n_pad: int):
+        """Jitted plane digest over rows [lo, hi) with GLOBAL position
+        weights: sum bits(x[i]) * (2i+1) mod 2^64 — the device half of
+        the scrub formula (host half: supervisor.host_plane_digest;
+        the full-prefix digest is just lo=0).  Cached per (dtype,
+        n_pad) like every other kernel; on a sharded feed GSPMD
+        partitions the reduction."""
+        dt = np.dtype(dtype)
+        key = ("scrubr", str(dt), n_pad)
+        fn = self._kernel_cache.get(key)
+        if fn is None:
+            if dt == np.bool_:
+                to_bits = lambda x: x.astype(jnp.uint64)    # noqa: E731
+            else:
+                # floats and ints alike: bitcast to the same-width
+                # unsigned view, then widen
+                udt = _UINT_BY_ITEMSIZE[dt.itemsize]
+
+                def to_bits(x, _udt=udt):
+                    return lax.bitcast_convert_type(x, _udt) \
+                        .astype(jnp.uint64)
+
+            def kern(x, lo_arr, hi_arr):
+                iota = jnp.arange(n_pad, dtype=jnp.uint64)
+                w = 2 * iota + 1
+                sel = (iota >= lo_arr.astype(jnp.uint64)) & \
+                    (iota < hi_arr.astype(jnp.uint64))
+                return jnp.sum(jnp.where(sel, to_bits(x) * w,
+                                         jnp.uint64(0)))
+
+            fn = self._kernel_cache[key] = jax.jit(kern)
+        return fn
+
+    def device_digest(self, arr, n: int):
+        """Digest of one resident plane's live prefix (device scalar —
+        the caller decides when to sync).  Deliberately avoids the
+        LRU scalar cache: the background scrubber calls this OUTSIDE
+        the dispatch lock, and the OrderedDict's move_to_end/popitem
+        is not safe against concurrent request threads."""
+        return self._range_digest_kernel(arr.dtype, arr.shape[0])(
+            arr, jnp.asarray(0, jnp.int64), jnp.asarray(n, jnp.int64))
+
+    def corrupt_resident_plane(self, feed: dict) -> None:
+        """Fault injection (device::feed_corrupt): flip one element of
+        the first resident plane in place of the HBM bit-flip a real
+        device fault would cause.  Test/chaos surface only."""
+        arr = feed["flat"][0]
+        dt = np.dtype(arr.dtype)
+        if dt == np.bool_:
+            bad = arr.at[0].set(~arr[0])
+        else:
+            # a true single-BIT flip, dtype-agnostic: bitcast → xor 1
+            u = lax.bitcast_convert_type(
+                arr, _UINT_BY_ITEMSIZE[dt.itemsize])
+            bad = lax.bitcast_convert_type(u.at[0].set(u[0] ^ 1),
+                                           arr.dtype)
+        feed["flat"] = (bad,) + feed["flat"][1:]
 
     # --------------------------------------------------------------- kernels
 
@@ -1389,6 +1597,10 @@ class DeviceRunner:
         """
         from ..utils import tracker
         _fp_degrade("device::before_fetch")
+        # a transfer-level corruption is DETECTED (link CRC) and surfaces
+        # as a failed fetch: the request degrades to the host pipeline —
+        # corrupted bytes never become an answer
+        _fp_degrade("device::d2h_corrupt")
         # the old monolithic "device_fetch" phase is split so a warm
         # p50 can be attributed from the artifact alone: "d2h_wait" is
         # the transfer + sync (here), "host_materialize" is the host
@@ -1420,6 +1632,16 @@ class DeviceRunner:
         plan = self._analyze(dag)
         if plan is None:
             raise RuntimeError("plan not supported by device backend")
+
+        if self._quarantined and hasattr(storage, "scan_columns") and \
+                self._consume_quarantine(self._feed_anchor(storage)):
+            # scrub divergence on this line: its feeds were dropped at
+            # quarantine time; serve THIS request from the host
+            # pipeline, then let the next one rebuild from host truth
+            from ..utils import tracker
+            tracker.label("device_feed", "quarantined")
+            from ..executors.runner import BatchExecutorsRunner
+            return BatchExecutorsRunner(dag, storage).handle_request()
 
         # bucket tiling (SURVEY §5.7 "region → chip, bucket → tile";
         # pd_client buckets): a hash-agg request covering a strict
@@ -1583,6 +1805,7 @@ class DeviceRunner:
             if memo_fresh():
                 meta["host_cols"] = built
 
+        pin_anchor = None
         try:
             _fp_degrade("device::before_dispatch")
             dtypes = get_dtypes()
@@ -1621,16 +1844,38 @@ class DeviceRunner:
                 else:   # scan_sel
                     result = self._run_scan_sel(dag, plan, dtypes, n,
                                                 get_batch, feed, storage)
+                if isinstance(result, _Pending) and \
+                        hasattr(storage, "scan_columns"):
+                    # pin the line for the in-flight dispatch: budget
+                    # eviction (arena.admit, also under this lock) must
+                    # never reclaim HBM a launched kernel still reads
+                    anc = self._feed_anchor(storage)
+                    pin_anchor = self._arena.pin(anc)
+                    # re-account: the run may have cached new device
+                    # state (sparse slot planes) in the request memo
+                    self._arena.admit(anc)
             if isinstance(result, _Pending) and not deferred:
                 # synchronous callers block here; the before_fetch
                 # failpoint inside _readback still degrades to host
-                result = self._finish(result)
+                try:
+                    result = self._finish(result)
+                finally:
+                    if pin_anchor is not None:
+                        self._arena.unpin(pin_anchor)
+                        pin_anchor = None
         except _FallbackToHost:
+            if pin_anchor is not None:
+                self._arena.unpin(pin_anchor)
             from ..executors.runner import BatchExecutorsRunner
             return BatchExecutorsRunner(orig_dag, storage).handle_request()
+        except BaseException:
+            if pin_anchor is not None:
+                self._arena.unpin(pin_anchor)
+            raise
 
         if isinstance(result, _Pending):
-            return DeferredResult(self, result, orig_dag, storage)
+            return DeferredResult(self, result, orig_dag, storage,
+                                  pin_anchor=pin_anchor)
         return self._apply_output_offsets(orig_dag, result)
 
     def _finish(self, pending: _Pending):
@@ -1679,13 +1924,11 @@ class DeviceRunner:
         base = meta["hash_bounds"][0] if "hash_bounds" in meta else 0
         n = meta["n_rows"]
         feed = None
-        try:
-            cache = self._feed_cache.get(self._feed_anchor(storage))
-            for k, v in (cache or {}).items():
-                if isinstance(v, dict) and "flat" in v:
-                    feed = v
-        except TypeError:
-            return None
+        cache = self._arena.bucket(self._feed_anchor(storage),
+                                   create=False)
+        for k, v in (cache or {}).items():
+            if isinstance(v, dict) and "flat" in v:
+                feed = v
         if feed is None:
             return None
         cols = tuple(feed["flat"][j] for j in entry["col_sel"])
@@ -1728,10 +1971,8 @@ class DeviceRunner:
         feed_key = (tuple(plan.scan.columns[ci].col_id
                           for ci in plan.used_cols), tuple(dts),
                     dag.ranges)
-        try:
-            cache = self._feed_cache.get(self._feed_anchor(storage))
-        except TypeError:
-            return None
+        cache = self._arena.bucket(self._feed_anchor(storage),
+                                   create=False)
         feed = (cache or {}).get(feed_key)
         if feed is None:
             return None
@@ -1756,10 +1997,8 @@ class DeviceRunner:
         ``_refresh_meta``)."""
         if not hasattr(storage, "scan_columns"):
             return {}
-        try:
-            per_storage = self._feed_cache.setdefault(
-                self._feed_anchor(storage), {})
-        except TypeError:
+        per_storage = self._arena.bucket(self._feed_anchor(storage))
+        if per_storage is None:         # anchor not trackable
             return {}
         return per_storage.setdefault(("meta", meta_key), {})
 

@@ -16,7 +16,10 @@ Events delivered:
   subscribers (the columnar delta log) must drop everything they
   derived from earlier applied writes;
 - ``on_region_changed(region)``: split/merge/conf-change/snapshot;
-- ``on_role_change(region_id, is_leader)``: leadership transitions.
+- ``on_role_change(region_id, is_leader)``: leadership transitions;
+- ``on_peer_destroyed(region_id)``: the peer was removed from this
+  store (merge-away / conf-change removal) — subscribers must drop
+  every artifact derived from the region's local data.
 """
 
 from __future__ import annotations
@@ -38,6 +41,9 @@ class Observer:
         pass
 
     def on_role_change(self, region_id: int, is_leader: bool) -> None:
+        pass
+
+    def on_peer_destroyed(self, region_id: int) -> None:
         pass
 
 
@@ -91,5 +97,12 @@ class CoprocessorHost:
         for obs in self._observers:
             try:
                 obs.on_role_change(region_id, is_leader)
+            except Exception:   # noqa: BLE001
+                pass
+
+    def notify_peer_destroyed(self, region_id: int) -> None:
+        for obs in self._observers:
+            try:
+                obs.on_peer_destroyed(region_id)
             except Exception:   # noqa: BLE001
                 pass

@@ -211,6 +211,9 @@ class RaftStore:
             wb = self.engine.write_batch()
             peer.peer_storage.destroy(wb)
             self.engine.write(wb)
+            # lifecycle teardown: subscribers (delta sink, device-state
+            # supervisor) drop every artifact derived from this region
+            self.coprocessor_host.notify_peer_destroyed(region_id)
 
     # ------------------------------------------------------------- routing
 

@@ -472,6 +472,30 @@ class Node:
         self.endpoint = Endpoint(self._copr_snapshot,
                                  device_runner=device_runner,
                                  device_row_threshold=device_row_threshold)
+        # device-state supervisor: lifecycle events (split/merge/epoch
+        # change/leader loss/snapshot apply/peer destroy) eagerly tear
+        # down the matching columnar cache lines and device feeds, the
+        # HBM feed arena enforces the configured budget, and a
+        # background scrubber audits resident planes against their
+        # build/patch-time digests (device/supervisor.py)
+        from ..device.supervisor import DeviceStateSupervisor
+        if device_runner is not None and \
+                config.coprocessor.device_hbm_budget_mb > 0 and \
+                hasattr(device_runner, "set_hbm_budget"):
+            device_runner.set_hbm_budget(
+                config.coprocessor.device_hbm_budget_mb << 20)
+        if device_runner is not None and \
+                hasattr(device_runner, "scrub_digests"):
+            device_runner.scrub_digests = \
+                config.coprocessor.scrub_digests
+        self.device_supervisor = DeviceStateSupervisor(
+            runner=device_runner, copr_cache=self.copr_cache,
+            delta_sink=self.copr_delta_sink,
+            scrub_interval=config.coprocessor.scrub_interval_s)
+        self.copr_cache.on_line_retired = \
+            self.device_supervisor.on_line_retired
+        self.raft_store.coprocessor_host.register(self.device_supervisor)
+        self.device_supervisor.start()
         # online reconfig (online_config ConfigManager registrations)
         self.config_controller.register("coprocessor", self._copr_cfg)
 
@@ -484,6 +508,11 @@ class Node:
         if "tombstone_compact_ratio" in diff:
             self.copr_cache._compact_ratio = \
                 diff["tombstone_compact_ratio"]
+        if "device_hbm_budget_mb" in diff and \
+                self.device_runner is not None and \
+                hasattr(self.device_runner, "set_hbm_budget"):
+            self.device_runner.set_hbm_budget(
+                int(diff["device_hbm_budget_mb"]) << 20)
 
     def _read_index_check(self, read_ts: int, region) -> bool:
         """Leader-side async-commit guard for replica reads: bump
@@ -536,6 +565,7 @@ class Node:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.raft_store.stop_pool()
+        self.device_supervisor.stop()
         # idle-drain both request pools: stop admitting reads and wait
         # for in-flight ones, then retire (and JOIN) the endpoint's
         # completion-pool workers — nodes restarted in-process (chaos

@@ -85,6 +85,13 @@ class StatusServer:
                         # late-materialized selection: routing-decision
                         # counts + per-plan observed-selectivity EWMAs
                         body["device_selection"] = dr.selection_stats()
+                    sup = getattr(node, "device_supervisor", None)
+                    if sup is not None and hasattr(sup, "stats"):
+                        # device-state integrity: HBM arena accounting
+                        # (resident bytes/lines vs budget, evictions),
+                        # scrub passes/divergences, quarantines, and
+                        # lifecycle invalidation counts
+                        body["device_state"] = sup.stats()
                     self._json(200, body)
                 elif path == "/config":
                     if outer._controller is None:

@@ -334,6 +334,34 @@ DEVICE_SEL_SELECTIVITY = REGISTRY.gauge(
     "tikv_device_selection_observed_selectivity",
     "last device-side observed selection selectivity "
     "(selected rows / scanned rows — the routing cost-model input)")
+COPR_RESIDENT_LINES = REGISTRY.gauge(
+    "tikv_coprocessor_region_cache_resident_lines",
+    "delta-maintained columnar cache lines currently resident "
+    "(lifecycle teardown + LRU keep this bounded)")
+DEVICE_HBM_RESIDENT_BYTES = REGISTRY.gauge(
+    "tikv_device_hbm_resident_bytes",
+    "bytes of device-resident derived state (HBM feeds + cached "
+    "sparse-slot planes) accounted by the runner's feed arena")
+DEVICE_FEED_LINES = REGISTRY.gauge(
+    "tikv_device_feed_resident_lines",
+    "feed-arena entries (one per snapshot/lineage anchor) resident "
+    "on device")
+DEVICE_FEED_EVICTION_COUNTER = REGISTRY.counter(
+    "tikv_device_feed_evictions_total",
+    "device feed lines dropped, by reason (budget = arena eviction, "
+    "lifecycle = region event teardown, quarantine = scrub "
+    "divergence, reject = would not fit the budget, drop = explicit)",
+    labels=("reason",))
+DEVICE_SCRUB_COUNTER = REGISTRY.counter(
+    "tikv_device_scrub_total",
+    "resident device feed LINES scrubbed, by result (clean / "
+    "divergence = on-device digest != recorded digest); whole-pass "
+    "counts live in the /health device_state.scrub_passes rollup",
+    labels=("result",))
+DEVICE_QUARANTINE_COUNTER = REGISTRY.counter(
+    "tikv_device_feed_quarantine_total",
+    "device feed lines quarantined after a scrub divergence "
+    "(the region degrades to the host backend, then rebuilds)")
 SCHED_COMMANDS = REGISTRY.counter(
     "tikv_scheduler_commands_total", "txn scheduler commands",
     labels=("type",))
