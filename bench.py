@@ -27,6 +27,12 @@ Configs (BASELINE.md + r4 additions):
       post-write queries serve via delta_apply + feed_patch (no
       columnar_build, no feed re-upload, no recompile); reports the
       delta-path cost vs a forced full rebuild (target ≤ 1/20)
+  6b. CONCURRENT SERVING: 64+ concurrent warm gRPC clients over a
+      Zipfian table/constant mix, the SAME seeded request schedule run
+      once with the request coalescer on and once forced per-request —
+      the cross-request batching proof (server/coalescer.py): batched
+      P99 ≤ solo P99, mean batch occupancy > 1.5, zero late acks
+      (# batch_occupancy= / # router= / # p99_batched_vs_solo= lines)
 
 Latency decomposition: "device_sync_floor_ms" reports the cost of ONE
 tiny dispatch+fetch through the device transport — over a tunneled TPU
@@ -575,6 +581,224 @@ def run_write_churn(device_runner, iters: int):
         pd_server.stop()
 
 
+def run_concurrent_serving(device_runner, iters: int):
+    """Config 6b: heavy-traffic serving — 64+ concurrent warm gRPC
+    clients over a Zipfian table/constant mix, measured twice on the
+    SAME seeded request schedule: once with the request coalescer on
+    (concurrent requests sharing a compile class + resident feed group
+    into one stacked device dispatch) and once forced per-request
+    (coalescer unwired — the pre-batching path: every request pays its
+    own launch + D2H sync).
+
+    What it proves (the cross-request batching tentpole): under real
+    concurrency the fixed dispatch overhead amortizes across group
+    members, so the batched phase's P99 must not exceed the solo
+    phase's, mean batch occupancy must exceed 1.5, and NO response is
+    ever served past its deadline because it waited in a coalesce
+    window (late acks are counted from deadline_exceeded errors plus
+    client-observed budget overruns; the target is zero).
+    """
+    import threading as _th
+
+    from tikv_tpu.raftstore.metapb import Store
+    from tikv_tpu.server import (
+        Node, PdServer, RemotePdClient, TikvServer, TxnClient,
+    )
+    from tikv_tpu.server.wire import RemoteError
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import int_table
+
+    n = int(os.environ.get("TIKV_TPU_BENCH_SERVE_ROWS", 1 << 18))
+    n_tables = int(os.environ.get("TIKV_TPU_BENCH_SERVE_TABLES", 3))
+    n_clients = int(os.environ.get("TIKV_TPU_BENCH_SERVE_CLIENTS", 64))
+    n_reqs = int(os.environ.get("TIKV_TPU_BENCH_SERVE_REQS", 6))
+    deadline_ms = int(os.environ.get(
+        "TIKV_TPU_BENCH_SERVE_DEADLINE_MS", 60_000))
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    node = Node("127.0.0.1:0", RemotePdClient(pd_addr),
+                device_runner=device_runner)
+    node.config.raftstore.region_split_size_mb = 1 << 20
+    node.config.raftstore.region_max_size_mb = 1 << 20
+    srv = TikvServer(node)
+    node.addr = f"127.0.0.1:{srv.port}"
+    node.pd.put_store(Store(node.store_id, node.addr))
+    srv.start()
+    try:
+        c = TxnClient(pd_addr)
+        tables = [int_table(2, table_id=9920 + i)
+                  for i in range(n_tables)]
+        load_s = 0.0
+        for t in tables:
+            load_s += _bulk_load(c, node, t, n)
+
+        # Zipfian mix: table popularity AND predicate-constant
+        # popularity both follow rank^-1.2 — a hot feed with a hot
+        # dashboard query plus a long tail, the shape that forms big
+        # coalesce groups on the head WITHOUT the tail starving.
+        # Thresholds sit in c1's [980, 996) top band (c1 = h % 1000 in
+        # _bulk_load) so selection responses stay ≤2% of the feed: the
+        # per-row response encode is GIL-bound host work identical in
+        # both phases, and letting it dominate would throttle the
+        # arrival rate below what any collection window could group —
+        # drowning the dispatch economics under test.
+        rng = np.random.default_rng(61)
+        thr_palette = [980 + i for i in range(16)]
+
+        def zipf_pick(k, size, s=1.2):
+            p = 1.0 / np.arange(1, k + 1) ** s
+            return rng.choice(k, size=size, p=p / p.sum())
+
+        total = n_clients * n_reqs
+        # 3:1 selection (stack-mode groups: differing constants, one
+        # compile class) : hash-agg (share-mode groups: the identical-
+        # plan thundering herd).  Table popularity is STEEP (s=2: head
+        # table ~73% of traffic — the hot-region reality the coalescer
+        # exists for); constants are milder (s=1.2) since every
+        # threshold of one table shares one const-blind group anyway.
+        schedule = list(zip(zipf_pick(n_tables, total, s=2.0),
+                            zipf_pick(len(thr_palette), total),
+                            rng.random(total) < 0.75))
+
+        def make_dag(ti, pi, is_sel, ts):
+            s = DagSelect.from_table(tables[ti], ["id", "c0", "c1"])
+            if is_sel:
+                return s.where(
+                    s.col("c1") > thr_palette[pi]).build(start_ts=ts)
+            return s.aggregate(
+                [s.col("c0")],
+                [("count_star", None), ("sum", s.col("c1"))]
+            ).build(start_ts=ts)
+
+        def run_phase():
+            lat, errors = [], {}
+            late = [0]
+            mu = _th.Lock()
+            start = _th.Barrier(n_clients)
+
+            def worker(ci):
+                start.wait()
+                for r in range(n_reqs):
+                    ti, pi, is_sel = schedule[ci * n_reqs + r]
+                    t0 = time.perf_counter()
+                    try:
+                        c.coprocessor(
+                            make_dag(ti, pi, is_sel, c.tso()),
+                            deadline_ms=deadline_ms,
+                            timeout=deadline_ms / 1e3 + 30)
+                    except RemoteError as e:
+                        with mu:
+                            k = e.kind
+                            errors[k] = errors.get(k, 0) + 1
+                            if k == "deadline_exceeded":
+                                late[0] += 1
+                        continue
+                    dt = time.perf_counter() - t0
+                    with mu:
+                        lat.append(dt)
+                        if dt > deadline_ms / 1e3:
+                            late[0] += 1    # served past its budget
+
+            ts = [_th.Thread(target=worker, args=(ci,))
+                  for ci in range(n_clients)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            wall = time.perf_counter() - t0
+            a = np.asarray(lat) if lat else np.asarray([0.0])
+            return {
+                "requests": total, "served": len(lat),
+                "errors": errors, "late_acks": late[0],
+                "p50_ms": round(float(np.percentile(a, 50)) * 1e3, 3),
+                "p99_ms": round(float(np.percentile(a, 99)) * 1e3, 3),
+                "wall_s": round(wall, 2),
+                "req_per_sec": round(len(lat) / wall, 1),
+            }
+
+        # warm every (table, plan-kind) once: cold columnar builds,
+        # feed uploads, and the SOLO kernel compiles happen here, not
+        # inside either measured phase
+        for ti in range(n_tables):
+            for pi, is_sel in ((0, True), (0, False)):
+                c.coprocessor(make_dag(ti, pi, is_sel, c.tso()),
+                              timeout=600)
+
+        coal = node.endpoint.coalescer
+        assert coal is not None, "node wired without a coalescer"
+        # collection window for the batched phase: occupancy forms when
+        # the window is of the INTER-ARRIVAL order (requests/s into the
+        # dispatcher), not the launch overhead's — the 2ms production
+        # default fits a co-located chip where launches are the
+        # bottleneck, while this bench's arrival spacing is set by the
+        # GIL-bound response encode (~50-100ms/req on CPU smoke, the
+        # tunnel RTT on a remote TPU).  150ms is the throughput-
+        # oriented tuning for both (under saturation the queue wait
+        # dwarfs it); deadline pressure still closes early.
+        window_ms = float(os.environ.get(
+            "TIKV_TPU_BENCH_SERVE_WINDOW_MS", 150.0))
+
+        # phase 1 — FORCED PER-REQUEST: unwire the coalescer entirely
+        # (router not consulted, every device request dispatches solo:
+        # the pre-batching serving path)
+        node.endpoint.coalescer = None
+        solo = run_phase()
+        node.endpoint.coalescer = coal
+        coal.configure(window_ms=window_ms)
+
+        # batched warmup burst: the stacked kernels compile per pow2
+        # lane bucket — pay those one-time compiles outside the
+        # measured phase, exactly as the solo phase's kernels were
+        # warmed above
+        for _ in range(2):
+            bts = [_th.Thread(
+                target=lambda i=i: c.coprocessor(
+                    make_dag(schedule[i][0], schedule[i][1],
+                             schedule[i][2], c.tso()), timeout=600))
+                for i in range(min(32, total))]
+            for t in bts:
+                t.start()
+            for t in bts:
+                t.join()
+
+        # phase 2 — COALESCED: same schedule, same seed
+        base = coal.stats()
+        batched = run_phase()
+        st = coal.stats()
+        groups = st["groups_dispatched"] - base["groups_dispatched"]
+        members = st["requests_coalesced"] - base["requests_coalesced"]
+        rbase = base["router"]["decisions"]
+        router = {k: v - rbase.get(k, 0)
+                  for k, v in st["router"]["decisions"].items()
+                  if v - rbase.get(k, 0)}
+        mean_occ = round(members / groups, 3) if groups else 0.0
+        return {
+            "rows": n, "tables": n_tables, "clients": n_clients,
+            "requests_per_phase": total,
+            "load_rows_per_sec": round(n_tables * n / load_s, 1),
+            "window_ms": st["window_ms"], "max_group": st["max_group"],
+            "solo": solo, "batched": batched,
+            "groups": groups, "members_coalesced": members,
+            "mean_occupancy": mean_occ,
+            "max_occupancy": st["max_occupancy"],
+            "solo_degrade": st["solo_degrade"] - base["solo_degrade"],
+            "router": router,
+            "launch_ewma_ms": st["router"]["launch_ewma_ms"],
+            "p99_ratio": round(batched["p99_ms"] /
+                               max(1e-9, solo["p99_ms"]), 3),
+            "batched_p99_le_solo":
+                bool(batched["p99_ms"] <= solo["p99_ms"]),
+            "occupancy_gt_1_5": bool(mean_occ > 1.5),
+            "zero_late_acks": bool(solo["late_acks"] == 0 and
+                                   batched["late_acks"] == 0),
+        }
+    finally:
+        srv.stop()
+        pd_server.stop()
+
+
 def run_selection_sweep(runner, n: int, iters: int):
     """Config 2s: selection selectivity sweep {0.1%, 1%, 10%, 50%, 99%}.
 
@@ -789,6 +1013,15 @@ def main() -> None:
     except Exception as e:      # noqa: BLE001 — bench must still report
         configs["6w_write_churn"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # 6b: heavy-traffic concurrent serving — the cross-request
+    # coalescer vs forced per-request dispatch on one seeded schedule
+    try:
+        configs["6b_concurrent_serving"] = run_concurrent_serving(
+            runner, iters)
+    except Exception as e:      # noqa: BLE001 — bench must still report
+        configs["6b_concurrent_serving"] = {
+            "error": f"{type(e).__name__}: {e}"}
+
     headline = configs["4_hash_agg"]
     print(json.dumps({
         "metric": "copr_hash_agg_rows_per_sec",
@@ -800,8 +1033,8 @@ def main() -> None:
         "configs": configs,
     }))
     for name, c in configs.items():
-        if name == "2s_selection_sweep":
-            continue            # dedicated # routing= lines below
+        if name in ("2s_selection_sweep", "6b_concurrent_serving"):
+            continue            # dedicated first-class lines below
         if "rows_per_sec" not in c:
             print(f"# {name}: {c}", file=sys.stderr)
             continue
@@ -874,6 +1107,34 @@ def main() -> None:
         print(f"# hbm_resident_mb= {cw.get('hbm_resident_mb', 0.0)} "
               f"(budget_mb={cw.get('hbm_budget_mb', 0.0)})",
               file=sys.stderr)
+    # 6b adjudication — first-class lines so the cross-request batching
+    # claim (occupancy forms, router mix, batched P99 vs solo P99, zero
+    # late acks) survives artifact truncation
+    cs = configs.get("6b_concurrent_serving", {})
+    if "batched" in cs:
+        print(f"# 6b_concurrent_serving: {cs['clients']} clients x "
+              f"{cs['requests_per_phase'] // cs['clients']} reqs over "
+              f"{cs['tables']} tables ({cs['rows']} rows each), "
+              f"window={cs['window_ms']}ms max_group={cs['max_group']}",
+              file=sys.stderr)
+        print(f"# batch_occupancy= mean={cs['mean_occupancy']} "
+              f"max={cs['max_occupancy']} groups={cs['groups']} "
+              f"members={cs['members_coalesced']} "
+              f"solo_degrade={cs['solo_degrade']} "
+              f"ok={cs['occupancy_gt_1_5']}", file=sys.stderr)
+        rt = " ".join(f"{k}={v}" for k, v in
+                      sorted(cs["router"].items()))
+        print(f"# router= {rt or 'none'} "
+              f"launch_ewma_ms={cs['launch_ewma_ms']}", file=sys.stderr)
+        print(f"# p99_batched_vs_solo= "
+              f"batched={cs['batched']['p99_ms']}ms "
+              f"solo={cs['solo']['p99_ms']}ms ratio={cs['p99_ratio']} "
+              f"ok={cs['batched_p99_le_solo']} "
+              f"late_acks_batched={cs['batched']['late_acks']} "
+              f"late_acks_solo={cs['solo']['late_acks']} "
+              f"zero_late_acks={cs['zero_late_acks']}", file=sys.stderr)
+    elif cs:
+        print(f"# 6b_concurrent_serving: {cs}", file=sys.stderr)
 
 
 if __name__ == "__main__":

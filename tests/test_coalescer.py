@@ -1,0 +1,628 @@
+"""Cross-request device batching (server/coalescer.py + the runner's
+stacked dispatch path).
+
+Covers the coalescing dispatcher end to end on the CPU mesh (tier-1
+safe — the stacked kernels are plain jit/vmap, platform-independent):
+
+- randomized batched-vs-solo parity: mixed predicate constants within
+  one compile class, NULL-heavy and tombstoned feeds, selections AND
+  aggregations — every member's answer is bit-identical to the host
+  pipeline's;
+- group-member fault isolation: a ``device::*`` failpoint inside the
+  SHARED fetch degrades every member to the host pipeline
+  individually (correct answers, never a group-wide failure), and
+  ``copr::coalesce_dispatch`` (batched launch failure) retries every
+  member as a solo dispatch;
+- router decision coverage: all four outcomes (device_batched /
+  device_solo / host / shed) reachable, shed carries retry_after_ms;
+- deadline-pressure group close: a member with a tight budget closes
+  its group before the window, and no response is served after its
+  deadline because it waited in a coalesce window;
+- the fast gRPC smoke twin of bench 6b: concurrent warm clients over
+  rotating constants, ≥2 requests share one dispatch, zero
+  deadline_exceeded, /health + /metrics observability.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from tikv_tpu.copr.endpoint import CopRequest, Endpoint, REQ_TYPE_DAG
+from tikv_tpu.datatype import Column, EvalType, FieldType
+from tikv_tpu.device import DeviceRunner
+from tikv_tpu.executors.columnar import ColumnarTable
+from tikv_tpu.executors.runner import BatchExecutorsRunner
+from tikv_tpu.server.coalescer import (
+    DEVICE_BATCHED,
+    DEVICE_SOLO,
+    HOST,
+    SHED,
+    RequestCoalescer,
+)
+from tikv_tpu.testing.dag import DagSelect
+from tikv_tpu.testing.fixture import Table, TableColumn
+from tikv_tpu.utils import deadline as dl_mod
+from tikv_tpu.utils import failpoint
+
+
+@pytest.fixture(scope="module")
+def runner():
+    import jax
+
+    from tikv_tpu.parallel import make_mesh
+    return DeviceRunner(mesh=make_mesh(jax.devices()[:1]),
+                        chunk_rows=1 << 12)
+
+
+@pytest.fixture(autouse=True)
+def _teardown_failpoints():
+    yield
+    failpoint.teardown()
+
+
+def make_snapshot(n=16_000, seed=0, tombstoned=False, null_heavy=False):
+    rng = np.random.default_rng(seed)
+    table = Table(8600 + seed, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("k", 2, FieldType.long()),
+        TableColumn("v", 3, FieldType.long())))
+    named = {
+        "k": Column(EvalType.INT,
+                    rng.integers(0, 40, n).astype(np.int64),
+                    np.ones(n, np.bool_)),
+        "v": Column(EvalType.INT,
+                    rng.integers(-1000, 1000, n).astype(np.int64),
+                    rng.random(n) > (0.5 if null_heavy else 0.1)),
+    }
+    snap = ColumnarTable.from_arrays(table, np.arange(n, dtype=np.int64),
+                                     named)
+    if tombstoned:
+        alive = rng.random(n) > 0.3
+        snap = ColumnarTable(table, snap.handles, snap.columns,
+                             alive=alive)
+    return table, snap
+
+
+def sel_dag(table, thr, extra=None):
+    s = DagSelect.from_table(table, ["id", "k", "v"])
+    conds = [s.col("v") > int(thr)]
+    if extra is not None:
+        conds.append(s.col("k") < int(extra))
+    return s.where(*conds).build()
+
+
+def agg_dag(table, bias=0):
+    s = DagSelect.from_table(table, ["id", "k", "v"])
+    aggs = [("count_star", None), ("sum", s.col("v"))]
+    if bias:
+        # a differing agg-side constant: its own exact plan (share
+        # groups key on the exact plan) but the same read-pool class
+        return s.where(s.col("v") > bias).aggregate(
+            [s.col("k")], aggs).build()
+    return s.aggregate([s.col("k")], aggs).build()
+
+
+def make_endpoint(runner, snap, window_ms=200.0, max_group=8,
+                  idle_bypass=False, threshold=1):
+    coal = RequestCoalescer(runner, window_ms=window_ms,
+                            max_group=max_group)
+    coal.idle_bypass = idle_bypass
+    ep = Endpoint(lambda req: snap, device_runner=runner,
+                  device_row_threshold=threshold, coalescer=coal)
+    return ep, coal
+
+
+def run_concurrent(ep, dags):
+    """Submit every dag on its own thread; → CopResponse list."""
+    out = [None] * len(dags)
+    errs = []
+
+    def one(i):
+        try:
+            out[i] = ep.handle(CopRequest(REQ_TYPE_DAG, dags[i]))
+        except Exception as e:      # noqa: BLE001 — surfaced below
+            errs.append((i, e))
+
+    ts = [threading.Thread(target=one, args=(i,))
+          for i in range(len(dags))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    return out
+
+
+# ----------------------------------------------------- randomized parity
+
+
+def test_randomized_batched_vs_solo_parity(runner):
+    """Mixed constants within one compile class over plain, NULL-heavy
+    and tombstoned feeds — every coalesced member bit-matches the host
+    pipeline (and the solo device path, transitively via PR 5's parity
+    suite)."""
+    shapes = [make_snapshot(seed=1), make_snapshot(seed=2, null_heavy=True),
+              make_snapshot(seed=3, tombstoned=True)]
+    rng = np.random.default_rng(77)
+    rounds = 0
+    for cycle in range(4):
+        for table, snap in shapes:
+            ep, coal = make_endpoint(runner, snap, max_group=8)
+            try:
+                thrs = rng.integers(-1100, 1100, 4).tolist()
+                if cycle % 2:       # conjunction shape: its own class
+                    dags = [sel_dag(table, t, extra=rng.integers(0, 40))
+                            for t in thrs]
+                else:
+                    dags = [sel_dag(table, t) for t in thrs]
+                results = run_concurrent(ep, dags)
+                for dag, got in zip(dags, results):
+                    want = BatchExecutorsRunner(dag, snap).handle_request()
+                    assert got.rows() == want.rows()
+                    rounds += 1
+                st = coal.stats()
+                assert st["requests_coalesced"] == len(dags), st
+            finally:
+                ep.close()
+    assert rounds >= 48, rounds
+
+
+def test_aggregation_share_mode_parity(runner):
+    """Identical aggregation plans coalesce in share mode: one
+    dispatch + one fetch serves every member, results exact."""
+    table, snap = make_snapshot(seed=5)
+    ep, coal = make_endpoint(runner, snap, max_group=4)
+    try:
+        dags = [agg_dag(table)] * 4
+        results = run_concurrent(ep, dags)
+        want = BatchExecutorsRunner(dags[0], snap).handle_request()
+        for got in results:
+            assert sorted(got.rows()) == sorted(want.rows())
+            assert got.backend == "device"
+        st = coal.stats()
+        assert st["groups_dispatched"] == 1, st
+        assert st["mean_occupancy"] == 4.0, st
+        # differing agg-side constants: distinct share groups, still
+        # exact per member
+        dags2 = [agg_dag(table, bias=b) for b in (10, 500, 10)]
+        for got, dag in zip(run_concurrent(ep, dags2), dags2):
+            want = BatchExecutorsRunner(dag, snap).handle_request()
+            assert sorted(got.rows()) == sorted(want.rows())
+    finally:
+        ep.close()
+
+
+def test_stacked_group_occupancy_and_route_label(runner):
+    """A full group runs as ONE stacked dispatch: occupancy equals the
+    member count and the selection route counter records 'batched'."""
+    table, snap = make_snapshot(seed=6)
+    ep, coal = make_endpoint(runner, snap, max_group=4)
+    try:
+        before = dict(runner._sel_route_counts)
+        dags = [sel_dag(table, t) for t in (-2000, 0, 250, 2000)]
+        run_concurrent(ep, dags)
+        st = coal.stats()
+        assert st["groups_dispatched"] == 1 and \
+            st["max_occupancy"] == 4, st
+        got = runner._sel_route_counts.get("batched", 0) - \
+            before.get("batched", 0)
+        assert got == 1, runner._sel_route_counts
+    finally:
+        ep.close()
+
+
+# ------------------------------------------------------- fault isolation
+
+
+def test_group_fetch_fault_degrades_members_to_host(runner):
+    """A device fault inside the group's SHARED fetch
+    (device::before_fetch) must degrade every member to the host
+    pipeline individually — exact answers, no group-wide failure."""
+    table, snap = make_snapshot(seed=7)
+    ep, coal = make_endpoint(runner, snap, max_group=3)
+    try:
+        failpoint.cfg("device::before_fetch", "1*return")
+        dags = [sel_dag(table, t) for t in (-500, 0, 500)]
+        results = run_concurrent(ep, dags)
+        for dag, got in zip(dags, results):
+            want = BatchExecutorsRunner(dag, snap).handle_request()
+            assert got.rows() == want.rows()
+            assert got.backend == "host", got.backend
+        st = coal.stats()
+        assert st["groups_dispatched"] == 1, st
+    finally:
+        ep.close()
+
+
+def test_coalesce_dispatch_failpoint_retries_members_solo(runner):
+    """copr::coalesce_dispatch: the batched LAUNCH fails — members
+    must retry as solo device dispatches (not fail, not silently share
+    a wrong answer)."""
+    table, snap = make_snapshot(seed=8)
+    ep, coal = make_endpoint(runner, snap, max_group=3)
+    try:
+        # warm the solo path once so the retry dispatches cleanly
+        ep.handle(CopRequest(REQ_TYPE_DAG, sel_dag(table, 123)))
+        failpoint.cfg("copr::coalesce_dispatch", "1*return")
+        dags = [sel_dag(table, t) for t in (-400, 100, 900)]
+        results = run_concurrent(ep, dags)
+        for dag, got in zip(dags, results):
+            want = BatchExecutorsRunner(dag, snap).handle_request()
+            assert got.rows() == want.rows()
+            assert got.backend == "device", got.backend
+        st = coal.stats()
+        assert st["solo_degrade"] == 3, st
+    finally:
+        ep.close()
+
+
+def test_forced_immediate_close_failpoint(runner):
+    """copr::coalesce_window forces groups closed at submit — every
+    member dispatches alone (occupancy 1) but still correctly."""
+    table, snap = make_snapshot(seed=9)
+    ep, coal = make_endpoint(runner, snap, max_group=8)
+    try:
+        failpoint.cfg("copr::coalesce_window", "return")
+        dags = [sel_dag(table, t) for t in (-100, 400)]
+        results = run_concurrent(ep, dags)
+        for dag, got in zip(dags, results):
+            want = BatchExecutorsRunner(dag, snap).handle_request()
+            assert got.rows() == want.rows()
+        st = coal.stats()
+        assert st["closes"].get("failpoint", 0) >= 2, st
+        assert st["max_occupancy"] == 1, st
+    finally:
+        ep.close()
+
+
+# -------------------------------------------------------------- routing
+
+
+def test_router_all_four_outcomes(runner):
+    table, snap = make_snapshot(seed=10)
+    ep, coal = make_endpoint(runner, snap)
+    try:
+        # device_batched: batchable, no deadline
+        d, key, _ = coal.route(sel_dag(table, 5), snap)
+        assert d == DEVICE_BATCHED and key is not None
+
+        # device_solo: batching disabled in place
+        coal.set_enabled(False)
+        d, key, _ = coal.route(sel_dag(table, 5), snap)
+        assert d == DEVICE_SOLO and key is None
+        coal.set_enabled(True)
+
+        # host: the threshold (the calibrated break-even) says this
+        # row count is far below the device crossover
+        ep._device_row_threshold = 1 << 22
+        d, _k, _ = coal.route(sel_dag(table, 5), snap)
+        assert d == HOST
+        ep._device_row_threshold = 1
+
+        # shed: remaining budget below the modeled cost of EVERY
+        # option — rejected with a retry hint
+        coal.router.launch_ewma = 0.5       # a 500ms modeled launch
+        dl = dl_mod.Deadline.after_ms(20)
+        tok = dl_mod.install(dl)
+        try:
+            d, _k, hint = coal.route(sel_dag(table, 5), snap)
+        finally:
+            dl_mod.uninstall(tok)
+        assert d == SHED and hint >= 1, (d, hint)
+        st = coal.stats()["router"]["decisions"]
+        for want in (DEVICE_BATCHED, DEVICE_SOLO, HOST, SHED):
+            assert st.get(want, 0) >= 1, st
+    finally:
+        ep.close()
+
+
+def test_shed_rides_the_wire_as_server_is_busy(runner):
+    """An endpoint-level shed surfaces as ServerIsBusy with a
+    retry_after_ms hint (the same contract read-pool shedding uses)."""
+    from tikv_tpu.server.read_pool import ServerIsBusy
+    table, snap = make_snapshot(seed=11)
+    ep, coal = make_endpoint(runner, snap)
+    try:
+        coal.router.launch_ewma = 0.5
+        dl = dl_mod.Deadline.after_ms(20)
+        tok = dl_mod.install(dl)
+        try:
+            with pytest.raises(ServerIsBusy) as ei:
+                ep.handle(CopRequest(REQ_TYPE_DAG, sel_dag(table, 5)))
+        finally:
+            dl_mod.uninstall(tok)
+        assert ei.value.retry_after_ms >= 1
+    finally:
+        ep.close()
+
+
+def test_router_respects_forced_backend(runner):
+    """force_backend='device' bypasses the router: parity suites
+    contract for a raw solo dispatch even under a coalescer."""
+    table, snap = make_snapshot(seed=12)
+    ep, coal = make_endpoint(runner, snap)
+    try:
+        before = coal.stats()["router"]["decisions"]
+        r = ep.handle(CopRequest(REQ_TYPE_DAG, sel_dag(table, 5),
+                                 force_backend="device"))
+        want = BatchExecutorsRunner(sel_dag(table, 5),
+                                    snap).handle_request()
+        assert r.rows() == want.rows()
+        assert coal.stats()["router"]["decisions"] == before
+    finally:
+        ep.close()
+
+
+# ----------------------------------------------------- deadline pressure
+
+
+def test_deadline_pressure_closes_group_early(runner):
+    """A member whose budget cannot survive the window forces the
+    group closed early — the response lands BEFORE its deadline even
+    though the configured window is far longer."""
+    table, snap = make_snapshot(seed=13)
+    # a 10-second window: only deadline pressure can close the group
+    ep, coal = make_endpoint(runner, snap, window_ms=10_000.0,
+                             max_group=8)
+    try:
+        # warm the feed + kernels OUTSIDE the coalescer so the group's
+        # post-close latency is the true warm cost
+        runner.handle_request(sel_dag(table, 77), snap)
+        expired = []
+        out = []
+
+        def one(thr, budget_ms):
+            dl = dl_mod.Deadline.after_ms(budget_ms) \
+                if budget_ms else None
+            tok = dl_mod.install(dl) if dl is not None else None
+            try:
+                r = ep.handle(CopRequest(REQ_TYPE_DAG,
+                                         sel_dag(table, thr)))
+                out.append((thr, r))
+                if dl is not None:
+                    expired.append(dl.expired())
+            finally:
+                if tok is not None:
+                    dl_mod.uninstall(tok)
+
+        # one patient member + one with a 2s budget: the group must
+        # close on the TIGHT member's pressure, not the 10s window
+        ts = [threading.Thread(target=one, args=(321, None)),
+              threading.Thread(target=one, args=(654, 2_000))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=8.0)
+        assert not any(t.is_alive() for t in ts), \
+            "group never closed under deadline pressure"
+        assert len(out) == 2
+        for thr, got in out:
+            want = BatchExecutorsRunner(sel_dag(table, thr),
+                                        snap).handle_request()
+            assert got.rows() == want.rows()
+        assert expired == [False], "served past its deadline"
+        st = coal.stats()
+        assert st["closes"].get("deadline", 0) >= 1, st
+    finally:
+        ep.close()
+
+
+def test_idle_bypass_skips_the_window(runner):
+    """A lone request on an idle coalescer dispatches immediately —
+    serial workloads never pay the collection window."""
+    import time
+    table, snap = make_snapshot(seed=14)
+    ep, coal = make_endpoint(runner, snap, window_ms=5_000.0,
+                             idle_bypass=True)
+    try:
+        ep.handle(CopRequest(REQ_TYPE_DAG, sel_dag(table, 5)))  # warm
+        t0 = time.perf_counter()
+        ep.handle(CopRequest(REQ_TYPE_DAG, sel_dag(table, 6)))
+        assert time.perf_counter() - t0 < 2.0
+        assert coal.stats()["closes"].get("idle", 0) >= 1
+    finally:
+        ep.close()
+
+
+# ------------------------------------------------- gRPC smoke (6b twin)
+
+
+@pytest.fixture(scope="module")
+def rig():
+    import jax
+
+    from tikv_tpu.parallel import make_mesh
+    from tikv_tpu.raftstore.metapb import Store
+    from tikv_tpu.server import (
+        Node, PdServer, RemotePdClient, TikvServer, TxnClient,
+    )
+    # single-device mesh: cross-request batching is single-device by
+    # design (batch_class), and the real bench chip is one device
+    device = DeviceRunner(mesh=make_mesh(jax.devices()[:1]))
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    node = Node("127.0.0.1:0", RemotePdClient(pd_addr),
+                device_runner=device, device_row_threshold=128)
+    srv = TikvServer(node)
+    node.addr = f"127.0.0.1:{srv.port}"
+    node.pd.put_store(Store(node.store_id, node.addr))
+    srv.start()
+    client = TxnClient(pd_addr)
+    yield {"srv": srv, "node": node, "client": client, "device": device}
+    srv.stop()
+    pd_server.stop()
+
+
+def test_smoke_concurrent_serving_coalesces(rig):
+    """Fast tier-1 twin of bench 6b: concurrent warm gRPC clients over
+    rotating predicate constants — ≥2 requests actually share one
+    dispatch, zero deadline_exceeded from coalesce wait, and the
+    observability surfaces report the subsystem."""
+    import json
+    import urllib.request
+
+    from tikv_tpu.server.status_server import StatusServer
+    from tikv_tpu.testing.fixture import encode_table_row, int_table
+    c, node = rig["client"], rig["node"]
+    coal = node.endpoint.coalescer
+    assert coal is not None, "node wired without a coalescer"
+    table = int_table(2, table_id=9450)
+    muts = []
+    for h in range(3000):
+        key, value = encode_table_row(
+            table, h, {"c0": h % 11, "c1": (h * 37) % 2000 - 1000})
+        muts.append(("put", key, value))
+    c.txn_write(muts)
+
+    def make_sel(ts, thr):
+        s = DagSelect.from_table(table, ["id", "c0", "c1"])
+        return s.where(s.col("c1") > thr).build(start_ts=ts)
+
+    # warm: feed + solo kernel + columnar cache
+    warm = c.coprocessor(make_sel(c.tso(), 0))
+    assert warm["backend"] == "device", warm.get("backend")
+
+    # collect deterministically for the burst (the idle bypass would
+    # let the very first arrival skip the window)
+    coal.configure(window_ms=150.0)
+    coal.idle_bypass = False
+    base = coal.stats()
+    thrs = [-500, 0, 500]
+    errors = []
+    lat_ok = []
+
+    def one(i):
+        try:
+            r = c.coprocessor(make_sel(c.tso(), thrs[i % 3]),
+                              deadline_ms=30_000, timeout=60)
+            lat_ok.append(r["backend"])
+        except Exception as e:      # noqa: BLE001
+            errors.append(e)
+
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    coal.idle_bypass = True
+    assert not errors, errors      # zero deadline_exceeded / sheds
+    assert len(lat_ok) == 8
+    st = coal.stats()
+    assert st["max_occupancy"] >= 2, st     # ≥2 shared one dispatch
+    assert st["requests_coalesced"] - base["requests_coalesced"] >= 8
+
+    status = StatusServer("127.0.0.1:0", node=node,
+                          config_controller=node.config_controller)
+    status.start()
+    try:
+        base_url = f"http://127.0.0.1:{status.port}"
+        body = json.load(urllib.request.urlopen(f"{base_url}/health"))
+        assert "coalescer" in body, sorted(body)
+        roll = body["coalescer"]
+        assert roll["groups_dispatched"] >= 1
+        assert "router" in roll and "decisions" in roll["router"]
+        metrics = urllib.request.urlopen(
+            f"{base_url}/metrics").read().decode()
+        assert "tikv_coprocessor_batch_occupancy" in metrics
+        assert "tikv_coprocessor_router_total" in metrics
+    finally:
+        status.stop()
+
+
+def test_coalesce_wait_phase_attributed(rig):
+    """The window time a member spent parked is split out as the
+    coalesce_wait tracker phase on its OWN TimeDetail."""
+    c, node = rig["client"], rig["node"]
+    from tikv_tpu.testing.dag import DagSelect as DS
+    from tikv_tpu.testing.fixture import int_table
+    coal = node.endpoint.coalescer
+    coal.configure(window_ms=120.0)
+    coal.idle_bypass = False
+    try:
+        table = int_table(2, table_id=9450)
+
+        def make_sel(ts, thr):
+            s = DS.from_table(table, ["id", "c0", "c1"])
+            return s.where(s.col("c1") > thr).build(start_ts=ts)
+
+        out = []
+
+        def one(thr):
+            out.append(c.coprocessor(make_sel(c.tso(), thr),
+                                     timeout=60))
+
+        ts = [threading.Thread(target=one, args=(t,))
+              for t in (-123, 456)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        phases = [r.get("time_detail", {}).get("phases_ms", {})
+                  for r in out]
+        assert any("coalesce_wait" in p for p in phases), phases
+    finally:
+        coal.idle_bypass = True
+        coal.configure(window_ms=2.0)
+
+
+def test_online_enable_from_disabled(rig):
+    """A node started with coalesce_window_ms=0 has no coalescer; an
+    online 0→N config change must construct and wire one (the field is
+    advertised as online-tunable — silently accepting the change while
+    batching stays off is the bug)."""
+    node = rig["node"]
+    orig = node.endpoint.coalescer
+    node.endpoint.coalescer = None
+    try:
+        node._copr_cfg({"coalesce_window_ms": 3.0,
+                        "coalesce_max_group": 5})
+        coal = node.endpoint.coalescer
+        assert coal is not None and coal is not orig
+        st = coal.stats()
+        assert st["window_ms"] == 3.0 and st["max_group"] == 5, st
+        assert coal._endpoint is node.endpoint     # bound
+        # N→0 disables in place
+        node._copr_cfg({"coalesce_window_ms": 0.0})
+        assert not coal.enabled
+        coal.close()
+    finally:
+        node.endpoint.coalescer = orig
+
+
+def test_readpool_class_keyed_ewma(rig):
+    """The read pool keys its service-time EWMA by compile class:
+    distinct plan shapes get distinct figures, rotating constants
+    share one."""
+    c, node = rig["client"], rig["node"]
+    from tikv_tpu.testing.dag import DagSelect as DS
+    from tikv_tpu.testing.fixture import int_table
+    table = int_table(2, table_id=9450)
+
+    def make_sel(ts, thr):
+        s = DS.from_table(table, ["id", "c0", "c1"])
+        return s.where(s.col("c1") > thr).build(start_ts=ts)
+
+    def make_agg(ts):
+        s = DS.from_table(table, ["id", "c0", "c1"])
+        return s.aggregate([s.col("c0")],
+                           [("count_star", None)]).build(start_ts=ts)
+
+    for thr in (1, 2, 3):
+        c.coprocessor(make_sel(c.tso(), thr))
+    c.coprocessor(make_agg(c.tso()))
+    c.get(b"nonexistent-key-xyz", c.tso())
+    rp = node.read_pool
+    sel_key = ("copr", make_sel(0, 99).class_key())
+    agg_key = ("copr", make_agg(0).class_key())
+    assert rp.class_ema(sel_key) > 0.0      # rotating consts: one class
+    assert rp.class_ema(agg_key) > 0.0
+    with rp._mu:
+        assert rp._class_ema[sel_key][1] >= 3, \
+            dict(rp._class_ema)[sel_key]
+    assert rp.class_ema("KvGet") > 0.0
+    assert rp.stats()["ema_classes"] >= 3

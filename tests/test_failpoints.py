@@ -361,11 +361,15 @@ def test_failpoint_inventory_resolves():
     # the mesh from PR 1 plus later PRs' additions must not shrink
     # (≥63 since the device-state integrity sites: device::hbm_oom
     # budget squeeze, device::feed_corrupt resident-plane bit-flip,
-    # device::d2h_corrupt detected transfer corruption)
-    assert len(sites) >= 63, f"only {len(sites)} unique sites"
+    # device::d2h_corrupt detected transfer corruption; ≥65 since the
+    # cross-request batching sites: copr::coalesce_dispatch batched
+    # launch failure → members retry solo, copr::coalesce_window
+    # forced immediate group close)
+    assert len(sites) >= 65, f"only {len(sites)} unique sites"
     for dev_site in ("device::hbm_oom", "device::feed_corrupt",
-                     "device::d2h_corrupt"):
-        assert dev_site in sites, f"missing device fault site {dev_site}"
+                     "device::d2h_corrupt", "copr::coalesce_dispatch",
+                     "copr::coalesce_window"):
+        assert dev_site in sites, f"missing fault site {dev_site}"
 
     nemesis_src = (root / "chaos" / "nemesis.py").read_text()
     referenced = set(re.findall(r'failpoint\.cfg\(\s*"([^"]+)"',

@@ -92,6 +92,14 @@ class CoprocessorConfig:
     device_hbm_budget_mb: int = 0
     scrub_interval_s: float = 0.0
     scrub_digests: bool = True
+    # cross-request device batching (server/coalescer.py): concurrent
+    # requests sharing a compile class + resident feed coalesce into
+    # one stacked dispatch under a bounded, deadline-aware collection
+    # window.  coalesce_window_ms = 0 disables the subsystem entirely
+    # (every device request dispatches solo); coalesce_max_group caps
+    # group size (also the stacked kernel's largest lane bucket)
+    coalesce_window_ms: float = 2.0
+    coalesce_max_group: int = 16
 
 
 @dataclass
@@ -173,6 +181,8 @@ _ONLINE_FIELDS = {
     "coprocessor.response_page_rows",
     "coprocessor.tombstone_compact_ratio",
     "coprocessor.device_hbm_budget_mb",
+    "coprocessor.coalesce_window_ms",
+    "coprocessor.coalesce_max_group",
     "readpool.concurrency",
 }
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional, Sequence, Union
 
-from ..datatype import EvalType, FieldType
+from ..datatype import EvalType, FieldType, device_const_dtype
 from ..expr import Expr
 
 
@@ -128,6 +128,36 @@ class DAGRequest:
                         e.eval_type.value if e.eval_type else None)
             return ("f", e.sig, tuple(expr_key(c) for c in e.children))
 
+        return self._plan_parts(expr_key)
+
+    def class_key(self) -> tuple:
+        """Const-blind COMPILE-CLASS identity: ``plan_key`` with numeric
+        constant VALUES erased (bucketed by device dtype only).  Two
+        requests differing solely in predicate/aggregate int/float
+        constants map to one class — the same hoisted-parameter grid the
+        device selection kernels share one trace over
+        (device/selection.py split_params/shape_key) — so per-class
+        service-time EWMAs (read-pool shedding) and the cross-request
+        coalescer group requests that are batchable into one dispatch.
+        A constant crossing the int32/int64 boundary is a genuine new
+        trace and keys separately."""
+        def expr_key(e: Expr):
+            if e.kind == "const":
+                v = e.value
+                if isinstance(v, bool) or v is None or \
+                        not isinstance(v, (int, float)):
+                    return ("c", repr(v),
+                            e.eval_type.value if e.eval_type else None)
+                return ("c?", device_const_dtype(v),
+                        e.eval_type.value if e.eval_type else None)
+            if e.kind == "column":
+                return ("col", e.col_idx,
+                        e.eval_type.value if e.eval_type else None)
+            return ("f", e.sig, tuple(expr_key(c) for c in e.children))
+
+        return self._plan_parts(expr_key)
+
+    def _plan_parts(self, expr_key) -> tuple:
         parts = []
         for ex in self.executors:
             if isinstance(ex, TableScanDesc):

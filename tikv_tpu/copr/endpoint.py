@@ -99,15 +99,34 @@ class Endpoint:
     # by the runner's per-plan EWMA router, not by this row count.
     # Tunneled-TPU sessions (~100 ms RTT floor) should raise this to
     # ~2^22 via config.
+    #
+    # UNDER CONCURRENCY the launch-overhead side of this break-even no
+    # longer belongs to one request: the coalescer
+    # (server/coalescer.py) stacks co-resident same-compile-class
+    # requests into one dispatch, dividing the fixed launch + D2H-sync
+    # tax by the group occupancy.  This threshold therefore keeps its
+    # meaning as the SOLO break-even — the zero-load anchor the cost
+    # router calibrates its host model against ((n / threshold) × the
+    # live launch EWMA) — while the effective device crossover at load
+    # sits below it by roughly the observed occupancy.  The router owns
+    # that shift per request; do not fold expected batching into this
+    # constant.
     DEFAULT_DEVICE_ROW_THRESHOLD = 131072
 
     def __init__(self, snapshot_provider: Callable[[CopRequest], "ScanStorage"],
                  device_runner: Optional[object] = None,
                  device_row_threshold: int = DEFAULT_DEVICE_ROW_THRESHOLD,
-                 completion_workers: int = 8):
+                 completion_workers: int = 8,
+                 coalescer: Optional[object] = None):
         self._snapshot_provider = snapshot_provider
         self._device_runner = device_runner
         self._device_row_threshold = device_row_threshold
+        # cross-request device batching (server/coalescer.py): the
+        # coalescing dispatcher + cost-based admission router in front
+        # of the device backend; None = every request dispatches solo
+        self.coalescer = coalescer
+        if coalescer is not None:
+            coalescer.bind(self)
         # deferred D2H fetches resolve on a small shared pool so N
         # in-flight requests overlap their transfer waits (handle_async)
         self._completion_workers = completion_workers
@@ -121,8 +140,13 @@ class Endpoint:
         self._runner_deferred: Optional[bool] = None
 
     def close(self) -> None:
-        """Release the completion pool's worker threads.  Server nodes
-        call this on stop; long-lived endpoints never need to."""
+        """Release the coalescer's dispatcher and the completion
+        pool's worker threads.  Server nodes call this on stop;
+        long-lived endpoints never need to."""
+        if self.coalescer is not None:
+            # before the completion pool: still-parked groups dispatch
+            # on close and resolve their members through the pool
+            self.coalescer.close()
         with self._completion_mu:
             if self._completion_pool is not None:
                 self._completion_pool.shutdown()
@@ -263,6 +287,30 @@ class Endpoint:
             # time and a completion-pool slot on an unusable answer
             from ..utils.deadline import check_current as _dl_check
             _dl_check("device_dispatch")
+            # cost-based admission router (server/coalescer.py): a
+            # device-eligible request may batch into a coalesced group
+            # dispatch, stay solo, fall back to the host pipeline, or
+            # shed with a retry hint — per-request, from measured
+            # launch/transfer EWMAs.  Forced-device requests (parity
+            # tests) bypass it: they contract for a raw solo dispatch.
+            if self.coalescer is not None and req.force_backend is None:
+                decision, bkey, hint = self.coalescer.route(req.dag,
+                                                            storage)
+                if decision == "shed":
+                    from ..server.read_pool import ServerIsBusy
+                    raise ServerIsBusy(
+                        "device router: remaining budget below modeled "
+                        "request cost", retry_after_ms=hint)
+                if decision == "host":
+                    tracker.label("backend", "host")
+                    return CopDeferred(self, req, storage, tag, t0,
+                                       "host", result=host_exec())
+                if decision == "device_batched" and bkey is not None:
+                    fut = self.coalescer.submit(bkey, req.dag, storage,
+                                                tag=tag)
+                    return CopDeferred(self, req, storage, tag, t0,
+                                       backend, future=fut)
+                # device_solo falls through to the direct dispatch
             try:
                 if self._supports_deferred():
                     out = self._device_runner.handle_request(

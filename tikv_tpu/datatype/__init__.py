@@ -9,7 +9,8 @@ dense numpy/jax value array plus a validity mask, padded to static tile
 shapes so XLA sees fixed shapes (SURVEY.md §7 "Dynamic shapes").
 """
 
-from .eval_type import EvalType, FieldType, FieldTypeFlag, FieldTypeTp
+from .eval_type import (EvalType, FieldType, FieldTypeFlag, FieldTypeTp,
+                        device_const_dtype)
 from .column import Column, ColumnBatch
 from .tile import Tile, TileBatch, pad_to_tile, TILE_ROWS
 

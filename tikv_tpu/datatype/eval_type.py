@@ -201,3 +201,17 @@ class FieldType:
         # (named new_decimal: a constructor called "decimal" would shadow
         # the dataclass field's default with the function object)
         return FieldType(tp=FieldTypeTp.NEW_DECIMAL, flen=flen, decimal=frac)
+
+
+def device_const_dtype(v) -> str:
+    """Device dtype bucket for a hoistable numeric constant — THE
+    compile-class identity of a predicate/aggregate constant once its
+    value is hoisted into a traced scalar parameter.  Shared by the
+    hoisting itself (device/selection.split_params), the const-blind
+    kernel key (selection.shape_key), and the const-blind plan class
+    (copr/dag.DAGRequest.class_key) so the three can never drift: a
+    float traces as float32; an int traces int32 unless it crosses the
+    int32 boundary, which is a genuinely new trace."""
+    if isinstance(v, float):
+        return "float32"
+    return "int32" if -(2 ** 31) <= v < 2 ** 31 else "int64"

@@ -347,6 +347,104 @@ class DeferredResult:
         return self._runner._apply_output_offsets(self._dag, r)
 
 
+class _BatchUnavailable(Exception):
+    """Raised when a cross-request batched dispatch cannot be served as
+    one stacked launch (plan/feed edge case, degrade mid-dispatch).
+    The coalescer catches it and retries every member as a SOLO
+    dispatch — a failed group must never fail its members."""
+
+
+class _GroupPending:
+    """Shared fetch handle for ONE stacked group dispatch.
+
+    Unlike :class:`DeferredResult` there is no built-in host fallback —
+    the raw fetched tree serves N member resolutions, and a member-level
+    failure must degrade THAT member (the endpoint's per-request
+    contract), never substitute one member's answer for another's.
+    ``fetch()`` blocks on the shared D2H once, memoizes, and releases
+    the group's arena pin exactly once.
+    """
+
+    __slots__ = ("_runner", "_pending", "_mu", "_memo", "_pin_anchor")
+
+    def __init__(self, runner, pending: _Pending, pin_anchor=None):
+        self._runner = runner
+        self._pending = pending
+        self._mu = threading.Lock()
+        self._memo = None
+        self._pin_anchor = pin_anchor
+
+    def fetch(self):
+        with self._mu:
+            if self._memo is None:
+                try:
+                    self._memo = ("ok",
+                                  self._runner._finish(self._pending))
+                except BaseException as e:  # noqa: BLE001 — memoized
+                    self._memo = ("err", e)
+                finally:
+                    self._unpin()
+            kind, val = self._memo
+        if kind == "err":
+            raise val
+        return val
+
+    def _unpin(self) -> None:
+        if self._pin_anchor is not None:
+            try:
+                self._runner._arena.unpin(self._pin_anchor)
+            except Exception:   # noqa: BLE001
+                pass
+            self._pin_anchor = None
+
+    def __del__(self):
+        # abandoned group (every member solo-degraded before fetching):
+        # the pin must not outlive the handle
+        if getattr(self, "_pin_anchor", None) is not None:
+            self._unpin()
+
+
+class _BatchedSelectionGroup:
+    """N per-request resolutions over one stacked selection dispatch.
+
+    ``member_result(i)`` joins the SHARED fetch (one D2H sync for the
+    whole group), slices member ``i``'s packed bitmask, seeds that
+    member's selectivity EWMA, and runs the member's own host gather
+    over its own snapshot — so concurrent members' gathers parallelize
+    on the completion pool while the device round trip is paid once.
+    """
+
+    __slots__ = ("_runner", "_gp", "_members")
+
+    def __init__(self, runner, gp: _GroupPending, members):
+        self._runner = runner
+        self._gp = gp
+        self._members = members
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def member_result(self, i: int):
+        from ..utils import tracker
+        counts, packed, n = self._gp.fetch()
+        dag, storage = self._members[i]
+        runner = self._runner
+        plan = runner._analyze(dag)
+        k = int(counts[i])
+        runner._sel_observe(runner._sel_keys(dag, plan),
+                            (k / n) if n else 0.0)
+        mask = np.unpackbits(packed[i])[:n].astype(np.bool_)
+        with tracker.phase("host_materialize"):
+            if isinstance(plan.scan, TableScanDesc) and \
+                    hasattr(storage, "gather_rows"):
+                out = storage.gather_rows(plan.scan, dag.ranges, mask)
+            else:
+                b = runner._scan_batch(dag, plan, storage)
+                out = b.filter(mask)
+        result = runner._result(dag, out.schema, out.columns)
+        return runner._apply_output_offsets(dag, result)
+
+
 class DeviceRunner:
     """Executes supported DAG plans on the device mesh.
 
@@ -455,7 +553,12 @@ class DeviceRunner:
         pipeline answers without the dispatch round trip; periodic
         re-probes rediscover workloads whose selectivity drifts back
         down.  The SIZE crossover lives in
-        Endpoint.device_row_threshold (rationale there).
+        Endpoint.device_row_threshold (rationale there) — and under
+        concurrency it is a conservative bound, since the request
+        coalescer (server/coalescer.py) amortizes the launch + D2H
+        sync this gate exists to avoid paying per-request: the cost
+        router in front of the device backend re-decides per request
+        with the fixed tax divided by group occupancy.
         force_backend="device" still runs declined shapes for parity
         testing, and a forced/direct call always dispatches the real
         kernels regardless of the EWMA.
@@ -467,6 +570,79 @@ class DeviceRunner:
             return bool(plan.sel_rpns) and \
                 self._sel_allows_device(self._sel_keys(dag, plan))
         return plan.kind in ("simple_agg", "hash_agg", "topn")
+
+    # -- cross-request batching (server/coalescer.py) --
+
+    def batch_class(self, dag: DAGRequest, storage):
+        """Coalescing identity for this request, or None if it cannot
+        share a dispatch.
+
+        Two requests grouped under the same key are served by ONE
+        device launch.  ``("stack", ...)`` keys mark selections whose
+        predicate constants are hoisted into traced scalar params
+        (selection.split_params): differing thresholds within one
+        const-blind ``shape_key`` stack as a leading axis of the params
+        and evaluate in one vmapped dispatch.  ``("share", ...)`` keys
+        mark byte-identical plans (same exact ``plan_key``, incl.
+        output offsets): one dispatch + one fetch serves every member
+        (the thundering-herd dashboard-query case) — aggregations and
+        param-less selections batch this way.  Either way the members
+        must target a CO-RESIDENT feed: same anchor (snapshot /
+        lineage identity), same data generation, same ranges.
+        Single-device only — a sharded mesh's per-shard launches are
+        already amortized by GSPMD and the stacked kernel does not
+        shard.
+        """
+        if not self._single or not hasattr(storage, "scan_columns"):
+            return None
+        plan = self._analyze(dag)
+        if plan is None:
+            return None
+        anchor = self._feed_anchor(storage)
+        lineage = getattr(storage, "feed_lineage", None)
+        req_v = getattr(storage, "feed_version", None)
+        if lineage is not None and req_v is None:
+            req_v = lineage.version
+        if plan.kind == "scan_sel" and plan.sel_rpns:
+            if plan.sel_params is None:
+                from . import selection as selmod
+                plan.sel_params = selmod.split_params(
+                    plan.sel_rpns, len(plan.used_cols))
+            _rpns, _vals, dts = plan.sel_params
+            if dts:
+                from .selection import shape_key
+                return ("stack", id(anchor), req_v, shape_key(plan),
+                        dts, dag.ranges, dag.output_offsets)
+        if plan.kind in ("simple_agg", "hash_agg", "topn", "scan_sel"):
+            return ("share", id(anchor), req_v, dag.plan_key(),
+                    dag.ranges)
+        return None
+
+    def handle_batched(self, members) -> "_BatchedSelectionGroup":
+        """ONE stacked dispatch for ``members`` — a list of
+        ``(dag, storage)`` pairs sharing a ``("stack", ...)``
+        batch_class.  Returns a :class:`_BatchedSelectionGroup`; raises
+        :class:`_BatchUnavailable` when the group cannot be served as
+        one launch (the caller retries members solo)."""
+        from . import selection as selmod
+        stacks = []
+        for dag, _s in members:
+            plan = self._analyze(dag)
+            if plan is None or plan.kind != "scan_sel":
+                raise _BatchUnavailable("not a stacked selection plan")
+            if plan.sel_params is None:
+                plan.sel_params = selmod.split_params(
+                    plan.sel_rpns, len(plan.used_cols))
+            stacks.append(plan.sel_params[1])
+        lead_dag, lead_storage = members[0]
+        got = self.handle_request(lead_dag, lead_storage, deferred=True,
+                                  _stack=tuple(stacks))
+        if not isinstance(got, _GroupPending):
+            # the run settled synchronously (zero rows, quarantine,
+            # sticky force-host) — those edges carry per-request
+            # semantics the solo path owns
+            raise _BatchUnavailable("batched dispatch unavailable")
+        return _BatchedSelectionGroup(self, got, list(members))
 
     # -- selectivity-adaptive selection routing (selection.py) --
 
@@ -1617,8 +1793,18 @@ class DeviceRunner:
 
     # ------------------------------------------------------------ dispatch
 
-    def handle_request(self, dag: DAGRequest, storage, deferred: bool = False):
+    def handle_request(self, dag: DAGRequest, storage,
+                       deferred: bool = False, _stack=None):
         """Execute a supported plan on the device.
+
+        ``_stack`` (handle_batched only): a tuple of per-member hoisted
+        predicate parameter value tuples.  The scan_sel run then builds
+        the STACKED mask kernel, dispatches the whole group once, and
+        the call returns a :class:`_GroupPending` (raw group arrays,
+        shared fetch) instead of a per-request result; any path that
+        cannot produce a group dispatch raises
+        :class:`_BatchUnavailable` or returns a settled result the
+        caller must treat as such.
 
         ``deferred=True``: return as soon as the kernel is dispatched —
         the result is a :class:`DeferredResult` whose ``result()`` runs
@@ -1843,7 +2029,8 @@ class DeviceRunner:
                                             n, get_batch, feed)
                 else:   # scan_sel
                     result = self._run_scan_sel(dag, plan, dtypes, n,
-                                                get_batch, feed, storage)
+                                                get_batch, feed, storage,
+                                                stack=_stack)
                 if isinstance(result, _Pending) and \
                         hasattr(storage, "scan_columns"):
                     # pin the line for the in-flight dispatch: budget
@@ -1866,6 +2053,12 @@ class DeviceRunner:
         except _FallbackToHost:
             if pin_anchor is not None:
                 self._arena.unpin(pin_anchor)
+            if _stack is not None:
+                # a degrade mid-group must not serve the LEADER's host
+                # answer to every member — the coalescer retries each
+                # member as a solo dispatch (per-member degrade intact)
+                raise _BatchUnavailable("degraded during batched "
+                                        "dispatch")
             from ..executors.runner import BatchExecutorsRunner
             return BatchExecutorsRunner(orig_dag, storage).handle_request()
         except BaseException:
@@ -1873,6 +2066,10 @@ class DeviceRunner:
                 self._arena.unpin(pin_anchor)
             raise
 
+        if _stack is not None:
+            if isinstance(result, _Pending):
+                return _GroupPending(self, result, pin_anchor)
+            return result       # settled synchronously: caller bails
         if isinstance(result, _Pending):
             return DeferredResult(self, result, orig_dag, storage,
                                   pin_anchor=pin_anchor)
@@ -2638,7 +2835,7 @@ class DeviceRunner:
                 self._sel_route_counts.get(route, 0) + 1
 
     def _run_scan_sel(self, dag, plan, dtypes, n, get_batch, feed,
-                      storage):
+                      storage, stack=None):
         """Device selection whose D2H volume scales with SELECTED rows.
 
         One fused dispatch evaluates the predicates over the resident
@@ -2668,6 +2865,40 @@ class DeviceRunner:
             plan.sel_params = selmod.split_params(plan.sel_rpns,
                                                   len(plan.used_cols))
         param_rpns, param_vals, param_dts = plan.sel_params
+        if stack is not None:
+            # cross-request STACKED dispatch (server/coalescer.py):
+            # every member's hoisted constants ride a leading group
+            # axis of the traced scalar params and the whole group is
+            # ONE launch + ONE shared D2H.  Pow2 lane buckets keep the
+            # compile classes logarithmic; dead lanes repeat lane 0's
+            # params and are sliced away by the per-member resolve.
+            # Always the packed-mask payload — the always-correct
+            # route, since per-member counts are unknown at dispatch.
+            G = len(stack)
+            gb = 1 << max(0, (G - 1).bit_length())
+            bkey = ("selmaskb", selmod.shape_key(plan),
+                    feed["null_flags"], n_pad, tuple(dtypes),
+                    param_dts, gb)
+            bkern = self._shard_kernel(
+                bkey, lambda: selmod.build_batched_mask_kernel(
+                    param_rpns, feed["null_flags"], n_pad,
+                    len(feed["flat"]), len(param_dts), gb))
+            lanes = []
+            for pi, dt in enumerate(param_dts):
+                vals = [stack[g][pi] for g in range(G)]
+                vals += [vals[0]] * (gb - G)
+                lanes.append(jnp.asarray(
+                    np.asarray(vals, dtype=np.dtype(dt))))
+            with _tracker.phase("device_dispatch"):
+                counts_dev, packed_dev = bkern(
+                    self._cached_scalar(n, jnp.int64), *lanes,
+                    *feed["flat"])
+            self._sel_route_note("batched")
+            return _Pending(
+                (counts_dev, packed_dev),
+                lambda fetched: (np.asarray(fetched[0]),
+                                 np.asarray(fetched[1]), n),
+                small=False)
         # const-blind kernel key: repeated selections at differing
         # thresholds within one n_pad bucket share ONE compile class
         skey = ("selmask", selmod.shape_key(plan), feed["null_flags"],

@@ -57,6 +57,7 @@ try:                                    # jax >= 0.5 top-level alias
 except AttributeError:                  # 0.4.x: experimental home
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from ..datatype import device_const_dtype
 from ..expr.eval import eval_rpn
 from ..expr.rpn import RpnColumnRef, RpnConst, RpnExpression
 from ..parallel import ROW_AXES, num_shards
@@ -103,10 +104,7 @@ def split_params(sel_rpns, n_cols: int):
         for nd in rpn.nodes:
             if isinstance(nd, RpnConst) and nd.value is not None and \
                     isinstance(nd.value, (int, float)):
-                if isinstance(nd.value, float):
-                    dt = "float32"
-                else:
-                    dt = "int32" if -(2**31) <= nd.value < 2**31 else "int64"
+                dt = device_const_dtype(nd.value)
                 nodes.append(RpnColumnRef(n_cols + len(vals), nd.eval_type))
                 vals.append(nd.value)
                 dts.append(dt)
@@ -127,11 +125,8 @@ def shape_key(plan) -> tuple:
         if isinstance(nd, RpnConst):
             if nd.value is None:
                 return ("cN", nd.eval_type.value)
-            if isinstance(nd.value, float):
-                return ("c", "float32")
-            if isinstance(nd.value, int):
-                return ("c", "int32" if -(2**31) <= nd.value < 2**31
-                        else "int64")
+            if isinstance(nd.value, (int, float)):
+                return ("c", device_const_dtype(nd.value))
             return ("c", repr(nd.value))    # non-numeric: host-only plans
         if isinstance(nd, RpnColumnRef):
             return ("col", nd.col_idx, nd.eval_type.value)
@@ -259,6 +254,54 @@ def build_mask_kernel(sel_rpns, null_flags, n_pad: int, n_flat: int,
         local_fn, mesh=mesh,
         in_specs=(P(),) * (1 + n_params) + (P(ROW_AXES),) * n_flat,
         out_specs=(P(), P(ROW_AXES), P(ROW_AXES))))
+
+
+def build_batched_mask_kernel(sel_rpns, null_flags, n_pad: int,
+                              n_flat: int, n_params: int, group: int):
+    """Cross-request STACKED predicate pass: ``group`` requests sharing
+    one compile class (same ``shape_key``, same feed) evaluate in ONE
+    dispatch → ``(counts (G,), packed bitmasks (G, n_pad/8))``.
+
+    The hoisted scalar parameters arrive with a leading group axis —
+    shape ``(G,)`` per parameter — and ``jax.vmap`` maps the solo
+    kernel's trace over it while the feed columns stay broadcast
+    (in_axes=None): the per-request fixed cost (launch + D2H sync) is
+    paid once for the whole group, which is the TPU-economics point
+    (Jouppi: amortize the launch/transfer overhead across a batch).
+    The feed is read once per lane by construction of the elementwise
+    pass; XLA keeps the lanes in one fused HBM traversal for the common
+    single-predicate shapes.  ``group`` is a pow2 bucket so compile
+    classes stay logarithmic in occupancy; dead lanes (group padding)
+    repeat a live lane's parameters and their outputs are discarded.
+
+    Single-device only: the coalescer never stacks on a sharded mesh
+    (a vmapped psum inside shard_map buys nothing there — per-shard
+    dispatch overhead is already amortized by GSPMD).
+    """
+    assert n_params >= 1, "stacked dispatch needs hoisted parameters"
+    idt = jnp.int32 if n_pad <= np.iinfo(np.int32).max else jnp.int64
+
+    def local_fn(n_scalar, *args):
+        params = args[:n_params]            # each (group,)
+        flat = args[n_params:]
+        iota = jnp.arange(n_pad, dtype=idt)
+        row_mask = iota < n_scalar.astype(idt)
+
+        def one(*ps):
+            pairs = _feed_pairs(flat, null_flags, row_mask)
+            one_b = jnp.ones((), jnp.bool_)
+            for p in ps:
+                pairs.append((p, one_b))
+            mask = row_mask
+            for rpn in sel_rpns:
+                v, ok = eval_rpn(rpn, pairs, n_pad, jnp)
+                mask = mask & ok & (v != 0)
+            mask = jnp.broadcast_to(mask, (n_pad,))
+            return jnp.sum(mask, dtype=jnp.int64), jnp.packbits(mask)
+
+        return jax.vmap(one)(*params)
+
+    return jax.jit(local_fn)
 
 
 def build_index_kernel(n_pad: int, k_cap: int, mesh=None):

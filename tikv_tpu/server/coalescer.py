@@ -1,0 +1,663 @@
+"""Cross-request device batching — coalescing dispatcher + cost router.
+
+The heavy-traffic serving subsystem (ROADMAP "Heavy-traffic serving"):
+thousands of concurrent small coprocessor queries each paid their own
+device dispatch, their own D2H sync, and their own trip through the
+read pool, even though config 4p proves the hardware amortizes those
+fixed costs across in-flight work (~6.1B rows/s pipelined vs ~1B
+single-stream).  The accelerator's economics are BATCH economics
+(Jouppi et al., PAPERS.md): a launch plus a transfer sync is a fixed
+tax, so the unit of dispatch must be a *group* of requests, exactly as
+MonetDB/X100 made the unit of interpretation a vector of tuples
+instead of one.
+
+Two pieces:
+
+:class:`RequestCoalescer` — concurrent requests that target a
+co-resident HBM feed and share a compile class (the const-blind
+``shape_key`` from the hoisted-parameter selection kernels, or a
+byte-identical plan) are grouped into ONE stacked device dispatch with
+a shared D2H, under a bounded, deadline-aware collection window:
+
+- a group closes on SIZE (``max_group`` members), WINDOW expiry
+  (``window_ms``), or tightest-deadline PRESSURE — a member is never
+  held past the point where waiting would eat its remaining budget
+  (the zero-late-acks contract from the deadline-propagation work);
+- IDLE BYPASS: a request arriving with nothing parked and nothing in
+  flight dispatches immediately (occupancy 1) — a serial workload pays
+  zero added latency, and the window only engages once a second
+  request arrives while the first is still in flight, which is exactly
+  when batching has something to amortize (the dynamic-batching rule
+  inference servers use);
+- ``("stack", ...)`` groups stack each member's hoisted predicate
+  constants as a leading axis of the traced scalar params
+  (device/selection.build_batched_mask_kernel) — differing thresholds,
+  one launch; ``("share", ...)`` groups (identical plans — the
+  dashboard thundering herd) share one solo dispatch and one fetch;
+- results resolve through the endpoint's CompletionPool as per-request
+  slices: ONE fetch, N resolutions, with each member's host gather
+  running on its own completion worker;
+- the group pins its arena lines once (generation-guarded pin tokens,
+  device/supervisor.py) for the shared dispatch;
+- a failed group NEVER fails its members: a batched-launch failure
+  (incl. the ``copr::coalesce_dispatch`` failpoint) retries every
+  member as a solo dispatch, and a fetch-side fault degrades each
+  member to the host pipeline through the endpoint's existing
+  per-request contract.
+
+:class:`CostRouter` — generalizes the read pool's EWMA shedding into a
+per-request, Jouppi-style cost decision over four outcomes:
+
+- ``device_batched``: launch overhead amortized over the expected
+  group occupancy (EWMA of recent group sizes) + the member's D2H
+  bytes; the expected collection wait (the open group's remaining
+  window, half a window when none is open) counts against the
+  request's DEADLINE feasibility but never against the backend
+  choice — wait is latency the member sits out, not a resource
+  either backend consumes, and charging it as cost would mean any
+  window longer than the host cost forces all traffic host and the
+  occupancy that justifies the window could never form;
+- ``device_solo``: full launch overhead + D2H — taken when the plan
+  cannot share a dispatch or the deadline cannot afford a window;
+- ``host``: the modeled host-pipeline cost undercuts both device
+  options.  The host model is CALIBRATED from the endpoint's
+  ``device_row_threshold`` — the operator-tuned, transport-measured
+  break-even (endpoint.py rationale) — so at zero load the router
+  never re-litigates the threshold's verdict; device costs additionally
+  carry the CURRENT backlog (members parked + in flight), so under a
+  device pile-up the marginal request overflows to the host CPU
+  instead of queueing — the slow-store-drain idea applied to the
+  accelerator itself;
+- ``shed``: the remaining deadline cannot fit even the cheapest
+  option — reject NOW with a ``retry_after_ms`` hint instead of
+  burning device time on an answer nobody can use (the read-pool
+  ``remaining < ema`` rule, upgraded from one global EWMA to a
+  modeled per-request cost).
+
+Launch overhead is MEASURED (EWMA over observed dispatch walls, seeded
+conservatively); D2H bytes come from the runner's per-plan selectivity
+EWMAs for selections (mask payload = n/8) and a small-constant agg
+readback otherwise.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ..utils.failpoint import fail_point
+from ..utils.metrics import (
+    COPR_BATCH_OCCUPANCY,
+    COPR_COALESCE_CLOSE_COUNTER,
+    COPR_ROUTER_COUNTER,
+)
+
+DEVICE_BATCHED = "device_batched"
+DEVICE_SOLO = "device_solo"
+HOST = "host"
+SHED = "shed"
+
+
+class CostRouter:
+    """Per-request admission decision from a measured cost model."""
+
+    # EWMA seeds/rates.  The launch figure is the dispatch+sync fixed
+    # cost on co-located chips (~1-2ms; a tunneled TPU measures ~100ms
+    # and the EWMA converges there after the first groups).
+    LAUNCH_SEED_S = 1.5e-3
+    LAUNCH_ALPHA = 0.2
+    OCC_ALPHA = 0.3
+    # modeled D2H link rate (static seed — the measured quantities are
+    # the launch overhead and the per-plan selectivity EWMAs; this only
+    # scales byte counts into comparable seconds)
+    D2H_BYTES_PER_S = 8e9
+    AGG_D2H_BYTES = 1 << 16
+    # host-cost calibration anchor: at n == device_row_threshold the
+    # host pipeline and a solo dispatch break even BY MEASUREMENT
+    # (that is what the threshold means — endpoint.py rationale), and
+    # a warm solo dispatch's cost IS the launch EWMA — so host cost is
+    # modeled as (n / threshold) × the LIVE launch figure.  Anchoring
+    # on the measured EWMA instead of a frozen seed keeps the two
+    # sides of the comparison consistent on any transport (a tunneled
+    # TPU's 100ms launch scales the host model with it); deployments
+    # that retune the threshold retune the host model too.
+    DEFAULT_ROW_THRESHOLD = 131072
+    # shed margin: remaining budget must cover the cheapest option with
+    # this headroom, else the request is rejected with a hint
+    SHED_MARGIN = 2.0
+    # the endpoint's row threshold already vetted the device for this
+    # request (transport-bound crossover, endpoint.py rationale); the
+    # router diverts it back to host only on a CLEAR modeled win, so
+    # model noise near the crossover cannot starve the batch pipeline
+    # of the occupancy that makes it profitable
+    HOST_BIAS = 2.0
+
+    def __init__(self, coalescer: "RequestCoalescer", runner):
+        self._coalescer = coalescer
+        self._runner = runner
+        self._mu = threading.Lock()
+        self.launch_ewma = self.LAUNCH_SEED_S
+        self.occupancy_ewma = 1.0
+        self.decisions: dict[str, int] = {}
+
+    # -- measurement feedback --
+
+    def note_launch(self, wall_s: float, occupancy: int) -> None:
+        """One group dispatched: fold the observed dispatch wall and
+        the group size into the model.  The wall covers enqueue + any
+        warm-path kernel lookup — the fixed cost the next request
+        would pay solo."""
+        with self._mu:
+            self.launch_ewma = (self.LAUNCH_ALPHA * wall_s +
+                                (1 - self.LAUNCH_ALPHA) * self.launch_ewma)
+            self.occupancy_ewma = (self.OCC_ALPHA * occupancy +
+                                   (1 - self.OCC_ALPHA) *
+                                   self.occupancy_ewma)
+
+    # -- the decision --
+
+    def _d2h_bytes(self, dag, n: Optional[int]) -> float:
+        """Modeled member D2H payload: packed mask (n/8) for
+        selections — the stacked route's per-member payload — scaled
+        down by the plan's observed-selectivity EWMA when the index/
+        compact routes would undercut it; small constant for
+        aggregations (KB-class packed states)."""
+        runner = self._runner
+        try:
+            plan = runner._analyze(dag)
+        except Exception:   # noqa: BLE001 — unanalyzable → agg-class
+            plan = None
+        if plan is None or plan.kind != "scan_sel" or not n:
+            return float(self.AGG_D2H_BYTES)
+        mask_bytes = n / 8.0
+        try:
+            pred = runner._sel_predict(runner._sel_keys(dag, plan))
+        except Exception:   # noqa: BLE001
+            pred = None
+        if pred is not None:
+            from ..device import selection as selmod
+            route = selmod.choose_route(n, pred * n, False)
+            return float(min(mask_bytes, selmod.modeled_d2h_bytes(
+                route, n, int(pred * n))))
+        return mask_bytes
+
+    def _host_s_per_row(self, launch: float) -> float:
+        ep = getattr(self._coalescer, "_endpoint", None)
+        thr = getattr(ep, "_device_row_threshold", 0) or \
+            self.DEFAULT_ROW_THRESHOLD
+        return launch / max(1, thr)
+
+    def route(self, dag, storage) -> tuple:
+        """→ ``(decision, batch_key, retry_after_ms)``.
+
+        ``batch_key`` is non-None only for ``device_batched``;
+        ``retry_after_ms`` only for ``shed``.  Batching is the DEFAULT
+        for batchable device requests with deadline slack — collection
+        windows are how occupancy (and thus amortization) materializes,
+        and the idle bypass keeps the default free for serial traffic —
+        while host/shed trigger on the modeled comparison."""
+        from ..utils import deadline as dl_mod
+        coal = self._coalescer
+        est = getattr(storage, "estimated_rows", None)
+        n = est() if callable(est) else None
+        key = self._runner.batch_class(dag, storage) \
+            if coal.enabled else None
+        with self._mu:
+            launch = self.launch_ewma
+            occ = max(1.0, self.occupancy_ewma)
+        busy = coal.busy()
+        d2h_s = self._d2h_bytes(dag, n) / self.D2H_BYTES_PER_S
+        # RESOURCE costs — what each option consumes.  Device
+        # dispatches serialize (the runner's dispatch lock): each
+        # backlogged member is ~one launch ahead of this request.
+        # Groups absorb backlog max_group at a time, so the batched
+        # queue term divides by the group size.  The collection-window
+        # wait is deliberately NOT in these figures (module doc): it
+        # is latency, entering only the deadline-feasibility terms.
+        cost_solo = launch * (1.0 + busy) + d2h_s
+        cost_batched = (launch * (1.0 + busy / coal.max_group) / occ +
+                        d2h_s) if key is not None else float("inf")
+        cost_host = n * self._host_s_per_row(launch) if n \
+            else float("inf")
+        wait = coal.expected_wait_s(key) if key is not None else 0.0
+        best = min(cost_solo, cost_batched + wait, cost_host)
+        dl = dl_mod.current()
+        rem = dl.remaining() if dl is not None else None
+        if rem is not None and rem < best * self.SHED_MARGIN:
+            hint = max(1, int(best * 1e3))
+            return self._note(SHED), None, hint
+        if cost_host * self.HOST_BIAS < min(cost_solo, cost_batched):
+            return self._note(HOST), None, 0
+        if key is not None and (
+                rem is None or
+                rem > 2.0 * self.SHED_MARGIN * cost_solo):
+            # batch even when the budget cannot afford the FULL window:
+            # the coalescer tightens the group's close time to the
+            # tightest member's remaining budget (deadline-pressure
+            # close), so joining costs at most the slack the member
+            # actually has — only a budget too tight for the
+            # post-dispatch work itself forces a solo dispatch
+            return self._note(DEVICE_BATCHED), key, 0
+        return self._note(DEVICE_SOLO), None, 0
+
+    def _note(self, decision: str) -> str:
+        COPR_ROUTER_COUNTER.labels(decision).inc()
+        from ..utils import tracker
+        tracker.label("router", decision)
+        with self._mu:
+            self.decisions[decision] = self.decisions.get(decision, 0) + 1
+        return decision
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "launch_ewma_ms": round(self.launch_ewma * 1e3, 3),
+                "occupancy_ewma": round(self.occupancy_ewma, 3),
+                "decisions": dict(self.decisions),
+            }
+
+
+class _Member:
+    """One request parked in a collection window."""
+
+    __slots__ = ("dag", "storage", "future", "tracker", "tag",
+                 "deadline_at", "t_submit_ns")
+
+    def __init__(self, dag, storage, future, tracker, tag, deadline_at):
+        self.dag = dag
+        self.storage = storage
+        self.future = future
+        self.tracker = tracker
+        self.tag = tag
+        self.deadline_at = deadline_at
+        self.t_submit_ns = time.perf_counter_ns()
+
+
+class _Group:
+    __slots__ = ("key", "members", "close_at", "window_close_at",
+                 "closed")
+
+    def __init__(self, key, close_at: float):
+        self.key = key
+        self.members: list[_Member] = []
+        self.close_at = close_at            # only ever tightens
+        self.window_close_at = close_at     # the untightened window
+        self.closed = False
+
+
+class RequestCoalescer:
+    """The coalescing dispatcher (module doc).  Owned by the endpoint;
+    one per node.  Lazy dispatcher thread — endpoints that never see a
+    device-batched request never start it."""
+
+    # post-dispatch latency reserve subtracted from a member's deadline
+    # when tightening the group's close time: a request must leave the
+    # window with enough budget for its dispatch + fetch + gather.
+    # Deliberately GENEROUS (and scaled by the measured launch EWMA):
+    # over-reserving only closes a group a little early — losing a
+    # member or two of occupancy — while under-reserving serves an
+    # answer past its deadline, which the zero-late-acks contract
+    # forbids outright.
+    RESERVE_FLOOR_S = 50e-3
+    # a member may spend at most this fraction of its REMAINING budget
+    # parked in a collection window; the rest stays for the dispatch +
+    # fetch + gather (whose first-group cost includes the stacked
+    # kernel's compile — far above the steady-state launch EWMA, so an
+    # EWMA-scaled reserve alone cannot cover it)
+    WAIT_FRACTION = 0.25
+
+    def __init__(self, runner, window_ms: float = 2.0,
+                 max_group: int = 16):
+        self._runner = runner
+        self.window_s = max(0.0, window_ms) / 1e3
+        self.max_group = max(1, int(max_group))
+        self.enabled = True
+        self.router = CostRouter(self, runner)
+        self._endpoint = None
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._open: dict = {}
+        self._ready: deque = deque()
+        self._thread: Optional[threading.Thread] = None
+        self._shutdown = False
+        # members closed-for-dispatch whose futures have not resolved;
+        # drives the idle-bypass busy signal
+        self._inflight = 0
+        # False: always collect for the window (deterministic tests)
+        self.idle_bypass = True
+        # counters (under _mu)
+        self.groups_dispatched = 0
+        self.requests_coalesced = 0
+        self.solo_degrade = 0
+        self.occupancy_sum = 0
+        self.max_observed_occupancy = 0
+        self.closes: dict[str, int] = {}
+
+    # ------------------------------------------------------------ wiring
+
+    def bind(self, endpoint) -> None:
+        """Attach the owning endpoint (completion pool provider)."""
+        self._endpoint = endpoint
+
+    def set_enabled(self, on: bool) -> None:
+        """Router gate: disabled → every device request routes solo
+        (the bench's forced per-request phase; online-config toggle via
+        window=0 recreates, this flips in place)."""
+        self.enabled = bool(on)
+
+    def configure(self, window_ms: Optional[float] = None,
+                  max_group: Optional[int] = None) -> None:
+        with self._mu:
+            if window_ms is not None:
+                self.window_s = max(0.0, float(window_ms)) / 1e3
+                self.enabled = window_ms > 0
+            if max_group is not None:
+                self.max_group = max(1, int(max_group))
+
+    def route(self, dag, storage) -> tuple:
+        return self.router.route(dag, storage)
+
+    def busy(self) -> int:
+        """Device backlog proxy: members parked in open windows plus
+        dispatched-but-unresolved members (the router's queue term)."""
+        with self._mu:
+            return self._inflight + sum(len(g.members)
+                                        for g in self._open.values())
+
+    def expected_wait_s(self, key) -> float:
+        """Modeled collection wait for a request joining ``key``'s
+        group NOW: the open group's remaining window when one exists
+        (a joiner inherits its close time), else half a window (the
+        expectation when this request opens the group and a size/
+        pressure close may beat the timer)."""
+        with self._mu:
+            g = self._open.get(key)
+            if g is not None and not g.closed:
+                return max(0.0, g.close_at - time.monotonic())
+        return self.window_s / 2.0
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, key, dag, storage, tag=None):
+        """Park one request into its group; → a Future resolving to the
+        member's SelectResult.  Called from handle_async under the
+        read-pool slot — nothing here blocks beyond the group lock."""
+        import concurrent.futures as cf
+
+        from ..utils import deadline as dl_mod
+        from ..utils import tracker
+        fut: "cf.Future" = cf.Future()
+        dl = dl_mod.current()
+        deadline_at = (time.monotonic() + dl.remaining()) \
+            if dl is not None else None
+        member = _Member(dag, storage, fut, tracker.current(), tag,
+                         deadline_at)
+        now = time.monotonic()
+        reserve = max(self.RESERVE_FLOOR_S,
+                      8.0 * self.router.launch_ewma)
+        inline = False      # dispatch on THIS thread (shutdown only)
+        with self._cv:
+            if self._shutdown:
+                # the endpoint is tearing down but a straggler arrived:
+                # serve it as an immediate singleton (no window).  The
+                # inline flag — not a re-read of _shutdown below —
+                # marks it for dispatch on this thread: a group closed
+                # on the NORMAL path is already queued for the
+                # dispatcher loop, and a close() racing in between the
+                # lock release and the check must not dispatch it twice
+                g = _Group(key, now)
+                g.members.append(member)
+                g.closed = True
+                self._inflight += 1     # _on_member_done undoes it
+                self.closes["shutdown"] = \
+                    self.closes.get("shutdown", 0) + 1
+                COPR_COALESCE_CLOSE_COUNTER.labels("shutdown").inc()
+                inline = True
+            else:
+                self._ensure_thread()
+                g = self._open.get(key)
+                if g is None or g.closed:
+                    g = _Group(key, now + self.window_s)
+                    self._open[key] = g
+                g.members.append(member)
+                if member.deadline_at is not None:
+                    rem = member.deadline_at - now
+                    g.close_at = min(g.close_at,
+                                     member.deadline_at - reserve,
+                                     now + self.WAIT_FRACTION * rem)
+                parked = sum(len(og.members)
+                             for og in self._open.values()) - 1
+                reason = None
+                if len(g.members) >= self.max_group:
+                    reason = "size"
+                elif fail_point("copr::coalesce_window") is not None:
+                    reason = "failpoint"
+                elif g.close_at <= now:
+                    reason = "deadline"
+                elif self.idle_bypass and self._inflight == 0 and \
+                        parked == 0:
+                    # nothing to amortize against: dispatch NOW —
+                    # serial workloads never pay the window
+                    reason = "idle"
+                if reason is not None:
+                    self._close_locked(g, reason)
+                self._cv.notify()
+        member.future.add_done_callback(self._on_member_done)
+        if inline:
+            self._dispatch(g)
+        return fut
+
+    # ------------------------------------------------------- group close
+
+    def _close_locked(self, g: _Group, reason: str) -> None:
+        if g.closed:
+            return
+        g.closed = True
+        if self._open.get(g.key) is g:
+            del self._open[g.key]
+        self._ready.append(g)
+        self._inflight += len(g.members)
+        self.closes[reason] = self.closes.get(reason, 0) + 1
+        COPR_COALESCE_CLOSE_COUNTER.labels(reason).inc()
+
+    def _on_member_done(self, _fut) -> None:
+        with self._mu:
+            self._inflight = max(0, self._inflight - 1)
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="copr-coalescer")
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._ready:
+                    now = time.monotonic()
+                    nxt = None
+                    for g in list(self._open.values()):
+                        if g.close_at <= now:
+                            self._close_locked(
+                                g, "window" if g.close_at >=
+                                g.window_close_at else "deadline")
+                        elif nxt is None or g.close_at < nxt:
+                            nxt = g.close_at
+                    if self._ready:
+                        break
+                    if self._shutdown:
+                        return
+                    self._cv.wait(None if nxt is None
+                                  else max(1e-4, nxt - now))
+                batch = list(self._ready)
+                self._ready.clear()
+            for g in batch:
+                self._dispatch(g)
+
+    # ---------------------------------------------------------- dispatch
+
+    def _dispatch(self, group: _Group) -> None:
+        from ..device.runner import (
+            DeferredResult,
+            _BatchUnavailable,
+        )
+        members = group.members
+        size = len(members)
+        COPR_BATCH_OCCUPANCY.observe(size)
+        with self._mu:
+            self.groups_dispatched += 1
+            self.requests_coalesced += size
+            self.occupancy_sum += size
+            self.max_observed_occupancy = max(
+                self.max_observed_occupancy, size)
+        from ..utils import tracker
+        # the group's dispatch work (feed lookup, kernel cache, launch)
+        # is attributed to the LEADER's TimeDetail — one member carries
+        # the shared cost's phases; every member still records its own
+        # coalesce_wait and resolution phases
+        lead_tok = tracker.adopt(members[0].tracker) \
+            if members[0].tracker is not None else None
+        t0 = time.perf_counter()
+        try:
+            if fail_point("copr::coalesce_dispatch") is not None:
+                raise _BatchUnavailable("copr::coalesce_dispatch")
+            if group.key[0] == "stack" and size > 1:
+                handle = self._runner.handle_batched(
+                    [(m.dag, m.storage) for m in members])
+                resolvers = [
+                    (lambda i=i, h=handle: h.member_result(i))
+                    for i in range(size)]
+            else:
+                # singleton / identical-plan share: one solo dispatch,
+                # its (memoized, thread-safe) fetch serves every member
+                d = self._runner.handle_request(
+                    members[0].dag, members[0].storage, deferred=True)
+                if isinstance(d, DeferredResult):
+                    resolvers = [d.result] * size
+                else:
+                    resolvers = [(lambda r=d: r)] * size
+        except Exception:   # noqa: BLE001 — incl. _BatchUnavailable
+            # the batched LAUNCH failed: a failed group must never fail
+            # its members — each retries as a solo dispatch (and any
+            # solo failure degrades to host through the endpoint's
+            # per-request contract at wait time)
+            if lead_tok is not None:
+                tracker.uninstall(lead_tok)
+                lead_tok = None
+            self.router.note_launch(time.perf_counter() - t0, size)
+            self._solo_fallback(members)
+            return
+        finally:
+            if lead_tok is not None:
+                tracker.uninstall(lead_tok)
+        self.router.note_launch(time.perf_counter() - t0, size)
+        t_dispatch_ns = time.perf_counter_ns()
+        for m, resolve in zip(members, resolvers):
+            self._complete(m, resolve,
+                           t_dispatch_ns - m.t_submit_ns)
+
+    def _solo_fallback(self, members) -> None:
+        from ..device.runner import DeferredResult
+        with self._mu:
+            self.solo_degrade += len(members)
+        for m in members:
+            t_ns = time.perf_counter_ns()
+            try:
+                d = self._runner.handle_request(m.dag, m.storage,
+                                                deferred=True)
+            except Exception as e:      # noqa: BLE001
+                # surfaces at the member's wait(): the endpoint applies
+                # its degrade-to-host policy there, per member
+                if not m.future.done():
+                    m.future.set_exception(e)
+                continue
+            if isinstance(d, DeferredResult):
+                resolve = d.result
+            else:
+                resolve = (lambda r=d: r)
+            self._complete(m, resolve, t_ns - m.t_submit_ns)
+
+    def _complete(self, m: _Member, resolve, wait_ns: int) -> None:
+        """Hand the member's resolution (shared fetch join + its own
+        host gather) to the completion pool; its result lands on the
+        member's future for CopDeferred.wait()."""
+        from ..resource_metering import GLOBAL_RECORDER
+        from ..utils import tracker
+
+        def task():
+            tok = tracker.adopt(m.tracker) if m.tracker is not None \
+                else None
+            try:
+                # the time a request spent parked in the collection
+                # window, split out of generic queue time so the
+                # batched-path p99 can be decomposed from the artifact
+                tracker.add_phase("coalesce_wait", max(0, wait_ns))
+                if m.tag is not None:
+                    with GLOBAL_RECORDER.attach(m.tag, requests=0):
+                        return resolve()
+                return resolve()
+            finally:
+                if tok is not None:
+                    tracker.uninstall(tok)
+
+        def run_and_set():
+            try:
+                r = task()
+            except BaseException as e:  # noqa: BLE001 — ride the future
+                if not m.future.done():
+                    m.future.set_exception(e)
+                return
+            if not m.future.done():
+                m.future.set_result(r)
+
+        pool = None
+        if self._endpoint is not None:
+            pool = self._endpoint._completion()
+        if pool is None:
+            run_and_set()
+            return
+        f = pool.submit(run_and_set)
+        if f.done() and f.exception() is not None and \
+                not m.future.done():
+            # completion pool already shut down: the submit was refused
+            # synchronously — surface it so the waiter host-degrades
+            m.future.set_exception(f.exception())
+
+    # ----------------------------------------------------------- teardown
+
+    def close(self) -> None:
+        """Stop collecting; dispatch every still-open group (their
+        members are parked waiters that must resolve) and join the
+        dispatcher."""
+        with self._cv:
+            self._shutdown = True
+            for g in list(self._open.values()):
+                self._close_locked(g, "shutdown")
+            self._cv.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._mu:
+            groups = self.groups_dispatched
+            out = {
+                "enabled": self.enabled,
+                "window_ms": round(self.window_s * 1e3, 3),
+                "max_group": self.max_group,
+                "open_groups": len(self._open),
+                "inflight": self._inflight,
+                "groups_dispatched": groups,
+                "requests_coalesced": self.requests_coalesced,
+                "mean_occupancy": round(
+                    self.occupancy_sum / groups, 3) if groups else 0.0,
+                "max_occupancy": self.max_observed_occupancy,
+                "solo_degrade": self.solo_degrade,
+                "closes": dict(self.closes),
+            }
+        out["router"] = self.router.stats()
+        return out

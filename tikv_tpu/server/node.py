@@ -469,9 +469,22 @@ class Node:
             compact_ratio=config.coprocessor.tombstone_compact_ratio,
             max_delta_rows=config.coprocessor.delta_log_rows)
         self.device_runner = device_runner      # /health selection rollup
+        # cross-request device batching: the coalescing dispatcher +
+        # cost-based admission router in front of the device backend
+        # (server/coalescer.py); window 0 disables it
+        coalescer = None
+        if device_runner is not None and \
+                config.coprocessor.coalesce_window_ms > 0 and \
+                hasattr(device_runner, "batch_class"):
+            from .coalescer import RequestCoalescer
+            coalescer = RequestCoalescer(
+                device_runner,
+                window_ms=config.coprocessor.coalesce_window_ms,
+                max_group=config.coprocessor.coalesce_max_group)
         self.endpoint = Endpoint(self._copr_snapshot,
                                  device_runner=device_runner,
-                                 device_row_threshold=device_row_threshold)
+                                 device_row_threshold=device_row_threshold,
+                                 coalescer=coalescer)
         # device-state supervisor: lifecycle events (split/merge/epoch
         # change/leader loss/snapshot apply/peer destroy) eagerly tear
         # down the matching columnar cache lines and device feeds, the
@@ -513,6 +526,27 @@ class Node:
                 hasattr(self.device_runner, "set_hbm_budget"):
             self.device_runner.set_hbm_budget(
                 int(diff["device_hbm_budget_mb"]) << 20)
+        coal = getattr(self.endpoint, "coalescer", None)
+        if coal is None and diff.get("coalesce_window_ms", 0) and \
+                self.device_runner is not None and \
+                hasattr(self.device_runner, "batch_class"):
+            # node started with coalescing disabled (window 0 → no
+            # coalescer constructed): an online 0→N enable builds and
+            # wires it now instead of silently accepting the change
+            from .coalescer import RequestCoalescer
+            coal = RequestCoalescer(
+                self.device_runner,
+                window_ms=float(diff["coalesce_window_ms"]),
+                max_group=diff.get(
+                    "coalesce_max_group",
+                    self.config.coprocessor.coalesce_max_group))
+            coal.bind(self.endpoint)
+            self.endpoint.coalescer = coal
+        elif coal is not None and ("coalesce_window_ms" in diff or
+                                   "coalesce_max_group" in diff):
+            coal.configure(
+                window_ms=diff.get("coalesce_window_ms"),
+                max_group=diff.get("coalesce_max_group"))
 
     def _read_index_check(self, read_ts: int, region) -> bool:
         """Leader-side async-commit guard for replica reads: bump

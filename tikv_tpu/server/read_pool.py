@@ -19,7 +19,14 @@ Overload defense on top of the watermark:
   pool's actual drain rate instead of blind exponential jitter;
 - deadline-aware shedding: a request whose remaining budget is below
   the EWMA service time is rejected at admission — it would only burn a
-  slot producing an answer nobody can use (fail fast, not fail late).
+  slot producing an answer nobody can use (fail fast, not fail late);
+- the service-time EWMA is keyed by COMPILE CLASS (``class_key`` —
+  the const-blind plan identity for coprocessor requests, the RPC
+  method otherwise; DAGRequest.class_key), falling back to the global
+  EWMA for unseen classes: a 10M-row hash-agg and a point-select no
+  longer share one figure, so shed decisions and ``retry_after_ms``
+  hints reflect the actual cost mix instead of whichever shape ran
+  last.
 """
 
 from __future__ import annotations
@@ -49,8 +56,12 @@ class ReadPool:
     # EWMA smoothing for service time: ~5 samples of memory — fast
     # enough to follow a brownout, slow enough to ignore one outlier
     EMA_ALPHA = 0.2
+    # per-compile-class EWMAs retained (LRU); the global EWMA covers
+    # evicted/unseen classes
+    CLASS_EMA_MAX = 128
 
     def __init__(self, max_concurrency: int = 8, max_pending: int = 64):
+        from collections import OrderedDict
         self._slots = threading.Semaphore(max_concurrency)
         self._mu = threading.Lock()
         self._max_concurrency = max_concurrency
@@ -64,22 +75,42 @@ class ReadPool:
         self.running = 0
         self.running_peak = 0
         self.ema_service_time = 0.0
+        # class_key -> (ema_seconds, n_obs); plan-aware shedding input
+        self._class_ema: "OrderedDict" = OrderedDict()
 
-    def retry_after_ms(self) -> int:
-        """Backoff hint for a busy rejection: how long the CURRENT
-        queue takes to drain at the observed service rate."""
+    def class_ema(self, class_key) -> float:
+        """Service-time EWMA for one compile class; 0.0 when unseen
+        (callers fall back to the global figure)."""
         with self._mu:
-            return self._retry_after_ms_locked()
+            got = self._class_ema.get(class_key)
+            return got[0] if got is not None else 0.0
 
-    def _retry_after_ms_locked(self) -> int:
+    def _ema_for_locked(self, class_key) -> float:
+        """The shed-decision figure: the class EWMA once observed, the
+        global EWMA otherwise."""
+        if class_key is not None:
+            got = self._class_ema.get(class_key)
+            if got is not None:
+                return got[0]
+        return self.ema_service_time
+
+    def retry_after_ms(self, class_key=None) -> int:
+        """Backoff hint for a busy rejection: how long the CURRENT
+        queue takes to drain at the observed service rate (the
+        requester's own class rate when known — a cheap point-select
+        is not told to wait out a hash-agg's figure)."""
+        with self._mu:
+            return self._retry_after_ms_locked(class_key)
+
+    def _retry_after_ms_locked(self, class_key=None) -> int:
         waiting = max(0, self._pending - self.running) + 1
-        ema = self.ema_service_time
+        ema = self._ema_for_locked(class_key)
         if ema <= 0:
             return 0
         return max(1, int(1000.0 * ema * waiting / self._max_concurrency))
 
     def run(self, fn, priority: str = "normal",
-            deadline: "Deadline | None" = None):
+            deadline: "Deadline | None" = None, class_key=None):
         """Execute ``fn`` under the pool's concurrency cap.
 
         Raises ServerIsBusy when the pending watermark is exceeded
@@ -87,13 +118,15 @@ class ReadPool:
         and DeadlineExceeded / ServerIsBusy when ``deadline`` is already
         expired / below the EWMA service time (deadline-aware shedding;
         applies to every priority — an unservable point read is still
-        unservable).
+        unservable).  ``class_key`` selects the per-compile-class EWMA
+        for the shed comparison and the retry hint; the observed
+        service time updates both that class and the global figure.
         """
         if deadline is not None:
             deadline.check("read_pool")      # expired: typed shed
             rem = deadline.remaining()
             with self._mu:
-                ema = self.ema_service_time
+                ema = self._ema_for_locked(class_key)
             if ema > 0 and rem < ema:
                 with self._mu:
                     self.deadline_shed += 1
@@ -102,7 +135,7 @@ class ReadPool:
                 raise ServerIsBusy(
                     f"remaining budget {rem * 1e3:.1f}ms < ema service "
                     f"time {ema * 1e3:.1f}ms",
-                    retry_after_ms=self.retry_after_ms())
+                    retry_after_ms=self.retry_after_ms(class_key))
         with self._mu:
             if self._closed:
                 raise ServerIsBusy("read pool shut down")
@@ -111,7 +144,7 @@ class ReadPool:
                 raise ServerIsBusy(
                     f"{self._pending} reads pending (max "
                     f"{self._max_pending})",
-                    retry_after_ms=self._retry_after_ms_locked())
+                    retry_after_ms=self._retry_after_ms_locked(class_key))
             self._pending += 1
             self._publish_gauges()
         try:
@@ -138,6 +171,19 @@ class ReadPool:
                             self.ema_service_time == 0.0 else \
                             (self.EMA_ALPHA * dt + (1 - self.EMA_ALPHA)
                              * self.ema_service_time)
+                        if class_key is not None:
+                            got = self._class_ema.pop(class_key, None)
+                            if got is None:
+                                self._class_ema[class_key] = (dt, 1)
+                            else:
+                                ema_c, n_c = got
+                                self._class_ema[class_key] = (
+                                    self.EMA_ALPHA * dt +
+                                    (1 - self.EMA_ALPHA) * ema_c,
+                                    n_c + 1)
+                            while len(self._class_ema) > \
+                                    self.CLASS_EMA_MAX:
+                                self._class_ema.popitem(last=False)
                         READ_POOL_EMA_GAUGE.set(self.ema_service_time)
                         self._publish_gauges()
         finally:
@@ -175,7 +221,8 @@ class ReadPool:
                     "served": self.served, "rejected": self.rejected,
                     "deadline_shed": self.deadline_shed,
                     "ema_service_time_ms":
-                        round(self.ema_service_time * 1e3, 3)}
+                        round(self.ema_service_time * 1e3, 3),
+                    "ema_classes": len(self._class_ema)}
 
 
 class CompletionPool:

@@ -96,6 +96,22 @@ class KvService:
         rgm = self.node.resource_groups
         rgm.charge_request(group)
         prio = _READ_METHODS.get(method)
+        # read-pool compile-class key: the pool's service-time EWMA is
+        # keyed by the request's COST SHAPE, not just "a read" — for
+        # coprocessor requests the const-blind plan class (a rotating
+        # threshold shares its class; a hash-agg does not share a
+        # point-select's), the RPC method otherwise.  The DAG decode is
+        # reused by the Coprocessor handler below (stashed on the
+        # request) so the classing costs no second parse.
+        class_key = method if prio is not None else None
+        if method == "Coprocessor" and isinstance(req, dict) and \
+                "dag" in req:
+            try:
+                dag_obj = wire.dec_dag(req["dag"])
+                req["__dag"] = dag_obj
+                class_key = ("copr", dag_obj.class_key())
+            except Exception:   # noqa: BLE001 — handler reports decode
+                pass
         t0 = time.perf_counter()
         # the deadline rides a thread-local so the executor pipeline
         # (between batches) and the device dispatch path can shed
@@ -111,7 +127,8 @@ class KvService:
                 try:
                     resp = self._guard(
                         lambda r: self.read_pool.run(
-                            lambda: fn(r), prio, deadline=dl), req)
+                            lambda: fn(r), prio, deadline=dl,
+                            class_key=class_key), req)
                     d = resp.pop("__deferred", None) \
                         if isinstance(resp, dict) else None
                     if d is not None:
@@ -327,9 +344,12 @@ class KvService:
 
     def Coprocessor(self, req: dict) -> dict:
         tp = req.get("tp", REQ_TYPE_DAG)
+        # handle() stashed its class-keying decode; fall back to a
+        # fresh parse for direct callers (tests, batch_commands)
+        predec = req.pop("__dag", None)
         if tp == 104:       # ANALYZE (endpoint.rs:275-312)
             from ..copr.analyze import AnalyzeReq
-            dag = wire.dec_dag(req["dag"])
+            dag = predec or wire.dec_dag(req["dag"])
             stats = self.endpoint.handle_analyze(AnalyzeReq(
                 dag.executors[0], dag.ranges,
                 req.get("buckets", 64), dag.start_ts))
@@ -340,11 +360,11 @@ class KvService:
                 for s in stats["columns"]]}
         if tp == 105:       # CHECKSUM (checksum.rs)
             from ..copr.analyze import ChecksumReq
-            dag = wire.dec_dag(req["dag"])
+            dag = predec or wire.dec_dag(req["dag"])
             return self.endpoint.handle_checksum(ChecksumReq(
                 dag.executors[0], dag.ranges, dag.start_ts))
         assert tp == REQ_TYPE_DAG, tp
-        dag = wire.dec_dag(req["dag"])
+        dag = predec or wire.dec_dag(req["dag"])
         creq = CopRequest(
             REQ_TYPE_DAG, dag, req.get("force_backend"),
             paging_size=req.get("paging_size", 0),

@@ -80,6 +80,14 @@ class StatusServer:
                         # rebuild counters, per-line tombstone ratio,
                         # delta-log depth
                         body["copr_cache"] = cc.stats()
+                    ep = getattr(node, "endpoint", None)
+                    coal = getattr(ep, "coalescer", None) \
+                        if ep is not None else None
+                    if coal is not None and hasattr(coal, "stats"):
+                        # cross-request batching: window config, group
+                        # occupancy, router decision mix, solo-degrade
+                        # count
+                        body["coalescer"] = coal.stats()
                     dr = getattr(node, "device_runner", None)
                     if dr is not None and hasattr(dr, "selection_stats"):
                         # late-materialized selection: routing-decision

@@ -328,7 +328,8 @@ HEDGE_COUNTER = REGISTRY.counter(
 DEVICE_SEL_ROUTE_COUNTER = REGISTRY.counter(
     "tikv_device_selection_route_total",
     "late-materialized device selection routing decisions "
-    "(mask / index / compact / mask_fallback = capacity overflow)",
+    "(mask / index / compact / mask_fallback = capacity overflow / "
+    "batched = coalesced stacked-group dispatch)",
     labels=("route",))
 DEVICE_SEL_SELECTIVITY = REGISTRY.gauge(
     "tikv_device_selection_observed_selectivity",
@@ -362,6 +363,22 @@ DEVICE_QUARANTINE_COUNTER = REGISTRY.counter(
     "tikv_device_feed_quarantine_total",
     "device feed lines quarantined after a scrub divergence "
     "(the region degrades to the host backend, then rebuilds)")
+COPR_BATCH_OCCUPANCY = REGISTRY.histogram(
+    "tikv_coprocessor_batch_occupancy",
+    "requests per coalesced device dispatch group at group close "
+    "(server/coalescer.py; 1 = a window expired with a lone member)",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32))
+COPR_ROUTER_COUNTER = REGISTRY.counter(
+    "tikv_coprocessor_router_total",
+    "cost-based admission router decisions for device-eligible "
+    "coprocessor requests (device_batched / device_solo / host / shed)",
+    labels=("decision",))
+COPR_COALESCE_CLOSE_COUNTER = REGISTRY.counter(
+    "tikv_coprocessor_coalesce_group_close_total",
+    "coalescer group closes by trigger (size = max_group reached, "
+    "window = collection window expired, deadline = tightest member "
+    "budget pressure, failpoint = copr::coalesce_window, shutdown)",
+    labels=("reason",))
 SCHED_COMMANDS = REGISTRY.counter(
     "tikv_scheduler_commands_total", "txn scheduler commands",
     labels=("type",))
