@@ -364,11 +364,13 @@ def test_failpoint_inventory_resolves():
     # device::d2h_corrupt detected transfer corruption; ≥65 since the
     # cross-request batching sites: copr::coalesce_dispatch batched
     # launch failure → members retry solo, copr::coalesce_window
-    # forced immediate group close)
-    assert len(sites) >= 65, f"only {len(sites)} unique sites"
+    # forced immediate group close; ≥66 since device::mvcc_resolve —
+    # device-side cold-build resolution failure degrades down the
+    # build ladder to native, then interpreted)
+    assert len(sites) >= 66, f"only {len(sites)} unique sites"
     for dev_site in ("device::hbm_oom", "device::feed_corrupt",
                      "device::d2h_corrupt", "copr::coalesce_dispatch",
-                     "copr::coalesce_window"):
+                     "copr::coalesce_window", "device::mvcc_resolve"):
         assert dev_site in sites, f"missing fault site {dev_site}"
 
     nemesis_src = (root / "chaos" / "nemesis.py").read_text()

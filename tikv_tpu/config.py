@@ -100,6 +100,22 @@ class CoprocessorConfig:
     # group size (also the stacked kernel's largest lane bucket)
     coalesce_window_ms: float = 2.0
     coalesce_max_group: int = 16
+    # cold-path kill (device/mvcc.py + copr/stream_build.py):
+    # device_cold_build enables the device rung of the columnar build
+    # ladder (flat-plane parse + on-device MVCC version resolution, the
+    # feed born resident); cold_stream additionally parses + uploads
+    # CF_WRITE planes of bulk-ingested SST chunks WHILE the load runs,
+    # so the first query's build degenerates to one resolve dispatch.
+    # cold_stream=None (the default) is AUTO: on iff the process has a
+    # spare core to run the parse worker on — the overlap premise is a
+    # second core, and on a single-CPU box the worker only steals
+    # cycles from the very ingest it shadows (measured: -20% loader
+    # throughput and a stalled first query).  True/False force it.
+    # cold_stream_max_mb bounds the retained host planes per region
+    # (device planes shed first at half the cap); 0 = unlimited
+    device_cold_build: bool = True
+    cold_stream: Optional[bool] = None
+    cold_stream_max_mb: int = 1024
 
 
 @dataclass
@@ -183,6 +199,7 @@ _ONLINE_FIELDS = {
     "coprocessor.device_hbm_budget_mb",
     "coprocessor.coalesce_window_ms",
     "coprocessor.coalesce_max_group",
+    "coprocessor.device_cold_build",
     "readpool.concurrency",
 }
 

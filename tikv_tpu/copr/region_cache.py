@@ -12,6 +12,16 @@ data version and materializes *columnar* arrays, so both the host
 vectorized path and the TPU device runner consume dense tiles instead of
 a per-row Python decode loop (SURVEY.md §7 "Decode on the hot path").
 
+The build itself is a LADDER — device → native → interpreted: when a
+:class:`~tikv_tpu.device.mvcc.DeviceMvccResolver` is wired
+(server/node.py), the host pass shrinks to a flat-plane PARSE and
+newest-version selection runs on the accelerator at feed-mint time,
+the feed born resident (``device/mvcc.py``); the streaming ingest
+pipeline (``copr/stream_build.py``) can have pre-parsed those planes
+while the bulk load was still running.  Out-of-envelope schemas fall
+to the native C++ one-pass build, then to the interpreted reference
+loop.
+
 Cache lines are keyed (region id, epoch version, table id, columns) and
 stamped with ``data_index`` — the last applied data-mutating raft entry
 (raftstore/peer.py stamps it on every RegionSnapshot; read barriers and
@@ -195,9 +205,13 @@ def _build_native(snap, table_id: int, col_infos: Sequence, read_ts: int):
     # of a per-row × per-column Python dict loop
     need = out["need_default"]
     if need:
-        fetched = _fetch_default_values(snap, table_id, need)
-        if fetched is None:
-            return None     # a spilled value vanished: rebuild row path
+        fetched, missing = _fetch_default_values(snap, table_id, need)
+        if missing:
+            # a spilled value is gone from BOTH the bulk map and the
+            # point path — the visible version's payload is unrecoverable
+            # and the interpreted reference would assert on it; only now
+            # does the whole build fall back
+            return None
         per_col: dict = {cid: ([], []) for cid in by_id}
         for (row, _start_ts, _user_key), raw in zip(need, fetched):
             payload_row = decode_row(raw)
@@ -223,15 +237,20 @@ def _build_native(snap, table_id: int, col_infos: Sequence, read_ts: int):
 
 
 def _fetch_default_values(snap, table_id: int, need):
-    """CF_DEFAULT payloads for the native builder's spill rows.
+    """CF_DEFAULT payloads for a builder's spill rows.
 
     ``need``: [(row, start_ts, user_key)].  Small sets use point gets;
     large sets do ONE bulk range fetch over the table's CF_DEFAULT slice
     and index it — the per-row get path was the measured hot spot on
-    spill-heavy schemas.  Returns a list aligned with ``need`` or None
-    when any payload is missing.
+    spill-heavy schemas.  Returns ``(values, missing)``: a list aligned
+    with ``need`` (None where no payload was found) plus the indices of
+    the missing entries, so the caller can degrade PER ROW — a bulk-map
+    miss retries as a point get here, and only a payload that both
+    paths miss is reported, instead of one absent value silently
+    discarding the caller's entire native build (the old contract).
     """
-    out = []
+    out: list = []
+    missing: list = []
     rng = getattr(snap, "range_cf", None)
     if len(need) >= 32 and rng is not None:
         lo, hi = table_record_range(table_id)
@@ -240,19 +259,128 @@ def _fetch_default_values(snap, table_id: int, need):
             keys, vals, skip = got
             by_key = {bytes(k[skip:]) if skip else bytes(k): v
                       for k, v in zip(keys, vals)}
-            for _row, start_ts, user_key in need:
-                v = by_key.get(append_ts(encode_key(user_key), start_ts))
+            for i, (_row, start_ts, user_key) in enumerate(need):
+                enc = append_ts(encode_key(user_key), start_ts)
+                v = by_key.get(enc)
                 if v is None:
-                    return None
+                    # per-row degrade: distrust the bulk index before
+                    # declaring the payload gone
+                    v = snap.get_value_cf(CF_DEFAULT, enc)
+                    if v is None:
+                        missing.append(i)
                 out.append(v)
-            return out
-    for _row, start_ts, user_key in need:
+            return out, missing
+    for i, (_row, start_ts, user_key) in enumerate(need):
         v = snap.get_value_cf(CF_DEFAULT,
                               append_ts(encode_key(user_key), start_ts))
         if v is None:
-            return None
+            missing.append(i)
         out.append(v)
-    return out
+    return out, missing
+
+
+def _build_device(snap, table_id: int, col_infos: Sequence,
+                  read_ts: int, resolver, stream=None):
+    """Device-side MVCC resolution build strategy (device/mvcc.py).
+
+    The host does a flat-plane PARSE only (or consumes planes the
+    streaming ingest pipeline already parsed AND uploaded during the
+    bulk load — copr/stream_build.py); newest-committed-version
+    selection runs on the accelerator at feed-mint time.  The returned
+    host table is a cheap numpy mirror of the same resolution
+    (vectorized takes over the winner rows — the cache line, delta
+    patching and scrub digests read host truth), and the
+    :class:`~tikv_tpu.device.mvcc.ColdFeedBundle` carries everything
+    the runner needs to mint the feed BORN RESIDENT: raw version
+    planes (possibly already device-resident), the resolve read_ts,
+    and the CF_DEFAULT spill rows to host-patch after the gather.
+
+    → (ColumnarTable, safe_ts, ColdFeedBundle) or None (out of
+    envelope / native parse unavailable — the native→interpreted
+    ladder takes over)."""
+    from ..utils.failpoint import fail_point
+    if resolver is None or not resolver.available() or \
+            fail_point("device::mvcc_resolve") is not None or \
+            read_ts >= (1 << 63):
+        return None
+    from ..device.mvcc import (
+        ColdFeedBundle,
+        align_planes,
+        host_mirror,
+        parse_write_planes,
+        plane_schema,
+        resolve_host,
+    )
+    from ..utils import tracker
+    if plane_schema(col_infos) is None:
+        return None
+    rng = getattr(snap, "range_cf", None)
+    if rng is None:
+        return None
+    lo, hi = table_record_range(table_id)
+    got = rng(CF_WRITE, encode_key(lo), encode_key(hi))
+    if got is None or not got[0]:
+        return None     # empty range: the native/interpreted path is free
+    keys, vals, skip = got
+    planes = dev = None
+    region = getattr(snap, "region", None)
+    data_index = getattr(snap, "data_index", None)
+    if stream is not None and region is not None and \
+            data_index is not None:
+        with tracker.phase("stream_take"):
+            st = stream.take(region.id, table_id, data_index,
+                             n_ver=len(keys),
+                             first_key=bytes(keys[0][skip:]),
+                             last_key=bytes(keys[-1][skip:]))
+        if st is not None:
+            raw_planes, dev = st
+            planes = align_planes(raw_planes, col_infos)
+            if planes is None:
+                dev = None      # schema the stream cannot serve
+    if planes is None:
+        with tracker.phase("mvcc_parse"):
+            planes = parse_write_planes(keys, vals, skip, col_infos)
+        if planes is None:
+            return None
+    winners = resolve_host(planes, read_ts)
+    n = len(winners)
+    handles, columns = host_mirror(planes, winners, col_infos)
+    # CF_DEFAULT spills among the WINNERS only (a superseded version's
+    # spilled payload is never fetched — late materialization on the
+    # version axis)
+    spill_patches: dict = {}
+    if planes.need_default:
+        spill_mask = planes.has_payload[winners] == 0
+        spill_rows = np.nonzero(spill_mask)[0]
+        if len(spill_rows):
+            by_ver = {row: (sts, uk)
+                      for row, sts, uk in planes.need_default}
+            need = []
+            for fr in spill_rows.tolist():
+                ent = by_ver.get(int(winners[fr]))
+                if ent is None:
+                    return None     # inconsistent parse: fall back
+                need.append((fr, ent[0], ent[1]))
+            fetched, missing = _fetch_default_values(snap, table_id,
+                                                     need)
+            if missing:
+                return None     # unrecoverable payload: ladder down
+            for (fr, _sts, _uk), raw in zip(need, fetched):
+                payload = decode_row(raw)
+                for info in col_infos:
+                    if info.is_pk_handle:
+                        continue
+                    pv = payload.get(info.col_id)
+                    if pv is not None:
+                        col = columns[info.col_id]
+                        col.values[fr] = pv
+                        col.validity[fr] = True
+                spill_patches[fr] = True
+    tbl = ColumnarTable(_TableShim(table_id), handles, columns)
+    bundle = ColdFeedBundle(resolver, planes, dev, n, read_ts,
+                            handles, columns,
+                            spill_patches=spill_patches)
+    return tbl, int(planes.safe_ts), bundle
 
 
 def build_region_columnar(snap, table_id: int, col_infos: Sequence,
@@ -264,10 +392,16 @@ def build_region_columnar(snap, table_id: int, col_infos: Sequence,
     them; per-request conflict checks happen at serve time against the
     request's own key ranges.
 
-    The hot loop (version resolution + key/row decode) runs in the
-    native builder when available; the interpreted loop below is the
-    behavioral reference and the fallback for exotic schemas.
-    """
+    Build-strategy ladder (each rung degrades to the next on any
+    envelope miss): **device** — flat-plane parse + device-side version
+    resolution, available through :func:`build_region_columnar_ex` when
+    the caller wires a resolver (the cold build is then an H2D copy
+    plus one resolve dispatch at feed-mint time, not a host decode
+    pass); **native** — the one-pass C++ resolve+decode
+    (fastbuild.cpp); **interpreted** — the loop below, the behavioral
+    reference.  This 3-arg entry point keeps the host-only contract
+    (device rung off)."""
+    from ..utils import tracker
     lo, hi = table_record_range(table_id)
     lower, upper = encode_key(lo), encode_key(hi)
     blocking_locks = _scan_blocking_locks(snap, lower, upper)
@@ -275,6 +409,7 @@ def build_region_columnar(snap, table_id: int, col_infos: Sequence,
     native = _build_native(snap, table_id, col_infos, read_ts)
     if native is not None:
         tbl, safe_ts = native
+        tracker.label("cold_build", "native")
         return tbl, safe_ts, blocking_locks
 
     reader = MvccReader(snap)
@@ -305,7 +440,33 @@ def build_region_columnar(snap, table_id: int, col_infos: Sequence,
             unsigned=info.field_type.is_unsigned)
     tbl = ColumnarTable(_TableShim(table_id),
                         np.asarray(handles, dtype=np.int64), columns)
+    tracker.label("cold_build", "interpreted")
     return tbl, safe_ts, blocking_locks
+
+
+def build_region_columnar_ex(snap, table_id: int, col_infos: Sequence,
+                             read_ts: int, device_resolver=None,
+                             stream_source=None):
+    """Ladder entry WITH the device rung: → (ColumnarTable, safe_ts,
+    blocking_locks, ColdFeedBundle-or-None).  Device refusal (missing
+    resolver, out-of-envelope schema, failpoint) falls through to the
+    module's :func:`build_region_columnar` host ladder — looked up at
+    call time, so tests substituting the host builder keep their
+    seam."""
+    from ..utils import tracker
+    if device_resolver is not None:
+        dev = _build_device(snap, table_id, col_infos, read_ts,
+                            device_resolver, stream=stream_source)
+        if dev is not None:
+            lo, hi = table_record_range(table_id)
+            locks = _scan_blocking_locks(snap, encode_key(lo),
+                                         encode_key(hi))
+            tbl, safe_ts, bundle = dev
+            tracker.label("cold_build", "device")
+            return tbl, safe_ts, locks, bundle
+    tbl, safe_ts, locks = build_region_columnar(
+        snap, table_id, col_infos, read_ts)
+    return tbl, safe_ts, locks, None
 
 
 class MvccColumnarSnapshot:
@@ -390,7 +551,8 @@ class FeedLineage:
     """
 
     __slots__ = ("version", "_base", "_patches", "_max", "_mu",
-                 "feed_digests", "region_hint", "__weakref__")
+                 "feed_digests", "region_hint", "cold_bundle",
+                 "__weakref__")
 
     def __init__(self, max_patches: int = 64):
         self.version = 0
@@ -404,6 +566,36 @@ class FeedLineage:
         # and region teardown uses region_hint to attribute quarantines
         self.feed_digests: dict = {}
         self.region_hint = None
+        # one-shot device-resolve artifacts from a cold device build
+        # (device/mvcc.py ColdFeedBundle): the runner's first feed miss
+        # mints the born-resident feed from them; any delta landing
+        # first releases them (the host upload path is always correct)
+        self.cold_bundle = None
+
+    def stash_cold(self, bundle) -> None:
+        bundle.lineage_v = self.version
+        with self._mu:
+            old, self.cold_bundle = self.cold_bundle, bundle
+        if old is not None:
+            old.release()
+
+    def take_cold(self, version):
+        """Pop the cold bundle iff it still reflects ``version``
+        (one-shot; a stale bundle is released, never served)."""
+        with self._mu:
+            b, self.cold_bundle = self.cold_bundle, None
+        if b is None:
+            return None
+        if getattr(b, "lineage_v", -1) != version:
+            b.release()
+            return None
+        return b
+
+    def drop_cold(self) -> None:
+        with self._mu:
+            b, self.cold_bundle = self.cold_bundle, None
+        if b is not None:
+            b.release()
 
     def record(self, patch: dict) -> None:
         with self._mu:
@@ -412,6 +604,9 @@ class FeedLineage:
             while len(self._patches) > self._max:
                 self._patches.pop(0)
                 self._base += 1
+            stale, self.cold_bundle = self.cold_bundle, None
+        if stale is not None:
+            stale.release()     # the line moved on before the mint
 
     def since(self, version: int, until: Optional[int] = None):
         """Patches bridging ``version`` → ``until`` (default: current),
@@ -621,6 +816,13 @@ class RegionColumnarCache:
         self.rebuilds = 0       # gaps that fell back to a full rebuild
         self.compactions = 0
         self.invalidations = 0  # lines dropped by lifecycle events
+        self.device_builds = 0  # cold builds served by device resolve
+        # device-side MVCC resolution (the cold-path kill): a
+        # DeviceMvccResolver enables the device rung of the build
+        # ladder; a ColdStreamBuilder supplies planes parsed + uploaded
+        # during bulk ingest (both wired by server/node.py)
+        self.device_resolver = None
+        self.stream_source = None
         # epoch fence: region id -> lowest epoch version still allowed
         # to cache.  A build racing a split can otherwise re-insert a
         # superseded-epoch line AFTER invalidate_region already swept it
@@ -661,6 +863,7 @@ class RegionColumnarCache:
                "deltas": self.deltas, "rebuilds": self.rebuilds,
                "compactions": self.compactions,
                "invalidations": self.invalidations,
+               "device_builds": self.device_builds,
                "resident_lines": len(lines), "lines": lines}
         if self._delta_source is not None:
             out["delta_log"] = self._delta_source.stats()
@@ -688,6 +891,8 @@ class RegionColumnarCache:
         teardown).  Never raises: teardown runs on apply/drive paths."""
         lineage = line.state.lineage if line is not None and \
             line.state is not None else None
+        if lineage is not None:
+            lineage.drop_cold()     # unminted resolve artifacts die too
         cb = self.on_line_retired
         if cb is not None and lineage is not None:
             try:
@@ -873,8 +1078,12 @@ class RegionColumnarCache:
         self.misses += 1
         tracker.label("copr_cache", "build")
         with tracker.phase("columnar_build"):
-            tbl, safe_ts, locks = build_region_columnar(
-                snap, scan.table_id, scan.columns, start_ts)
+            tbl, safe_ts, locks, bundle = build_region_columnar_ex(
+                snap, scan.table_id, scan.columns, start_ts,
+                device_resolver=self.device_resolver,
+                stream_source=self.stream_source)
+        if bundle is not None:
+            self.device_builds += 1
         ent = MvccColumnarSnapshot(tbl, start_ts, safe_ts, locks)
         lock_src = ent
         retired: list = []
@@ -887,6 +1096,8 @@ class RegionColumnarCache:
                 # answer is exact for THIS request, but the line must
                 # not be cached — a resurrected stale line would
                 # linger unreachable until LRU pressure
+                if bundle is not None:
+                    bundle.release()
                 self._count("miss")
                 return ent, lock_src
             prev = self._lines.get(base_key)
@@ -915,6 +1126,11 @@ class RegionColumnarCache:
                 state = _LineState(scan.table_id, scan.columns, tbl,
                                    safe_ts, start_ts, locks)
                 state.lineage.region_hint = base_key[0]
+                if bundle is not None:
+                    # the runner's first feed miss for this line mints
+                    # the born-resident feed from the resolve artifacts
+                    state.lineage.stash_cold(bundle)
+                    bundle = None
                 ent = lock_src = state.publish()
                 new_line = _Line(base_key, data_index, ent, state)
                 if prev is not None:
@@ -928,6 +1144,8 @@ class RegionColumnarCache:
                 _k, evicted = self._lines.popitem(last=False)
                 retired.append(evicted)
             self._publish_lines()
+        if bundle is not None:      # parked / uncached build
+            bundle.release()
         for line in retired:
             self._retire(line)
         self._count(result)

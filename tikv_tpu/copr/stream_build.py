@@ -1,0 +1,386 @@
+"""Streaming cold pipeline: ingest → parse → H2D, overlapped.
+
+The cold path used to be three SEQUENTIAL phases: bulk SST ingest
+(~30s for 10M rows), then a full-region host MVCC build (~4s), then a
+full-feed H2D upload — each one idle while the previous ran.  This
+module turns the middle and tail into work that rides the load: a
+:class:`ColdStreamBuilder` registered on the raftstore's
+CoprocessorHost observes every applied ``IngestSst`` entry, hands the
+blob to ONE background worker, and for each chunk
+
+- decodes the v2 container's CF_WRITE group (sorted keys/values — the
+  exact slice ``snap.range_cf`` would return at query time),
+- runs the native flat-plane parse in DISCOVERY mode
+  (``native.mvcc_parse_planes`` with no schema — the query's schema
+  does not exist yet; the core loop releases the GIL, so the parse
+  genuinely overlaps the loader's next encode and the server's next
+  ingest RPC), and
+- appends the planes to device-resident, capacity-bucketed buffers
+  (:class:`~tikv_tpu.device.mvcc.DeviceVersionPlanes` — the same
+  jitted ``dynamic_update_slice`` span machinery the delta feed
+  patches use), so chunk *k*'s H2D overlaps chunk *k+1*'s parse
+  overlaps chunk *k+2*'s ingest.
+
+At the first cold query, ``RegionColumnarCache``'s device build
+strategy (:func:`~tikv_tpu.copr.region_cache.build_region_columnar_ex`)
+calls :meth:`ColdStreamBuilder.take`: if the accumulated stream still
+exactly matches the snapshot (same ``data_index``, same version count,
+same first/last raw key — set equality follows, since every streamed
+key is in the snapshot and nothing mutated since), the multi-second
+parse AND the feed H2D are already done — the cold build degenerates
+to a numpy winner-take mirror plus ONE resolve dispatch.
+
+Soundness: the stream is an exact replica of the ingested CF_WRITE
+range or it is NOT USED.  Any non-ingest data write, snapshot apply,
+epoch change or peer destroy drops the region's stream; ``take`` is
+one-shot and verifies against the live snapshot before serving.  Every
+degrade lands on the ordinary parse-at-build path — streaming is a
+prefetch, never a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+from ..engine.traits import CF_WRITE
+from ..raftstore.observer import Observer
+
+
+class _Stream:
+    __slots__ = ("index", "chunks", "dev", "n_ver", "n_keys",
+                 "table_id", "first_raw", "last_raw", "nbytes")
+
+    def __init__(self):
+        self.index = None           # last ingest entry's raft index
+        self.chunks: list = []      # per-chunk WritePlanes (host)
+        self.dev = None             # DeviceVersionPlanes or None
+        self.n_ver = 0
+        self.n_keys = 0
+        self.table_id = None
+        self.first_raw = None       # raw txn-encoded first/last CF_WRITE
+        self.last_raw = None        # keys (ascending-coverage fence)
+        self.nbytes = 0             # host plane bytes
+
+
+class ColdStreamBuilder(Observer):
+    """Background ingest-chunk parser + device version-plane uploader.
+
+    ``resolver``: the runner's DeviceMvccResolver, or None for a
+    host-only deployment — the stream then still pre-parses planes
+    (the parse is the dominant host cost), it just skips the H2D leg.
+    The H2D leg also stays off on the CPU backend
+    (``resolver.h2d_profitable()``): a CPU device_put aliases host
+    memory, so there is no transfer to overlap and the chunk-append
+    kernels would contend with the load itself.
+    ``max_bytes`` bounds the HOST plane bytes retained per region
+    (device planes are dropped first at half the cap); 0 = unlimited.
+    """
+
+    def __init__(self, resolver=None, max_bytes: int = 1 << 30,
+                 max_regions: int = 4, max_lag: int = 6):
+        from ..sst_importer import enable_ingest_parse_memo
+        enable_ingest_parse_memo(True)      # apply-side parse handoff
+        self._resolver = resolver
+        self._max_bytes = max_bytes
+        self._max_regions = max_regions
+        # a worker more than max_lag chunks behind the ingest will not
+        # be ready when the first query lands either — drop the stream
+        # instead of queuing decoded chunks (and their memory) it can
+        # never profitably consume
+        self._max_lag = max_lag
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._states: dict[int, _Stream] = {}
+        self._queue: deque = deque()
+        self._inflight: dict[int, int] = {}     # region -> queued items
+        # per-chunk worker seconds EWMA: take()'s wait budget is
+        # "what would draining the backlog actually cost", not a guess
+        # proportional to the range size
+        self._chunk_s = 0.05
+        # regions whose stream a take() already popped while chunks were
+        # still queued: the worker abandons their remaining blobs (a
+        # fresh parse is already serving the build — burning GIL on a
+        # stream nobody can consume would contend with it)
+        self._doomed: set = set()
+        self._worker: Optional[threading.Thread] = None
+        self._stopped = False
+        # counters (surfaced in /health cold_build rollup)
+        self.chunks_parsed = 0
+        self.chunks_rejected = 0
+        self.regions_dropped = 0
+        self.takes = 0
+        self.take_misses = 0
+        self.h2d_bytes = 0
+
+    # -- observer events (apply path: enqueue only, never block) --------
+
+    def on_apply_write(self, region_id: int, index: int, ops) -> None:
+        from ..sst_importer import pop_ingest_parse
+        blobs = []
+        for op in ops:
+            if getattr(op, "op", None) == "ingest":
+                blobs.append(op.value)
+            else:
+                blobs = None
+                break
+        with self._mu:
+            if self._stopped:
+                return
+            if blobs:
+                if region_id not in self._states and \
+                        len(self._states) >= self._max_regions and \
+                        self._inflight.get(region_id, 0) == 0:
+                    return      # at capacity: don't start a new stream
+                if self._inflight.get(region_id, 0) >= self._max_lag:
+                    # worker hopelessly behind: it would still be
+                    # parsing when the first query arrives — stop
+                    # feeding it and drop the stream instead
+                    self._queue.append(("drop", region_id, None, None))
+                    self._ensure_worker()
+                    self._cv.notify_all()
+                    return
+                # hand the apply thread's OWN decode of each blob to
+                # the worker (it just parsed them on the checked ingest
+                # path) — the worker never re-unpacks msgpack, its
+                # dominant GIL hold
+                self._queue.append(
+                    ("ingest", region_id, index,
+                     tuple((b, pop_ingest_parse(b)) for b in blobs)))
+                self._inflight[region_id] = \
+                    self._inflight.get(region_id, 0) + 1
+                self._ensure_worker()
+                self._cv.notify_all()
+            elif region_id in self._states or \
+                    self._inflight.get(region_id, 0):
+                # a plain data write: the stream no longer mirrors the
+                # region (and its data_index moved anyway) — drop it
+                self._queue.append(("drop", region_id, index, None))
+                self._ensure_worker()
+                self._cv.notify_all()
+
+    def on_data_replaced(self, region_id: int, index: int) -> None:
+        self._drop(region_id)
+
+    def on_region_changed(self, region) -> None:
+        self._drop(region.id)
+
+    def on_peer_destroyed(self, region_id: int) -> None:
+        self._drop(region_id)
+
+    def _drop(self, region_id: int) -> None:
+        with self._mu:
+            if region_id in self._states or \
+                    self._inflight.get(region_id, 0):
+                self._queue.append(("drop", region_id, None, None))
+                self._ensure_worker()
+                self._cv.notify_all()
+
+    # -- worker ----------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._loop, daemon=True, name="cold-stream")
+            self._worker.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._mu:
+                while not self._queue and not self._stopped:
+                    self._cv.wait(timeout=1.0)
+                if self._stopped and not self._queue:
+                    return
+                kind, region_id, index, blobs = self._queue.popleft()
+            try:
+                if kind == "ingest":
+                    self._ingest(region_id, index, blobs)
+                else:
+                    self._drop_now(region_id)
+            except Exception:   # noqa: BLE001 — prefetch must not die
+                self._drop_now(region_id)
+            finally:
+                with self._mu:
+                    if kind == "ingest":
+                        left = self._inflight.get(region_id, 1) - 1
+                        if left <= 0:
+                            self._inflight.pop(region_id, None)
+                            self._doomed.discard(region_id)
+                        else:
+                            self._inflight[region_id] = left
+                    self._cv.notify_all()
+
+    def _drop_now(self, region_id: int) -> None:
+        with self._mu:
+            st = self._states.pop(region_id, None)
+        if st is not None:
+            self.regions_dropped += 1
+
+    def _ingest(self, region_id: int, index: int, blobs) -> None:
+        import time
+
+        from ..device.mvcc import (
+            DeviceVersionPlanes,
+            parse_write_planes,
+        )
+        from ..sst_importer import read_sst_cf
+        for blob, groups in blobs:
+            with self._mu:
+                if region_id in self._doomed:
+                    return      # consumer already gave up on this stream
+            t0 = time.monotonic()
+            if groups is None:
+                # memo miss (lagging consumer evicted it): re-unpack —
+                # validate=False because apply admitted this exact blob
+                # through the checked path before the event fired
+                groups = read_sst_cf(blob, validate=False)
+            got = groups.get(CF_WRITE)
+            if got is None or not got[0]:
+                continue        # default/lock-only blob: nothing to do
+            keys, vals = got
+            with self._mu:
+                st = self._states.get(region_id)
+            if st is None:
+                st = _Stream()
+            elif st.last_raw is not None and (
+                    keys[0] <= st.last_raw or
+                    bytes(keys[0])[:-8] == st.last_raw[:-8]):
+                # out-of-order / overlapping run — OR versions of ONE
+                # user key straddling the chunk boundary (raw CF_WRITE
+                # keys embed the INVERTED commit_ts, so an older
+                # version of the previous chunk's last key still sorts
+                # ASCENDING; concat_planes would mint a duplicate
+                # segment for it and the resolve would emit the key
+                # twice).  Either way coverage is broken: drop.
+                self.chunks_rejected += 1
+                self._drop_now(region_id)
+                return
+            planes = parse_write_planes(keys, vals, 0, None,
+                                        release_gil=True)
+            if planes is None:
+                self.chunks_rejected += 1
+                self._drop_now(region_id)
+                return
+            if st.table_id is not None and \
+                    planes.table_id != st.table_id:
+                self.chunks_rejected += 1
+                self._drop_now(region_id)
+                return
+            if st.dev is None and st.n_ver == 0 and \
+                    self._resolver is not None and \
+                    self._resolver.available() and \
+                    self._resolver.h2d_profitable():
+                st.dev = DeviceVersionPlanes()
+            if st.dev is not None:
+                try:
+                    st.dev.append(self._resolver, planes, st.n_keys)
+                    self.h2d_bytes += planes.nbytes()
+                except Exception:   # noqa: BLE001 — H2D leg optional
+                    st.dev = None
+            st.chunks.append(planes)
+            st.n_ver += planes.n_ver
+            st.n_keys += planes.n_keys
+            st.nbytes += planes.nbytes()
+            st.table_id = planes.table_id
+            if st.first_raw is None:
+                st.first_raw = bytes(keys[0])
+            st.last_raw = bytes(keys[-1])
+            st.index = index
+            self.chunks_parsed += 1
+            self._chunk_s += 0.3 * ((time.monotonic() - t0) -
+                                    self._chunk_s)
+            if self._max_bytes:
+                if st.dev is not None and \
+                        st.dev.nbytes > self._max_bytes // 2:
+                    st.dev = None       # shed the device leg first
+                if st.nbytes > self._max_bytes:
+                    self._drop_now(region_id)
+                    return
+            with self._mu:
+                if region_id in self._doomed:
+                    return      # take() popped the stream mid-blob
+                self._states[region_id] = st
+
+    # -- consumer (the cold build) --------------------------------------
+
+    def take(self, region_id: int, table_id: int, data_index: int,
+             n_ver: int, first_key: bytes, last_key: bytes):
+        """Pop the region's accumulated planes iff they exactly mirror
+        the snapshot being built: → (WritePlanes, DeviceVersionPlanes
+        or None), or None.  Waits briefly for queued chunks to drain —
+        the budget is what draining the backlog should actually cost
+        (queued chunks × the worker's measured per-chunk EWMA, hard
+        cap 3s), so a worker that fell far behind degrades to a miss
+        instead of stalling the cold query past what parse-at-build
+        would have cost.  The wall clock is re-checked against the cap
+        on every wakeup: on a starved box the condition wait can overrun
+        its timeout (the worker's C-level holds delay the re-acquire),
+        and the cap must bound the stall, not the sleep."""
+        import time
+
+        from ..device.mvcc import concat_planes
+        t0 = time.monotonic()
+        hard_end = t0 + 3.0
+        with self._mu:
+            if region_id not in self._states and \
+                    not self._inflight.get(region_id, 0):
+                return None     # never streamed: not a miss, just cold
+            backlog = self._inflight.get(region_id, 0)
+            end = min(hard_end,
+                      t0 + 0.1 + backlog * self._chunk_s * 1.5)
+            while self._inflight.get(region_id, 0) and \
+                    not self._stopped:
+                left = end - time.monotonic()
+                if left <= 0:
+                    break       # budget spent: miss beats stalling
+                self._cv.wait(timeout=min(0.25, left))
+            st = self._states.pop(region_id, None)
+            if self._inflight.get(region_id, 0):
+                # chunks still queued: the worker abandons them — the
+                # caller is about to parse fresh and must not contend
+                self._doomed.add(region_id)
+        if st is None:
+            self.take_misses += 1
+            return None
+        if st.table_id != table_id or st.index != data_index or \
+                st.n_ver != n_ver or st.first_raw != first_key or \
+                st.last_raw != last_key:
+            self.take_misses += 1
+            return None
+        self.takes += 1
+        return concat_planes(st.chunks), st.dev
+
+    # -- lifecycle / observability --------------------------------------
+
+    def stop(self) -> None:
+        from ..sst_importer import enable_ingest_parse_memo
+        with self._mu:
+            if self._stopped:
+                return
+            enable_ingest_parse_memo(False)
+            self._stopped = True
+            self._queue.clear()
+            self._inflight.clear()
+            self._states.clear()
+            self._cv.notify_all()
+        w = self._worker
+        if w is not None:
+            w.join(timeout=5)
+
+    def stats(self) -> dict:
+        with self._mu:
+            regions = {rid: {"n_ver": st.n_ver, "n_keys": st.n_keys,
+                             "chunks": len(st.chunks),
+                             "device": st.dev is not None,
+                             "host_mb": round(st.nbytes / (1 << 20), 2)}
+                       for rid, st in self._states.items()}
+        return {
+            "chunks_parsed": self.chunks_parsed,
+            "chunks_rejected": self.chunks_rejected,
+            "regions_dropped": self.regions_dropped,
+            "takes": self.takes,
+            "take_misses": self.take_misses,
+            "h2d_bytes": self.h2d_bytes,
+            "regions": regions,
+        }

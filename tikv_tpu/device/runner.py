@@ -529,6 +529,19 @@ class DeviceRunner:
         # record per-plane content digests at feed build/patch time so
         # the background scrubber can audit resident planes against them
         self.scrub_digests = True
+        # device-side MVCC resolution (device/mvcc.py): lazily built —
+        # host-only deployments and sharded meshes never pay for it
+        self._mvcc_resolver = None
+
+    def mvcc_resolver(self, create: bool = True):
+        """The runner's DeviceMvccResolver (the cold-path kill: flat
+        CF_WRITE planes resolve newest-version-≤-read_ts on device and
+        the feed is born resident).  Single-device only — the sharded
+        mesh keeps the host upload pipeline (GSPMD re-lays feeds)."""
+        if self._mvcc_resolver is None and create and self._single:
+            from .mvcc import DeviceMvccResolver
+            self._mvcc_resolver = DeviceMvccResolver(self)
+        return self._mvcc_resolver
 
     # ------------------------------------------------------------------ plan
 
@@ -899,6 +912,13 @@ class DeviceRunner:
         # lax.cond guard in _mega's scan step), so the ≤12.5% padding
         # costs DMA + grid steps, not kernel time.
         if not self._chunk_override and blocks > 8:
+            # one block of growth headroom BEFORE bucketing: it only
+            # moves sizes that land exactly on a bucket edge (ceil
+            # absorbs it everywhere else), so a feed whose live rows
+            # exactly fill its bucket — e.g. a power-of-two bulk load —
+            # no longer changes compile class (≈30s XLA recompile +
+            # full re-upload) on the very first appended row
+            blocks += 1
             # round up to a 4-significant-bit block count (k·2^s,
             # 8 ≤ k ≤ 15): keeps n_pad rich in powers of two so
             # _pick_chunk's gcd still finds large scan chunks
@@ -1011,6 +1031,33 @@ class DeviceRunner:
                 tracker.label("device_feed", "patch")
                 self._register_digests(lineage, feed_key, feed)
                 return feed
+        # cold-path kill (device/mvcc.py): a device build left its
+        # resolve artifacts on the lineage — mint the feed BORN
+        # RESIDENT (H2D of raw version planes — or nothing, if the
+        # streaming ingest pipeline already uploaded them — plus ONE
+        # resolve+gather dispatch) instead of the host pad/astype/upload
+        # pass.  One-shot and version-pinned; any failure falls through
+        # to the plain upload below, which is always correct.
+        if lineage is not None and \
+                getattr(lineage, "cold_bundle", None) is not None:
+            if positional and cache is not None:
+                bundle = lineage.take_cold(req_v)
+                if bundle is not None:
+                    feed = bundle.mint(self, used_infos, dtypes, n,
+                                       self._pad_rows(n))
+                    if feed is not None:
+                        tracker.label("device_feed", "device_resolve")
+                        feed["lineage_v"] = req_v
+                        cache[feed_key] = feed
+                        self._arena.admit(anchor)
+                        self._register_digests(lineage, feed_key, feed)
+                        return feed
+            else:
+                # first feed build for this line cannot consume the
+                # bundle (desc/index scan): release the raw planes
+                # now rather than pinning ~100 bytes/version on the
+                # lineage until a delta or teardown gets there
+                lineage.drop_cold()
         tracker.label("device_feed", "upload")
         _fp_degrade("device::before_feed_upload")
         with tracker.phase("feed_upload"):
@@ -1173,6 +1220,11 @@ class DeviceRunner:
         digest scalars) in the quarantine map forever."""
         with self._quar_mu:
             self._quarantined.pop(id(anchor), None)
+        drop_cold = getattr(anchor, "drop_cold", None)
+        if callable(drop_cold):
+            # unminted cold-resolve artifacts (device version planes)
+            # die with the line too
+            drop_cold()
         return self._arena.drop(anchor, reason=reason)
 
     def quarantine(self, anchor, reason: str = "") -> None:

@@ -62,3 +62,9 @@ def _load():
 _mod = _load()
 mvcc_build_columnar = getattr(_mod, "mvcc_build_columnar", None)
 build_mvcc_sst = getattr(_mod, "build_mvcc_sst", None)
+# flat-plane CF_WRITE parse (device-side MVCC resolution feed; the core
+# loop optionally releases the GIL — always on the streaming worker, so
+# its parse overlaps SST ingest and the loader's encode; only
+# with a spare core on the build path, where yielding on a single-CPU
+# box just hands the core to background tick threads)
+mvcc_parse_planes = getattr(_mod, "mvcc_parse_planes", None)

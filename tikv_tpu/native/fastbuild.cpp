@@ -443,6 +443,334 @@ PyObject* mvcc_build(PyObject*, PyObject* args) {
   return ret;
 }
 
+/* ------------------------------------------------------------------ *
+ * Flat-plane MVCC parse — the device-resolve feed (device/mvcc.py).
+ *
+ * Where mvcc_build resolves versions AND decodes rows in one host pass,
+ * this export only PARSES: every CF_WRITE version becomes one row of a
+ * set of flat, fixed-width planes (key-ordinal segments, commit_ts,
+ * start_ts, write type, per-column datum planes) that upload H2D as-is,
+ * so newest-committed-version selection — a segmented arg-max over
+ * commit_ts — runs on the accelerator instead of in this loop.  The
+ * core loop holds NO Python objects (key/value pointers are snapshotted
+ * first), so it runs with the GIL RELEASED and a concurrent SST encode
+ * or ingest RPC makes real progress — the property the streaming cold
+ * pipeline (copr/stream_build.py) is built on.
+ *
+ * Envelope: numeric columns only (kinds 0=int64, 1=float64, 3=uint64 —
+ * bytes columns cannot live in device planes); PUTs without a short
+ * value are reported in need_default for the caller's CF_DEFAULT patch.
+ *
+ * Two schema modes:
+ *  - explicit (col_ids non-empty): planes for exactly those columns,
+ *    datums coerced to the requested kinds (the cold-build path, which
+ *    knows the scan schema);
+ *  - DISCOVERY (col_ids empty): the streaming ingest path has no
+ *    schema yet — every column id seen in any row payload mints a
+ *    plane, kind inferred from its first non-NIL datum (INT->0,
+ *    FLT->1; BIN is out of envelope).  The consumer reconciles the
+ *    discovered planes against the query schema at build time
+ *    (device/mvcc.py align_planes).
+ */
+
+struct ParseErr {
+  const char* msg = nullptr;
+};
+
+struct NeedDefault {
+  int64_t row;
+  uint64_t start_ts;
+  std::string ukey;
+};
+
+PyObject* mvcc_parse_planes(PyObject*, PyObject* args) {
+  PyObject *keys_o, *vals_o, *colids_o, *colkinds_o;
+  Py_ssize_t prefix_skip;
+  int release_gil = 1;
+  if (!PyArg_ParseTuple(args, "OOnOO|p", &keys_o, &vals_o, &prefix_skip,
+                        &colids_o, &colkinds_o, &release_gil))
+    return nullptr;
+  PyObject* keys = PySequence_Fast(keys_o, "keys not a sequence");
+  if (!keys) return nullptr;
+  PyObject* vals = PySequence_Fast(vals_o, "values not a sequence");
+  if (!vals) { Py_DECREF(keys); return nullptr; }
+  Py_ssize_t n_in = PySequence_Fast_GET_SIZE(keys);
+  if (PySequence_Fast_GET_SIZE(vals) != n_in) {
+    Py_DECREF(keys); Py_DECREF(vals);
+    return fail("keys/values length mismatch");
+  }
+
+  Py_ssize_t ncols = PySequence_Size(colids_o);
+  bool discover = (ncols == 0);   /* streaming mode: no schema yet */
+  std::vector<int64_t> col_ids(ncols);
+  std::vector<int> col_kinds(ncols);
+  for (Py_ssize_t c = 0; c < ncols; c++) {
+    PyObject* ido = PySequence_GetItem(colids_o, c);
+    PyObject* ko = PySequence_GetItem(colkinds_o, c);
+    col_ids[c] = PyLong_AsLongLong(ido);
+    col_kinds[c] = (int)PyLong_AsLong(ko);
+    Py_XDECREF(ido); Py_XDECREF(ko);
+    if (col_kinds[c] != 0 && col_kinds[c] != 1 && col_kinds[c] != 3) {
+      Py_DECREF(keys); Py_DECREF(vals);
+      return fail("plane parse supports numeric kinds only");
+    }
+  }
+
+  /* pass 1 (GIL held): snapshot raw (ptr, len) for every key/value */
+  std::vector<const uint8_t*> kp(n_in), vp(n_in);
+  std::vector<Py_ssize_t> kl(n_in), vl(n_in);
+  for (Py_ssize_t i = 0; i < n_in; i++) {
+    char* p;
+    Py_ssize_t l;
+    if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(keys, i), &p,
+                                &l) < 0) {
+      Py_DECREF(keys); Py_DECREF(vals);
+      return nullptr;
+    }
+    kp[i] = reinterpret_cast<const uint8_t*>(p);
+    kl[i] = l;
+    if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(vals, i), &p,
+                                &l) < 0) {
+      Py_DECREF(keys); Py_DECREF(vals);
+      return nullptr;
+    }
+    vp[i] = reinterpret_cast<const uint8_t*>(p);
+    vl[i] = l;
+  }
+
+  /* pass 2 (GIL released): parse into preallocated flat planes */
+  std::vector<uint64_t> commit_ts(n_in), start_ts(n_in);
+  std::vector<uint8_t> wtype(n_in), has_payload(n_in, 0);
+  std::vector<int32_t> seg_id(n_in);
+  std::vector<int64_t> handles;        /* per key */
+  std::vector<int64_t> seg_start;      /* n_keys + 1 offsets */
+  handles.reserve(n_in);
+  seg_start.reserve(n_in + 1);
+  struct PlaneCol {
+    int kind;
+    std::vector<int64_t> i64;
+    std::vector<double> f64;
+    std::vector<uint64_t> u64;
+    std::vector<uint8_t> valid;
+  };
+  std::vector<PlaneCol> planes(ncols);
+  for (Py_ssize_t c = 0; c < ncols; c++) {
+    planes[c].kind = col_kinds[c];
+    planes[c].valid.assign(n_in, 0);
+    if (col_kinds[c] == 1) planes[c].f64.assign(n_in, 0.0);
+    else if (col_kinds[c] == 3) planes[c].u64.assign(n_in, 0);
+    else planes[c].i64.assign(n_in, 0);
+  }
+  std::vector<NeedDefault> need;
+  uint64_t safe_ts = 0;
+  int64_t table_id = 0;
+  ParseErr err;
+
+  /* release_gil=0: the cold-build path on a single-CPU box — there,
+   * yielding the GIL only hands the core to the node's background
+   * tick threads and the parse's wall time balloons (measured 3.8s →
+   * 18s at 10M versions); the host builder it replaces held the GIL
+   * for its whole pass too.  The streaming worker always releases:
+   * its entire point is letting the apply loop make progress. */
+  PyThreadState* _save_ts = nullptr;
+  if (release_gil) _save_ts = PyEval_SaveThread();
+  std::string user_key, prev_key;
+  for (Py_ssize_t i = 0; i < n_in && !err.msg; i++) {
+    const uint8_t* k = kp[i];
+    Py_ssize_t klen = kl[i];
+    Py_ssize_t off = prefix_skip;
+    if (off >= klen || k[off] != 'x') { err.msg = "bad key mode"; break; }
+    off += 1;
+    if (mc_decode(k, klen - 8, &off, &user_key) < 0 || off != klen - 8) {
+      err.msg = "bad memcomparable key";
+      break;
+    }
+    uint64_t cts = ~be64(k + klen - 8);
+    if (cts > safe_ts) safe_ts = cts;
+    if (user_key.size() != 19 || user_key[0] != 't' ||
+        user_key[9] != '_' || user_key[10] != 'r') {
+      err.msg = "not a record key";     /* index keys: out of envelope */
+      break;
+    }
+    const uint8_t* uk = reinterpret_cast<const uint8_t*>(user_key.data());
+    int64_t tid = (int64_t)(be64(uk + 1) - kSignMask);
+    if (handles.empty()) table_id = tid;
+    else if (tid != table_id) { err.msg = "mixed tables"; break; }
+    if (user_key != prev_key) {
+      prev_key = user_key;
+      handles.push_back((int64_t)(be64(uk + 11) - kSignMask));
+      seg_start.push_back((int64_t)i);
+    }
+    seg_id[i] = (int32_t)(handles.size() - 1);
+    commit_ts[i] = cts;
+
+    const uint8_t* v = vp[i];
+    Py_ssize_t vlen = vl[i];
+    if (vlen < 2) { err.msg = "short write record"; break; }
+    char wt = (char)v[0];
+    Py_ssize_t voff = 1;
+    uint64_t sts;
+    if (read_varu64(v, vlen, &voff, &sts) < 0) {
+      err.msg = "bad write start_ts";
+      break;
+    }
+    start_ts[i] = sts;
+    const uint8_t* sval = nullptr;
+    uint64_t svlen = 0;
+    while (voff < vlen) {
+      char tag = (char)v[voff++];
+      if (tag == 'v') {
+        if (read_varu64(v, vlen, &voff, &svlen) < 0 ||
+            voff + (Py_ssize_t)svlen > vlen) {
+          err.msg = "bad short value";
+          break;
+        }
+        sval = v + voff;
+        voff += svlen;
+      } else if (tag == 'R') {
+        /* overlapped rollback marker on a committed write */
+      } else {
+        err.msg = "bad write tag";
+        break;
+      }
+    }
+    if (err.msg) break;
+    uint8_t code;
+    switch (wt) {
+      case 'P': code = 0; break;
+      case 'D': code = 1; break;
+      case 'L': code = 2; break;
+      case 'R': code = 3; break;
+      default: err.msg = "bad write type"; code = 0; break;
+    }
+    if (err.msg) break;
+    wtype[i] = code;
+    if (code != 0) continue;            /* only PUTs carry row payloads */
+    if (sval == nullptr) {
+      need.push_back(NeedDefault{(int64_t)i, sts, user_key});
+      continue;
+    }
+    has_payload[i] = 1;
+    Py_ssize_t moff = 0;
+    uint32_t pairs;
+    if (mp_map_len(sval, (Py_ssize_t)svlen, &moff, &pairs) < 0) {
+      err.msg = "bad row map";
+      break;
+    }
+    for (uint32_t e = 0; e < pairs && !err.msg; e++) {
+      MpVal cid, val;
+      if (mp_read(sval, (Py_ssize_t)svlen, &moff, &cid) < 0 ||
+          cid.type != MpVal::INT ||
+          mp_read(sval, (Py_ssize_t)svlen, &moff, &val) < 0) {
+        err.msg = "bad row datum";
+        break;
+      }
+      Py_ssize_t c = 0;
+      for (; c < ncols; c++)
+        if (col_ids[c] == cid.i) break;
+      if (c == ncols) {
+        if (!discover || val.type == MpVal::NIL) continue;
+        /* discovery: mint a plane on first sight, kind from the datum
+         * (all-NIL columns never materialize — the consumer
+         * synthesizes an invalid plane for them) */
+        int kind;
+        if (val.type == MpVal::INT) kind = 0;
+        else if (val.type == MpVal::FLT) kind = 1;
+        else { err.msg = "bytes col out of plane envelope"; break; }
+        col_ids.push_back(cid.i);
+        col_kinds.push_back(kind);
+        planes.emplace_back();
+        PlaneCol& np_ = planes.back();
+        np_.kind = kind;
+        np_.valid.assign(n_in, 0);
+        if (kind == 1) np_.f64.assign(n_in, 0.0);
+        else np_.i64.assign(n_in, 0);
+        ncols = (Py_ssize_t)col_ids.size();
+      }
+      PlaneCol& pc = planes[c];
+      if (val.type == MpVal::NIL) continue;
+      switch (pc.kind) {
+        case 0:
+          if (val.type == MpVal::INT) pc.i64[i] = val.i;
+          else if (val.type == MpVal::FLT) pc.i64[i] = (int64_t)val.f;
+          else err.msg = "type mismatch int col";
+          break;
+        case 1:
+          if (val.type == MpVal::FLT) pc.f64[i] = val.f;
+          else if (val.type == MpVal::INT) pc.f64[i] = (double)val.i;
+          else err.msg = "type mismatch real col";
+          break;
+        case 3:
+          if (val.type == MpVal::INT) pc.u64[i] = (uint64_t)val.i;
+          else err.msg = "type mismatch u64 col";
+          break;
+      }
+      if (!err.msg) pc.valid[i] = 1;
+    }
+  }
+  if (_save_ts) PyEval_RestoreThread(_save_ts);
+
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  if (err.msg) return fail(err.msg);
+  seg_start.push_back((int64_t)n_in);
+
+  auto as_bytes = [](const void* p, size_t nbytes) {
+    return PyByteArray_FromStringAndSize(
+        reinterpret_cast<const char*>(p), (Py_ssize_t)nbytes);
+  };
+  PyObject* nd = PyList_New(0);
+  if (!nd) return nullptr;
+  for (auto& d : need) {
+    PyObject* t = Py_BuildValue("LKy#", (long long)d.row,
+                                (unsigned long long)d.start_ts,
+                                d.ukey.data(), (Py_ssize_t)d.ukey.size());
+    if (!t || PyList_Append(nd, t) < 0) {
+      Py_XDECREF(t);
+      Py_DECREF(nd);
+      return nullptr;
+    }
+    Py_DECREF(t);
+  }
+  PyObject* out_cols = PyList_New(0);
+  if (!out_cols) { Py_DECREF(nd); return nullptr; }
+  for (Py_ssize_t c = 0; c < ncols; c++) {
+    PlaneCol& pc = planes[c];
+    PyObject* payload =
+        pc.kind == 1 ? as_bytes(pc.f64.data(), (size_t)n_in * 8)
+        : pc.kind == 3 ? as_bytes(pc.u64.data(), (size_t)n_in * 8)
+                       : as_bytes(pc.i64.data(), (size_t)n_in * 8);
+    PyObject* validity = as_bytes(pc.valid.data(), (size_t)n_in);
+    PyObject* tup = (payload && validity)
+        ? Py_BuildValue("(LiOO)", (long long)col_ids[c], pc.kind,
+                        payload, validity)
+        : nullptr;
+    Py_XDECREF(payload);
+    Py_XDECREF(validity);
+    if (!tup || PyList_Append(out_cols, tup) < 0) {
+      Py_XDECREF(tup);
+      Py_DECREF(nd);
+      Py_DECREF(out_cols);
+      return nullptr;
+    }
+    Py_DECREF(tup);
+  }
+  Py_ssize_t n_keys = (Py_ssize_t)handles.size();
+  PyObject* ret = Py_BuildValue(
+      "{s:n,s:n,s:L,s:K,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N}",
+      "n_ver", n_in, "n_keys", n_keys, "table_id", (long long)table_id,
+      "safe_ts", (unsigned long long)safe_ts,
+      "commit_ts", as_bytes(commit_ts.data(), (size_t)n_in * 8),
+      "start_ts", as_bytes(start_ts.data(), (size_t)n_in * 8),
+      "wtype", as_bytes(wtype.data(), (size_t)n_in),
+      "has_payload", as_bytes(has_payload.data(), (size_t)n_in),
+      "seg_id", as_bytes(seg_id.data(), (size_t)n_in * 4),
+      "handles", as_bytes(handles.data(), (size_t)n_keys * 8),
+      "seg_start", as_bytes(seg_start.data(), (size_t)(n_keys + 1) * 8),
+      "cols", out_cols, "need_default", nd);
+  return ret;
+}
+
 /* crc64-xz (ECMA-182 reflected, check 0x995DC9BBDF1939FA — what the
  * reference's crc64fast computes), table-driven; XOR-folded over KV
  * pairs so the checksum is order-independent and composes across
@@ -655,6 +983,13 @@ PyObject* build_mvcc_sst(PyObject*, PyObject* args) {
   wvals.reserve((size_t)n * 32);
   uint64_t n_w = 0, n_d = 0;
   std::string ukey, enc, payload, rec;
+  /* the encode loop touches only the raw buffers snapshotted above
+   * (the caller's tuples keep them alive), so it runs with the GIL
+   * RELEASED: the bench loader's build-ahead thread encodes the next
+   * chunk while the ingest RPC (and the server's parse/apply, in the
+   * in-process test topology) make real progress — serializing them
+   * was the measured loader ceiling. */
+  Py_BEGIN_ALLOW_THREADS
   for (Py_ssize_t i = 0; i < n; i++) {
     ukey.clear();
     ukey.push_back('t');
@@ -715,9 +1050,13 @@ PyObject* build_mvcc_sst(PyObject*, PyObject* args) {
                (uint32_t)rec.size());
     n_w++;
   }
+  Py_END_ALLOW_THREADS
 
   /* payload: fixarray of [cf(fixstr), keys(array32), vals(array32)] */
+  if (!g_crc32_ready) crc32_init();     /* init under the GIL */
   std::string body;
+  std::string out;
+  Py_BEGIN_ALLOW_THREADS
   body.reserve(wkeys.size() + wvals.size() + dkeys.size() + dvals.size()
                + 64);
   int groups = 1 + (n_d ? 1 : 0);
@@ -739,12 +1078,12 @@ PyObject* build_mvcc_sst(PyObject*, PyObject* args) {
   body.push_back((char)0xDD); put_be32(&body, (uint32_t)n_w);
   body += wvals;
 
-  std::string out;
   out.reserve(body.size() + 16);
   out.append("TKVSST2\n");
   out += body;
   put_be32(&out, crc32_buf(reinterpret_cast<const uint8_t*>(body.data()),
                            body.size()));
+  Py_END_ALLOW_THREADS
   return PyBytes_FromStringAndSize(out.data(), (Py_ssize_t)out.size());
 }
 
@@ -752,6 +1091,10 @@ PyMethodDef methods[] = {
     {"mvcc_build_columnar", mvcc_build, METH_VARARGS,
      "One-pass MVCC resolve + row decode into columnar buffers.\n"
      "(keys, values, read_ts, prefix_skip, col_ids, col_kinds) -> dict"},
+    {"mvcc_parse_planes", mvcc_parse_planes, METH_VARARGS,
+     "Flat-plane CF_WRITE parse for device-side MVCC resolution (GIL\n"
+     "released in the core loop): (keys, values, prefix_skip, col_ids,\n"
+     "col_kinds) -> dict of fixed-width planes + need_default"},
     {"checksum_pairs", checksum_pairs, METH_VARARGS,
      "XOR-folded crc64-xz over (key||value) pairs -> (checksum, bytes)"},
     {"build_mvcc_sst", build_mvcc_sst, METH_VARARGS,

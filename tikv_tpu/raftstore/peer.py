@@ -771,7 +771,10 @@ class RaftPeer:
                 # passing the CDC observer — BR/Lightning require
                 # no-import during replication for the same reason.
                 from ..sst_importer import read_sst_cf
-                for cf, (keys, vals) in read_sst_cf(op.value).items():
+                # memo=True: hand this decode to the streaming cold
+                # pipeline's observer read of the same blob object
+                for cf, (keys, vals) in read_sst_cf(
+                        op.value, memo=True).items():
                     wb.ingest_cf(cf, [data_key(k) for k in keys], vals)
             else:   # pragma: no cover
                 raise ValueError(op.op)

@@ -509,6 +509,32 @@ class Node:
             self.device_supervisor.on_line_retired
         self.raft_store.coprocessor_host.register(self.device_supervisor)
         self.device_supervisor.start()
+        # cold-path kill: device-side MVCC resolution as the columnar
+        # build ladder's first rung, plus the streaming ingest→parse→H2D
+        # pipeline that runs it during bulk loads (copr/stream_build.py)
+        self.cold_stream = None
+        if device_runner is not None and \
+                config.coprocessor.device_cold_build and \
+                hasattr(device_runner, "mvcc_resolver"):
+            resolver = device_runner.mvcc_resolver()
+            if resolver is not None:
+                self.copr_cache.device_resolver = resolver
+                stream_on = config.coprocessor.cold_stream
+                if stream_on is None:
+                    # AUTO: the stream's overlap premise is a spare
+                    # core for the parse worker; on a single-CPU box it
+                    # only steals cycles from the ingest it shadows
+                    from ..utils import spare_cores
+                    stream_on = spare_cores() > 1
+                if stream_on:
+                    from ..copr.stream_build import ColdStreamBuilder
+                    self.cold_stream = ColdStreamBuilder(
+                        resolver,
+                        max_bytes=config.coprocessor.cold_stream_max_mb
+                        << 20)
+                    self.raft_store.coprocessor_host.register(
+                        self.cold_stream)
+                    self.copr_cache.stream_source = self.cold_stream
         # online reconfig (online_config ConfigManager registrations)
         self.config_controller.register("coprocessor", self._copr_cfg)
 
@@ -526,6 +552,40 @@ class Node:
                 hasattr(self.device_runner, "set_hbm_budget"):
             self.device_runner.set_hbm_budget(
                 int(diff["device_hbm_budget_mb"]) << 20)
+        if "device_cold_build" in diff:
+            if not diff["device_cold_build"]:
+                self.copr_cache.device_resolver = None
+                # the stream exists only to feed the device rung: left
+                # running it would keep parsing every ingested chunk
+                # (racing the apply loop) and retain host planes that
+                # nothing can ever take() — tear it down with the rung
+                if self.cold_stream is not None:
+                    self.copr_cache.stream_source = None
+                    self.raft_store.coprocessor_host.unregister(
+                        self.cold_stream)
+                    self.cold_stream.stop()
+                    self.cold_stream = None
+            elif self.device_runner is not None and \
+                    hasattr(self.device_runner, "mvcc_resolver"):
+                resolver = self.device_runner.mvcc_resolver()
+                self.copr_cache.device_resolver = resolver
+                # re-enable restores the WHOLE rung: the disable branch
+                # tore the stream down, so rebuild it under the same
+                # gate the constructor used
+                if resolver is not None and self.cold_stream is None:
+                    stream_on = self.config.coprocessor.cold_stream
+                    if stream_on is None:
+                        from ..utils import spare_cores
+                        stream_on = spare_cores() > 1
+                    if stream_on:
+                        from ..copr.stream_build import ColdStreamBuilder
+                        self.cold_stream = ColdStreamBuilder(
+                            resolver,
+                            max_bytes=self.config.coprocessor
+                            .cold_stream_max_mb << 20)
+                        self.raft_store.coprocessor_host.register(
+                            self.cold_stream)
+                        self.copr_cache.stream_source = self.cold_stream
         coal = getattr(self.endpoint, "coalescer", None)
         if coal is None and diff.get("coalesce_window_ms", 0) and \
                 self.device_runner is not None and \
@@ -600,6 +660,8 @@ class Node:
             self._thread.join(timeout=5)
         self.raft_store.stop_pool()
         self.device_supervisor.stop()
+        if self.cold_stream is not None:
+            self.cold_stream.stop()
         # idle-drain both request pools: stop admitting reads and wait
         # for in-flight ones, then retire (and JOIN) the endpoint's
         # completion-pool workers — nodes restarted in-process (chaos

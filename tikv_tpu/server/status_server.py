@@ -100,6 +100,24 @@ class StatusServer:
                         # scrub passes/divergences, quarantines, and
                         # lifecycle invalidation counts
                         body["device_state"] = sup.stats()
+                    # cold-path kill rollup: device-resolve builds
+                    # (mvcc_resolve/h2d_stream phases), mint counters,
+                    # and the streaming ingest pipeline's parse/upload
+                    # progress
+                    cold: dict = {}
+                    if cc is not None:
+                        cold["device_builds"] = getattr(
+                            cc, "device_builds", 0)
+                    if dr is not None and \
+                            hasattr(dr, "mvcc_resolver"):
+                        res = dr.mvcc_resolver(create=False)
+                        if res is not None:
+                            cold["resolver"] = res.stats()
+                    cs = getattr(node, "cold_stream", None)
+                    if cs is not None and hasattr(cs, "stats"):
+                        cold["stream"] = cs.stats()
+                    if cold:
+                        body["cold_build"] = cold
                     self._json(200, body)
                 elif path == "/config":
                     if outer._controller is None:

@@ -49,6 +49,45 @@ class SstWriter:
 
 _SST2_MAGIC = b"TKVSST2\n"
 
+# Ingest-parse memo: the apply thread unpacks every ingested v2 blob
+# (read_sst_cf below); moments later the streaming cold pipeline's
+# worker (copr/stream_build.py) re-reads the SAME decoded blob object
+# off the observer event.  When a consumer opts in, the apply-side
+# parse is kept (keyed by blob object identity, the blob itself pinned
+# so the id cannot be recycled) and the worker's read consumes it —
+# the msgpack unpack is the worker's dominant GIL hold, and paying it
+# twice starved the worker behind the very apply loop that feeds it.
+# Bounded: a lagging consumer evicts oldest-first and re-parses.
+_INGEST_MEMO: dict = {}         # id(blob) -> (blob, groups)
+_INGEST_MEMO_CAP = 2
+_INGEST_MEMO_MU = __import__("threading").Lock()
+_memo_consumers = 0
+
+
+def enable_ingest_parse_memo(on: bool) -> None:
+    """Consumer registration (refcounted): only memoize while someone
+    (a ColdStreamBuilder) will actually consume the entries."""
+    global _memo_consumers
+    with _INGEST_MEMO_MU:
+        _memo_consumers = max(0, _memo_consumers + (1 if on else -1))
+        if not _memo_consumers:
+            _INGEST_MEMO.clear()
+
+
+def pop_ingest_parse(blob):
+    """Pop the memoized decode of ``blob`` (→ {cf: (keys, vals)} or
+    None).  The streaming cold pipeline calls this ON the observer
+    event — the apply thread parsed this exact blob moments ago, so the
+    hit rate at event time is ~100%, and the decoded groups travel with
+    the queue entry instead of being re-unpacked by the worker (a
+    multi-second GIL hold per 1M-row chunk that starved both the loader
+    and the cold query's bounded take-wait)."""
+    with _INGEST_MEMO_MU:
+        hit = _INGEST_MEMO.pop(id(blob), None)
+    if hit is not None and hit[0] is blob:
+        return hit[1]
+    return None
+
 
 def read_sst(blob: bytes) -> list:
     """→ [(cf, key, value)]; raises ValueError on a corrupt artifact."""
@@ -70,13 +109,32 @@ def is_sst_v2(blob: bytes) -> bool:
     return blob.startswith(_SST2_MAGIC)
 
 
-def read_sst_cf(blob: bytes) -> dict:
+def read_sst_cf(blob: bytes, validate: bool = True,
+                memo: bool = False) -> dict:
     """v2 container → {cf: (keys list, values list)} with keys sorted.
 
     The column-group layout keeps the ingest path free of per-row
     Python: msgpack unpacks straight to lists of bytes, and the engine
     bulk-merges whole sorted runs (the analog of the reference's
-    RocksDB file ingest, which links an SST without replaying ops)."""
+    RocksDB file ingest, which links an SST without replaying ops).
+
+    ``validate=False`` skips the sorted/duplicate re-check (a full
+    sorted copy + set per group): sound ONLY for consumers re-reading a
+    blob that apply already admitted — the streaming cold pipeline's
+    parse worker observes entries post-engine-write, after this exact
+    blob passed the checked path on the apply thread.
+
+    ``memo=True`` (the APPLY path only — peer.py IngestSst) seeds the
+    ingest-parse memo with this decode for the observer's follow-up
+    read.  Seeding must stay off everywhere else: the RPC-side
+    validation call's blob round-trips through the raft log as a fresh
+    bytes object, so its entry could never be popped — it would pin a
+    decoded chunk for the process lifetime and evict the useful
+    apply-seeded entries from the small memo."""
+    with _INGEST_MEMO_MU:
+        hit = _INGEST_MEMO.pop(id(blob), None)
+    if hit is not None and hit[0] is blob:
+        return hit[1]
     if not blob.startswith(_SST2_MAGIC) or len(blob) < len(_SST2_MAGIC) + 4:
         raise ValueError("bad sst v2 magic")
     payload = blob[len(_SST2_MAGIC):-4]
@@ -94,11 +152,18 @@ def read_sst_cf(blob: bytes) -> dict:
         # C-speed checks: this runs on the apply path of every replica,
         # and an interpreted per-key loop would stall the apply loop on
         # multi-million-row ingests.
-        if len(keys) > 1 and (keys != sorted(keys) or
-                              len(set(keys)) != len(keys)):
+        if validate and len(keys) > 1 and (keys != sorted(keys) or
+                                           len(set(keys)) != len(keys)):
             raise ValueError(
                 f"sst v2 cf {cf!r}: keys not strictly ascending")
         out[cf] = (keys, vals)
+    if _memo_consumers and memo:
+        # the checked apply-side parse seeds the memo for the
+        # streaming consumer's follow-up read of the same blob object
+        with _INGEST_MEMO_MU:
+            while len(_INGEST_MEMO) >= _INGEST_MEMO_CAP:
+                _INGEST_MEMO.pop(next(iter(_INGEST_MEMO)))
+            _INGEST_MEMO[id(blob)] = (blob, out)
     return out
 
 

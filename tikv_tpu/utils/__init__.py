@@ -1,1 +1,18 @@
 """Shared runtime utilities (tikv_util analog)."""
+
+from __future__ import annotations
+
+
+def spare_cores() -> int:
+    """CPUs this process may actually run on (affinity-aware).
+
+    Several subsystems gate "overlap" machinery on having a core to
+    spare — the cold-stream parse worker, the bulk loader's build-ahead
+    depth, the build-path parse's GIL release: on a single-CPU box
+    each of those only time-slices against the very work it shadows.
+    """
+    import os
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
