@@ -1063,8 +1063,15 @@ def main() -> None:
         "vs_baseline": headline["vs_baseline"],
         "platform": f"{jax.devices()[0].platform}:{len(jax.devices())}",
         "device_sync_floor_ms": device_sync_floor_ms(),
+        "mesh": (ms := runner.mesh_stats()),
         "configs": configs,
     }))
+    # mesh shape rides a first-class line too: a multi-chip bench run
+    # must be distinguishable from single-chip in the truncated
+    # artifact (per-device-count scaling lives in the MULTICHIP
+    # harness, __graft_entry__.dryrun_multichip)
+    print(f"# mesh= shape={ms['shape']} n_devices={ms['n_devices']} "
+          f"platform={ms['platform']}", file=sys.stderr)
     for name, c in configs.items():
         if name in ("2s_selection_sweep", "6b_concurrent_serving"):
             continue            # dedicated first-class lines below

@@ -29,8 +29,12 @@ FAULT_KINDS = ("partition", "asym_partition", "leader_isolate",
 # device::feed_corrupt so the next scrub pass bit-flips a resident
 # plane and must catch it; d2h_corrupt arms device::d2h_corrupt so a
 # fraction of fetches surface as detected transfer corruption and
-# degrade to the host pipeline
-DEVICE_FAULT_KINDS = ("hbm_squeeze", "feed_corrupt", "d2h_corrupt")
+# degrade to the host pipeline; shard_launch arms device::shard_launch
+# so a fraction of SHARDED mesh dispatches fail one shard's enqueue —
+# the whole plan must degrade to host (never a partial per-shard
+# answer) without wedging the serialized dispatch stream
+DEVICE_FAULT_KINDS = ("hbm_squeeze", "feed_corrupt", "d2h_corrupt",
+                      "shard_launch")
 
 # crash boundaries: a ``panic`` here unwinds out of the drive loop like
 # a process kill at that point of the write path (the same boundaries
@@ -87,6 +91,8 @@ def generate_schedule(seed: int, steps: int,
         elif kind == "feed_corrupt":
             out.append(_mk(kind))
         elif kind == "d2h_corrupt":
+            out.append(_mk(kind, pct=rng.choice((25, 50, 100))))
+        elif kind == "shard_launch":
             out.append(_mk(kind, pct=rng.choice((25, 50, 100))))
         else:   # pragma: no cover
             raise ValueError(kind)
@@ -179,6 +185,12 @@ class Nemesis:
         failpoint.cfg("device::d2h_corrupt", f"{pct}%return")
         self._heals.append(
             lambda: failpoint.remove("device::d2h_corrupt"))
+
+    def _apply_shard_launch(self, fault: Fault) -> None:
+        pct = fault.param("pct", 100)
+        failpoint.cfg("device::shard_launch", f"{pct}%return")
+        self._heals.append(
+            lambda: failpoint.remove("device::shard_launch"))
 
     def _apply_disk_stall(self, fault: Fault) -> None:
         ms = fault.param("ms", 5)

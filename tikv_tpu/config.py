@@ -116,6 +116,18 @@ class CoprocessorConfig:
     device_cold_build: bool = True
     cold_stream: Optional[bool] = None
     cold_stream_max_mb: int = 1024
+    # multi-chip scale-out (parallel/mesh.py, device/placement.py):
+    # mesh_shape pins the ("range", "tile") mesh factorization
+    # ("2x4"; default None lets _factor2 pick the squarest split —
+    # note a PRIME device count then degenerates to 1xN).  Fixed at
+    # runner construction; the live shape is visible in /health
+    # device_mesh.  device_placement turns on hot-region → slice
+    # routing: small regions pin to single-device slices spread by
+    # load (PD's balance-region policy one level down), feeds at or
+    # above placement_rows shard over the whole mesh.
+    mesh_shape: Optional[str] = None
+    device_placement: bool = False
+    placement_rows: int = 1 << 22
 
 
 @dataclass

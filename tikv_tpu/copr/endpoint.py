@@ -111,6 +111,14 @@ class Endpoint:
     # sits below it by roughly the observed occupancy.  The router owns
     # that shift per request; do not fold expected batching into this
     # constant.
+    #
+    # MULTI-CHIP meshes keep the same single-chip figure: a whole-mesh
+    # sharded dispatch amortizes its per-launch overhead across chips
+    # (the Jouppi batch-amortization argument applied to mesh axes),
+    # but the sync floor it must beat is unchanged, and a
+    # placement-routed request (device/placement.py) executes on ONE
+    # slice anyway — so the solo break-even stays the anchor and the
+    # mesh only moves the large-n end of the curve.
     DEFAULT_DEVICE_ROW_THRESHOLD = 131072
 
     def __init__(self, snapshot_provider: Callable[[CopRequest], "ScanStorage"],
@@ -138,6 +146,22 @@ class Endpoint:
         # capability decision — a TypeError raised INSIDE a run must
         # degrade, not silently re-execute the request)
         self._runner_deferred: Optional[bool] = None
+        # request-level mesh attribution: device-routed requests carry
+        # a "mesh" tracker label ("RxT" shape, or "RxT+placement") so
+        # the multichip bench and /status TimeDetails can tell sharded
+        # serving from single-chip without reaching into the runner
+        self._mesh_label: Optional[str] = None
+        if device_runner is not None and \
+                hasattr(device_runner, "mesh_stats"):
+            try:
+                ms = device_runner.mesh_stats()
+                shape = ms.get("shape", {})
+                self._mesh_label = "x".join(
+                    str(v) for v in shape.values()) or None
+                if self._mesh_label and "placement" in ms:
+                    self._mesh_label += "+placement"
+            except Exception:   # noqa: BLE001 — attribution only
+                self._mesh_label = None
 
     def close(self) -> None:
         """Release the coalescer's dispatcher and the completion
@@ -261,6 +285,8 @@ class Endpoint:
             storage = self._snapshot_provider(req)
             backend = self._pick_backend(req, storage)
             tracker.label("backend", backend)
+            if backend == "device" and self._mesh_label is not None:
+                tracker.label("mesh", self._mesh_label)
 
             def host_exec():
                 from ..executors.runner import BatchExecutorsRunner

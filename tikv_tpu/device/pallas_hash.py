@@ -167,7 +167,7 @@ def n_slots(plan, capacity: int, mode: str = MODE_DENSE) -> int:
 
 
 def supported(plan, feed, dtypes, pf: int, capacity: int,
-              single_device: bool, mode: str = MODE_DENSE) -> bool:
+              n_shards: int = 1, mode: str = MODE_DENSE) -> bool:
     """Static gate for the Pallas fast path.
 
     int32 kernel-input columns only (int64 is unsupported in Mosaic),
@@ -175,12 +175,17 @@ def supported(plan, feed, dtypes, pf: int, capacity: int,
     plane inputs), int byte-plane aggregates only (pf == 0), and a slot
     span whose one-hot fits VMEM.  Columns outside the kernel's input
     set (e.g. a sparse key consumed as slot ids) are exempt.
+
+    ``n_shards > 1``: the sharded mesh runs this same kernel PER SHARD
+    under shard_map — each shard's grid covers its local feed slice,
+    so the padded feed must split into whole BLOCKs per shard; the
+    per-shard packed partials psum on ICI (runner._try_pallas).
     """
-    if not single_device or pf != 0:
+    if pf != 0:
         return False
     if n_slots(plan, capacity, mode) > MAX_SLOTS:
         return False
-    if feed["n_pad"] % BLOCK != 0:
+    if feed["n_pad"] % (max(1, n_shards) * BLOCK) != 0:
         return False
     kcols = kernel_col_ids(plan, mode)
     if not kcols:
@@ -363,15 +368,23 @@ def build(plan, layouts, p8: int, capacity: int, nblk: int,
 
     scal_cache: dict = {}
 
-    def run(row_lo: int, row_hi: int, base: int, blk0: int, cols):
+    def run(row_lo, row_hi, base, blk0, cols):
         # a fresh scalar H2D on every request adds ~30 ms to the fetch
         # through the tunnel; the scalar tuple is constant per
-        # (feed, tile)
-        key = (row_lo, row_hi, base, blk0)
-        scal = scal_cache.get(key)
-        if scal is None:
-            scal = jnp.asarray(np.asarray(key, np.int32))
-            scal_cache[key] = scal
+        # (feed, tile).  Traced scalars (the sharded per-shard path:
+        # row bounds depend on lax.axis_index) stack instead of
+        # caching — inside shard_map there is no H2D to save.
+        if isinstance(row_lo, (int, np.integer)):
+            key = (row_lo, int(row_hi), int(base), int(blk0))
+            scal = scal_cache.get(key)
+            if scal is None:
+                scal = jnp.asarray(np.asarray(key, np.int32))
+                scal_cache[key] = scal
+        else:
+            with jax.enable_x64(False):
+                scal = jnp.stack([
+                    jnp.asarray(v).astype(jnp.int32)
+                    for v in (row_lo, row_hi, base, blk0)])
         with jax.enable_x64(False):
             return call(scal, *cols)
 

@@ -1,0 +1,269 @@
+"""Hot-region → mesh-slice placement (the PD loop, one level down).
+
+A multi-chip node has two ways to use its mesh (parallel/mesh.py):
+shard one big feed over every chip (scale-up — a single request's
+kernel runs as per-shard partials + tree-reduce), or pin many small
+regions' feeds to single-device slices (scale-out — many concurrent
+requests each run whole on one chip).  Left alone, the second mode
+degenerates: every region lands wherever the runner happens to live
+and one chip saturates while seven idle — exactly the hot-store
+problem PD's balance-region scheduler exists to prevent.
+
+:class:`SlicePlacer` closes that loop locally.  It owns one
+single-device sub-runner per mesh slice and routes each feed anchor
+(region lineage / snapshot) to a slice chosen by the PD policy
+(pd/scheduler.pick_slice) over a blended score:
+
+- **occupancy** — the slice arena's resident HBM bytes (PR 6's
+  accounting), normalized across slices; and
+- **load** — a decayed per-slice dispatch rate (PR 3's slow-score
+  discipline: recent traffic dominates, history fades), so a Zipfian
+  mix's hot regions spread by the traffic they actually draw, not
+  just by bytes.
+
+Placement is STICKY (a placed anchor keeps its slice — its HBM feed,
+request memos, and compile classes live there) until the opportunistic
+rebalance step (pd/scheduler.rebalance_donor) finds the spread
+unjustifiable; then the hottest slice's coldest anchor is dropped
+(``runner.drop_feed``) and re-pinned to the coolest slice — the next
+request rebuilds the feed there, the same add-then-remove shape as a
+balance-region operator.  Feeds above ``whole_mesh_rows`` bypass
+placement and shard over the full mesh (scale-up wins past the point
+where one chip's HBM pass dominates the launch overhead).
+
+The placer is OFF by default (``DeviceRunner(placement=False)``) —
+single-chip deployments and whole-mesh benches never pay the routing
+indirection; ``coprocessor.device_placement`` turns it on for serving
+nodes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Optional
+
+from ..parallel import make_mesh, mesh_slices
+from ..pd.scheduler import pick_slice, rebalance_donor, slice_scores
+
+# feeds at or above this many rows shard over the WHOLE mesh instead of
+# pinning to one slice: one chip's HBM pass over 4M+ rows costs more
+# than the cross-chip launch + tree-reduce overhead it would save
+DEFAULT_WHOLE_MESH_ROWS = 1 << 22
+
+# decayed-load half life in seconds: recent dispatches dominate the
+# traffic score, minutes-old history fades (the slow-score shape)
+LOAD_HALFLIFE_S = 30.0
+
+# run the rebalance check every N routed requests — placement decisions
+# stay O(1) per request, the O(slices·anchors) scan amortizes
+REBALANCE_EVERY = 64
+
+
+class SlicePlacer:
+    """Per-slice sub-runners + the placement policy over them.
+
+    ``parent`` is the whole-mesh :class:`DeviceRunner`; sub-runners are
+    built from its mesh's single-device slices with the parent's tuning
+    (chunk override, capacities, per-slice share of the HBM budget).
+    """
+
+    def __init__(self, parent, whole_mesh_rows: int =
+                 DEFAULT_WHOLE_MESH_ROWS):
+        self._parent = parent
+        self.whole_mesh_rows = whole_mesh_rows
+        self._mu = threading.Lock()
+        self._slices = [parent._make_slice_runner(make_mesh(devs))
+                        for devs in mesh_slices(parent._mesh)]
+        if parent._arena.budget_bytes > 0:
+            # a budget passed at parent CONSTRUCTION must bind the
+            # slices too, not only the set_hbm_budget() path
+            self.set_hbm_budget(parent._arena.budget_bytes)
+        self._load = [0.0] * len(self._slices)
+        self._load_t = time.monotonic()
+        # id(anchor) -> slice index; weakref finalizers prune entries
+        # for anchors that die without an explicit drop
+        self._placed: dict[int, int] = {}
+        self._refs: dict[int, object] = {}
+        self._routes = 0
+        self.places = 0
+        self.moves = 0
+        self.whole_mesh_routes = 0
+
+    def __len__(self) -> int:
+        return len(self._slices)
+
+    @property
+    def slices(self) -> list:
+        return list(self._slices)
+
+    # -- scoring ------------------------------------------------------
+
+    def _decay_locked(self) -> None:
+        now = time.monotonic()
+        dt = now - self._load_t
+        if dt <= 0:
+            return
+        f = 0.5 ** (dt / LOAD_HALFLIFE_S)
+        self._load = [v * f for v in self._load]
+        self._load_t = now
+
+    def _scores_locked(self) -> list:
+        self._decay_locked()
+        occ = {i: r._arena.resident_bytes()
+               for i, r in enumerate(self._slices)}
+        mx_b = max(occ.values(), default=0) or 1
+        mx_l = max(self._load, default=0.0) or 1.0
+        return slice_scores({i: b / mx_b for i, b in occ.items()},
+                            {i: v / mx_l
+                             for i, v in enumerate(self._load)},
+                            len(self._slices))
+
+    # -- routing ------------------------------------------------------
+
+    def route(self, storage, n_hint: Optional[int] = None):
+        """→ the runner that should serve this request: a placed slice
+        sub-runner, or the whole-mesh parent for large feeds and
+        untrackable anchors."""
+        from ..utils import metrics as m
+        anchor = self._parent._feed_anchor(storage)
+        if n_hint is None:
+            est = getattr(storage, "estimated_rows", None)
+            if callable(est):
+                try:
+                    n_hint = est()
+                except Exception:   # noqa: BLE001 — hint only
+                    n_hint = None
+        if n_hint is not None and n_hint >= self.whole_mesh_rows:
+            key = id(anchor)
+            with self._mu:
+                self.whole_mesh_routes += 1
+                # an anchor that GREW past the threshold graduates to
+                # the whole mesh: its stale slice feed would otherwise
+                # sit unpatched (and unevicted under no budget) forever
+                idx = self._placed.pop(key, None)
+                self._refs.pop(key, None)
+            if idx is not None:
+                self._slices[idx].drop_feed(anchor, reason="placement")
+            m.DEVICE_PLACEMENT_COUNTER.labels("whole_mesh").inc()
+            return self._parent
+        key = id(anchor)
+        with self._mu:
+            idx = self._placed.get(key)
+            if idx is None:
+                idx = pick_slice(self._scores_locked())
+                try:
+                    self._refs[key] = weakref.ref(
+                        anchor, lambda _r, k=key: self._forget(k))
+                except TypeError:
+                    return self._parent      # untrackable anchor
+                self._placed[key] = idx
+                self.places += 1
+                m.DEVICE_PLACEMENT_COUNTER.labels("place").inc()
+            self._load[idx] += 1.0
+            self._routes += 1
+            rebalance = self._routes % REBALANCE_EVERY == 0
+        if rebalance:
+            self.rebalance()
+        return self._slices[idx]
+
+    def owner(self, anchor):
+        """The sub-runner currently holding ``anchor``, or None."""
+        with self._mu:
+            idx = self._placed.get(id(anchor))
+        return None if idx is None else self._slices[idx]
+
+    def _forget(self, key: int) -> None:
+        with self._mu:
+            self._placed.pop(key, None)
+            self._refs.pop(key, None)
+
+    def forget(self, anchor) -> None:
+        self._forget(id(anchor))
+
+    # -- rebalance ----------------------------------------------------
+
+    def rebalance(self) -> bool:
+        """One balance step: when the hottest slice carries an
+        unjustifiable share of the blended score, drop its COLDEST
+        anchor's feed and re-pin the anchor to the coolest slice (the
+        next request rebuilds there).  Coldest-first keeps the move
+        cheap — the hot anchor's warm feed and compile classes stay
+        put, mirroring how PD drains a hot store by moving replicas,
+        not leaders, first.  Returns True when a move happened."""
+        from ..utils import metrics as m
+        with self._mu:
+            pair = rebalance_donor(self._scores_locked(), min_ratio=2.0,
+                                   min_gap=0.25)
+            if pair is None:
+                return False
+            hot, cool = pair
+            donor = self._slices[hot]
+            victim = None
+            v_stats = None
+            for anchor, nbytes, hits, tick, pins in \
+                    donor._arena.entry_stats():
+                if pins > 0 or self._placed.get(id(anchor)) != hot:
+                    continue
+                st = (hits, tick)
+                if v_stats is None or st < v_stats:
+                    victim, v_stats = anchor, st
+            if victim is None:
+                return False
+            self._placed[id(victim)] = cool
+            self.moves += 1
+        donor.drop_feed(victim, reason="placement")
+        m.DEVICE_PLACEMENT_COUNTER.labels("move").inc()
+        return True
+
+    # -- fan-out helpers (parent delegation) --------------------------
+
+    def drop_feed_all(self, anchor, reason: str) -> int:
+        freed = 0
+        for r in self._slices:
+            freed += r.drop_feed(anchor, reason=reason)
+        self.forget(anchor)
+        return freed
+
+    def set_hbm_budget(self, parent_budget: int) -> None:
+        """Per-slice share of the node budget: slices split it evenly
+        (each owns a disjoint anchor set), the parent keeps the full
+        figure for whole-mesh feeds."""
+        share = parent_budget // len(self._slices) \
+            if parent_budget > 0 else 0
+        for r in self._slices:
+            r.set_hbm_budget(share)
+
+    # -- observability ------------------------------------------------
+
+    def publish_metrics(self) -> None:
+        from ..utils import metrics as m
+        with self._mu:
+            self._decay_locked()
+            loads = list(self._load)
+        for i, r in enumerate(self._slices):
+            m.DEVICE_SLICE_RESIDENT_BYTES.labels(str(i)).set(
+                r._arena.resident_bytes())
+            m.DEVICE_SLICE_LOAD.labels(str(i)).set(round(loads[i], 3))
+
+    def stats(self) -> dict:
+        self.publish_metrics()
+        with self._mu:
+            loads = [round(v, 3) for v in self._load]
+            placed = [0] * len(self._slices)
+            for idx in self._placed.values():
+                if 0 <= idx < len(placed):
+                    placed[idx] += 1
+            out = {
+                "slices": [
+                    {"resident_bytes": r._arena.resident_bytes(),
+                     "resident_lines": r._arena.resident_lines(),
+                     "load": loads[i],
+                     "placed_anchors": placed[i]}
+                    for i, r in enumerate(self._slices)],
+                "places": self.places,
+                "moves": self.moves,
+                "whole_mesh_routes": self.whole_mesh_routes,
+            }
+        return out

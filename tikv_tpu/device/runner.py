@@ -19,20 +19,32 @@ computation per plan over the whole HBM-resident feed:
   (slot = hi·LO+lo, kernels.twolevel_partial) with exact int8 byte-split
   arithmetic — ~8× the straight one-hot matmul, which itself is ~10×
   XLA's scatter lowering on TPU;
-- cross-shard merging happens ONCE after the scan (psum for the
+- cross-shard merging happens ONCE after the scan, as a partial-agg →
+  tree-reduce split on the interconnect (the TiDB partial-at-TiKV /
+  final-at-TiDB architecture mapped onto mesh axes): psum for the
   count/sum/nonnull fields — TiKV's psum-mergeable partial aggregate
-  states, tidb_query_aggr; per-shard stacks reduced on host for
-  min/max/first);
+  states, tidb_query_aggr — and an all-to-all by key bucket for the
+  order-sensitive hash-agg min/max slots (_finalize_hash_bucket_merge);
+  simple-agg min/max/first come back as a per-shard (S,) stack for a
+  scalar host reduce;
 - the result returns in ONE packed uint8 buffer with the D2H transfer
   started asynchronously (through a tunneled TPU every blocking sync
   costs ~0.1s; r2's per-array readback spent 3+ RTTs per request).
 
 On a 1-device mesh kernels compile as plain jit (no shard_map, no
 NamedSharding transfers — both measurably degrade the tunneled session's
-dispatch path). Host decode never appears on this path: the scan feed is
-a columnar snapshot (executors/columnar.py). Small requests stay on the
-host numpy path (copr/endpoint.py routing) so p99 latency never pays
-device dispatch.
+dispatch path). A SHARDED mesh is a first-class backend, not a degraded
+one: feeds upload row-sharded and delta-PATCH in place
+(GSPMD-partitioned dynamic_update_slice, _dus), the fused Pallas kernel
+runs as per-shard partial grids psum-merged on ICI
+(_pallas_sharded_wrap), selection mask/index routing is
+shard-concatenable, and hot regions optionally pin to single-device
+slices via the placement loop (device/placement.py) so a
+many-small-regions mix scales OUT while a single big feed scales UP.
+Host decode never appears on this path: the scan feed is a columnar
+snapshot (executors/columnar.py). Small requests stay on the host numpy
+path (copr/endpoint.py routing) so p99 latency never pays device
+dispatch.
 """
 
 from __future__ import annotations
@@ -455,7 +467,9 @@ class DeviceRunner:
     def __init__(self, mesh=None, chunk_rows: Optional[int] = None,
                  max_hash_capacity: int = 1 << 20,
                  max_topn_limit: int = 1 << 14,
-                 hbm_budget_bytes: int = 0):
+                 hbm_budget_bytes: int = 0,
+                 placement: bool = False,
+                 placement_rows: Optional[int] = None):
         # int64 accumulators are required for exact SUM/COUNT over 1e8
         # rows; jax defaults to 32-bit.  Values stay int32/float32 on
         # device, only accumulators widen.  (Set here, not at import, so
@@ -475,16 +489,26 @@ class DeviceRunner:
         # chunk_rows override shrinks it so tests drive multi-step scans
         # on tiny fixtures
         S = num_shards(self._mesh)
+        self._is_tpu = self._mesh.devices.flat[0].platform == "tpu"
         if chunk_rows is None:
-            # single-device feeds pad to the Pallas block so the fused
-            # hash kernel (pallas_hash.BLOCK rows/grid step) divides the
-            # feed exactly; the XLA scan paths gcd down from this
+            # feeds pad to the Pallas block so the fused hash kernel
+            # (pallas_hash.BLOCK rows/grid step) divides the feed — per
+            # SHARD on a sharded TPU mesh, since the sharded fast path
+            # runs the same kernel per shard before the tree-reduce;
+            # the XLA scan paths gcd down from this.  A sharded CPU
+            # mesh (virtual-device parity tests) keeps the smaller
+            # unit: no Mosaic lowering exists there and 8×2^18-row
+            # minimum pads would swamp the fixtures.
             from .pallas_hash import BLOCK as _PL_BLOCK
-            self._block_local = _PL_BLOCK if self._single else _FEED_BLOCK
+            self._block_local = _PL_BLOCK \
+                if (self._single or self._is_tpu) else _FEED_BLOCK
             self._chunk_override = False
         else:
             self._block_local = max(8, ((max(chunk_rows, 8) // S) // 8) * 8)
             self._chunk_override = True
+        self._init_args = {"chunk_rows": chunk_rows,
+                           "max_hash_capacity": max_hash_capacity,
+                           "max_topn_limit": max_topn_limit}
         self._plan_cache: dict = {}
         self._kernel_cache: dict = {}
         # dispatch serialization: two threads launching multi-device
@@ -532,12 +556,49 @@ class DeviceRunner:
         # device-side MVCC resolution (device/mvcc.py): lazily built —
         # host-only deployments and sharded meshes never pay for it
         self._mvcc_resolver = None
+        # hot-region → slice placement (device/placement.py): sharded
+        # meshes opt in to scale-OUT routing — small regions pin to
+        # single-device sub-runners spread by load, large feeds still
+        # shard over the whole mesh.  Off by default: single-chip
+        # deployments and whole-mesh benches skip the indirection.
+        self._placer = None
+        if placement and not self._single:
+            from .placement import DEFAULT_WHOLE_MESH_ROWS, SlicePlacer
+            self._placer = SlicePlacer(
+                self, whole_mesh_rows=placement_rows
+                if placement_rows is not None
+                else DEFAULT_WHOLE_MESH_ROWS)
+        from ..utils.metrics import DEVICE_MESH_SHARDS
+        DEVICE_MESH_SHARDS.set(num_shards(self._mesh))
+
+    def _make_slice_runner(self, mesh) -> "DeviceRunner":
+        """A single-device sub-runner for one placement slice, tuned
+        like the parent (chunk override, capacities); the placer owns
+        per-slice HBM budget splits."""
+        return DeviceRunner(mesh=mesh, **self._init_args)
+
+    @property
+    def placer(self):
+        return self._placer
+
+    def mesh_stats(self) -> dict:
+        """Mesh shape + placement rollup for /health."""
+        shape = dict(zip(ROW_AXES,
+                         (int(s) for s in self._mesh.devices.shape)))
+        out = {"shape": shape,
+               "n_devices": num_shards(self._mesh),
+               "platform": self._mesh.devices.flat[0].platform}
+        if self._placer is not None:
+            out["placement"] = self._placer.stats()
+        return out
 
     def mvcc_resolver(self, create: bool = True):
         """The runner's DeviceMvccResolver (the cold-path kill: flat
         CF_WRITE planes resolve newest-version-≤-read_ts on device and
-        the feed is born resident).  Single-device only — the sharded
-        mesh keeps the host upload pipeline (GSPMD re-lays feeds)."""
+        the feed is born resident).  Single-device only — a sharded
+        mesh's cold builds keep the host upload pipeline (the resolve
+        output is committed to one chip; re-laying it across shards
+        would pay the D2H+H2D the device build exists to avoid)."""
         if self._mvcc_resolver is None and create and self._single:
             from .mvcc import DeviceMvccResolver
             self._mvcc_resolver = DeviceMvccResolver(self)
@@ -602,11 +663,23 @@ class DeviceRunner:
         param-less selections batch this way.  Either way the members
         must target a CO-RESIDENT feed: same anchor (snapshot /
         lineage identity), same data generation, same ranges.
-        Single-device only — a sharded mesh's per-shard launches are
-        already amortized by GSPMD and the stacked kernel does not
-        shard.
+
+        The stacked kernel itself is single-device, but a sharded mesh
+        is no longer excluded: with placement on, the request routes
+        to its anchor's single-device SLICE and coalesces there (the
+        slice id joins the key so groups never straddle chips); only
+        whole-mesh sharded dispatches — already launch-amortized by
+        GSPMD — stay uncoalesced.
         """
-        if not self._single or not hasattr(storage, "scan_columns"):
+        if not hasattr(storage, "scan_columns"):
+            return None
+        if self._placer is not None:
+            target = self._placer.route(storage)
+            if target is not self:
+                key = target.batch_class(dag, storage)
+                return None if key is None \
+                    else ("slice", id(target)) + key
+        if not self._single:
             return None
         plan = self._analyze(dag)
         if plan is None:
@@ -637,6 +710,10 @@ class DeviceRunner:
         batch_class.  Returns a :class:`_BatchedSelectionGroup`; raises
         :class:`_BatchUnavailable` when the group cannot be served as
         one launch (the caller retries members solo)."""
+        if self._placer is not None and members:
+            target = self._placer.route(members[0][1])
+            if target is not self:
+                return target.handle_batched(members)
         from . import selection as selmod
         stacks = []
         for dag, _s in members:
@@ -728,13 +805,19 @@ class DeviceRunner:
         return None
 
     def selection_stats(self) -> dict:
-        """Routing-decision + observed-selectivity rollup (/health)."""
+        """Routing-decision + observed-selectivity rollup (/health).
+        With placement on, slice runners' route counts fold in (the
+        requests execute there)."""
         with self._sel_mu:
             plans = [{"ewma": round(st["ewma"], 4)
                       if st["ewma"] is not None else None,
                       "n_obs": st["n_obs"]}
                      for st in list(self._sel_stats.values())[-8:]]
             routes = dict(self._sel_route_counts)
+        if self._placer is not None:
+            for r in self._placer.slices:
+                for k, v in r.selection_stats()["routes"].items():
+                    routes[k] = routes.get(k, 0) + v
         return {"routes": routes, "plans": plans}
 
     def _analyze(self, dag: DAGRequest) -> Optional[_Plan]:
@@ -848,8 +931,12 @@ class DeviceRunner:
             # the k-row output on device and skip the host gather
             # entirely (selection.py).  Otherwise only the predicate
             # columns go to HBM and the mask/index routes gather on
-            # host.  Single-device only — sharded meshes never take the
-            # compact route, so widening would waste H2D/HBM there.
+            # host.  The mask and index routes run sharded (per-shard
+            # packbits/compaction, psum'd count); only COMPACT stays
+            # single-device — its gather output is committed to one
+            # chip by construction, so widening a whole-mesh feed
+            # would waste H2D/HBM there.  Placement-routed requests
+            # land on a single-device slice and keep the route.
             lossless = (EvalType.INT, EvalType.DATETIME, EvalType.DURATION)
             if all(c.is_pk_handle or
                    (c.field_type.eval_type in lossless and
@@ -1175,17 +1262,19 @@ class DeviceRunner:
         """Jitted in-place-style slice update (dynamic_update_slice);
         the start index is traced, so repeated single-row patches at
         different positions share one compile class per update length.
-        On a sharded feed the result is pinned back to the row sharding
-        so downstream shard_map kernels see their expected layout."""
+        On a sharded feed GSPMD partitions the update and the jit's
+        ``out_shardings`` pins the result to the row sharding in the
+        SAME dispatch — no post-hoc device_put re-lay, so delta churn
+        on a sharded feed costs one small collective-free launch per
+        span, exactly like the single-device path."""
         fn = self._kernel_cache.get("feed_patch_fn")
         if fn is None:
             def _upd(a, u, i):
                 return lax.dynamic_update_slice(a, u, (i,))
-            fn = self._kernel_cache["feed_patch_fn"] = jax.jit(_upd)
-        out = fn(arr, update, jnp.asarray(lo, jnp.int32))
-        if not self._single:
-            out = jax.device_put(out, self._row_sharding)
-        return out
+            fn = self._kernel_cache["feed_patch_fn"] = jax.jit(_upd) \
+                if self._single else \
+                jax.jit(_upd, out_shardings=self._row_sharding)
+        return fn(arr, update, jnp.asarray(lo, jnp.int32))
 
     # ------------------------------------- device-state supervision
     #
@@ -1197,19 +1286,39 @@ class DeviceRunner:
     def set_hbm_budget(self, nbytes: int) -> None:
         """Set (or clear, 0) the HBM budget and enforce it NOW — an
         online shrink must not wait for the next feed admission to
-        sweep resident state under the new cap."""
+        sweep resident state under the new cap.  With placement on,
+        the slices split the budget evenly (each owns a disjoint
+        anchor set); this whole-mesh arena keeps the full figure for
+        the feeds that shard over every chip."""
         self._arena.budget_bytes = int(nbytes)
         self._arena.enforce()
+        if self._placer is not None:
+            self._placer.set_hbm_budget(int(nbytes))
 
     def hbm_stats(self) -> dict:
         out = self._arena.stats()
         with self._quar_mu:
             out["quarantined"] = len(self._quarantined)
+        if self._placer is not None:
+            # node-level rollup: the budget invariant is judged against
+            # ALL device-resident bytes, wherever the anchor is pinned
+            for r in self._placer.slices:
+                sub = r.hbm_stats()
+                for k in ("resident_bytes", "resident_lines",
+                          "pinned_lines", "pinned_bytes", "evictions",
+                          "rejections", "drops", "quarantined"):
+                    out[k] = out.get(k, 0) + sub.get(k, 0)
         return out
 
     def arena_items(self) -> list:
-        """(anchor, bucket) snapshot for the scrubber."""
-        return self._arena.items()
+        """(anchor, bucket) snapshot for the scrubber — placement
+        slices included, so one scrub pass audits every resident
+        plane on the node."""
+        items = self._arena.items()
+        if self._placer is not None:
+            for r in self._placer.slices:
+                items.extend(r.arena_items())
+        return items
 
     def drop_feed(self, anchor, reason: str = "drop") -> int:
         """Explicitly release every device feed and request memo
@@ -1225,12 +1334,22 @@ class DeviceRunner:
             # unminted cold-resolve artifacts (device version planes)
             # die with the line too
             drop_cold()
-        return self._arena.drop(anchor, reason=reason)
+        freed = self._arena.drop(anchor, reason=reason)
+        if self._placer is not None:
+            freed += self._placer.drop_feed_all(anchor, reason)
+        return freed
 
     def quarantine(self, anchor, reason: str = "") -> None:
         """Scrub divergence: drop the anchor's feeds now and route its
         NEXT request to the host backend; the request after that
-        rebuilds a fresh feed from host truth (re-admission)."""
+        rebuilds a fresh feed from host truth (re-admission).  A
+        placed anchor quarantines on its OWNING slice — that is the
+        runner its next request routes to."""
+        if self._placer is not None:
+            owner = self._placer.owner(anchor)
+            if owner is not None:
+                owner.quarantine(anchor, reason=reason)
+                return
         from ..utils.metrics import DEVICE_QUARANTINE_COUNTER
         self._arena.drop(anchor, reason="quarantine")
         with self._quar_mu:
@@ -1538,14 +1657,20 @@ class DeviceRunner:
         return (jax.tree.map(lambda x: jax.device_put(x, repl), summed),
                 jax.tree.map(lambda x: jax.device_put(x, rows), stacked))
 
-    def _init_agg_carry(self, plan: _Plan, slots: Optional[int]):
+    def _init_agg_carry(self, plan: _Plan, slots: Optional[int],
+                        stacked_slots: Optional[int] = None):
         """Zero/identity states for the scatter-path carries.
 
-        ``slots=None`` → simple agg (scalar states); else hash agg arrays.
+        ``slots=None`` → simple agg (scalar states); else hash agg
+        arrays.  ``stacked_slots`` widens only the per-shard stacked
+        leaves (min/max/first) — the sharded tree-reduce pads their
+        slot axis to a multiple of the shard count so the all-to-all
+        bucket exchange splits it evenly.
         """
         S = self._nshards()
         shape = () if slots is None else (slots,)
-        sshape = (S,) if slots is None else (S, slots)
+        sshape = (S,) if slots is None else \
+            (S, slots if stacked_slots is None else stacked_slots)
         summed, stacked = [], []
         for spec, rpn in zip(plan.specs, plan.agg_rpns):
             is_real = rpn is not None and rpn.ret_type is EvalType.REAL
@@ -1586,6 +1711,66 @@ class DeviceRunner:
             return jax.tree.map(self._psum, summed), stacked
         return fin
 
+    def _finalize_hash_bucket_merge(self):
+        """Sharded hash-agg tree-reduce, entirely on the interconnect:
+        psum the mergeable (count/sum/nonnull/present) fields, and
+        merge the order-sensitive stacked fields (min/max) with an
+        ALL-TO-ALL BY KEY BUCKET — each shard sends bucket ``j`` of
+        its local (1, slots_m) partial to shard ``j``, reduces the
+        (S, slots_m/S) pile it receives, and returns its merged bucket.
+        This is the TiDB partial-at-TiKV / final-at-TiDB split mapped
+        onto mesh axes: the runtime here lowers only Sum all-reduce
+        (no pmin/pmax), but an all-to-all is a pure permutation, so
+        the min/max merge that used to ship a (S, slots) stack over
+        D2H for a host reduce now crosses ICI once and ships (slots,)."""
+        def fin(carry):
+            summed, stacked = carry
+            summed = jax.tree.map(self._psum, summed)
+            out_st = []
+            for st in stacked:
+                d = {}
+                for k, v in st.items():
+                    b = lax.all_to_all(v, ROW_AXES, split_axis=1,
+                                       concat_axis=0, tiled=True)
+                    red = jnp.max if k == "max" else jnp.min
+                    d[k] = red(b, axis=0, keepdims=True)
+                out_st.append(d)
+            return summed, out_st
+        return fin
+
+    @staticmethod
+    def _pad_stacked(st: dict, pad: int) -> dict:
+        """Pad a new stacked state's slot axis with the merge identity
+        (min/pos → +big, max → -big) so it folds into the widened
+        sharded carry without perturbing any real slot."""
+        if not pad:
+            return st
+        out = {}
+        for k, v in st.items():
+            if v.dtype.kind == "f":
+                fill = -jnp.inf if k == "max" else jnp.inf
+            else:
+                fill = np.iinfo(np.int64).min if k == "max" \
+                    else np.iinfo(np.int64).max
+            out[k] = jnp.pad(v, ((0, 0), (0, pad)),
+                             constant_values=fill)
+        return out
+
+    @staticmethod
+    def _merge_bucketed(specs, summed_states, stacked_states,
+                        slots: int) -> list:
+        """Host-side unpack after the device bucket merge: the fetched
+        stacked leaves are (S, slots_m/S) — shard j's row IS bucket j,
+        already cross-shard reduced — so the merged per-slot vector is
+        just the row-major flatten, trimmed of the all-to-all pad."""
+        out = []
+        for spec, sm, st in zip(specs, summed_states, stacked_states):
+            d = {k: np.asarray(v) for k, v in sm.items()}
+            for k, v in st.items():
+                d[k] = np.asarray(v).reshape(-1)[:slots]
+            out.append(d)
+        return out
+
     # -- kernel bodies --
 
     def _build_simple_body(self, plan: _Plan, n_cols: int):
@@ -1622,7 +1807,8 @@ class DeviceRunner:
         return body
 
     def _build_hash_scatter_body(self, plan: _Plan, n_cols: int,
-                                 capacity: int, sparse: bool = False):
+                                 capacity: int, sparse: bool = False,
+                                 stack_pad: int = 0):
         specs = plan.specs
         n_pairs = n_cols + (1 if sparse else 0)
 
@@ -1654,6 +1840,7 @@ class DeviceRunner:
             for spec, s, cs, cst in zip(specs, st["states"], summed_c,
                                         stacked_c):
                 sm, stk = self._split_new_state(self._canon_state(s))
+                stk = self._pad_stacked(stk, stack_pad)
                 out_sm.append(self._merge_summed(cs, sm))
                 out_st.append(self._merge_stacked_dict(cst, stk)
                               if stk else cst)
@@ -1867,6 +2054,15 @@ class DeviceRunner:
         builds that validate synchronously) still return a finished
         SelectResult; callers must accept either.
         """
+        if self._placer is not None and _stack is None and \
+                hasattr(storage, "scan_columns"):
+            # hot-region placement (device/placement.py): small feeds
+            # pin to a single-device slice picked by load; large feeds
+            # come back to this whole-mesh runner (scale-up)
+            target = self._placer.route(storage)
+            if target is not self:
+                return target.handle_request(dag, storage,
+                                             deferred=deferred)
         plan = self._analyze(dag)
         if plan is None:
             raise RuntimeError("plan not supported by device backend")
@@ -2058,6 +2254,17 @@ class DeviceRunner:
             positional = isinstance(plan.scan, TableScanDesc) and \
                 not getattr(plan.scan, "desc", False)
             with self._dispatch_mu:
+                if not self._single:
+                    # one shard's enqueue failing (device loss, ICI
+                    # fault) surfaces as a whole-launch failure mid-
+                    # dispatch, with the lock HELD.  The plan degrades
+                    # to host WHOLE — never a partial per-shard answer
+                    # — and the raise unwinds this ``with``, releasing
+                    # the lock on the way out: a sharded launch fault
+                    # must not wedge the serialized dispatch stream
+                    # (the launch-order-inversion hazard the lock
+                    # exists for — see its comment at the definition)
+                    _fp_degrade("device::shard_launch")
                 feed = self._get_feed(storage, feed_key,
                                       host_cols_stream, n,
                                       lineage=lineage,
@@ -2159,7 +2366,9 @@ class DeviceRunner:
         entry = None
         for key, val in self._kernel_cache.items():
             if isinstance(key, tuple) and key and key[0] == "hashpl" \
-                    and isinstance(val, dict):
+                    and isinstance(val, dict) and "runs" in val:
+                # sharded entries wrap their grid in shard_map; the
+                # launch-train probe times the raw single-device runs
                 if key[1] == dag.plan_key():
                     entry = val
         if entry is None:
@@ -2431,7 +2640,15 @@ class DeviceRunner:
 
         def fin(fetched):
             summed, stacked = fetched
-            merged = self._merge_stacked(plan.specs, summed, stacked)
+            if not self._single:
+                # summed fields already psum-merged on ICI; only the
+                # per-shard (S,) min/max/first scalars reduce here
+                with _tracker.phase("shard_merge"):
+                    merged = self._merge_stacked(plan.specs, summed,
+                                                 stacked)
+            else:
+                merged = self._merge_stacked(plan.specs, summed,
+                                             stacked)
             return self._simple_result(dag, plan, merged)
 
         return _Pending(carry, fin)
@@ -2630,9 +2847,17 @@ class DeviceRunner:
             chunk = self._pick_chunk(feed["n_pad"], _CHUNK_AGG)
             key = self._kern_key("hashsc", dag, feed, chunk, tuple(dtypes),
                                  capacity, sparse)
+            # sharded: the order-sensitive stacked states (min/max)
+            # tree-reduce on device via the all-to-all bucket merge —
+            # the slot axis pads to a shard multiple so buckets split
+            # evenly, and D2H shrinks from (S, slots) to (slots,)
+            S = self._nshards()
+            bucket_merge = not self._single
+            slots_m = -(-slots // S) * S if bucket_merge else slots
 
             def build_scatter_carry():
-                sm_init, st_init = self._init_agg_carry(plan, slots)
+                sm_init, st_init = self._init_agg_carry(
+                    plan, slots, stacked_slots=slots_m)
                 return ((sm_init, np.zeros(slots, np.int64),
                          np.zeros((), np.int64)), st_init)
 
@@ -2640,7 +2865,10 @@ class DeviceRunner:
             kern = self._shard_kernel(
                 key, lambda: self._wrap_mega(
                     self._mega(self._build_hash_scatter_body(
-                        plan, n_cols, capacity, sparse=sparse),
+                        plan, n_cols, capacity, sparse=sparse,
+                        stack_pad=slots_m - slots),
+                        self._finalize_hash_bucket_merge()
+                        if bucket_merge else
                         self._finalize_psum_summed(),
                         kern_null_flags, feed["n_pad"], chunk),
                     carry, len(kern_flat)))
@@ -2651,11 +2879,17 @@ class DeviceRunner:
             def fin_scatter(fetched):
                 (summed, present_counts, ovf), stacked = fetched
                 assert int(ovf) == 0, "hash agg key range overflow"
+                if bucket_merge:
+                    with _tracker.phase("shard_merge"):
+                        states = self._merge_bucketed(
+                            plan.specs, summed, stacked, slots)
+                else:
+                    states = self._merge_stacked(plan.specs, summed,
+                                                 stacked)
                 return hash_result({
                     "present": present_counts > 0,
                     "overflow": False,
-                    "states": self._merge_stacked(plan.specs, summed,
-                                                  stacked),
+                    "states": states,
                 })
 
             return _Pending(carry, fin_scatter)
@@ -2690,6 +2924,31 @@ class DeviceRunner:
             S8 = np.pad(S8, ((0, 0), (0, slots - have)))
         return states_from_matmul(layouts, specs, S8, None, xp=np)
 
+    def _pallas_sharded_wrap(self, run, n_in: int, n_local_pad: int):
+        """shard_map wrapper for the fused kernel: each shard runs one
+        grid over its LOCAL feed slice (row bounds traced from the
+        shard index — the kernel's dead-block guard masks the ragged
+        tail shard exactly as it masks bucket padding), then the packed
+        int32 partial pairs psum over both mesh axes.  check_rep is
+        disabled where the API still has it: pallas_call carries no
+        replication rule, and the psum makes the output replicated by
+        construction."""
+        def local_fn(n_arr, base_arr, *cols_local):
+            start = self._shard_index() * n_local_pad
+            row_hi = jnp.clip(n_arr - start, 0, n_local_pad)
+            packed = run(jnp.asarray(0, jnp.int32), row_hi, base_arr,
+                         jnp.asarray(0, jnp.int32), cols_local)
+            return lax.psum(packed, ROW_AXES)
+
+        kwargs = dict(mesh=self._mesh,
+                      in_specs=(P(), P()) + (P(ROW_AXES),) * n_in,
+                      out_specs=P())
+        try:
+            sm = _shard_map(local_fn, check_rep=False, **kwargs)
+        except TypeError:       # newer jax: check_rep retired
+            sm = _shard_map(local_fn, **kwargs)
+        return jax.jit(sm)
+
     def _try_pallas(self, dag, plan, feed, dtypes, n, base, capacity,
                     layouts, p8, pf, arg_nbytes, arg_ok_is_mask,
                     mode="dense", spans=None, slots_dev=None):
@@ -2716,14 +2975,25 @@ class DeviceRunner:
 
         A build or compile failure is cached so the fallback is taken
         once per plan, not per request.
+
+        SHARDED meshes ride the same kernel as per-shard partials
+        (partial-at-shard / final-on-ICI — the TiDB split): shard_map
+        runs one grid over each shard's local feed slice with traced
+        row bounds from the shard index, and the packed int32 partial
+        pairs — exact sums by construction — psum across both mesh
+        axes before ONE replicated (2, HI, W) result crosses D2H.  Any
+        build/lowering failure falls back to the sharded XLA paths
+        exactly like the single-device case.
         """
         from . import pallas_hash
         dev0 = self._mesh.devices.flat[0]
         if dev0.platform == "cpu":
             return None     # Mosaic kernels need real TPU lowering
         if not pallas_hash.supported(plan, feed, dtypes, pf, capacity,
-                                     self._single, mode):
+                                     self._nshards(), mode):
             return None
+        if not self._single and spans is not None:
+            return None     # bucket tiles are a single-device shape
         sparse = mode == pallas_hash.MODE_SPARSE
         B = pallas_hash.BLOCK
         total_blocks = feed["n_pad"] // B
@@ -2772,21 +3042,42 @@ class DeviceRunner:
 
         key = ("hashpl", dag.plan_key(), mode,
                tuple(sorted({t[3] for t in tiles})), tuple(dtypes),
-               capacity, arg_nbytes, tuple(arg_ok_is_mask))
+               capacity, arg_nbytes, tuple(arg_ok_is_mask),
+               self._nshards())
         entry = self._kernel_cache.get(key)
         if entry is False:
             return None
         if entry is None:
             try:
-                runs_by_nb = {}
-                LO = None
-                for nb in sorted({t[3] for t in tiles}):
+                if not self._single:
+                    # per-shard partial grids + psum tree-reduce: one
+                    # shard_map launch, one replicated packed result
+                    S = self._nshards()
                     run, LO, HI = pallas_hash.build(
-                        plan, layouts, p8, capacity, nb, col_map,
-                        mode=mode)
-                    runs_by_nb[nb] = run
-                # compile + validate now so Mosaic rejections fall back
-                packed = dispatch(runs_by_nb)
+                        plan, layouts, p8, capacity,
+                        feed["n_pad"] // (S * B), col_map, mode=mode)
+                    wrapped = self._pallas_sharded_wrap(
+                        run, len(cols), feed["n_pad"] // S)
+                    # compile + validate now so Mosaic/shard_map
+                    # rejections fall back to the sharded XLA paths
+                    packed = np.asarray(wrapped(
+                        self._cached_scalar(n, jnp.int64),
+                        self._cached_scalar(base, jnp.int64), *cols))
+                    entry = {"sharded": wrapped, "LO": LO,
+                             "col_sel": col_sel, "mode": mode}
+                else:
+                    runs_by_nb = {}
+                    LO = None
+                    for nb in sorted({t[3] for t in tiles}):
+                        run, LO, HI = pallas_hash.build(
+                            plan, layouts, p8, capacity, nb, col_map,
+                            mode=mode)
+                        runs_by_nb[nb] = run
+                    # compile + validate now so Mosaic rejections fall
+                    # back
+                    packed = dispatch(runs_by_nb)
+                    entry = {"runs": runs_by_nb, "LO": LO,
+                             "col_sel": col_sel, "mode": mode}
             except Exception as e:
                 # never silently: a swallowed genuine bug here would
                 # disguise itself as the slower XLA path
@@ -2815,19 +3106,23 @@ class DeviceRunner:
                         "%r: %s: %s", key[1], name, e)
                     self._kernel_cache[key] = False
                 return None
-            entry = {"runs": runs_by_nb, "LO": LO, "col_sel": col_sel,
-                     "mode": mode}
             self._kernel_cache[key] = entry
             # success clears the transient strike count — three isolated
             # hiccups over a process lifetime must not kill the fast path
             self._kernel_cache.pop(("hashpl_tries", key), None)
-            return ("sync", packed, LO)
-        runs_by_nb, LO = entry["runs"], entry["LO"]
+            return ("sync", packed, entry["LO"])
+        LO = entry["LO"]
         try:
             from ..utils import tracker
             with tracker.phase("device_dispatch"):
-                parts = [runs_by_nb[nb](lo, hi, base, blk0, cols)
-                         for lo, hi, blk0, nb in tiles]
+                if "sharded" in entry:
+                    parts = [entry["sharded"](
+                        self._cached_scalar(n, jnp.int64),
+                        self._cached_scalar(base, jnp.int64), *cols)]
+                else:
+                    runs_by_nb = entry["runs"]
+                    parts = [runs_by_nb[nb](lo, hi, base, blk0, cols)
+                             for lo, hi, blk0, nb in tiles]
             self._kernel_cache.pop(("hashpl_tries", key), None)
         except Exception as e:
             # a transient DISPATCH failure on a cached kernel must fall
@@ -3235,6 +3530,12 @@ def _analyze_on_device(runner, dag, storage, n_buckets: int):
     focused on DAG execution).  Returning None routes the request to
     the host analyze path — including when a device::* failpoint fires
     inside the dispatch/fetch (the degrade contract)."""
+    if runner._placer is not None and hasattr(storage, "scan_columns"):
+        # placement: ANALYZE sorts are single-device kernels — run them
+        # on the region's placed slice instead of declining shard-wide
+        target = runner._placer.route(storage)
+        if target is not runner:
+            return _analyze_on_device(target, dag, storage, n_buckets)
     try:
         return _analyze_on_device_impl(runner, dag, storage, n_buckets)
     except _FallbackToHost:

@@ -25,6 +25,15 @@ D2H volume per route (n scanned rows, k selected):
                where every device route's D2H + gather meets or exceeds
                the plain host scan.
 
+The mask and index routes are SHARD-CONCATENABLE and run on sharded
+meshes as-is: each shard packs/compacts its local rows in feed order,
+the count psums on ICI, and the host sees the same byte layout
+concatenated (index entries carry global row offsets via the shard
+index).  Only ``compact`` stays single-device — its gathered output is
+committed to one chip by construction — and placement-routed requests
+(device/placement.py) land on a single-device slice where every route
+applies.
+
 Unlike the aggregation kernels there is no Mosaic/Pallas body here by
 measurement, not omission: the selection pass is purely elementwise
 (predicate eval) plus a segmented popcount/prefix-sum — XLA fuses it
@@ -274,9 +283,11 @@ def build_batched_mask_kernel(sel_rpns, null_flags, n_pad: int,
     classes stay logarithmic in occupancy; dead lanes (group padding)
     repeat a live lane's parameters and their outputs are discarded.
 
-    Single-device only: the coalescer never stacks on a sharded mesh
-    (a vmapped psum inside shard_map buys nothing there — per-shard
-    dispatch overhead is already amortized by GSPMD).
+    The stacked kernel itself is single-device, but sharded meshes are
+    no longer excluded from coalescing: a placement-routed request
+    (device/placement.py) stacks on its anchor's single-device slice.
+    Only whole-mesh sharded dispatches — whose per-shard launches GSPMD
+    already amortizes — stay solo.
     """
     assert n_params >= 1, "stacked dispatch needs hoisted parameters"
     idt = jnp.int32 if n_pad <= np.iinfo(np.int32).max else jnp.int64

@@ -321,6 +321,16 @@ class FeedArena:
                      for e in list(self._entries.values())]
         return [(a, b) for a, b in pairs if a is not None]
 
+    def entry_stats(self) -> list:
+        """(anchor, nbytes, hits, tick, pins) snapshot with live
+        anchors — the placement rebalancer's victim-selection surface
+        (device/placement.py picks the coldest unpinned anchor)."""
+        with self._mu:
+            rows = [(e.ref(), e.nbytes, e.hits, e.tick, e.pins)
+                    for e in list(self._entries.values())]
+        return [(a, nb, h, t, p) for a, nb, h, t, p in rows
+                if a is not None]
+
     def _publish(self) -> None:
         from ..utils.metrics import (
             DEVICE_FEED_LINES,

@@ -5,8 +5,10 @@ from .mesh import (
     ROW_AXES,
     TILE_AXIS,
     make_mesh,
+    mesh_slices,
     num_shards,
     pad_rows_for,
+    parse_mesh_shape,
     replicated,
     row_sharding,
 )
@@ -16,8 +18,10 @@ __all__ = [
     "ROW_AXES",
     "TILE_AXIS",
     "make_mesh",
+    "mesh_slices",
     "num_shards",
     "pad_rows_for",
+    "parse_mesh_shape",
     "replicated",
     "row_sharding",
 ]

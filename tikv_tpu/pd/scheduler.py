@@ -26,7 +26,76 @@ score decays once the store recovers, and normal scheduling resumes.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Mapping, Optional, Sequence
+
+
+# ---------------------------------------------------------------- slices
+#
+# The same balance-region policy shape, applied one level down: a
+# multi-chip node's device mesh exposes single-device placement slices
+# (parallel/mesh.mesh_slices) and hot regions' HBM feeds are the
+# "replicas" being spread.  The score combines the slice's resident
+# bytes (PR 6's arena accounting — the capacity half) with a decayed
+# dispatch-rate load (PR 3's slow-score discipline — the traffic half);
+# the policy itself stays PURE so device/placement.py can drive it
+# without a PD handle and unit tests can pin its decisions.
+
+
+def pick_slice(scores: Sequence[float],
+               exclude: Sequence[int] = ()) -> int:
+    """Least-loaded slice index (the balance-region receiver pick).
+
+    Ties break toward the LOWEST index so a fresh node fills slice
+    order deterministically.  ``exclude`` marks slices a placement must
+    avoid (browned-out / quarantine-heavy), mirroring how the store
+    balancer never picks a slow store as a receiver; if every slice is
+    excluded the least-loaded one is returned anyway — a degraded
+    placement beats no placement.
+    """
+    best = None
+    for i, s in enumerate(scores):
+        if i in exclude:
+            continue
+        if best is None or s < scores[best]:
+            best = i
+    if best is None:
+        best = min(range(len(scores)), key=lambda i: scores[i])
+    return best
+
+
+def rebalance_donor(scores: Sequence[float],
+                    min_ratio: float = 2.0,
+                    min_gap: float = 1.0) -> Optional[tuple[int, int]]:
+    """→ (donor, receiver) slice pair when the spread justifies a move,
+    else None.
+
+    A move is justified the way a balance-region step is: the hottest
+    slice carries at least ``min_ratio``× the coolest's score AND the
+    absolute gap clears ``min_gap`` (so two near-idle slices never
+    churn feeds back and forth — the oscillation guard the store
+    balancer gets from max_diff)."""
+    if len(scores) < 2:
+        return None
+    hot = max(range(len(scores)), key=lambda i: scores[i])
+    cool = min(range(len(scores)), key=lambda i: scores[i])
+    if hot == cool:
+        return None
+    if scores[hot] < min_gap + scores[cool]:
+        return None
+    if scores[hot] < min_ratio * max(scores[cool], 1e-9):
+        return None
+    return hot, cool
+
+
+def slice_scores(occupancy: Mapping[int, float],
+                 load: Mapping[int, float], n_slices: int,
+                 occupancy_weight: float = 1.0,
+                 load_weight: float = 1.0) -> list[float]:
+    """Blend per-slice occupancy (resident bytes, normalized by the
+    caller) and decayed dispatch load into one placement score list."""
+    return [occupancy_weight * float(occupancy.get(i, 0.0))
+            + load_weight * float(load.get(i, 0.0))
+            for i in range(n_slices)]
 
 
 class Scheduler:

@@ -114,10 +114,6 @@ def main(argv=None) -> int:
         from .node import Node
         from .pd_server import RemotePdClient
         from .server import TikvServer
-        device_runner = None
-        if args.with_device:
-            from ..device import DeviceRunner
-            device_runner = DeviceRunner()
         config = None
         if args.config:
             from ..config import TikvConfig
@@ -125,6 +121,22 @@ def main(argv=None) -> int:
             if config.security.enabled:
                 from .security import set_default
                 set_default(config.security)
+        device_runner = None
+        if args.with_device:
+            from ..device import DeviceRunner
+            if config is not None:
+                # multi-chip: honor the explicit mesh factorization and
+                # the hot-region placement opt-in (config rationale at
+                # CoprocessorConfig.mesh_shape)
+                from ..parallel import make_mesh, parse_mesh_shape
+                cc = config.coprocessor
+                device_runner = DeviceRunner(
+                    mesh=make_mesh(
+                        shape=parse_mesh_shape(cc.mesh_shape)),
+                    placement=cc.device_placement,
+                    placement_rows=cc.placement_rows)
+            else:
+                device_runner = DeviceRunner()
         if args.status_addr and config is not None:
             config.server.status_addr = args.status_addr
         node = Node(args.addr, RemotePdClient(args.pd),

@@ -93,6 +93,13 @@ class StatusServer:
                         # late-materialized selection: routing-decision
                         # counts + per-plan observed-selectivity EWMAs
                         body["device_selection"] = dr.selection_stats()
+                    if dr is not None and hasattr(dr, "mesh_stats"):
+                        # multi-chip rollup: mesh shape (incl. any
+                        # coprocessor.mesh_shape override), and when
+                        # placement is on the per-slice occupancy
+                        # (arena resident bytes/lines), decayed load,
+                        # and place/move/whole-mesh counters
+                        body["device_mesh"] = dr.mesh_stats()
                     sup = getattr(node, "device_supervisor", None)
                     if sup is not None and hasattr(sup, "stats"):
                         # device-state integrity: HBM arena accounting

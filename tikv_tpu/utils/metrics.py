@@ -379,6 +379,26 @@ COPR_COALESCE_CLOSE_COUNTER = REGISTRY.counter(
     "window = collection window expired, deadline = tightest member "
     "budget pressure, failpoint = copr::coalesce_window, shutdown)",
     labels=("reason",))
+DEVICE_MESH_SHARDS = REGISTRY.gauge(
+    "tikv_device_mesh_shards",
+    "devices in the runner's (range, tile) mesh (1 = single-chip; the "
+    "sharded kernels partial-agg per shard and tree-reduce on ICI)")
+DEVICE_SLICE_RESIDENT_BYTES = REGISTRY.gauge(
+    "tikv_device_slice_resident_bytes",
+    "HBM bytes resident per placement slice (device/placement.py; the "
+    "occupancy half of the hot-region placement score)",
+    labels=("slice",))
+DEVICE_SLICE_LOAD = REGISTRY.gauge(
+    "tikv_device_slice_load",
+    "decayed dispatch-rate load score per placement slice (the "
+    "slow-store-style traffic half of the placement score)",
+    labels=("slice",))
+DEVICE_PLACEMENT_COUNTER = REGISTRY.counter(
+    "tikv_device_placement_total",
+    "hot-region placement decisions (place = new anchor assigned to a "
+    "slice, move = rebalance dropped an anchor off a hot slice, "
+    "whole_mesh = feed large enough to shard over every chip)",
+    labels=("decision",))
 SCHED_COMMANDS = REGISTRY.counter(
     "tikv_scheduler_commands_total", "txn scheduler commands",
     labels=("type",))
