@@ -369,12 +369,17 @@ def test_failpoint_inventory_resolves():
     # build ladder to native, then interpreted; ≥67 since
     # device::shard_launch — a sharded mesh dispatch losing one
     # shard's enqueue degrades the WHOLE plan to host without wedging
-    # the serialized dispatch stream)
-    assert len(sites) >= 67, f"only {len(sites)} unique sites"
+    # the serialized dispatch stream; ≥69 since the chip failure
+    # domains: device::slice_dead — persistent, per-slice-targeted
+    # chip death (dispatch/fetch/canary all fail until healed) — and
+    # device::mesh_rebuild, faulting the elastic-degrade rebuild
+    # itself so host is provably reachable as the ladder's last rung)
+    assert len(sites) >= 69, f"only {len(sites)} unique sites"
     for dev_site in ("device::hbm_oom", "device::feed_corrupt",
                      "device::d2h_corrupt", "copr::coalesce_dispatch",
                      "copr::coalesce_window", "device::mvcc_resolve",
-                     "device::shard_launch"):
+                     "device::shard_launch", "device::slice_dead",
+                     "device::mesh_rebuild"):
         assert dev_site in sites, f"missing fault site {dev_site}"
 
     nemesis_src = (root / "chaos" / "nemesis.py").read_text()
@@ -383,6 +388,18 @@ def test_failpoint_inventory_resolves():
     referenced |= set(CRASH_SITES)
     missing = referenced - sites
     assert not missing, f"nemesis steers unknown sites: {missing}"
+
+    # every device::* site must be exercised by at least one nemesis
+    # kind — a failure-domain site nothing chaoses is a failure mode
+    # nothing proves survivable.  The nemesis names its sites as
+    # string literals (dedicated _apply_* kinds or the DEGRADE_SITES
+    # rotation), so a plain source scan is the coverage oracle.
+    device_sites = {s for s in sites if s.startswith("device::")}
+    nemesis_named = set(re.findall(r'"(device::[a-z0-9_]+)"',
+                                   nemesis_src))
+    uncovered = device_sites - nemesis_named
+    assert not uncovered, \
+        f"device sites with no nemesis coverage: {sorted(uncovered)}"
 
     readme = (root.parent / "README.md").read_text()
     documented = set(re.findall(r"`([a-z_]+)::\*`", readme))

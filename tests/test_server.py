@@ -241,18 +241,27 @@ def test_concurrent_coprocessor_over_network(cluster):
 
 
 def test_split_and_routing_over_network(cluster):
+    from tikv_tpu.storage.txn_types import encode_key
     c = cluster["client"]
     c.put(b"srv-a", b"1")
     c.put(b"srv-z", b"2")
     right = c.split(b"srv-m")
     import time
-    time.sleep(0.3)
-    region_a = c.pd.get_region(
-        __import__("tikv_tpu.storage.txn_types",
-                   fromlist=["encode_key"]).encode_key(b"srv-a"))
-    region_z = c.pd.get_region(
-        __import__("tikv_tpu.storage.txn_types",
-                   fromlist=["encode_key"]).encode_key(b"srv-z"))
+    # the new right region reaches PD on its next heartbeat: poll with
+    # a bound instead of a fixed sleep (racy on a loaded 1-core box —
+    # PD transiently answers "no region" for the carved-off range)
+    deadline = time.monotonic() + 10
+    region_a = region_z = None
+    while time.monotonic() < deadline:
+        try:
+            region_a = c.pd.get_region(encode_key(b"srv-a"))
+            region_z = c.pd.get_region(encode_key(b"srv-z"))
+            if region_a.id != region_z.id:
+                break
+        except Exception:   # noqa: BLE001 — transient routing gap
+            pass
+        time.sleep(0.05)
+    assert region_a is not None and region_z is not None
     assert region_a.id != region_z.id
     # reads/writes still route correctly across the split
     assert c.get(b"srv-a") == b"1"
@@ -419,10 +428,16 @@ def test_per_request_tracker_details(cluster):
     # the scan covered every row once
     assert sd["processed_versions"] == 300
 
-    # warm repeat: cache hit labeled, still consistent
-    dag2 = sel.aggregate([sel.col("c0")],
-                         [("count_star", None)]).build(start_ts=c.tso())
-    resp2 = c.coprocessor(dag2)
+    # warm repeat: cache hit labeled, still consistent.  A lifecycle
+    # event racing the repeat (PD-driven leader churn on this shared
+    # cluster under full-suite load) legitimately retires the line and
+    # re-labels "build" — retry a couple of times for the hit
+    for attempt in range(3):
+        dag2 = sel.aggregate([sel.col("c0")],
+                             [("count_star", None)]).build(start_ts=c.tso())
+        resp2 = c.coprocessor(dag2)
+        if resp2["time_detail"]["labels"]["copr_cache"] == "hit":
+            break
     assert resp2["time_detail"]["labels"]["copr_cache"] == "hit"
 
     # point read: kv_read phase + 1 processed version

@@ -19,8 +19,10 @@ from .invariants import (        # noqa: F401
     check_conservation,
     check_goodput,
     check_hbm_within_budget,
+    check_mesh_serves_degraded,
     check_no_late_acks,
     check_no_lost_acks,
+    check_no_quarantined_dispatch,
     check_no_stale_epoch,
     check_read_correctness,
     check_replica_consistency,
@@ -28,6 +30,7 @@ from .invariants import (        # noqa: F401
 )
 from .nemesis import (           # noqa: F401
     CRASH_SITES,
+    DEGRADE_SITES,
     DEVICE_FAULT_KINDS,
     FAULT_KINDS,
     Fault,

@@ -177,6 +177,69 @@ def check_scrub_clean(supervisor) -> None:
             f"heal: {out}")
 
 
+def check_no_quarantined_dispatch(runner) -> None:
+    """Chip failure domains: no device kernel ever LAUNCHED on a slice
+    while it was quarantined (the dispatch gate refused instead — the
+    request degraded or rescued), and a quarantined slice holds no
+    resident feed lines (the drain actually ran and nothing re-uploaded
+    onto a condemned chip).  Call at a quiesced point — an in-flight
+    upload racing the trip is exactly what this hunts."""
+    board = getattr(runner, "_board", None)
+    if board is None:
+        return
+    for s in board.stats():
+        if s["launched_quarantined"]:
+            raise InvariantViolation(
+                f"slice {s['slice']} launched "
+                f"{s['launched_quarantined']} dispatch(es) while "
+                f"quarantined (score {s['score']}, strikes "
+                f"{s['strikes']})")
+    placer = getattr(runner, "placer", None)
+    if placer is not None:
+        for i in board.quarantined_set():
+            # bytes, not entry count: a refused request's empty memo
+            # bucket is host bookkeeping; FEED bytes on a condemned
+            # chip are the leak this hunts
+            nbytes = placer.slices[i]._arena.resident_bytes()
+            if nbytes:
+                raise InvariantViolation(
+                    f"quarantined slice {i} still holds {nbytes} "
+                    f"resident feed byte(s) — the drain leaked")
+
+
+def check_mesh_serves_degraded(records, device_floor: float = 0.5
+                               ) -> None:
+    """Elastic mesh degrade contract: while a chip is quarantined the
+    system keeps SERVING — zero wrong results, zero late acks, and at
+    least ``device_floor`` of the warm stream still answers from the
+    device backend (surviving slices / healthy submesh), because
+    "everything falls back to host" is not a survivable steady state
+    (the host link cannot absorb a mesh's traffic — Jouppi cost model).
+
+    ``records``: one dict per warm request observed DURING the degrade,
+    ``{"backend": "device"|"host", "wrong": bool, "late": bool}``.
+    """
+    if not records:
+        raise InvariantViolation("no requests observed during degrade")
+    for i, r in enumerate(records):
+        if r.get("wrong"):
+            raise InvariantViolation(
+                f"request {i} returned a WRONG result during mesh "
+                "degrade")
+        if r.get("late"):
+            raise InvariantViolation(
+                f"request {i} was acknowledged after its deadline "
+                "during mesh degrade")
+    dev = sum(1 for r in records if r.get("backend") == "device")
+    frac = dev / len(records)
+    if frac < device_floor:
+        raise InvariantViolation(
+            f"only {frac:.0%} ({dev}/{len(records)}) of warm requests "
+            f"served from the device during degrade (floor "
+            f"{device_floor:.0%}) — the mesh collapsed to the host "
+            "rung instead of its healthy submesh")
+
+
 def check_goodput(results, floor: float) -> None:
     """The served fraction stays above ``floor`` during the brownout —
     fail-slow must not degrade into fail-stop."""

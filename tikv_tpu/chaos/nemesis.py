@@ -32,9 +32,27 @@ FAULT_KINDS = ("partition", "asym_partition", "leader_isolate",
 # degrade to the host pipeline; shard_launch arms device::shard_launch
 # so a fraction of SHARDED mesh dispatches fail one shard's enqueue —
 # the whole plan must degrade to host (never a partial per-shard
-# answer) without wedging the serialized dispatch stream
+# answer) without wedging the serialized dispatch stream; slice_dead
+# arms device::slice_dead PERSISTENTLY against one slice (a chip gone
+# for the fault's whole duration — the failure-domain supervisor must
+# quarantine it, drain its anchors, downsize whole-mesh serving to the
+# largest healthy submesh, and re-admit after heal); chip_flap arms
+# the same site at a percentage AND faults the degrade path itself
+# (device::mesh_rebuild) — the nastiest mix: strikes accumulate and
+# decay while the downsize that would route around them intermittently
+# fails to the host rung; device_degrade arms one of the plain
+# degrade-to-host sites (DEGRADE_SITES) at a percentage so every
+# device::* site sees nemesis traffic
 DEVICE_FAULT_KINDS = ("hbm_squeeze", "feed_corrupt", "d2h_corrupt",
-                      "shard_launch")
+                      "shard_launch", "slice_dead", "chip_flap",
+                      "device_degrade")
+
+# the plain degrade-to-host failpoint sites the device_degrade nemesis
+# rotates over; the remaining device::* sites have dedicated kinds
+# above (the inventory test asserts the union covers EVERY device::*
+# site in the tree, so a new site needs a nemesis before it ships)
+DEGRADE_SITES = ("device::before_feed_upload", "device::before_dispatch",
+                 "device::before_fetch", "device::mvcc_resolve")
 
 # crash boundaries: a ``panic`` here unwinds out of the drive loop like
 # a process kill at that point of the write path (the same boundaries
@@ -58,8 +76,10 @@ def _mk(kind: str, **params) -> Fault:
 
 def generate_schedule(seed: int, steps: int,
                       kinds: Sequence[str] = FAULT_KINDS,
-                      n_stores: int = 3) -> list[Fault]:
-    """Derive a reproducible fault schedule from one seed."""
+                      n_stores: int = 3,
+                      n_slices: int = 8) -> list[Fault]:
+    """Derive a reproducible fault schedule from one seed.
+    ``n_slices`` bounds the slice indices chip-death faults target."""
     rng = random.Random(seed)
     stores = list(range(1, n_stores + 1))
     out: list[Fault] = []
@@ -94,6 +114,14 @@ def generate_schedule(seed: int, steps: int,
             out.append(_mk(kind, pct=rng.choice((25, 50, 100))))
         elif kind == "shard_launch":
             out.append(_mk(kind, pct=rng.choice((25, 50, 100))))
+        elif kind == "slice_dead":
+            out.append(_mk(kind, slice=rng.randrange(n_slices)))
+        elif kind == "chip_flap":
+            out.append(_mk(kind, slice=rng.randrange(n_slices),
+                           pct=rng.choice((25, 50, 75))))
+        elif kind == "device_degrade":
+            out.append(_mk(kind, site=rng.choice(DEGRADE_SITES),
+                           pct=rng.choice((25, 50, 100))))
         else:   # pragma: no cover
             raise ValueError(kind)
     return out
@@ -191,6 +219,37 @@ class Nemesis:
         failpoint.cfg("device::shard_launch", f"{pct}%return")
         self._heals.append(
             lambda: failpoint.remove("device::shard_launch"))
+
+    def _apply_slice_dead(self, fault: Fault) -> None:
+        """Persistent chip death: every dispatch/fetch/canary touching
+        the targeted slice fails until heal.  The failure-domain
+        supervisor must quarantine it, drain its placed anchors,
+        downsize whole-mesh sharded serving (healthy_submesh), rescue
+        in-flight work — and only RE-ADMIT after this heals."""
+        failpoint.cfg("device::slice_dead",
+                      f"return({fault.param('slice', 0)})")
+        self._heals.append(
+            lambda: failpoint.remove("device::slice_dead"))
+
+    def _apply_chip_flap(self, fault: Fault) -> None:
+        """Flapping chip: the slice dies intermittently (pct%) while
+        the mesh-degrade path ITSELF faults some of the time — strikes
+        accumulate and decay, half-open probes race re-deaths, and a
+        failed rebuild must land on the host rung, never wedge."""
+        pct = fault.param("pct", 50)
+        failpoint.cfg("device::slice_dead",
+                      f"{pct}%return({fault.param('slice', 0)})")
+        failpoint.cfg("device::mesh_rebuild", f"{min(pct, 25)}%return")
+        self._heals.append(
+            lambda: (failpoint.remove("device::slice_dead"),
+                     failpoint.remove("device::mesh_rebuild")))
+
+    def _apply_device_degrade(self, fault: Fault) -> None:
+        """One plain degrade-to-host site (DEGRADE_SITES) fires at a
+        percentage — the answer must stay correct, just host-served."""
+        site = fault.param("site", DEGRADE_SITES[0])
+        failpoint.cfg(site, f"{fault.param('pct', 100)}%return")
+        self._heals.append(lambda s=site: failpoint.remove(s))
 
     def _apply_disk_stall(self, fault: Fault) -> None:
         ms = fault.param("ms", 5)

@@ -128,6 +128,17 @@ class CoprocessorConfig:
     mesh_shape: Optional[str] = None
     device_placement: bool = False
     placement_rows: int = 1 << 22
+    # chip failure domains (device/supervisor.py SliceHealth): strikes
+    # to quarantine a mesh slice (dispatch/fetch faults and scrub
+    # quarantines weigh 1.0, launch-latency outliers 0.25; served
+    # requests decay 0.5), the half-open canary-probe cooldown after a
+    # trip, and the round-trip latency above which a served request
+    # still counts as an outlier strike (0 disables the latency feed —
+    # cold compiles on slow transports would otherwise strike healthy
+    # slices)
+    slice_trip_strikes: float = 3.0
+    slice_probe_cooldown_s: float = 0.25
+    slice_latency_outlier_s: float = 0.0
 
 
 @dataclass

@@ -31,6 +31,19 @@ balance-region operator.  Feeds above ``whole_mesh_rows`` bypass
 placement and shard over the full mesh (scale-up wins past the point
 where one chip's HBM pass dominates the launch overhead).
 
+A slice is NOT assumed healthy forever.  The placer shares the
+runner's :class:`~.supervisor.SliceHealthBoard` (dispatch/fetch
+faults, scrub quarantines and latency outliers strike per-slice
+scores, PR 3's slow-store shape): a QUARANTINED slice stops being
+scored — ``pick_slice`` excludes it, its sticky anchors DRAIN onto
+healthy slices through the same re-pin machinery rebalance uses
+(spread via ``pd.scheduler.drain_receivers``, feeds dropped through
+the PR 6 retirement path), and routing that still finds an anchor
+pinned to a dead slice fails it over on the spot.  Half-open canary
+probes re-admit the slice with a DECAYED (not reset) score, so the
+health penalty in the placement blend lets anchors trickle back —
+never a thundering re-pin.
+
 The placer is OFF by default (``DeviceRunner(placement=False)``) —
 single-chip deployments and whole-mesh benches never pay the routing
 indirection; ``coprocessor.device_placement`` turns it on for serving
@@ -45,7 +58,12 @@ import weakref
 from typing import Optional
 
 from ..parallel import make_mesh, mesh_slices
-from ..pd.scheduler import pick_slice, rebalance_donor, slice_scores
+from ..pd.scheduler import (
+    drain_receivers,
+    pick_slice,
+    rebalance_donor,
+    slice_scores,
+)
 
 # feeds at or above this many rows shard over the WHOLE mesh instead of
 # pinning to one slice: one chip's HBM pass over 4M+ rows costs more
@@ -74,8 +92,11 @@ class SlicePlacer:
         self._parent = parent
         self.whole_mesh_rows = whole_mesh_rows
         self._mu = threading.Lock()
-        self._slices = [parent._make_slice_runner(make_mesh(devs))
-                        for devs in mesh_slices(parent._mesh)]
+        self._slices = [parent._make_slice_runner(make_mesh(devs),
+                                                  slice_indices=(i,),
+                                                  bind_health=True)
+                        for i, devs in
+                        enumerate(mesh_slices(parent._mesh))]
         if parent._arena.budget_bytes > 0:
             # a budget passed at parent CONSTRUCTION must bind the
             # slices too, not only the set_hbm_budget() path
@@ -90,6 +111,13 @@ class SlicePlacer:
         self.places = 0
         self.moves = 0
         self.whole_mesh_routes = 0
+        # chip failure domains: the parent's health board scores these
+        # same slices; a trip drains the dead slice's anchors here
+        self._board = parent._board
+        self.failovers = 0
+        self.drained = 0
+        if self._board is not None:
+            self._board.add_trip_listener(self._on_slice_trip)
 
     def __len__(self) -> int:
         return len(self._slices)
@@ -115,10 +143,21 @@ class SlicePlacer:
                for i, r in enumerate(self._slices)}
         mx_b = max(occ.values(), default=0) or 1
         mx_l = max(self._load, default=0.0) or 1.0
-        return slice_scores({i: b / mx_b for i, b in occ.items()},
-                            {i: v / mx_l
-                             for i, v in enumerate(self._load)},
-                            len(self._slices))
+        scores = slice_scores({i: b / mx_b for i, b in occ.items()},
+                              {i: v / mx_l
+                               for i, v in enumerate(self._load)},
+                              len(self._slices))
+        if self._board is not None:
+            # health penalty: a freshly-readmitted slice carries a
+            # decayed-but-high strike score, so new placements trickle
+            # back instead of thundering onto a chip that just flapped
+            scores = [s + self._board.penalty(i)
+                      for i, s in enumerate(scores)]
+        return scores
+
+    def _dead_locked(self) -> frozenset:
+        return self._board.quarantined_set() \
+            if self._board is not None else frozenset()
 
     # -- routing ------------------------------------------------------
 
@@ -148,22 +187,47 @@ class SlicePlacer:
                 self._slices[idx].drop_feed(anchor, reason="placement")
             m.DEVICE_PLACEMENT_COUNTER.labels("whole_mesh").inc()
             return self._parent
+        # half-open probing rides routing: a quarantined slice whose
+        # cooldown elapsed gets its canary now (bounded by the board's
+        # per-slice probe gate — cheap when nothing is due)
+        self._parent.probe_quarantined()
         key = id(anchor)
+        failover_from = None
         with self._mu:
+            dead = self._dead_locked()
             idx = self._placed.get(key)
+            if idx is not None and idx in dead and \
+                    len(dead) < len(self._slices):
+                # the anchor's slice died since it was placed (or the
+                # trip-time drain raced this request): fail it over to
+                # a healthy slice NOW — its feed rebuilds there.
+                # Total mesh death keeps the pin instead: pick_slice's
+                # all-excluded fallback would just re-pin onto another
+                # dead slice every request (a failover storm in the
+                # counters); the refusal gate host-serves until a
+                # probe re-admits something
+                failover_from = idx
+                idx = None
             if idx is None:
-                idx = pick_slice(self._scores_locked())
+                idx = pick_slice(self._scores_locked(), exclude=dead)
                 try:
                     self._refs[key] = weakref.ref(
                         anchor, lambda _r, k=key: self._forget(k))
                 except TypeError:
                     return self._parent      # untrackable anchor
                 self._placed[key] = idx
-                self.places += 1
-                m.DEVICE_PLACEMENT_COUNTER.labels("place").inc()
+                if failover_from is None:
+                    self.places += 1
+                    m.DEVICE_PLACEMENT_COUNTER.labels("place").inc()
+                else:
+                    self.failovers += 1
             self._load[idx] += 1.0
             self._routes += 1
             rebalance = self._routes % REBALANCE_EVERY == 0
+        if failover_from is not None:
+            self._slices[failover_from].drop_feed(anchor,
+                                                  reason="failover")
+            m.DEVICE_FAILOVER_COUNTER.labels("failover").inc()
         if rebalance:
             self.rebalance()
         return self._slices[idx]
@@ -182,6 +246,41 @@ class SlicePlacer:
     def forget(self, anchor) -> None:
         self._forget(id(anchor))
 
+    # -- failure-domain drain -----------------------------------------
+
+    def _on_slice_trip(self, idx: int, reason: str) -> None:
+        """Board trip listener: drain every anchor stuck to the dead
+        slice — re-pin each onto a healthy slice (least-loaded-first
+        round-robin via ``drain_receivers``, the evict-slow-store
+        spread, NOT a single-receiver dump) and drop its device feeds
+        through the retirement path.  The next request per anchor
+        rebuilds its feed on the new slice; answers stay correct
+        throughout because a rebuild is just a cold hit."""
+        from ..utils import metrics as m
+        with self._mu:
+            victims = [k for k, v in self._placed.items() if v == idx]
+            if not victims:
+                return
+            dead = self._dead_locked() | {idx}
+            targets = drain_receivers(self._scores_locked(),
+                                      exclude=dead, k=len(victims))
+            anchors = []
+            for j, k in enumerate(victims):
+                if targets:
+                    self._placed[k] = targets[j]
+                # no healthy receiver (total mesh death): keep the
+                # pin — route-time failover re-pins when a slice
+                # re-admits — but the feeds below STILL drop: HBM
+                # state on a condemned chip is garbage either way
+                ref = self._refs.get(k)
+                a = ref() if ref is not None else None
+                if a is not None:
+                    anchors.append(a)
+            self.drained += len(victims)
+        for a in anchors:
+            self._slices[idx].drop_feed(a, reason="failover")
+        m.DEVICE_FAILOVER_COUNTER.labels("drain").inc(len(victims))
+
     # -- rebalance ----------------------------------------------------
 
     def rebalance(self) -> bool:
@@ -199,6 +298,12 @@ class SlicePlacer:
             if pair is None:
                 return False
             hot, cool = pair
+            if cool in self._dead_locked():
+                # never balance ONTO a quarantined slice (its health
+                # penalty usually keeps it off the cool end, but a
+                # fully-loaded mesh can tie) — the drain already moved
+                # its anchors the other way
+                return False
             donor = self._slices[hot]
             victim = None
             v_stats = None
@@ -255,15 +360,19 @@ class SlicePlacer:
             for idx in self._placed.values():
                 if 0 <= idx < len(placed):
                     placed[idx] += 1
+            dead = self._dead_locked()
             out = {
                 "slices": [
                     {"resident_bytes": r._arena.resident_bytes(),
                      "resident_lines": r._arena.resident_lines(),
                      "load": loads[i],
-                     "placed_anchors": placed[i]}
+                     "placed_anchors": placed[i],
+                     "quarantined": i in dead}
                     for i, r in enumerate(self._slices)],
                 "places": self.places,
                 "moves": self.moves,
                 "whole_mesh_routes": self.whole_mesh_routes,
+                "failovers": self.failovers,
+                "drained": self.drained,
             }
         return out

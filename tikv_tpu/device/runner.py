@@ -353,6 +353,16 @@ class DeferredResult:
         try:
             r = self._runner._finish(self._pending)
         except _FallbackToHost:
+            # fetch-side fault: strike the slice's health score, then —
+            # if the slice is actually DEAD (quarantined, or the
+            # persistent slice_dead fault names it) — rescue the
+            # request onto a healthy slice/submesh before falling to
+            # the host rung.  The pin release in result()'s finally is
+            # untouched either way: exactly-once, never doubled.
+            self._runner._note_slice_fault("fetch")
+            rescued = self._runner._rescue(self._dag, self._storage)
+            if rescued is not None:
+                return rescued
             from ..executors.runner import BatchExecutorsRunner
             return BatchExecutorsRunner(self._dag,
                                         self._storage).handle_request()
@@ -393,6 +403,10 @@ class _GroupPending:
                     self._memo = ("ok",
                                   self._runner._finish(self._pending))
                 except BaseException as e:  # noqa: BLE001 — memoized
+                    if isinstance(e, _FallbackToHost):
+                        # one strike for the shared fetch, not one per
+                        # member resolution (the memo re-raises N times)
+                        self._runner._note_slice_fault("fetch")
                     self._memo = ("err", e)
                 finally:
                     self._unpin()
@@ -438,7 +452,19 @@ class _BatchedSelectionGroup:
 
     def member_result(self, i: int):
         from ..utils import tracker
-        counts, packed, n = self._gp.fetch()
+        try:
+            counts, packed, n = self._gp.fetch()
+        except _FallbackToHost:
+            # the group's slice died between dispatch and fetch: rescue
+            # THIS member on a healthy slice — per member, so no member
+            # ever fails (or host-degrades) for a group-mate's fault it
+            # could survive; the shared pin was already released
+            # exactly once inside the memoized fetch
+            dag, storage = self._members[i]
+            rescued = self._runner._rescue(dag, storage)
+            if rescued is not None:
+                return rescued
+            raise       # the endpoint's per-member host degrade applies
         dag, storage = self._members[i]
         runner = self._runner
         plan = runner._analyze(dag)
@@ -469,7 +495,10 @@ class DeviceRunner:
                  max_topn_limit: int = 1 << 14,
                  hbm_budget_bytes: int = 0,
                  placement: bool = False,
-                 placement_rows: Optional[int] = None):
+                 placement_rows: Optional[int] = None,
+                 slice_trip_strikes: Optional[float] = None,
+                 slice_probe_cooldown_s: Optional[float] = None,
+                 slice_latency_outlier_s: Optional[float] = None):
         # int64 accumulators are required for exact SUM/COUNT over 1e8
         # rows; jax defaults to 32-bit.  Values stay int32/float32 on
         # device, only accumulators widen.  (Set here, not at import, so
@@ -509,6 +538,36 @@ class DeviceRunner:
         self._init_args = {"chunk_rows": chunk_rows,
                            "max_hash_capacity": max_hash_capacity,
                            "max_topn_limit": max_topn_limit}
+        # -- chip failure domains (device/supervisor.py SliceHealth) --
+        # The whole-mesh runner owns ONE health board covering its
+        # slices; per-slice sub-runners (placement) and degraded
+        # submesh runners strike the SAME board through these links:
+        #   _health          this runner IS one slice (placement slice)
+        #   _failover_parent the runner whose front door serves rescues
+        #   _slice_indices   the PARENT-mesh flat indices of my devices
+        #                    (what device::slice_dead's argument names)
+        self._health = None
+        self._failover_parent = None
+        self._slice_indices = tuple(range(num_shards(self._mesh)))
+        from .supervisor import (
+            DEFAULT_PROBE_COOLDOWN_S,
+            DEFAULT_TRIP_STRIKES,
+            SliceHealthBoard,
+        )
+        self._board = SliceHealthBoard(
+            num_shards(self._mesh),
+            trip_strikes=slice_trip_strikes
+            if slice_trip_strikes is not None else DEFAULT_TRIP_STRIKES,
+            cooldown_s=slice_probe_cooldown_s
+            if slice_probe_cooldown_s is not None
+            else DEFAULT_PROBE_COOLDOWN_S,
+            latency_outlier_s=slice_latency_outlier_s) \
+            if not self._single else None
+        # elastic mesh degrade: (frozenset(dead slices), sub-runner)
+        # serving whole-mesh plans on the largest healthy submesh while
+        # a chip is quarantined; None = full mesh healthy
+        self._degraded: Optional[tuple] = None
+        self._degrade_mu = threading.Lock()
         self._plan_cache: dict = {}
         self._kernel_cache: dict = {}
         # dispatch serialization: two threads launching multi-device
@@ -571,15 +630,298 @@ class DeviceRunner:
         from ..utils.metrics import DEVICE_MESH_SHARDS
         DEVICE_MESH_SHARDS.set(num_shards(self._mesh))
 
-    def _make_slice_runner(self, mesh) -> "DeviceRunner":
-        """A single-device sub-runner for one placement slice, tuned
-        like the parent (chunk override, capacities); the placer owns
-        per-slice HBM budget splits."""
-        return DeviceRunner(mesh=mesh, **self._init_args)
+    def _make_slice_runner(self, mesh, slice_indices=None,
+                           bind_health: bool = False) -> "DeviceRunner":
+        """A sub-runner over a subset of this runner's chips: one
+        placement slice (single device) or a degraded healthy submesh.
+        Tuned like the parent (chunk override, capacities); the placer
+        owns per-slice HBM budget splits.  ``slice_indices`` are the
+        PARENT-mesh flat indices of ``mesh``'s devices — the identity
+        ``device::slice_dead`` targets and the health board scores; the
+        sub-runner strikes the parent's board, never a private one.
+
+        ``bind_health`` (placement slices only): attribute this
+        runner's per-request faults/latency to its slice's score.  A
+        DEGRADED submesh runner must NOT bind even at 1 device — its
+        requests are whole-mesh plans squeezed onto survivors, whose
+        inherently-higher latency would strike (and eventually condemn)
+        the last healthy chip for doing its job."""
+        sub = DeviceRunner(mesh=mesh, **self._init_args)
+        sub._failover_parent = self
+        if slice_indices is not None:
+            sub._slice_indices = tuple(slice_indices)
+            if bind_health and len(slice_indices) == 1 and \
+                    self._board is not None:
+                sub._health = self._board.slice(slice_indices[0])
+        # one board per PHYSICAL mesh: the sub-runner must not route
+        # its own degrade ladder — the parent owns that decision
+        sub._board = None
+        return sub
 
     @property
     def placer(self):
         return self._placer
+
+    # ------------------------------------------------ chip failure domains
+    #
+    # Each mesh slice is a failure domain, scored like PR 3 scores a
+    # store (device/supervisor.py SliceHealth): dispatch faults, fetch
+    # faults, scrub quarantines and launch-latency outliers strike; a
+    # tripped slice is quarantined — placement drains its anchors,
+    # whole-mesh sharded plans rebuild on the largest healthy submesh
+    # (8→4→2→1; parallel.mesh.healthy_submesh), in-flight work rescues
+    # onto survivors — and a half-open canary re-admits it.  Host is
+    # the degrade ladder's FINAL rung only.
+
+    def _strike_board(self):
+        """The board slice-attributable faults land on: my own for the
+        whole-mesh runner, the parent's for slice/submesh runners
+        (``_health`` owners strike through the outer fault handler
+        instead, so one request never double-counts)."""
+        if self._board is not None:
+            return self._board
+        p = self._failover_parent
+        return p._board if p is not None else None
+
+    def _slice_dead_targets(self, indices=None) -> tuple:
+        """My slice indices the ``device::slice_dead`` failpoint
+        currently names, () when unarmed.  Argument grammar:
+        ``return(i)`` / ``return(i j)`` kills specific slices, a bare
+        ``return`` kills every slice (whole-device death); percent
+        prefixes make the chip FLAP instead of staying dead."""
+        from ..utils.failpoint import fail_point
+        fp = fail_point("device::slice_dead")
+        if fp is None:
+            return ()
+        mine = tuple(indices) if indices is not None \
+            else self._slice_indices
+        v = getattr(fp, "value", None)
+        if v is None or not str(v).strip():
+            return mine
+        try:
+            targets = {int(t) for t in
+                       str(v).replace(",", " ").split()}
+        except ValueError:
+            return mine
+        return tuple(i for i in mine if i in targets)
+
+    def _note_slice_fault(self, kind: str) -> None:
+        if self._health is not None:
+            if self._health.note_fault(kind):
+                board = self._strike_board()
+                if board is not None:
+                    board._fire_trip(self._health.idx, kind)
+
+    def _note_slice_ok(self, latency_s: Optional[float] = None) -> None:
+        h = self._health
+        if h is not None:
+            if h.note_ok(latency_s):
+                # a latency-outlier strike can be the tripping one:
+                # the drain/degrade listeners must fire for it exactly
+                # as for a hard fault
+                board = self._strike_board()
+                if board is not None:
+                    board._fire_trip(h.idx, "latency")
+            return
+        # whole-mesh / degraded-submesh runner: a served sharded
+        # request ran on EVERY one of my slices — decay them all, so a
+        # re-admitted chip earns its score back under mesh traffic too
+        # (latency stays None here: a whole-mesh round trip cannot
+        # attribute an outlier to one chip, and striking all of them
+        # would let one slow request condemn the entire mesh)
+        board = self._strike_board()
+        if board is not None:
+            for i in self._slice_indices:
+                board.slice(i).note_ok()
+
+    def _refuse_if_quarantined(self) -> bool:
+        """Early dispatch gate: a QUARANTINED slice refuses the request
+        before it touches ANY per-slice state (no arena bucket, no feed
+        upload, no launch — launching on a dead chip would hang the
+        stream; check_no_quarantined_dispatch counts on this gate).
+        → True when the caller must serve from the host pipeline."""
+        from ..utils import metrics as m
+        h = self._health
+        if h is not None and h.quarantined():
+            h.refusals += 1
+            m.DEVICE_FAILOVER_COUNTER.labels("refused_dispatch").inc()
+            return True
+        return False
+
+    def _preflight_slice(self) -> None:
+        """Dispatch-site gate: a slice the ``device::slice_dead``
+        failpoint names fails the dispatch the way the dead chip
+        would (the quarantine refusal ran earlier, before any
+        per-slice state was touched)."""
+        hit = self._slice_dead_targets()
+        if hit:
+            if self._health is None:
+                board = self._strike_board()
+                if board is not None:
+                    for i in hit:
+                        board.note_fault(i, "dispatch")
+            # _health owners strike once in the outer fault handler
+            raise _FallbackToHost("device::slice_dead")
+
+    def _canary(self, idx: int) -> bool:
+        """One cheap half-open probe of slice ``idx``: a trivial
+        committed computation through the real runtime, gated by the
+        same slice_dead failpoint a live dispatch would hit — a
+        persistently-dead chip keeps failing its canary until the
+        fault lifts."""
+        try:
+            if self._slice_dead_targets(indices=(idx,)):
+                return False
+            pos = self._slice_indices.index(idx) \
+                if idx in self._slice_indices else idx
+            dev = self._mesh.devices.flat[pos]
+            x = jax.device_put(np.arange(8, dtype=np.int64), dev)
+            return int(np.asarray(jnp.sum(x))) == 28
+        except Exception:   # noqa: BLE001 — any runtime error = dead
+            return False
+
+    def probe_quarantined(self) -> int:
+        """Half-open probing for quarantined slices (the supervisor's
+        scrub loop and the routing paths call this opportunistically;
+        the board's per-slice cooldown + single-probe gate bound the
+        work).  → probes run."""
+        if self._board is None:
+            return 0
+        return self._board.maybe_probe(self._canary)
+
+    def _degraded_sub(self) -> Optional["DeviceRunner"]:
+        """Locked snapshot of the current degraded-submesh runner (the
+        one surface stats/budget/teardown fold it through), or None."""
+        with self._degrade_mu:
+            return self._degraded[1] if self._degraded is not None \
+                else None
+
+    def _degraded_target(self) -> Optional["DeviceRunner"]:
+        """The runner whole-mesh plans should use right now: a sub-
+        runner over the largest healthy submesh while any slice is
+        quarantined (8→4→2→1 — re-minting sharded feeds from host
+        truth onto the survivors), self's own mesh when healthy.
+        Raises _FallbackToHost when no healthy submesh exists or the
+        rebuild itself faults (``device::mesh_rebuild``) — host is the
+        final rung of the ladder, never the first."""
+        board = self._board
+        if board is None:
+            return None
+        self.probe_quarantined()
+        dead = board.quarantined_set()
+        from ..utils import metrics as m
+        from ..utils import tracker
+        with self._degrade_mu:
+            if not dead:
+                if self._degraded is not None:
+                    # every slice re-admitted: the full mesh takes over
+                    # and the submesh feeds release their HBM (the full
+                    # mesh re-mints from host truth on first touch)
+                    old = self._degraded[1]
+                    self._degraded = None
+                    old._arena.drop_all(reason="drop")
+                    m.DEVICE_FAILOVER_COUNTER.labels(
+                        "mesh_restore").inc()
+                return None
+            key = frozenset(dead)
+            if self._degraded is None or self._degraded[0] != key:
+                _fp_degrade("device::mesh_rebuild")
+                from ..parallel import healthy_submesh
+                devs = healthy_submesh(self._mesh, dead)
+                if devs is None:
+                    raise _FallbackToHost("no healthy submesh")
+                flat = list(self._mesh.devices.flat)
+                gidx = tuple(flat.index(d) for d in devs)
+                with tracker.phase("mesh_rebuild"):
+                    sub = self._make_slice_runner(
+                        make_mesh(devs), slice_indices=gidx)
+                    sub._arena.budget_bytes = self._arena.budget_bytes
+                if self._degraded is not None:
+                    self._degraded[1]._arena.drop_all(reason="failover")
+                # the full-mesh feeds span the dead chip — useless now;
+                # in-flight dispatches keep their own buffer references
+                self._arena.drop_all(reason="failover")
+                self._degraded = (key, sub)
+                m.DEVICE_FAILOVER_COUNTER.labels("mesh_downsize").inc()
+            return self._degraded[1]
+
+    def _rescue(self, dag: DAGRequest, storage):
+        """In-flight rescue: a request whose slice died between
+        dispatch and fetch retries ONCE through the failover root's
+        front door — the placer re-pins its anchor onto a healthy
+        slice, or the degraded submesh serves it — instead of burning
+        the host rung on a provably-dead chip.  → a finished
+        SelectResult, or None when this runner is not actually sick
+        (the ordinary host-degrade contract then applies unchanged).
+        Never touches this runner's pins: the caller's exactly-once
+        unpin discipline stands."""
+        from ..utils import metrics as m
+        from ..utils import tracker
+        try:
+            h = self._health
+            hit = self._slice_dead_targets()
+            sick = h is not None and h.quarantined()
+            if hit:
+                sick = True
+                if h is not None:
+                    # a targeted persistent death needs no three-strike
+                    # deliberation: trip now so the placer drains and
+                    # the retry routes around this slice
+                    board = self._strike_board()
+                    if h.trip("slice_dead") and board is not None:
+                        board._fire_trip(h.idx, "slice_dead")
+                else:
+                    board = self._strike_board()
+                    if board is not None:
+                        for i in hit:
+                            board.trip(i, "slice_dead")
+            if not sick and self._board is not None and \
+                    self._board.quarantined_set():
+                sick = True     # mesh already degraded: reroute
+            if not sick:
+                return None
+            target = self._failover_parent
+            if target is None:
+                target = self if self._board is not None else None
+            if target is None:
+                return None
+            m.DEVICE_FAILOVER_COUNTER.labels("rescue").inc()
+            tracker.label("device_rescue", "slice_failover")
+            return target.handle_request(dag, storage)
+        except Exception:   # noqa: BLE001 — rescue is best-effort;
+            return None     # the host rung follows
+
+    def failure_domain_stats(self) -> dict:
+        """Per-slice health + degrade rollup (/health device_health)."""
+        out: dict = {"n_slices": len(self._slice_indices),
+                     "slices": self._board.stats()
+                     if self._board is not None else []}
+        with self._degrade_mu:
+            if self._degraded is not None:
+                dead, sub = self._degraded
+                out["degraded"] = {
+                    "dead_slices": sorted(dead),
+                    "healthy_devices": num_shards(sub._mesh)}
+        return out
+
+    def close(self) -> None:
+        """Teardown: drop every device-resident line (node.stop()
+        orders this after the endpoint/completion pool drain, so pins
+        are already released), retire any degraded submesh runner, and
+        clear quarantine state — an in-process restart starts clean
+        with no leaked HBM accounting.  Idempotent."""
+        if self._placer is not None:
+            for r in self._placer.slices:
+                r.close()
+        with self._degrade_mu:
+            if self._degraded is not None:
+                self._degraded[1].close()
+                self._degraded = None
+        self._arena.drop_all(reason="drop")
+        with self._quar_mu:
+            self._quarantined.clear()
+        if self._board is not None:
+            self._board.reset()
 
     def mesh_stats(self) -> dict:
         """Mesh shape + placement rollup for /health."""
@@ -1294,30 +1636,42 @@ class DeviceRunner:
         self._arena.enforce()
         if self._placer is not None:
             self._placer.set_hbm_budget(int(nbytes))
+        degraded = self._degraded_sub()
+        if degraded is not None:
+            degraded._arena.budget_bytes = int(nbytes)
+            degraded._arena.enforce()
 
     def hbm_stats(self) -> dict:
         out = self._arena.stats()
         with self._quar_mu:
             out["quarantined"] = len(self._quarantined)
-        if self._placer is not None:
-            # node-level rollup: the budget invariant is judged against
-            # ALL device-resident bytes, wherever the anchor is pinned
-            for r in self._placer.slices:
-                sub = r.hbm_stats()
-                for k in ("resident_bytes", "resident_lines",
-                          "pinned_lines", "pinned_bytes", "evictions",
-                          "rejections", "drops", "quarantined"):
-                    out[k] = out.get(k, 0) + sub.get(k, 0)
+        subs = [r for r in self._placer.slices] \
+            if self._placer is not None else []
+        degraded = self._degraded_sub()
+        if degraded is not None:
+            subs.append(degraded)
+        # node-level rollup: the budget invariant is judged against
+        # ALL device-resident bytes, wherever the anchor is pinned —
+        # placement slices and any degraded submesh runner included
+        for r in subs:
+            sub = r.hbm_stats()
+            for k in ("resident_bytes", "resident_lines",
+                      "pinned_lines", "pinned_bytes", "evictions",
+                      "rejections", "drops", "quarantined"):
+                out[k] = out.get(k, 0) + sub.get(k, 0)
         return out
 
     def arena_items(self) -> list:
         """(anchor, bucket) snapshot for the scrubber — placement
-        slices included, so one scrub pass audits every resident
-        plane on the node."""
+        slices and any degraded submesh runner included, so one scrub
+        pass audits every resident plane on the node."""
         items = self._arena.items()
         if self._placer is not None:
             for r in self._placer.slices:
                 items.extend(r.arena_items())
+        degraded = self._degraded_sub()
+        if degraded is not None:
+            items.extend(degraded.arena_items())
         return items
 
     def drop_feed(self, anchor, reason: str = "drop") -> int:
@@ -1337,6 +1691,9 @@ class DeviceRunner:
         freed = self._arena.drop(anchor, reason=reason)
         if self._placer is not None:
             freed += self._placer.drop_feed_all(anchor, reason)
+        degraded = self._degraded_sub()
+        if degraded is not None:
+            freed += degraded.drop_feed(anchor, reason=reason)
         return freed
 
     def quarantine(self, anchor, reason: str = "") -> None:
@@ -1351,7 +1708,22 @@ class DeviceRunner:
                 owner.quarantine(anchor, reason=reason)
                 return
         from ..utils.metrics import DEVICE_QUARANTINE_COUNTER
+        # a scrub divergence is evidence about the CHIP, not just the
+        # line: strike the slice's failure-domain score too (repeated
+        # corruption on one slice trips it out of placement entirely)
+        self._note_slice_fault("scrub")
         self._arena.drop(anchor, reason="quarantine")
+        degraded = self._degraded_sub()
+        if degraded is not None:
+            # while the mesh is degraded the LIVE feed sits on the
+            # submesh runner — and the degrade branch routes the next
+            # request there BEFORE this runner's quarantine gate can
+            # fire.  The corrupt line must drop (and host-serve its
+            # next request) on the sub too, or the scrubber's verdict
+            # changes nothing about what keeps being served.
+            degraded._arena.drop(anchor, reason="quarantine")
+            with degraded._quar_mu:
+                degraded._quarantined[id(anchor)] = (anchor, reason)
         with self._quar_mu:
             self._quarantined[id(anchor)] = (anchor, reason)
             # bounded: a quarantined region that is never queried again
@@ -2016,6 +2388,17 @@ class DeviceRunner:
         # as a failed fetch: the request degrades to the host pipeline —
         # corrupted bytes never become an answer
         _fp_degrade("device::d2h_corrupt")
+        # a chip that died BETWEEN dispatch and fetch fails the D2H: the
+        # in-flight request rescues onto a healthy slice/submesh
+        # (DeferredResult/_GroupPending catch this) or degrades to host
+        hit = self._slice_dead_targets()
+        if hit:
+            if self._health is None:
+                board = self._strike_board()
+                if board is not None:
+                    for i in hit:
+                        board.note_fault(i, "fetch")
+            raise _FallbackToHost("device::slice_dead")
         # the old monolithic "device_fetch" phase is split so a warm
         # p50 can be attributed from the artifact alone: "d2h_wait" is
         # the transfer + sync (here), "host_materialize" is the host
@@ -2063,9 +2446,39 @@ class DeviceRunner:
             if target is not self:
                 return target.handle_request(dag, storage,
                                              deferred=deferred)
+        if self._board is not None:
+            # elastic mesh degrade: a quarantined chip routes whole-
+            # mesh plans to the largest healthy submesh (8→4→2→1; the
+            # sharded feeds re-mint from host truth onto survivors)
+            # instead of collapsing to host — host stays the FINAL
+            # rung, taken only when the rebuild itself fails
+            try:
+                degraded = self._degraded_target()
+            except _FallbackToHost:
+                from ..executors.runner import BatchExecutorsRunner
+                return BatchExecutorsRunner(dag, storage).handle_request()
+            if degraded is not None:
+                return degraded.handle_request(dag, storage,
+                                               deferred=deferred,
+                                               _stack=_stack)
         plan = self._analyze(dag)
         if plan is None:
             raise RuntimeError("plan not supported by device backend")
+
+        if self._refuse_if_quarantined():
+            if _stack is not None:
+                # a group must not burn the leader's deadline on a
+                # throwaway synchronous host run — the coalescer's
+                # solo retries re-route each member via the placer,
+                # which now excludes this slice
+                raise _BatchUnavailable("slice quarantined")
+            # this slice is a condemned chip: serve from the host
+            # pipeline without touching any per-slice state (a racing
+            # caller that bypassed the placer's exclusion lands here)
+            from ..utils import tracker
+            tracker.label("device_feed", "slice_quarantined")
+            from ..executors.runner import BatchExecutorsRunner
+            return BatchExecutorsRunner(dag, storage).handle_request()
 
         if self._quarantined and hasattr(storage, "scan_columns") and \
                 self._consume_quarantine(self._feed_anchor(storage)):
@@ -2242,6 +2655,10 @@ class DeviceRunner:
         pin_anchor = None
         try:
             _fp_degrade("device::before_dispatch")
+            # chip failure domains: refuse to launch on a quarantined
+            # slice, and fail the way the chip would when
+            # device::slice_dead names one of mine
+            self._preflight_slice()
             dtypes = get_dtypes()
 
             feed_key = (tuple(plan.scan.columns[ci].col_id
@@ -2291,6 +2708,13 @@ class DeviceRunner:
                                                 get_batch, feed, storage,
                                                 stack=_stack)
                 if isinstance(result, _Pending) and \
+                        self._health is not None and \
+                        self._health.quarantined():
+                    # the invariant counter chaos audits: a quarantine
+                    # landing between the preflight gate and the launch
+                    # means a kernel ran on a condemned chip
+                    self._health.launched_quarantined += 1
+                if isinstance(result, _Pending) and \
                         hasattr(storage, "scan_columns"):
                     # pin the line for the in-flight dispatch: budget
                     # eviction (arena.admit, also under this lock) must
@@ -2312,6 +2736,11 @@ class DeviceRunner:
         except _FallbackToHost:
             if pin_anchor is not None:
                 self._arena.unpin(pin_anchor)
+            # a dispatch-side fault on a placement slice strikes its
+            # health score exactly once (the failure-domain feed; the
+            # whole-mesh runner's slice-attributable strikes happen at
+            # the _preflight_slice / _readback sites instead)
+            self._note_slice_fault("dispatch")
             if _stack is not None:
                 # a degrade mid-group must not serve the LEADER's host
                 # answer to every member — the coalescer retries each
@@ -2336,10 +2765,17 @@ class DeviceRunner:
 
     def _finish(self, pending: _Pending):
         """Blocking fetch + host finalize for a dispatched request."""
+        import time as _time
+
         from ..utils import tracker
+        t0 = _time.perf_counter()
         fetched = self._readback(pending.tree)
         with tracker.phase("host_materialize"):
-            return pending.finalize(fetched)
+            out = pending.finalize(fetched)
+        # a served request decays the slice's strike score (and feeds
+        # the launch-latency outlier detector when configured)
+        self._note_slice_ok(_time.perf_counter() - t0)
+        return out
 
     @staticmethod
     def _apply_output_offsets(dag, result):

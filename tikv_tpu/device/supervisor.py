@@ -31,6 +31,18 @@ discipline, PAPERS.md):
   rebuilds a fresh feed from host truth.  ``device::feed_corrupt``
   injects the bit-flip the scrubber exists to catch.
 
+- **failure-domain-aware** — :class:`SliceHealth` /
+  :class:`SliceHealthBoard` treat each mesh slice (one chip) the way
+  the store-level slow-score loop treats a store: dispatch faults,
+  fetch faults, scrub quarantines and launch-latency outliers strike a
+  per-slice score that decays on success; a slice crossing the trip
+  threshold is QUARANTINED — placement stops scoring it, its sticky
+  anchors drain onto healthy slices, whole-mesh sharded feeds rebuild
+  on the largest healthy submesh (``parallel.mesh.healthy_submesh``)
+  — and a half-open canary probe re-admits it with score decay, never
+  a thundering re-pin.  ``device::slice_dead`` injects the persistent
+  chip death this machinery exists to survive.
+
 This module imports no jax at module scope — a Node without a device
 runner can host the supervisor (it still drives columnar cache
 lifecycle teardown) without paying the accelerator runtime import.
@@ -85,6 +97,289 @@ def _bucket_nbytes(bucket: dict) -> int:
         if ss is not None:
             total += int(getattr(ss[3], "nbytes", 0))
     return total
+
+
+# ------------------------------------------------- slice failure domains
+#
+# The store-level control loop (utils/health.py SlowScore rise/decay +
+# CircuitBreaker trip/half-open, pd/scheduler.py evict-slow-store) one
+# level down: each mesh slice — one chip — is a failure domain.  The
+# board is deliberately DUMB policy-wise: it scores, trips and gates
+# probes; the consumers (SlicePlacer drain/exclusion, DeviceRunner's
+# elastic mesh degrade) read ``quarantined_set()`` and act.
+
+# strikes to quarantine.  1.0 per dispatch/fetch fault or scrub
+# quarantine, 0.25 per launch-latency outlier; each clean fetch decays
+# the score by 0.5 — a healthy slice absorbs isolated faults, a dead
+# chip trips within three requests.
+DEFAULT_TRIP_STRIKES = 3.0
+# half-open probe cooldown after a trip (and after a failed probe)
+DEFAULT_PROBE_COOLDOWN_S = 0.25
+
+# live boards, for the tier-1 leak guard (tests/conftest.py): a test
+# must not leave a slice quarantined behind for the next test to trip
+# over.  WeakSet: boards die with their runners.
+_LIVE_BOARDS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_boards() -> list:
+    """Snapshot of every live SliceHealthBoard (conftest leak guard)."""
+    return list(_LIVE_BOARDS)
+
+
+class SliceHealth:
+    """Strike/recovery health score for ONE mesh slice.
+
+    State machine (the trip/drain/probe cycle, README "Device failure
+    domains"):
+
+      healthy --(score >= trip)--> quarantined
+      quarantined --(cooldown, one canary at a time)--> probing
+      probing --success--> healthy (score decayed to trip-1, so the
+                           placement penalty stays high and re-pinning
+                           is gradual — never a thundering herd)
+      probing --failure--> quarantined (cooldown restarts)
+
+    Fault feeds: dispatch faults, fetch faults, scrub quarantines
+    (weight 1.0) and launch-latency outliers (weight 0.25, only when
+    the owner configures ``latency_outlier_s``).  Success decays the
+    score by 0.5 — the SlowScore rise-fast/decay-slow discipline.
+    """
+
+    __slots__ = ("idx", "_mu", "score", "state", "trip_strikes",
+                 "cooldown_s", "latency_outlier_s", "strikes", "trips",
+                 "readmits", "refusals", "probe_failures",
+                 "launched_quarantined", "_opened_at", "_probe_inflight")
+
+    def __init__(self, idx: int,
+                 trip_strikes: float = DEFAULT_TRIP_STRIKES,
+                 cooldown_s: float = DEFAULT_PROBE_COOLDOWN_S,
+                 latency_outlier_s: Optional[float] = None):
+        self.idx = idx
+        self._mu = threading.Lock()
+        self.score = 0.0
+        self.state = "healthy"          # healthy | quarantined
+        self.trip_strikes = trip_strikes
+        self.cooldown_s = cooldown_s
+        self.latency_outlier_s = latency_outlier_s
+        self.strikes: dict = {}
+        self.trips = 0
+        self.readmits = 0
+        # dispatches REFUSED because the slice was quarantined (the
+        # request degraded/rescued instead of launching on a dead chip)
+        self.refusals = 0
+        self.probe_failures = 0
+        # dispatches that LAUNCHED while quarantined — the invariant
+        # chaos asserts stays zero (check_no_quarantined_dispatch)
+        self.launched_quarantined = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+
+    # -- fault/success feeds ------------------------------------------
+
+    def note_fault(self, kind: str, weight: float = 1.0) -> bool:
+        """One strike; → True when this strike TRIPPED the slice."""
+        with self._mu:
+            self.strikes[kind] = self.strikes.get(kind, 0) + 1
+            self.score += weight
+            return self._maybe_trip_locked()
+
+    def trip(self, kind: str) -> bool:
+        """Decisive quarantine (a targeted persistent chip death needs
+        no three-strike deliberation); → True on the transition."""
+        with self._mu:
+            self.strikes[kind] = self.strikes.get(kind, 0) + 1
+            self.score = max(self.score, self.trip_strikes)
+            return self._maybe_trip_locked()
+
+    def _maybe_trip_locked(self) -> bool:
+        if self.state != "healthy" or self.score < self.trip_strikes:
+            return False
+        self.state = "quarantined"
+        self.trips += 1
+        self._opened_at = time.monotonic()
+        self._probe_inflight = False
+        return True
+
+    def note_ok(self, latency_s: Optional[float] = None) -> bool:
+        """A served request: decay the score — or strike fractionally
+        when the launch latency is an outlier (the fail-slow feed).
+        → True when the outlier strike TRIPPED the slice (the caller
+        must fire the board's trip listeners, exactly as for
+        note_fault — a latency-induced quarantine drains like any
+        other)."""
+        # a threshold of None OR <= 0 disables the latency feed (the
+        # config default is 0.0 = off — cold compiles on slow
+        # transports must never strike a healthy slice)
+        if latency_s is not None and self.latency_outlier_s and \
+                self.latency_outlier_s > 0 and \
+                latency_s >= self.latency_outlier_s:
+            return self.note_fault("latency", weight=0.25)
+        with self._mu:
+            if self.state == "healthy":
+                self.score = max(0.0, self.score - 0.5)
+        return False
+
+    # -- half-open probing --------------------------------------------
+
+    def quarantined(self) -> bool:
+        return self.state == "quarantined"
+
+    def try_probe(self) -> bool:
+        """→ True when a canary probe may run NOW: quarantined, the
+        cooldown elapsed, and no other probe is in flight (the
+        CircuitBreaker half-open single-probe discipline)."""
+        with self._mu:
+            if self.state != "quarantined" or self._probe_inflight:
+                return False
+            if time.monotonic() - self._opened_at < self.cooldown_s:
+                return False
+            self._probe_inflight = True
+            return True
+
+    def probe_result(self, ok: bool) -> None:
+        with self._mu:
+            self._probe_inflight = False
+            if self.state != "quarantined":
+                return
+            if ok:
+                self.state = "healthy"
+                # decay, don't reset: the slice re-enters scoring with
+                # a high (but sub-trip) score, so placement re-pins
+                # anchors gradually and one fresh fault re-trips
+                self.score = max(0.0, self.trip_strikes - 1.0)
+                self.readmits += 1
+            else:
+                self.probe_failures += 1
+                self._opened_at = time.monotonic()
+
+    def penalty(self) -> float:
+        """Normalized score for the placement blend (0 healthy …
+        ~1 at the trip threshold)."""
+        with self._mu:
+            return self.score / self.trip_strikes \
+                if self.trip_strikes > 0 else 0.0
+
+    def reset(self) -> None:
+        with self._mu:
+            self.score = 0.0
+            self.state = "healthy"
+            self._probe_inflight = False
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"slice": self.idx,
+                    "score": round(self.score, 3),
+                    "state": self.state,
+                    "strikes": dict(self.strikes),
+                    "trips": self.trips,
+                    "readmits": self.readmits,
+                    "refusals": self.refusals,
+                    "probe_failures": self.probe_failures,
+                    "probe_inflight": self._probe_inflight,
+                    "launched_quarantined": self.launched_quarantined}
+
+
+class SliceHealthBoard:
+    """Per-slice health for one device mesh.
+
+    Owned by the mesh's whole-mesh :class:`~..runner.DeviceRunner`;
+    shared with its :class:`~.placement.SlicePlacer` (the slices are
+    the same chips) and struck by degraded submesh runners through
+    their ``_failover_parent`` back-pointer, so every observation about
+    a chip lands on ONE score wherever it was made.
+    """
+
+    def __init__(self, n_slices: int,
+                 trip_strikes: float = DEFAULT_TRIP_STRIKES,
+                 cooldown_s: float = DEFAULT_PROBE_COOLDOWN_S,
+                 latency_outlier_s: Optional[float] = None):
+        self._slices = [SliceHealth(i, trip_strikes=trip_strikes,
+                                    cooldown_s=cooldown_s,
+                                    latency_outlier_s=latency_outlier_s)
+                        for i in range(n_slices)]
+        self._mu = threading.Lock()
+        self._listeners: list = []
+        _LIVE_BOARDS.add(self)
+
+    def __len__(self) -> int:
+        return len(self._slices)
+
+    def slice(self, i: int) -> SliceHealth:
+        return self._slices[i]
+
+    def add_trip_listener(self, fn) -> None:
+        """``fn(idx, reason)`` fires on every healthy→quarantined
+        transition, OUTSIDE any board/slice lock (listeners take their
+        own — the placer drains under its placement lock)."""
+        with self._mu:
+            self._listeners.append(fn)
+
+    def _fire_trip(self, idx: int, reason: str) -> None:
+        from ..utils.metrics import DEVICE_FAILOVER_COUNTER
+        DEVICE_FAILOVER_COUNTER.labels("quarantine").inc()
+        with self._mu:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(idx, reason)
+            except Exception:   # noqa: BLE001 — a listener must not
+                pass            # poison the scoring path
+
+    def note_fault(self, idx: int, kind: str,
+                   weight: float = 1.0) -> None:
+        if 0 <= idx < len(self._slices) and \
+                self._slices[idx].note_fault(kind, weight=weight):
+            self._fire_trip(idx, kind)
+
+    def trip(self, idx: int, reason: str) -> None:
+        if 0 <= idx < len(self._slices) and \
+                self._slices[idx].trip(reason):
+            self._fire_trip(idx, reason)
+
+    def quarantined_set(self) -> frozenset:
+        return frozenset(i for i, s in enumerate(self._slices)
+                         if s.quarantined())
+
+    def penalty(self, i: int) -> float:
+        return self._slices[i].penalty()
+
+    def maybe_probe(self, canary) -> int:
+        """Run ``canary(idx) -> bool`` for every quarantined slice
+        whose cooldown elapsed (one probe per slice at a time); feed
+        the results back.  → probes run.  Cheap when nothing is due —
+        the callers (placement routing, mesh-degrade routing, the
+        supervisor's scrub loop) invoke it opportunistically."""
+        from ..utils.metrics import DEVICE_FAILOVER_COUNTER
+        ran = 0
+        for s in self._slices:
+            if not s.try_probe():
+                continue
+            ran += 1
+            try:
+                ok = bool(canary(s.idx))
+            except Exception:   # noqa: BLE001 — a crashed canary is a
+                ok = False      # failed probe, not a crashed caller
+            s.probe_result(ok)
+            if ok:
+                DEVICE_FAILOVER_COUNTER.labels("readmit").inc()
+            else:
+                DEVICE_FAILOVER_COUNTER.labels("probe_fail").inc()
+        return ran
+
+    def reset(self) -> None:
+        for s in self._slices:
+            s.reset()
+
+    def publish_metrics(self) -> None:
+        from ..utils.metrics import DEVICE_SLICE_HEALTH
+        for s in self._slices:
+            DEVICE_SLICE_HEALTH.labels(str(s.idx)).set(
+                round(s.penalty(), 4))
+
+    def stats(self) -> list:
+        self.publish_metrics()
+        return [s.stats() for s in self._slices]
 
 
 class _ArenaEntry:
@@ -297,6 +592,24 @@ class FeedArena:
                 self._resident -= ent.nbytes
                 self.drops += 1
                 DEVICE_FEED_EVICTION_COUNTER.labels(reason).inc()
+        self._publish()
+        return freed
+
+    def drop_all(self, reason: str = "drop") -> int:
+        """Drop EVERY entry, pins included — the mesh-degrade and node
+        teardown path: a feed sharded over a chip that just died (or a
+        runner being torn down) holds nothing worth protecting, and
+        in-flight dispatches keep their own buffer references alive.
+        Stale pin tokens no-op at unpin (entry gone).  → bytes freed."""
+        from ..utils.metrics import DEVICE_FEED_EVICTION_COUNTER
+        with self._mu:
+            freed = self._resident
+            n = len(self._entries)
+            self._entries.clear()
+            self._resident = 0
+            self.drops += n
+            if n:
+                DEVICE_FEED_EVICTION_COUNTER.labels(reason).inc(n)
         self._publish()
         return freed
 
@@ -595,6 +908,17 @@ class DeviceStateSupervisor(Observer):
                 import logging
                 logging.getLogger(__name__).warning(
                     "device scrub pass failed", exc_info=True)
+            # half-open probing for quarantined mesh slices rides the
+            # same cadence: a re-admission must not wait for traffic
+            # (the on-route probes) when the node has gone idle
+            probe = getattr(self._runner, "probe_quarantined", None)
+            if callable(probe):
+                try:
+                    probe()
+                except Exception:   # noqa: BLE001 — same contract
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "slice probe pass failed", exc_info=True)
 
     # -- observability ------------------------------------------------
 

@@ -4,6 +4,7 @@ from .mesh import (
     RANGE_AXIS,
     ROW_AXES,
     TILE_AXIS,
+    healthy_submesh,
     make_mesh,
     mesh_slices,
     num_shards,
@@ -17,6 +18,7 @@ __all__ = [
     "RANGE_AXIS",
     "ROW_AXES",
     "TILE_AXIS",
+    "healthy_submesh",
     "make_mesh",
     "mesh_slices",
     "num_shards",

@@ -31,6 +31,14 @@ PRIME device count necessarily degenerates to ``(1, n)`` — every row
 block then rides the ``tile`` axis).  Deployments pin an explicit shape
 via ``coprocessor.mesh_shape`` ("2x4"), parsed by ``parse_mesh_shape``
 and surfaced in ``/health``.
+
+A configured device is NOT assumed healthy forever: the failure-domain
+supervisor (device/supervisor.py) scores each slice and quarantines a
+sick chip, and ``healthy_submesh`` gives the runner the largest
+power-of-two survivor set (8→4→2→1) to rebuild sharded serving on —
+the degrade ladder is slice → submesh → host, with host only the final
+rung (the host link cannot absorb a whole mesh's traffic; Jouppi cost
+model, PAPERS.md).
 """
 
 from __future__ import annotations
@@ -120,9 +128,32 @@ def mesh_slices(mesh: Mesh) -> list:
     the placement loop (device/placement.py) assigns hot regions to.
     Slice index ``i`` corresponds to shard index ``i`` of the full
     mesh's row sharding, so per-slice occupancy lines up with the
-    sharded kernels' shard numbering in /health.
+    sharded kernels' shard numbering in /health — and with the
+    failure-domain supervisor's per-slice health scores
+    (device/supervisor.py SliceHealthBoard), which use the same
+    numbering to quarantine a chip out of both serving modes at once.
     """
     return [[d] for d in mesh.devices.flat]
+
+
+def healthy_submesh(mesh: Mesh, dead_slices) -> Optional[list]:
+    """Devices of the largest healthy power-of-two submesh, or None
+    when every slice is dead.
+
+    The elastic-degrade ladder (8→4→2→1, README "Device failure
+    domains"): ``dead_slices`` holds flattened slice indices the
+    failure-domain supervisor quarantined; the survivors keep their
+    flat order and are truncated to the largest power of two, so the
+    rebuilt mesh's ``_factor2`` shape stays a clean (R, T) split and
+    sharded feeds re-pad to a familiar per-shard unit.  Host fallback
+    is the caller's FINAL rung, taken only when this returns None.
+    """
+    dead = set(dead_slices)
+    devs = [d for i, d in enumerate(mesh.devices.flat) if i not in dead]
+    if not devs:
+        return None
+    k = 1 << (len(devs).bit_length() - 1)
+    return devs[:k]
 
 
 def row_sharding(mesh: Mesh) -> NamedSharding:

@@ -87,6 +87,26 @@ def rebalance_donor(scores: Sequence[float],
     return hot, cool
 
 
+def drain_receivers(scores: Sequence[float],
+                    exclude,
+                    k: int) -> list[int]:
+    """``k`` receiver slices for a quarantine drain, least-loaded-first
+    round-robin.
+
+    The evict-slow-store shape one level down: when a slice trips, its
+    sticky anchors must all leave AT ONCE — unlike the one-step
+    rebalance, which moves a single anchor per call.  Dumping them all
+    on the single coolest slice would just mint the next hot spot, so
+    receivers rotate over the healthy slices in ascending score order.
+    Empty when every slice is excluded (the caller falls back to the
+    whole-mesh/host path)."""
+    order = sorted((i for i in range(len(scores)) if i not in exclude),
+                   key=lambda i: scores[i])
+    if not order:
+        return []
+    return [order[j % len(order)] for j in range(k)]
+
+
 def slice_scores(occupancy: Mapping[int, float],
                  load: Mapping[int, float], n_slices: int,
                  occupancy_weight: float = 1.0,

@@ -134,7 +134,10 @@ def main(argv=None) -> int:
                     mesh=make_mesh(
                         shape=parse_mesh_shape(cc.mesh_shape)),
                     placement=cc.device_placement,
-                    placement_rows=cc.placement_rows)
+                    placement_rows=cc.placement_rows,
+                    slice_trip_strikes=cc.slice_trip_strikes,
+                    slice_probe_cooldown_s=cc.slice_probe_cooldown_s,
+                    slice_latency_outlier_s=cc.slice_latency_outlier_s)
             else:
                 device_runner = DeviceRunner()
         if args.status_addr and config is not None:

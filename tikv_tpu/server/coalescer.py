@@ -43,7 +43,14 @@ a shared D2H, under a bounded, deadline-aware collection window:
   (incl. the ``copr::coalesce_dispatch`` failpoint) retries every
   member as a solo dispatch, and a fetch-side fault degrades each
   member to the host pipeline through the endpoint's existing
-  per-request contract.
+  per-request contract.  That contract extends across CHIP DEATH
+  (device/supervisor.py failure domains): a group whose slice dies
+  between dispatch and fetch rescues PER MEMBER onto a healthy slice
+  (the placer re-pins the anchor; _BatchedSelectionGroup.member_result
+  catches the shared-fetch fault), the solo retries re-route through
+  the placer — which now excludes the quarantined slice — and the
+  group's arena pin still releases exactly once inside the memoized
+  shared fetch, dead chip or not.
 
 :class:`CostRouter` — generalizes the read pool's EWMA shedding into a
 per-request, Jouppi-style cost decision over four outcomes:
@@ -629,8 +636,8 @@ class RequestCoalescer:
 
     def close(self) -> None:
         """Stop collecting; dispatch every still-open group (their
-        members are parked waiters that must resolve) and join the
-        dispatcher."""
+        members are parked waiters that must resolve — flush, never
+        abandon) and join the dispatcher."""
         with self._cv:
             self._shutdown = True
             for g in list(self._open.values()):
@@ -639,6 +646,15 @@ class RequestCoalescer:
             t = self._thread
         if t is not None:
             t.join(timeout=5.0)
+        # belt and braces for stop-under-load: if the dispatcher died
+        # (or the join timed out) with groups still queued, dispatch
+        # them inline — a parked member's future must NEVER be left
+        # unresolved by teardown, or its waiter hangs forever
+        with self._mu:
+            leftovers = list(self._ready)
+            self._ready.clear()
+        for g in leftovers:
+            self._dispatch(g)
 
     # -------------------------------------------------------------- stats
 

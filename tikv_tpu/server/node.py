@@ -665,11 +665,23 @@ class Node:
         # idle-drain both request pools: stop admitting reads and wait
         # for in-flight ones, then retire (and JOIN) the endpoint's
         # completion-pool workers — nodes restarted in-process (chaos
-        # cycles, per-test servers) must not leak threads each stop
+        # cycles, per-test servers) must not leak threads each stop.
+        # Order matters for the device runner: the endpoint close
+        # flushes the coalescer's parked members and drains the
+        # completion pool, so every in-flight deferred has resolved
+        # (and released its arena pin) before the runner teardown
+        # below asserts a pin-free arena.
         self.read_pool.shutdown()
         close = getattr(self.endpoint, "close", None)
         if callable(close):
             close()
+        # device teardown last: with the pools drained, no pins remain
+        # — drop every resident feed line, retire any degraded submesh
+        # runner, and clear quarantine state so an in-process restart
+        # starts clean (no leaked HBM accounting, no stale health)
+        runner_close = getattr(self.device_runner, "close", None)
+        if callable(runner_close):
+            runner_close()
         # the resolved-ts fan-out's cached channels hold real sockets
         for c in self._rts_clients.values():
             try:

@@ -100,7 +100,10 @@ class PdService:
 class PdServer:
     def __init__(self, addr: str, pd: Optional[MockPd] = None):
         self.pd = pd if pd is not None else MockPd()
-        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        # held so stop() can join the (non-daemon) handler workers —
+        # same leak-per-cycle rationale as TikvServer
+        self._pool = futures.ThreadPoolExecutor(max_workers=4)
+        self._server = grpc.server(self._pool)
         self._server.add_generic_rpc_handlers((
             _GenericHandler("/pd.PD/", PdService(self.pd).handle),))
         from .security import bind_port
@@ -111,7 +114,8 @@ class PdServer:
         self._server.start()
 
     def stop(self, grace=0.5) -> None:
-        self._server.stop(grace)
+        self._server.stop(grace).wait()
+        self._pool.shutdown(wait=True)
 
     def wait(self) -> None:
         self._server.wait_for_termination()

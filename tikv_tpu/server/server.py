@@ -70,8 +70,12 @@ class TikvServer:
         self.node = node
         self._stopped = False
         self.service = KvService(node)
-        self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers))
+        # keep the handler pool so stop() can JOIN its (non-daemon)
+        # workers — grpc's stop() alone leaves them parked on the work
+        # queue until the executor is garbage collected, which leaks a
+        # thread per in-process server cycle (chaos restarts, tests)
+        self._pool = futures.ThreadPoolExecutor(max_workers=max_workers)
+        self._server = grpc.server(self._pool)
         self._server.add_generic_rpc_handlers((
             _GenericHandler(
                 "/tikv.Tikv/", self.service.handle,
@@ -106,8 +110,12 @@ class TikvServer:
         self._stopped = True    # service_event dispatcher exits on this
         if self.status_server is not None:
             self.status_server.stop()
-        self._server.stop(grace)
+        # wait out the grace so in-flight handlers finish before the
+        # node (and its pools) tear down under them, then join the
+        # handler workers — stop-under-load must leave no threads
+        self._server.stop(grace).wait()
         self.node.stop()
+        self._pool.shutdown(wait=True)
 
     def wait(self) -> None:
         self._server.wait_for_termination()

@@ -100,6 +100,14 @@ class StatusServer:
                         # (arena resident bytes/lines), decayed load,
                         # and place/move/whole-mesh counters
                         body["device_mesh"] = dr.mesh_stats()
+                    if dr is not None and \
+                            hasattr(dr, "failure_domain_stats"):
+                        # chip failure domains: per-slice health score
+                        # + state (trip/drain/probe cycle), refusal and
+                        # rescue counts, and the degraded-submesh shape
+                        # while a chip is quarantined
+                        body["device_health"] = \
+                            dr.failure_domain_stats()
                     sup = getattr(node, "device_supervisor", None)
                     if sup is not None and hasattr(sup, "stats"):
                         # device-state integrity: HBM arena accounting

@@ -393,6 +393,24 @@ DEVICE_SLICE_LOAD = REGISTRY.gauge(
     "decayed dispatch-rate load score per placement slice (the "
     "slow-store-style traffic half of the placement score)",
     labels=("slice",))
+DEVICE_SLICE_HEALTH = REGISTRY.gauge(
+    "tikv_device_slice_health_penalty",
+    "per-slice failure-domain health penalty (0 healthy .. ~1 at the "
+    "quarantine trip threshold; device/supervisor.py SliceHealth — "
+    "strikes from dispatch/fetch faults, scrub quarantines and "
+    "launch-latency outliers, decayed by served requests)",
+    labels=("slice",))
+DEVICE_FAILOVER_COUNTER = REGISTRY.counter(
+    "tikv_device_failure_domain_total",
+    "chip failure-domain events (quarantine = slice tripped, drain = "
+    "anchor re-pinned off a tripped slice, failover = route-time "
+    "re-pin, refused_dispatch = launch refused on a quarantined "
+    "slice, mesh_downsize = sharded serving rebuilt on a smaller "
+    "healthy submesh, mesh_restore = full mesh back after "
+    "re-admission, rescue = in-flight request retried off a dead "
+    "slice, readmit = half-open canary succeeded, probe_fail = "
+    "canary failed and the cooldown restarted)",
+    labels=("event",))
 DEVICE_PLACEMENT_COUNTER = REGISTRY.counter(
     "tikv_device_placement_total",
     "hot-region placement decisions (place = new anchor assigned to a "
