@@ -391,6 +391,26 @@ def test_region_cache_build_does_not_block_other_hits():
         rc.build_region_columnar = orig
 
 
+def test_check_leader_response_survives_wire(cluster):
+    """Regression: the CheckLeader fan-out response used int region-id
+    map keys, which msgpack's strict_map_key unpack REJECTS — every
+    non-empty response failed client-side deserialization (harmless to
+    the fire-and-forget fan-out, but each decode error logged and the
+    noise destabilized timing-sensitive brownout runs).  The handler's
+    output must round-trip through the real wire codec."""
+    from tikv_tpu.server import wire
+    from tikv_tpu.server.service import KvService
+
+    node = cluster["servers"][0].node
+    svc = KvService(node)
+    peer = node.raft_store.peers[1]
+    resp = svc.CheckLeader({"regions": [
+        {"region_id": 1, "resolved_ts": node.pd.tso(),
+         "applied_index": peer.applied_engine}]})
+    assert resp["advanced"], resp       # non-empty: the failing shape
+    assert wire.unpack(wire.pack(resp)) == resp
+
+
 def test_per_request_tracker_details(cluster):
     """Every read RPC returns TimeDetail/ScanDetail built by the
     per-request tracker (components/tracker/src/lib.rs:16,32-40):

@@ -1,0 +1,728 @@
+"""Causal request tracing: span trees, handoffs, trace export, slow
+log, flight recorder.
+
+Reference test model: TiKV's tracker/minitrace integration tests (span
+attribution survives thread handoffs, TimeDetail rides the wire) plus
+the slow_log! redaction contract.  The acceptance bars from the
+tracing tentpole live here: a warm device request's exported trace
+decomposes ≥95% of its RPC wall into named spans with an explicit
+``untracked`` residual; a coalesced group's single shared dispatch
+span is follows-from linked into ≥2 member traces with correct
+occupancy; /debug/trace/<id>?format=chrome emits schema-valid Chrome
+trace-event JSON; the slow-query log fires exactly for over-threshold
+requests and never leaks user keys.
+"""
+
+import json
+import logging
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tikv_tpu.utils import failpoint
+from tikv_tpu.utils import trace as trace_mod
+from tikv_tpu.utils import tracker
+from tikv_tpu.utils.trace import TraceBuffer, Tracker, to_chrome
+from tikv_tpu.utils.trace_vocab import SPAN_VOCABULARY
+
+
+@pytest.fixture(autouse=True)
+def _fp_teardown():
+    yield
+    failpoint.teardown()
+
+
+# ------------------------------------------------------------ unit: spans
+
+
+def test_span_tree_nesting_and_time_detail_shape():
+    tr, tok = tracker.install()
+    try:
+        with tracker.phase("host_exec"):
+            time.sleep(0.01)
+            with tracker.phase("host_materialize"):
+                time.sleep(0.005)
+        tracker.add_scan(42, 100)
+        tracker.label("backend", "host")
+    finally:
+        tracker.uninstall(tok)
+    tr.finish()
+    # TimeDetail wire shape unchanged
+    td = tr.time_detail()
+    assert set(td) >= {"total_rpc_wall_ms", "wait_wall_ms",
+                       "process_wall_ms", "phases_ms"}
+    assert td["phases_ms"]["host_exec"] >= 10.0
+    assert td["labels"]["backend"] == "host"
+    assert tr.scan_detail() == {"processed_versions": 42,
+                                "processed_versions_size": 100}
+    # span tree: root + two nested spans, child parented to its phase
+    by_name = {s.name: s for s in tr.spans}
+    assert by_name["rpc"].parent_id is None
+    outer, inner = by_name["host_exec"], by_name["host_materialize"]
+    assert outer.parent_id == by_name["rpc"].span_id
+    assert inner.parent_id == outer.span_id
+    assert outer.t0 <= inner.t0 and inner.t1 <= outer.t1
+    # exactly-once closure: all spans closed, unique ids
+    assert all(s.t1 is not None for s in tr.spans)
+    assert len({s.span_id for s in tr.spans}) == len(tr.spans)
+
+
+def test_unsampled_tracker_keeps_wire_shape_without_spans():
+    tr, tok = tracker.install(sampled=False)
+    try:
+        with tracker.phase("kv_read"):
+            time.sleep(0.002)
+        tracker.add_phase("coalesce_wait", 1_000_000)
+        tracker.add_wait(500_000)
+    finally:
+        tracker.uninstall(tok)
+    tr.finish()
+    td = tr.time_detail()
+    assert td["phases_ms"]["kv_read"] >= 2.0
+    assert td["phases_ms"]["coalesce_wait"] == 1.0
+    assert td["wait_wall_ms"] == 0.5
+    assert tr.spans == [] and tr.root is None
+    # breakdown degrades to all-untracked, never crashes
+    assert set(tr.breakdown()) == {"untracked"}
+
+
+def test_adopt_handoff_retro_spans_and_closure():
+    """adopt() carries the tree to another thread; retro add_phase /
+    add_wait land timestamped spans; closure is exactly-once even when
+    the handoff thread races the installer."""
+    tr, tok = tracker.install()
+    done = threading.Event()
+
+    def worker():
+        t = tracker.adopt(tr)
+        try:
+            tracker.add_phase("d2h_wait", 3_000_000)
+            with tracker.phase("host_materialize"):
+                time.sleep(0.002)
+            tracker.add_wait(1_000_000)
+        finally:
+            tracker.uninstall(t)
+            done.set()
+
+    th = threading.Thread(target=worker)
+    th.start()
+    done.wait(5)
+    th.join(5)
+    tracker.uninstall(tok)
+    tr.finish()
+    names = [s.name for s in tr.spans]
+    assert names.count("d2h_wait") == 1
+    assert names.count("host_materialize") == 1
+    assert names.count("read_pool_wait") == 1
+    retro = next(s for s in tr.spans if s.name == "d2h_wait")
+    assert retro.t1 - retro.t0 == 3_000_000
+    # spans from the worker carry its thread id, root the installer's
+    root = tr.root
+    assert retro.tid != root.tid
+    assert retro.parent_id == root.span_id
+    assert all(s.t1 is not None for s in tr.spans)
+    assert len({s.span_id for s in tr.spans}) == len(tr.spans)
+
+
+def test_breakdown_innermost_wins_and_untracked_residual():
+    tr, tok = tracker.install()
+    try:
+        with tracker.span("await_deferred"):        # umbrella
+            with tracker.phase("d2h_wait"):
+                time.sleep(0.02)
+            time.sleep(0.01)    # umbrella-only time
+        time.sleep(0.01)        # uncovered → untracked
+    finally:
+        tracker.uninstall(tok)
+    tr.finish()
+    bd = tr.breakdown()
+    total = tr.time_detail()["total_rpc_wall_ms"]
+    # decomposition is exact: parts sum to the total
+    assert abs(sum(bd.values()) - total) < 0.02, (bd, total)
+    # innermost wins: d2h_wait keeps its 20ms, the umbrella only the
+    # 10ms nothing more specific covers
+    assert bd["d2h_wait"] >= 18.0
+    assert 8.0 <= bd["await_deferred"] < 20.0
+    assert bd["untracked"] >= 8.0
+    # umbrella span() does NOT pollute the flat phases dict
+    assert "await_deferred" not in tr.time_detail()["phases_ms"]
+    assert tr.coverage() < 1.0
+
+
+def test_follows_from_link_and_chrome_flow_events():
+    lead, ltok = tracker.install()
+    sp = lead.begin("group_dispatch")
+    lead.annotate_span(sp, occupancy=3)
+    time.sleep(0.002)
+    lead.end(sp)
+    tracker.uninstall(ltok)
+    lead.finish()
+
+    member, mtok = tracker.install()
+    member.link_from("group_dispatch", lead.trace_id, sp.span_id,
+                     occupancy=3, lane=1)
+    tracker.uninstall(mtok)
+    member.finish()
+    marker = next(s for s in member.spans
+                  if s.name == "group_dispatch")
+    assert marker.links == [{"trace_id": lead.trace_id,
+                             "span_id": sp.span_id}]
+    assert marker.attrs == {"occupancy": 3, "lane": 1}
+    assert marker.t0 == marker.t1      # zero-duration marker
+
+    buf = TraceBuffer()
+    buf.record(lead)
+    doc = to_chrome(member, resolve=buf.get)
+    _validate_chrome(doc)
+    # the foreign (leader) dispatch span rides the export on a peer pid
+    linked = [e for e in doc["traceEvents"]
+              if e.get("cat") == "linked"]
+    assert linked and linked[0]["args"]["span_id"] == sp.span_id
+    assert linked[0]["args"]["occupancy"] == 3
+    flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "f")]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+
+
+def _validate_chrome(doc):
+    """Strict Chrome trace-event schema check (the format Perfetto and
+    chrome://tracing load): required keys, types, paired flow ids."""
+    assert isinstance(doc, dict)
+    assert doc.get("displayTimeUnit") in ("ms", "ns")
+    evs = doc.get("traceEvents")
+    assert isinstance(evs, list) and evs
+    flows = {}
+    for ev in evs:
+        assert isinstance(ev, dict)
+        assert isinstance(ev.get("name"), str) and ev["name"]
+        assert ev.get("ph") in ("X", "M", "s", "f"), ev
+        assert isinstance(ev.get("pid"), int)
+        assert isinstance(ev.get("tid"), int)
+        assert isinstance(ev.get("ts"), (int, float))
+        assert ev["ts"] >= 0
+        if ev["ph"] == "X":
+            assert isinstance(ev.get("dur"), (int, float))
+            assert ev["dur"] >= 0
+        if ev["ph"] in ("s", "f"):
+            flows.setdefault(ev["id"], set()).add(ev["ph"])
+    for fid, phs in flows.items():
+        assert phs == {"s", "f"}, f"unpaired flow {fid}"
+    json.loads(json.dumps(doc))     # round-trips as JSON
+
+
+def test_trace_buffer_tail_biased_retention():
+    buf = TraceBuffer(capacity=4, slow_keep=1)
+
+    def mk(total_ms, **flags):
+        tr = Tracker()
+        tr.t1 = tr.t0 + int(total_ms * 1e6)
+        buf.record(tr, class_key="c", **flags)
+        return tr.trace_id
+
+    slowest = mk(500)
+    errored = mk(1, error=True)
+    fast = [mk(1) for _ in range(8)]
+    # ring evicted the early fast traces...
+    assert buf.get(fast[0]) is None
+    # ...but the class's slowest and the errored one are pinned
+    assert buf.get(slowest) is not None
+    assert buf.get(errored) is not None
+    idx = buf.index()
+    assert len(idx["recent"]) <= 4
+    assert idx["slowest_per_class"]["c"][0]["trace_id"] == slowest
+    assert any(e["trace_id"] == errored and "error" in e["flags"]
+               for e in idx["flagged"])
+    st = buf.stats()
+    assert st["recorded"] == 10 and st["capacity"] == 4
+    # online shrink holds the bound
+    buf.set_capacity(4)
+    assert buf.stats()["capacity"] == 4
+    # unsampled traces are never retained
+    un = Tracker(sampled=False)
+    buf.record(un)
+    assert buf.get(un.trace_id) is None
+    # trace-id reuse (clients may resend one id): evicting one heap
+    # entry must not strip the pin a live entry still references
+    buf2 = TraceBuffer(capacity=4, slow_keep=2)
+    for total in (100, 200, 50):
+        tr = Tracker(trace_id="reused-id")
+        tr.t1 = tr.t0 + total * 1_000_000
+        buf2.record(tr, class_key="c")
+    assert buf2.get("reused-id") is not None
+
+
+# ------------------------------------------------ span-name inventory
+
+
+def test_span_vocabulary_inventory():
+    """Every span/phase name used in tikv_tpu/ resolves to the
+    registered vocabulary — and the vocabulary carries no dead names —
+    so a typo'd label fails CI instead of silently forking the latency
+    breakdown (the failpoint-inventory discipline applied to spans)."""
+    import pathlib
+
+    import tikv_tpu
+
+    root = pathlib.Path(tikv_tpu.__file__).parent
+    pat = re.compile(
+        r'(?:\bphase|\badd_phase|\bspan|\bbegin|\blink_from'
+        r'|_new_span)\(\s*\n?\s*"([a-z0-9_]+)"')
+    used = set()
+    for p in root.rglob("*.py"):
+        used |= set(pat.findall(p.read_text()))
+    # names minted through module constants (the root span + the
+    # synthesized residual)
+    used |= {trace_mod.ROOT_SPAN_NAME, trace_mod.UNTRACKED_NAME}
+    assert len(used) >= 20, f"span scan found only {sorted(used)}"
+    unknown = used - set(SPAN_VOCABULARY)
+    assert not unknown, \
+        f"span names missing from trace_vocab.SPAN_VOCABULARY: " \
+        f"{sorted(unknown)}"
+    dead = set(SPAN_VOCABULARY) - used
+    assert not dead, f"vocabulary entries no code emits: {sorted(dead)}"
+    # descriptions exist for the README table
+    assert all(isinstance(v, str) and v for v in
+               SPAN_VOCABULARY.values())
+
+
+# ------------------------------------------------------- gRPC rig (e2e)
+
+
+@pytest.fixture(scope="module")
+def rig():
+    import jax
+
+    from tikv_tpu.device import DeviceRunner
+    from tikv_tpu.parallel import make_mesh
+    from tikv_tpu.raftstore.metapb import Store
+    from tikv_tpu.server import (
+        Node, PdServer, RemotePdClient, TikvServer, TxnClient,
+    )
+    from tikv_tpu.server.status_server import StatusServer
+    from tikv_tpu.testing.fixture import encode_table_row, int_table
+
+    device = DeviceRunner(mesh=make_mesh(jax.devices()[:1]))
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    node = Node("127.0.0.1:0", RemotePdClient(pd_addr),
+                device_runner=device, device_row_threshold=128)
+    srv = TikvServer(node)
+    node.addr = f"127.0.0.1:{srv.port}"
+    node.pd.put_store(Store(node.store_id, node.addr))
+    srv.start()
+    status = StatusServer("127.0.0.1:0", node=node,
+                          config_controller=node.config_controller)
+    status.start()
+    client = TxnClient(pd_addr)
+    table = int_table(2, table_id=9460)
+    muts = []
+    for h in range(4000):
+        key, value = encode_table_row(
+            table, h, {"c0": h % 13, "c1": (h * 41) % 2000 - 1000})
+        muts.append(("put", key, value))
+    client.txn_write(muts)
+    yield {"node": node, "client": client, "table": table,
+           "base_url": f"http://127.0.0.1:{status.port}",
+           "device": device}
+    status.stop()
+    srv.stop()
+    pd_server.stop()
+
+
+def _agg_dag(rig_d, ts):
+    from tikv_tpu.testing.dag import DagSelect
+    s = DagSelect.from_table(rig_d["table"], ["id", "c0", "c1"])
+    return s.aggregate([s.col("c0")],
+                       [("count_star", None), ("sum", s.col("c1"))]
+                       ).build(start_ts=ts)
+
+
+def _sel_dag(rig_d, ts, thr):
+    from tikv_tpu.testing.dag import DagSelect
+    s = DagSelect.from_table(rig_d["table"], ["id", "c0", "c1"])
+    return s.where(s.col("c1") > thr).build(start_ts=ts)
+
+
+def _fetch_trace(rig_d, trace_id, fmt=None):
+    url = f"{rig_d['base_url']}/debug/trace/{trace_id}"
+    if fmt:
+        url += f"?format={fmt}"
+    return json.load(urllib.request.urlopen(url))
+
+
+def test_e2e_warm_trace_decomposes_and_exports(rig):
+    """The config-6 acceptance bar: a warm device request's trace
+    decomposes ≥95% of total_rpc_wall_ms into named spans with an
+    explicit untracked residual, and the Chrome export is schema-valid.
+    A client-sent trace_id is echoed and forces sampling."""
+    c = rig["client"]
+    c.coprocessor(_agg_dag(rig, c.tso()), timeout=120)     # warm
+    best = 0.0
+    doc = None
+    for _ in range(3):      # full-suite load can preempt between spans
+        resp = c.coprocessor(_agg_dag(rig, c.tso()), timeout=60,
+                             trace_id="cafe0123deadbeef")
+        assert resp["backend"] == "device"
+        assert resp["trace_id"] == "cafe0123deadbeef"
+        assert resp["time_detail"]["total_rpc_wall_ms"] > 0
+        doc = _fetch_trace(rig, resp["trace_id"])
+        bd = doc["breakdown_ms"]
+        total = sum(bd.values())
+        cov = 1.0 - bd["untracked"] / total if total else 0.0
+        best = max(best, cov)
+        if best >= 0.95:
+            break
+    assert best >= 0.95, (best, doc["breakdown_ms"])
+    assert "untracked" in doc["breakdown_ms"]       # residual explicit
+    # the async stack is visible: dispatch + fetch + serialize spans
+    names = {s["name"] for s in doc["spans"]}
+    assert {"rpc", "plan_decode", "snapshot", "device_dispatch",
+            "resp_serialize"} <= names, sorted(names)
+    assert "d2h_wait" in names or "await_deferred" in names
+    # exactly-once: span ids unique, every span closed within bounds
+    ids = [s["span_id"] for s in doc["spans"]]
+    assert len(ids) == len(set(ids))
+    assert all(s["dur_us"] >= 0 for s in doc["spans"])
+    # the device_dispatch span carries its flight record inline
+    disp = [s for s in doc["spans"] if s["name"] == "device_dispatch"]
+    assert any("compile_class" in (s.get("attrs") or {}) for s in disp)
+    # chrome export loads as valid trace-event JSON
+    chrome = _fetch_trace(rig, resp["trace_id"], fmt="chrome")
+    _validate_chrome(chrome)
+    assert chrome["otherData"]["trace_id"] == resp["trace_id"]
+
+
+def test_e2e_coalesced_group_follows_from(rig):
+    """The 6b acceptance bar: one shared dispatch span follows-from
+    linked into ≥2 member traces with correct occupancy + lane."""
+    c, node = rig["client"], rig["node"]
+    coal = node.endpoint.coalescer
+    assert coal is not None
+    c.coprocessor(_sel_dag(rig, c.tso(), 0), timeout=120)   # warm solo
+    coal.configure(window_ms=200.0)
+    coal.idle_bypass = False
+    tids, errors = [], []
+    mu = threading.Lock()
+
+    def one(thr):
+        try:
+            r = c.coprocessor(_sel_dag(rig, c.tso(), thr),
+                              timeout=60)
+            with mu:
+                tids.append(r["trace_id"])
+        except Exception as e:      # noqa: BLE001
+            errors.append(e)
+
+    try:
+        ts = [threading.Thread(target=one, args=(100 * i,))
+              for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        coal.idle_bypass = True
+        coal.configure(window_ms=2.0)
+    assert not errors, errors
+    assert len(tids) == 6
+    # collect follows-from markers across member traces
+    by_target: dict = {}
+    real_spans: dict = {}
+    for tid in tids:
+        doc = _fetch_trace(rig, tid)
+        for s in doc["spans"]:
+            if s["name"] != "group_dispatch":
+                continue
+            links = s.get("follows_from")
+            if links:
+                tgt = (links[0]["trace_id"], links[0]["span_id"])
+                by_target.setdefault(tgt, []).append(
+                    (tid, s.get("attrs") or {}))
+            else:
+                real_spans[(doc["trace_id"], s["span_id"])] = \
+                    s.get("attrs") or {}
+    assert by_target, "no follows-from links recorded"
+    tgt, markers = max(by_target.items(), key=lambda kv: len(kv[1]))
+    assert len(markers) >= 2, by_target    # ≥2 member traces linked
+    occ = markers[0][1].get("occupancy", 0)
+    assert occ >= 3
+    assert all(m[1].get("occupancy") == occ for m in markers)
+    lanes = [m[1].get("lane") for m in markers]
+    assert len(set(lanes)) == len(lanes)    # distinct lane indices
+    # the linked-to span really exists in the leader's trace, with the
+    # SAME occupancy
+    assert tgt in real_spans, (tgt, sorted(real_spans))
+    assert real_spans[tgt].get("occupancy") == occ
+    # one member's chrome export shows the leader's dispatch span
+    member_tid = markers[0][0]
+    chrome = _fetch_trace(rig, member_tid, fmt="chrome")
+    _validate_chrome(chrome)
+    assert any(e.get("cat") == "linked"
+               for e in chrome["traceEvents"])
+
+
+def test_e2e_dispatch_failpoint_races_deferred_fetch_traces(rig):
+    """Satellite: adopt() across the completion pool with a dispatch-
+    side failpoint racing another request's deferred fetch — BOTH
+    traces still decompose ≥95% of their own wall with exactly-once
+    closure.  (Closure/uniqueness must hold EVERY round; the coverage
+    bar allows retries — on a loaded 1-core box a single scheduler
+    preemption between spans is several % of a sub-5ms request.)"""
+    c = rig["client"]
+    c.coprocessor(_agg_dag(rig, c.tso()), timeout=120)      # warm
+    worst_bd = None
+    for _ in range(4):
+        barrier = threading.Barrier(2)
+        out, errors = {}, []
+
+        def run(name, arm):
+            try:
+                barrier.wait(5)
+                if arm:
+                    failpoint.cfg("device::before_dispatch",
+                                  "1*return->off")
+                r = c.coprocessor(_agg_dag(rig, c.tso()), timeout=60)
+                out[name] = r
+            except Exception as e:      # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=run, args=("inflight", False)),
+              threading.Thread(target=run, args=("raced", True))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        failpoint.teardown()
+        assert not errors, errors
+        round_cov = 1.0
+        for name, resp in out.items():
+            doc = _fetch_trace(rig, resp["trace_id"])
+            bd = doc["breakdown_ms"]
+            total = sum(bd.values())
+            cov = 1.0 - bd["untracked"] / total if total else 0.0
+            if cov < round_cov:
+                round_cov, worst_bd = cov, bd
+            # hard invariants, every round: exactly-once closure
+            ids = [s["span_id"] for s in doc["spans"]]
+            assert len(ids) == len(set(ids)), name
+            assert all(s["dur_us"] >= 0 for s in doc["spans"]), name
+        if round_cov >= 0.95:
+            return
+    assert False, f"no round decomposed >=95%: {worst_bd}"
+
+
+def test_e2e_group_member_degrade_trace_integrity(rig):
+    """Satellite: a coalesced group whose shared fetch faults degrades
+    members to host — each member's trace still decomposes ≥95% of its
+    own RPC wall, closes every span exactly once, and is flagged
+    degraded in the retention buffer."""
+    c, node = rig["client"], rig["node"]
+    coal = node.endpoint.coalescer
+    c.coprocessor(_sel_dag(rig, c.tso(), 50), timeout=120)  # warm
+    coal.configure(window_ms=200.0)
+    coal.idle_bypass = False
+    tids, errors = [], []
+    mu = threading.Lock()
+
+    def one(thr):
+        try:
+            r = c.coprocessor(_sel_dag(rig, c.tso(), thr), timeout=60)
+            with mu:
+                tids.append(r["trace_id"])
+        except Exception as e:      # noqa: BLE001
+            errors.append(e)
+
+    failpoint.cfg("device::before_fetch", "1*return->off")
+    try:
+        ts = [threading.Thread(target=one, args=(thr,))
+              for thr in (-700, 700)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        coal.idle_bypass = True
+        coal.configure(window_ms=2.0)
+        failpoint.teardown()
+    assert not errors, errors
+    assert len(tids) == 2
+    degraded_flagged = {e["trace_id"]
+                        for e in node.trace_buffer.index()["flagged"]
+                        if "degraded" in e.get("flags", ())}
+    saw_host_exec = 0
+    for tid in tids:
+        doc = _fetch_trace(rig, tid)
+        bd = doc["breakdown_ms"]
+        total = sum(bd.values())
+        cov = 1.0 - bd["untracked"] / total if total else 0.0
+        assert cov >= 0.95, bd
+        ids = [s["span_id"] for s in doc["spans"]]
+        assert len(ids) == len(set(ids))
+        names = {s["name"] for s in doc["spans"]}
+        if "host_exec" in names:
+            saw_host_exec += 1
+            assert tid in degraded_flagged or \
+                doc["labels"].get("degraded"), doc["labels"]
+    assert saw_host_exec >= 1, "no member actually degraded to host"
+
+
+def test_e2e_error_responses_carry_time_detail_and_trace_id(rig):
+    """Satellite: deadline_exceeded and ServerIsBusy responses are
+    debuggable from the response alone — time_detail + trace_id ride
+    even the error wire shape, and the traces pin in the buffer."""
+    from tikv_tpu.server import wire
+    from tikv_tpu.server.service import KvService
+
+    node = rig["node"]
+    svc = KvService(node)
+    dag = _agg_dag(rig, rig["client"].tso())
+    # dead on arrival → deadline_exceeded at admission
+    resp = svc.handle("Coprocessor",
+                      {"tp": 103, "dag": wire.enc_dag(dag),
+                       "deadline_ms": 0})
+    assert resp["error"]["kind"] == "deadline_exceeded"
+    assert "time_detail" in resp and "scan_detail" in resp
+    assert resp["trace_id"]
+    assert node.trace_buffer.get(resp["trace_id"]) is not None
+    late_tid = resp["trace_id"]
+    # saturated pool → ServerIsBusy, same contract
+    old_pending = node.read_pool._max_pending
+    node.read_pool._max_pending = 0
+    try:
+        resp = svc.handle("Coprocessor",
+                          {"tp": 103, "dag": wire.enc_dag(dag)})
+    finally:
+        node.read_pool._max_pending = old_pending
+    assert resp["error"]["kind"] == "server_is_busy"
+    assert "time_detail" in resp and resp["trace_id"]
+    flagged = {e["trace_id"]: e["flags"]
+               for e in node.trace_buffer.index()["flagged"]}
+    assert "late" in flagged.get(late_tid, ())
+    assert "shed" in flagged.get(resp["trace_id"], ())
+
+
+def test_e2e_slow_log_fires_exactly_and_redacts(rig, caplog):
+    """Satellite: the slow-query line fires for requests over
+    slow_log_threshold_ms ONLY, and user keys never appear verbatim
+    (log_redact digests only)."""
+    c, node = rig["client"], rig["node"]
+    cc = node.config.coprocessor
+    old = cc.slow_log_threshold_ms
+    logger = logging.getLogger("tikv_tpu.slow_query")
+    try:
+        # threshold far above any smoke request: nothing fires
+        cc.slow_log_threshold_ms = 60_000.0
+        with caplog.at_level(logging.WARNING,
+                             logger="tikv_tpu.slow_query"):
+            c.coprocessor(_agg_dag(rig, c.tso()), timeout=60)
+        assert not [r for r in caplog.records
+                    if r.name == "tikv_tpu.slow_query"]
+        caplog.clear()
+        # threshold below everything: exactly one line per request
+        cc.slow_log_threshold_ms = 0.001
+        with caplog.at_level(logging.WARNING,
+                             logger="tikv_tpu.slow_query"):
+            r = c.coprocessor(_agg_dag(rig, c.tso()), timeout=60)
+        recs = [x for x in caplog.records
+                if x.name == "tikv_tpu.slow_query"]
+        assert len(recs) == 1, [x.getMessage() for x in recs]
+        msg = recs[0].getMessage()
+        assert r["trace_id"] in msg
+        assert "total_ms=" in msg
+        # redaction: the range-start key renders as a digest, never raw
+        assert "key~" in msg
+        start = _agg_dag(rig, c.tso()).ranges[0].start
+        assert repr(start) not in msg
+        assert str(start) not in msg
+        # and the buffer's slow counter advanced
+        assert node.trace_buffer.stats()["slow_logged"] >= 1
+    finally:
+        cc.slow_log_threshold_ms = old
+        caplog.clear()
+
+
+def test_e2e_flight_recorder_and_health_rollup(rig):
+    """Device flight recorder: bounded ring of recent launches with
+    compile-vs-cached flags, surfaced on /debug/trace and /health."""
+    c, node = rig["client"], rig["node"]
+    fr = rig["device"].flight_recorder
+    c.coprocessor(_agg_dag(rig, c.tso()), timeout=120)
+    c.coprocessor(_agg_dag(rig, c.tso()), timeout=60)
+    items = fr.items()
+    assert items, "no launches recorded"
+    for e in items:
+        assert {"t_unix_s", "launch_ms", "compile_class",
+                "first_launch", "mesh", "slice", "pinned_bytes",
+                "ok"} <= set(e)
+        assert e["launch_ms"] >= 0
+    st = fr.stats()
+    assert st["launches"] > st["first_launches"] >= 1
+    # repeat launches of one class flip first_launch off
+    byc: dict = {}
+    for e in items:
+        byc.setdefault(e["compile_class"], []).append(e["first_launch"])
+    assert any(flags[0] and not all(flags[1:])
+               for flags in byc.values() if len(flags) > 1) or \
+        any(not f for flags in byc.values() for f in flags)
+    # /debug/trace index carries the recorder; /health the rollup
+    idx = json.load(urllib.request.urlopen(
+        f"{rig['base_url']}/debug/trace"))
+    assert "flight_recorder" in idx
+    assert idx["flight_recorder"]["recent"]
+    assert idx["recent"], idx
+    health = json.load(urllib.request.urlopen(
+        f"{rig['base_url']}/health"))
+    assert "tracing" in health
+    roll = health["tracing"]
+    assert roll["sample"] == node.config.coprocessor.trace_sample
+    assert "buffer" in roll and "flight_recorder" in roll
+    # ring bound holds
+    assert len(fr.items()) <= fr.stats()["depth"]
+    # unknown trace id → 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"{rig['base_url']}/debug/trace/deadbeef00000000")
+    assert ei.value.code == 404
+
+
+def test_e2e_trace_knobs_online_updatable(rig):
+    """Satellite: trace_sample / trace_buffer / slow_log_threshold_ms /
+    flight_recorder_depth flow through POST /config end to end."""
+    c, node = rig["client"], rig["node"]
+    ctl = node.config_controller
+    fr = rig["device"].flight_recorder
+    old_depth = fr.stats()["depth"]
+    try:
+        applied = ctl.update({
+            "coprocessor.trace-sample": 0.0,
+            "coprocessor.trace-buffer": 16,
+            "coprocessor.slow-log-threshold-ms": 123.0,
+            "coprocessor.flight-recorder-depth": 8,
+        })
+        assert applied["coprocessor.trace_sample"] == 0.0
+        assert node.trace_buffer.stats()["capacity"] == 16
+        assert fr.stats()["depth"] == 8
+        assert node.config.coprocessor.slow_log_threshold_ms == 123.0
+        # sample 0: the response still carries trace_id + TimeDetail
+        # but no span tree is retained
+        r = c.coprocessor(_agg_dag(rig, c.tso()), timeout=60)
+        assert r["trace_id"] and "time_detail" in r
+        assert node.trace_buffer.get(r["trace_id"]) is None
+        # a client-sent trace_id overrides sampling-off
+        r = c.coprocessor(_agg_dag(rig, c.tso()), timeout=60,
+                          trace_id="feedface00000001")
+        assert node.trace_buffer.get("feedface00000001") is not None
+        # garbage client ids (unbounded / bad charset) are NOT honored:
+        # the server mints its own instead of storing/echoing them
+        r = c.coprocessor(_agg_dag(rig, c.tso()), timeout=60,
+                          trace_id="x" * 500)
+        assert r["trace_id"] != "x" * 500
+        assert len(r["trace_id"]) <= 64
+    finally:
+        ctl.update({"coprocessor.trace-sample": 1.0,
+                    "coprocessor.trace-buffer": 256,
+                    "coprocessor.slow-log-threshold-ms": 1000.0,
+                    "coprocessor.flight-recorder-depth": old_depth})

@@ -139,6 +139,18 @@ class CoprocessorConfig:
     slice_trip_strikes: float = 3.0
     slice_probe_cooldown_s: float = 0.25
     slice_latency_outlier_s: float = 0.0
+    # causal request tracing (utils/trace.py): trace_sample is the
+    # fraction of read RPCs recording full span trees (a client-sent
+    # trace_id always samples; TimeDetail stays on the wire for every
+    # request regardless), trace_buffer bounds the /debug/trace
+    # retention ring (tail-biased: slowest-per-class + errored/late
+    # requests pin past ring eviction), slow_log_threshold_ms fires
+    # the redacted slow-query log line (TiKV slow_log! analog; 0
+    # disables), flight_recorder_depth bounds the device launch ring
+    trace_sample: float = 1.0
+    trace_buffer: int = 256
+    slow_log_threshold_ms: float = 1000.0
+    flight_recorder_depth: int = 256
 
 
 @dataclass
@@ -223,6 +235,10 @@ _ONLINE_FIELDS = {
     "coprocessor.coalesce_window_ms",
     "coprocessor.coalesce_max_group",
     "coprocessor.device_cold_build",
+    "coprocessor.trace_sample",
+    "coprocessor.trace_buffer",
+    "coprocessor.slow_log_threshold_ms",
+    "coprocessor.flight_recorder_depth",
     "readpool.concurrency",
 }
 

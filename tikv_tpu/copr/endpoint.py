@@ -356,6 +356,7 @@ class Endpoint:
                     "device backend failed; degrading to host",
                     exc_info=True)
                 tracker.label("backend", "host")
+                tracker.label("degraded", "dispatch")
                 return CopDeferred(self, req, storage, tag, t0, "host",
                                    result=host_exec())
             from ..device.runner import DeferredResult
@@ -414,6 +415,7 @@ class Endpoint:
             "deferred device fetch failed; degrading to host",
             exc_info=True)
         tracker.label("backend", "host")
+        tracker.label("degraded", "fetch")
         with GLOBAL_RECORDER.attach(d.tag, requests=0):
             with tracker.phase("host_exec"):
                 return BatchExecutorsRunner(

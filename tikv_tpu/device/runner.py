@@ -51,6 +51,8 @@ from __future__ import annotations
 
 import math
 import threading
+import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -498,7 +500,8 @@ class DeviceRunner:
                  placement_rows: Optional[int] = None,
                  slice_trip_strikes: Optional[float] = None,
                  slice_probe_cooldown_s: Optional[float] = None,
-                 slice_latency_outlier_s: Optional[float] = None):
+                 slice_latency_outlier_s: Optional[float] = None,
+                 flight_recorder_depth: Optional[int] = None):
         # int64 accumulators are required for exact SUM/COUNT over 1e8
         # rows; jax defaults to 32-bit.  Values stay int32/float32 on
         # device, only accumulators widen.  (Set here, not at import, so
@@ -602,6 +605,21 @@ class DeviceRunner:
         # depends on GC timing.
         from .supervisor import FeedArena
         self._arena = FeedArena(budget_bytes=hbm_budget_bytes)
+        # device flight recorder (device/supervisor.py): bounded ring
+        # of recent launches feeding the device_dispatch span's attrs
+        # and the status server's /debug/trace surface.  One ring per
+        # PHYSICAL runner — slice/submesh sub-runners share it
+        # (_make_slice_runner), so the chip's launch history reads in
+        # order with per-entry slice ids.
+        from .supervisor import (
+            DEFAULT_FLIGHT_RECORDER_DEPTH,
+            FlightRecorder,
+        )
+        self.flight_recorder = FlightRecorder(
+            flight_recorder_depth if flight_recorder_depth is not None
+            else DEFAULT_FLIGHT_RECORDER_DEPTH)
+        self._mesh_desc = "x".join(
+            str(d) for d in self._mesh.devices.shape)
         # scrub-quarantined anchors: id(anchor) -> (anchor, reason).
         # The next request for a quarantined anchor serves from the
         # host pipeline (its feeds are already dropped); the one after
@@ -648,6 +666,9 @@ class DeviceRunner:
         the last healthy chip for doing its job."""
         sub = DeviceRunner(mesh=mesh, **self._init_args)
         sub._failover_parent = self
+        # the PARENT's flight recorder records this slice's launches
+        # (entries carry the slice id) — one black box per chip
+        sub.flight_recorder = self.flight_recorder
         if slice_indices is not None:
             sub._slice_indices = tuple(slice_indices)
             if bind_health and len(slice_indices) == 1 and \
@@ -2371,6 +2392,42 @@ class DeviceRunner:
             in_specs=(P(),) + (P(ROW_AXES),) * n_flat,
             out_specs=(P(ROW_AXES),) * 3))
 
+    # -- dispatch span + flight-recorder feed --
+
+    @contextmanager
+    def _dispatch_phase(self, klass: str, key=None):
+        """Every kernel launch site runs under this: the
+        ``device_dispatch`` tracker span, plus one flight-recorder
+        entry (launch wall, compile class, first-launch flag, mesh
+        shape, slice id, arena-pinned bytes) annotated onto the span —
+        the trace carries the launch's black-box record inline.
+
+        ``key`` refines the compile class (n_pad bucket / kernel cache
+        key) so the ``first_launch`` flag distinguishes a real
+        cold-compile launch from a warm cache hit within the same plan
+        kind."""
+        from ..utils import tracker
+        rec = self.flight_recorder
+        with tracker.phase("device_dispatch"):
+            t0 = time.perf_counter()
+            ok = True
+            try:
+                yield
+            except BaseException:
+                ok = False
+                raise
+            finally:
+                if rec is not None:
+                    entry = rec.note(
+                        klass=klass, key=key,
+                        wall_s=time.perf_counter() - t0,
+                        mesh=self._mesh_desc,
+                        slice_id=self._slice_indices[0]
+                        if len(self._slice_indices) == 1 else None,
+                        pinned_bytes=self._arena.pinned_bytes(),
+                        ok=ok)
+                    tracker.annotate(**entry)
+
     # -- packed device→host readback (one transfer, one sync) --
 
     def _readback(self, tree):
@@ -3021,6 +3078,7 @@ class DeviceRunner:
         return self._result(dag, schema, cols)
 
     def _run_simple(self, dag, plan, host_cols, dtypes, n, feed, meta):
+        from ..utils import tracker as _tracker
         # the fused Pallas kernel serves simple aggregations too (r6):
         # a single-slot grid turns SUM/COUNT/AVG into one direct-index
         # pass — the XLA scan's per-step and fusion-boundary costs
@@ -3068,8 +3126,7 @@ class DeviceRunner:
                            self._finalize_psum_summed(),
                            feed["null_flags"], feed["n_pad"], chunk),
                 carry, len(feed["flat"])))
-        from ..utils import tracker as _tracker
-        with _tracker.phase("device_dispatch"):
+        with self._dispatch_phase("simple", key):
             carry = kern(carry, self._cached_scalar(n, jnp.int64),
                          self._cached_scalar(0, jnp.int64),
                          *feed["flat"])
@@ -3139,6 +3196,7 @@ class DeviceRunner:
 
     def _run_hash(self, dag, plan, host_cols, dtypes, n, feed, meta,
                   tile_spans=None):
+        from ..utils import tracker as _tracker
         from .kernels import (
             build_layouts,
             matmul_supported,
@@ -3263,8 +3321,7 @@ class DeviceRunner:
                         self._finalize_psum_summed(),
                         kern_null_flags, feed["n_pad"], chunk),
                     carry, len(kern_flat)))
-            from ..utils import tracker as _tracker
-            with _tracker.phase("device_dispatch"):
+            with self._dispatch_phase("hash_twolevel", key):
                 carry = kern(carry, n_arr, aux_arr, *kern_flat)
 
             def fin_twolevel(fetched):
@@ -3308,8 +3365,7 @@ class DeviceRunner:
                         self._finalize_psum_summed(),
                         kern_null_flags, feed["n_pad"], chunk),
                     carry, len(kern_flat)))
-            from ..utils import tracker as _tracker
-            with _tracker.phase("device_dispatch"):
+            with self._dispatch_phase("hash_scatter", key):
                 carry = kern(carry, n_arr, aux_arr, *kern_flat)
 
             def fin_scatter(fetched):
@@ -3549,8 +3605,7 @@ class DeviceRunner:
             return ("sync", packed, entry["LO"])
         LO = entry["LO"]
         try:
-            from ..utils import tracker
-            with tracker.phase("device_dispatch"):
+            with self._dispatch_phase("pallas_hash", key):
                 if "sharded" in entry:
                     parts = [entry["sharded"](
                         self._cached_scalar(n, jnp.int64),
@@ -3672,7 +3727,7 @@ class DeviceRunner:
                 vals += [vals[0]] * (gb - G)
                 lanes.append(jnp.asarray(
                     np.asarray(vals, dtype=np.dtype(dt))))
-            with _tracker.phase("device_dispatch"):
+            with self._dispatch_phase("scan_sel_batched", bkey):
                 counts_dev, packed_dev = bkern(
                     self._cached_scalar(n, jnp.int64), *lanes,
                     *feed["flat"])
@@ -3691,7 +3746,7 @@ class DeviceRunner:
             len(param_dts), None if self._single else self._mesh))
         params = tuple(self._cached_param(v, dt)
                        for v, dt in zip(param_vals, param_dts))
-        with _tracker.phase("device_dispatch"):
+        with self._dispatch_phase("scan_sel_mask", skey):
             count_dev, packed_dev, mask_dev = kern(
                 self._cached_scalar(n, jnp.int64), *params, *feed["flat"])
         # bench attribution seam (probe_scan_kernel launch train): ONE
@@ -3755,7 +3810,7 @@ class DeviceRunner:
             ckern = self._shard_kernel(
                 ckey, lambda: selmod.build_compact_kernel(
                     n_pad, cap, feed["null_flags"]))
-            with _tracker.phase("device_dispatch"):
+            with self._dispatch_phase("scan_sel_compact", ckey):
                 outs_dev, ovf_dev = ckern(mask_dev, *feed["flat"])
             self._sel_route_note(route)
             scan_cols = plan.scan.columns
@@ -3794,7 +3849,7 @@ class DeviceRunner:
             ikern = self._shard_kernel(
                 ikey, lambda: selmod.build_index_kernel(
                     n_pad, cap, None if self._single else self._mesh))
-            with _tracker.phase("device_dispatch"):
+            with self._dispatch_phase("scan_sel_index", ikey):
                 idx_dev, ovf_dev = ikern(mask_dev)
             self._sel_route_note(route)
 
@@ -3834,8 +3889,7 @@ class DeviceRunner:
             key, lambda: self._build_topn_kernel(
                 plan, len(plan.used_cols), k, feed["null_flags"],
                 feed["n_pad"], len(feed["flat"]), n_used=n_used))
-        from ..utils import tracker as _tracker
-        with _tracker.phase("device_dispatch"):
+        with self._dispatch_phase("topn", key):
             ys = kern(self._cached_scalar(n, jnp.int64), *feed["flat"])
 
         def fin(fetched):

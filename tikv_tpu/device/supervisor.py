@@ -99,6 +99,83 @@ def _bucket_nbytes(bucket: dict) -> int:
     return total
 
 
+# ----------------------------------------------------- flight recorder
+
+DEFAULT_FLIGHT_RECORDER_DEPTH = 256
+
+
+class FlightRecorder:
+    """Bounded ring of recent device launches — the black box an
+    operator (or the /debug/trace surface) reads after a latency spike:
+    per-launch wall, compile class, whether this launch was the class's
+    FIRST (compile-vs-cached — the difference between a 0.6ms warm
+    enqueue and a multi-second XLA compile), mesh shape, slice id for
+    placement-routed launches, and arena-pinned bytes at dispatch.
+
+    One recorder per PHYSICAL runner: placement slices and degraded
+    submesh sub-runners share their parent's ring (their entries carry
+    the slice id), so the box records the whole chip's launch history
+    in order.  Entries feed the ``device_dispatch`` span's attributes,
+    so a trace's launch carries its flight record inline.
+    """
+
+    CLASS_SEEN_MAX = 4096       # first-launch memory (LRU-bounded)
+
+    def __init__(self, depth: int = DEFAULT_FLIGHT_RECORDER_DEPTH):
+        from collections import OrderedDict, deque
+        self._mu = threading.Lock()
+        self._ring: "deque" = deque(maxlen=max(1, int(depth)))
+        self._seen: "OrderedDict" = OrderedDict()
+        self.launches = 0
+        self.first_launches = 0
+        self.faults = 0
+
+    def note(self, klass: str, key=None, wall_s: float = 0.0,
+             mesh: str = "", slice_id=None, pinned_bytes: int = 0,
+             ok: bool = True) -> dict:
+        ck = (klass, key)
+        with self._mu:
+            first = ck not in self._seen
+            self._seen[ck] = True
+            self._seen.move_to_end(ck)
+            while len(self._seen) > self.CLASS_SEEN_MAX:
+                self._seen.popitem(last=False)
+            self.launches += 1
+            if first:
+                self.first_launches += 1
+            if not ok:
+                self.faults += 1
+            entry = {"t_unix_s": round(time.time(), 6),
+                     "launch_ms": round(wall_s * 1e3, 3),
+                     "compile_class": klass,
+                     "first_launch": first,
+                     "mesh": mesh,
+                     "slice": slice_id,
+                     "pinned_bytes": int(pinned_bytes),
+                     "ok": ok}
+            self._ring.append(entry)
+        return entry
+
+    def set_depth(self, depth: int) -> None:
+        """Online-resize the ring, keeping the newest tail."""
+        from collections import deque
+        with self._mu:
+            self._ring = deque(self._ring, maxlen=max(1, int(depth)))
+
+    def items(self, limit: int = 0) -> list:
+        with self._mu:
+            out = list(self._ring)
+        return out[-limit:] if limit > 0 else out
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"depth": self._ring.maxlen,
+                    "recorded": len(self._ring),
+                    "launches": self.launches,
+                    "first_launches": self.first_launches,
+                    "faults": self.faults}
+
+
 # ------------------------------------------------- slice failure domains
 #
 # The store-level control loop (utils/health.py SlowScore rise/decay +
@@ -425,6 +502,10 @@ class FeedArena:
         # the per-request paths (admit, unpin) must not pay an
         # O(anchors) sum at the thousands-of-regions scale
         self._resident = 0
+        # running pinned-byte total, same discipline: the flight
+        # recorder stamps it on EVERY kernel launch, so it must be
+        # O(1), not an O(entries) sum under the arena mutex
+        self._pinned = 0
         self.budget_bytes = int(budget_bytes)
         self.evictions = 0
         self.rejections = 0
@@ -465,6 +546,8 @@ class FeedArena:
             ent = self._entries.pop(key, None)
             if ent is not None:
                 self._resident -= ent.nbytes
+                if ent.pins > 0:
+                    self._pinned = max(0, self._pinned - ent.nbytes)
         self._publish()
 
     # -- pinning ------------------------------------------------------
@@ -479,6 +562,8 @@ class FeedArena:
             ent = self._entries.get(id(anchor))
             if ent is None:
                 return None
+            if ent.pins == 0:
+                self._pinned += ent.nbytes
             ent.pins += 1
             return (id(anchor), ent.gen)
 
@@ -490,6 +575,8 @@ class FeedArena:
             ent = self._entries.get(key)
             if ent is not None and ent.gen == gen and ent.pins > 0:
                 ent.pins -= 1
+                if ent.pins == 0:
+                    self._pinned = max(0, self._pinned - ent.nbytes)
             # a pin release may be what the budget was waiting for
             # (a pinned entry admitted over the cap): sweep now
             if self.budget_bytes > 0:
@@ -512,6 +599,10 @@ class FeedArena:
                 return False
             fresh = _bucket_nbytes(ent.bucket)
             self._resident += fresh - ent.nbytes
+            if ent.pins > 0:
+                # re-accounting a pinned entry moves the pinned total
+                # with it, or the pair of counters drifts apart
+                self._pinned = max(0, self._pinned + fresh - ent.nbytes)
             ent.nbytes = fresh
             budget = self.budget_bytes
             fp = fail_point("device::hbm_oom")
@@ -590,6 +681,8 @@ class FeedArena:
             freed = ent.nbytes if ent is not None else 0
             if ent is not None:
                 self._resident -= ent.nbytes
+                if ent.pins > 0:
+                    self._pinned = max(0, self._pinned - ent.nbytes)
                 self.drops += 1
                 DEVICE_FEED_EVICTION_COUNTER.labels(reason).inc()
         self._publish()
@@ -607,6 +700,7 @@ class FeedArena:
             n = len(self._entries)
             self._entries.clear()
             self._resident = 0
+            self._pinned = 0
             self.drops += n
             if n:
                 DEVICE_FEED_EVICTION_COUNTER.labels(reason).inc(n)
@@ -621,6 +715,13 @@ class FeedArena:
     def resident_bytes(self) -> int:
         with self._mu:
             return self._total_locked()
+
+    def pinned_bytes(self) -> int:
+        """Bytes held by entries pinned by in-flight dispatches (the
+        flight recorder stamps this per launch — O(1) running total,
+        maintained at pin/unpin/re-account/drop)."""
+        with self._mu:
+            return self._pinned
 
     def resident_lines(self) -> int:
         with self._mu:
@@ -664,9 +765,7 @@ class FeedArena:
                 # bytes the budget cannot reclaim right now (in use by
                 # launched kernels) — check_hbm_within_budget allows
                 # resident to exceed the cap by at most this much
-                "pinned_bytes": sum(e.nbytes
-                                    for e in self._entries.values()
-                                    if e.pins > 0),
+                "pinned_bytes": self._pinned,
                 "evictions": self.evictions,
                 "rejections": self.rejections,
                 "drops": self.drops,

@@ -137,7 +137,8 @@ def main(argv=None) -> int:
                     placement_rows=cc.placement_rows,
                     slice_trip_strikes=cc.slice_trip_strikes,
                     slice_probe_cooldown_s=cc.slice_probe_cooldown_s,
-                    slice_latency_outlier_s=cc.slice_latency_outlier_s)
+                    slice_latency_outlier_s=cc.slice_latency_outlier_s,
+                    flight_recorder_depth=cc.flight_recorder_depth)
             else:
                 device_runner = DeviceRunner()
         if args.status_addr and config is not None:

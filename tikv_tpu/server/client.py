@@ -633,7 +633,8 @@ class TxnClient:
                     resource_group: str = "default",
                     request_source: str = "",
                     timeout: float = 10,
-                    deadline_ms: Optional[int] = None) -> dict:
+                    deadline_ms: Optional[int] = None,
+                    trace_id: Optional[str] = None) -> dict:
         key = key_hint if key_hint is not None else \
             (dag.ranges[0].start if dag.ranges else b"")
         req = {
@@ -642,6 +643,11 @@ class TxnClient:
             "paging_size": paging_size, "resume_token": resume_token,
             "resource_group": resource_group,
             "request_source": request_source}
+        if trace_id is not None:
+            # client-propagated causal trace id (the server mints one
+            # otherwise); sending it forces span sampling and the
+            # response echoes it next to time_detail
+            req["trace_id"] = trace_id
         if deadline_ms is not None:
             # the endpoint checks this budget at admission, between
             # executor batches, and before the device dispatch

@@ -523,9 +523,26 @@ class RequestCoalescer:
         # the group's dispatch work (feed lookup, kernel cache, launch)
         # is attributed to the LEADER's TimeDetail — one member carries
         # the shared cost's phases; every member still records its own
-        # coalesce_wait and resolution phases
-        lead_tok = tracker.adopt(members[0].tracker) \
-            if members[0].tracker is not None else None
+        # coalesce_wait and resolution phases.  The explicit
+        # group_dispatch span wraps the shared launch on the leader's
+        # trace and is follows-from linked into every OTHER member's
+        # trace (with occupancy + lane index) so "my request stacked
+        # behind a group-mate" reads from any one member's trace.
+        lead_tr = members[0].tracker
+        # the span lives on the first SAMPLED member's trace (usually
+        # the leader's) — a client-forced trace in lane 3 must not lose
+        # the group correlation just because lane 0 went unsampled
+        span_tr = next((m.tracker for m in members
+                        if m.tracker is not None and
+                        getattr(m.tracker, "sampled", False)), None)
+        gsp = None
+        if span_tr is not None:
+            gsp = span_tr.begin("group_dispatch")
+            span_tr.annotate_span(gsp, occupancy=size,
+                                  group_kind=str(group.key[0]))
+        lead_tok = tracker.adopt(
+            lead_tr, parent=gsp if span_tr is lead_tr else None) \
+            if lead_tr is not None else None
         t0 = time.perf_counter()
         try:
             if fail_point("copr::coalesce_dispatch") is not None:
@@ -559,6 +576,15 @@ class RequestCoalescer:
         finally:
             if lead_tok is not None:
                 tracker.uninstall(lead_tok)
+            if gsp is not None:
+                span_tr.end(gsp)
+                for i, mm in enumerate(members):
+                    mtr = mm.tracker
+                    if mtr is None or mtr is span_tr or \
+                            not getattr(mtr, "sampled", False):
+                        continue    # the span host HAS the span itself
+                    mtr.link_from("group_dispatch", span_tr.trace_id,
+                                  gsp.span_id, occupancy=size, lane=i)
         self.router.note_launch(time.perf_counter() - t0, size)
         t_dispatch_ns = time.perf_counter_ns()
         for m, resolve in zip(members, resolvers):
@@ -601,10 +627,15 @@ class RequestCoalescer:
                 # window, split out of generic queue time so the
                 # batched-path p99 can be decomposed from the artifact
                 tracker.add_phase("coalesce_wait", max(0, wait_ns))
-                if m.tag is not None:
-                    with GLOBAL_RECORDER.attach(m.tag, requests=0):
-                        return resolve()
-                return resolve()
+                # group_fetch_wait: this member's join of the group's
+                # shared (memoized) fetch — for the first joiner it
+                # nests the real d2h_wait/host_materialize spans, for
+                # the rest it IS the wait on the memo
+                with tracker.span("group_fetch_wait"):
+                    if m.tag is not None:
+                        with GLOBAL_RECORDER.attach(m.tag, requests=0):
+                            return resolve()
+                    return resolve()
             finally:
                 if tok is not None:
                     tracker.uninstall(tok)

@@ -535,10 +535,31 @@ class Node:
                     self.raft_store.coprocessor_host.register(
                         self.cold_stream)
                     self.copr_cache.stream_source = self.cold_stream
+        # causal request tracing (utils/trace.py): per-node retention
+        # buffer behind /debug/trace — tail-biased (slowest per class +
+        # every errored/late/shed/degraded request pinned past the ring)
+        from ..utils.trace import TraceBuffer
+        self.trace_buffer = TraceBuffer(
+            capacity=config.coprocessor.trace_buffer)
+        if device_runner is not None and \
+                hasattr(device_runner, "flight_recorder") and \
+                config.coprocessor.flight_recorder_depth > 0:
+            device_runner.flight_recorder.set_depth(
+                config.coprocessor.flight_recorder_depth)
         # online reconfig (online_config ConfigManager registrations)
         self.config_controller.register("coprocessor", self._copr_cfg)
 
     def _copr_cfg(self, diff: dict) -> None:
+        # tracing knobs: trace_sample / slow_log_threshold_ms are read
+        # live off the config tree by the service per request; only the
+        # bounded stores need an explicit poke
+        if "trace_buffer" in diff:
+            self.trace_buffer.set_capacity(int(diff["trace_buffer"]))
+        if "flight_recorder_depth" in diff and \
+                self.device_runner is not None and \
+                hasattr(self.device_runner, "flight_recorder"):
+            self.device_runner.flight_recorder.set_depth(
+                int(diff["flight_recorder_depth"]))
         if "device_row_threshold" in diff:
             self.endpoint._device_row_threshold = \
                 diff["device_row_threshold"]

@@ -262,6 +262,26 @@ class CompletionPool:
 
     def submit(self, fn, priority: str = "normal"):
         import concurrent.futures as cf
+
+        from ..utils import tracker
+        # queue-wait attribution: the span tree must show time a
+        # deferred fetch spent WAITING for a completion worker apart
+        # from the D2H wait itself — under completion-pool saturation
+        # that queue is exactly where warm-path latency hides
+        cur = tracker.current()
+        if cur is not None:
+            t_enq = time.perf_counter_ns()
+            inner = fn
+
+            def fn():
+                tok = tracker.adopt(cur)
+                try:
+                    tracker.add_phase(
+                        "completion_queue_wait",
+                        time.perf_counter_ns() - t_enq)
+                finally:
+                    tracker.uninstall(tok)
+                return inner()
         fut: "cf.Future" = cf.Future()
         with self._mu:
             if self._shutdown:

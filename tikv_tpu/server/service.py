@@ -9,6 +9,9 @@ callables dict → dict; server.py binds them to gRPC methods.
 
 from __future__ import annotations
 
+import logging
+import random
+import re
 import threading
 import time
 from typing import Callable, Optional
@@ -35,6 +38,14 @@ _READ_METHODS = {
     "RawGet": "high", "RawBatchGet": "high", "RawScan": "normal",
     "Coprocessor": "normal",
 }
+
+# the slow-query channel (TiKV slow_log!): one redacted line per
+# request over coprocessor.slow_log_threshold_ms
+_slow_query_logger = logging.getLogger("tikv_tpu.slow_query")
+
+# client-supplied trace ids: opaque but BOUNDED — url-safe charset,
+# ≤64 chars (they key the retention buffer and ride logs verbatim)
+_TRACE_ID_RE = re.compile(r"[0-9A-Za-z_-]{1,64}")
 
 
 class KvService:
@@ -65,9 +76,6 @@ class KvService:
             return {"error": wire.enc_error(e)}
 
     def handle(self, method: str, req: dict) -> dict:
-        from ..utils import deadline as dl_mod
-        from ..utils import metrics as m
-        from ..utils.deadline import Deadline, DeadlineExceeded
         if self.paused:
             # ServiceEvent.PAUSE_GRPC (components/service): reject
             # instead of queueing — clients back off and retry
@@ -76,42 +84,94 @@ class KvService:
         fn = getattr(self, method, None)
         if fn is None:
             return {"error": {"kind": "unimplemented", "method": method}}
+        prio = _READ_METHODS.get(method)
+        if prio is None:
+            return self._dispatch_rpc(method, fn, req, None)
+        # per-request causal trace (components/tracker + minitrace):
+        # installed BEFORE admission/decode so even a shed or
+        # deadline-exceeded response carries TimeDetail + trace_id —
+        # late/rejected work is debuggable from the response alone.  A
+        # client-supplied trace_id forces sampling (the caller is
+        # asking for this trace); otherwise coprocessor.trace_sample
+        # gates span recording, and unsampled requests still pay only
+        # the flat phase accumulation the old tracker cost.
+        tid = req.get("trace_id") if isinstance(req, dict) else None
+        if tid is not None and not (
+                isinstance(tid, str) and 0 < len(tid) <= 64 and
+                _TRACE_ID_RE.fullmatch(tid)):
+            # a hostile/garbage client id would be stored per request,
+            # echoed in every response, and printed in the slow-query
+            # line — mint a server id instead of honoring it
+            tid = None
+        sample = getattr(self.node.config.coprocessor,
+                         "trace_sample", 1.0)
+        sampled = tid is not None or sample >= 1.0 or \
+            (sample > 0.0 and random.random() < sample)
+        tr, tok = tracker.install(trace_id=tid, sampled=sampled)
+        try:
+            resp = self._dispatch_rpc(method, fn, req, prio)
+        finally:
+            tracker.uninstall(tok)
+        return self._seal_traced(method, req, resp, tr)
+
+    def _dispatch_rpc(self, method: str, fn, req: dict, prio) -> dict:
+        from ..utils import deadline as dl_mod
+        from ..utils import metrics as m
+        from ..utils.deadline import Deadline, DeadlineExceeded
         # deadline admission (overload defense): the request carries its
         # REMAINING budget at send time; work that is dead on arrival is
         # shed before touching the read pool or the resource bucket
-        dl = None
-        budget = req.get("deadline_ms") if isinstance(req, dict) else None
-        if budget is not None:
-            dl = Deadline.after_ms(budget)
-            try:
-                dl.check("admission")
-            except DeadlineExceeded as e:
-                m.GRPC_MSG_COUNTER.labels(method, "err").inc()
-                return {"error": wire.enc_error(e)}
-        # resource-control admission: the group's token bucket throttles
-        # BEFORE the request runs (resource_control ResourceLimiter);
-        # a second charge after the response covers the bytes touched
-        group = req.get("resource_group") if isinstance(req, dict) \
-            else None
-        rgm = self.node.resource_groups
-        rgm.charge_request(group)
-        prio = _READ_METHODS.get(method)
-        # read-pool compile-class key: the pool's service-time EWMA is
-        # keyed by the request's COST SHAPE, not just "a read" — for
-        # coprocessor requests the const-blind plan class (a rotating
-        # threshold shares its class; a hash-agg does not share a
-        # point-select's), the RPC method otherwise.  The DAG decode is
-        # reused by the Coprocessor handler below (stashed on the
-        # request) so the classing costs no second parse.
-        class_key = method if prio is not None else None
-        if method == "Coprocessor" and isinstance(req, dict) and \
-                "dag" in req:
-            try:
-                dag_obj = wire.dec_dag(req["dag"])
-                req["__dag"] = dag_obj
-                class_key = ("copr", dag_obj.class_key())
-            except Exception:   # noqa: BLE001 — handler reports decode
-                pass
+        # the admission umbrella: deadline/resource gating + compile-
+        # class keying — finer spans (plan_decode) nest inside; what
+        # they don't cover is still attributed, not "untracked"
+        with tracker.span("admission"):
+            # deadline admission (overload defense): the request
+            # carries its REMAINING budget at send time; work that is
+            # dead on arrival is shed before touching the read pool or
+            # the resource bucket
+            dl = None
+            budget = req.get("deadline_ms") \
+                if isinstance(req, dict) else None
+            if budget is not None:
+                dl = Deadline.after_ms(budget)
+                try:
+                    dl.check("admission")
+                except DeadlineExceeded as e:
+                    m.GRPC_MSG_COUNTER.labels(method, "err").inc()
+                    return {"error": wire.enc_error(e)}
+            # resource-control admission: the group's token bucket
+            # throttles BEFORE the request runs (resource_control
+            # ResourceLimiter); a second charge after the response
+            # covers the bytes touched
+            group = req.get("resource_group") if isinstance(req, dict) \
+                else None
+            rgm = self.node.resource_groups
+            rgm.charge_request(group)
+            # read-pool compile-class key: the pool's service-time EWMA
+            # is keyed by the request's COST SHAPE, not just "a read" —
+            # for coprocessor requests the const-blind plan class (a
+            # rotating threshold shares its class; a hash-agg does not
+            # share a point-select's), the RPC method otherwise.  The
+            # DAG decode is reused by the Coprocessor handler below
+            # (stashed on the request) so the classing costs no second
+            # parse.
+            class_key = method if prio is not None else None
+            if method == "Coprocessor" and isinstance(req, dict) and \
+                    "dag" in req:
+                try:
+                    with tracker.phase("plan_decode"):
+                        dag_obj = wire.dec_dag(req["dag"])
+                    req["__dag"] = dag_obj
+                    class_key = ("copr", dag_obj.class_key())
+                    # stash for the seal step: slow-log range redaction
+                    # + trace-buffer class retention (__dag itself is
+                    # popped by the handler)
+                    req["__trace_class"] = class_key
+                    if dag_obj.ranges:
+                        req["__trace_range_start"] = \
+                            dag_obj.ranges[0].start
+                except Exception:   # noqa: BLE001 — handler reports it
+                    pass
         t0 = time.perf_counter()
         # the deadline rides a thread-local so the executor pipeline
         # (between batches) and the device dispatch path can shed
@@ -119,32 +179,26 @@ class KvService:
         dl_tok = dl_mod.install(dl) if dl is not None else None
         try:
             if prio is not None:
-                # per-request tracker (components/tracker/src/lib.rs):
-                # every layer below attributes wall/wait/scan into it;
-                # the accumulated TimeDetail/ScanDetail return on the
-                # wire
-                tr, tok = tracker.install()
-                try:
-                    resp = self._guard(
-                        lambda r: self.read_pool.run(
-                            lambda: fn(r), prio, deadline=dl,
-                            class_key=class_key), req)
-                    d = resp.pop("__deferred", None) \
-                        if isinstance(resp, dict) else None
-                    if d is not None:
-                        # async copr: the read-pool slot covered only
-                        # the dispatch; the D2H fetch resolves on the
-                        # endpoint's completion pool while THIS thread
-                        # parks here — N in-flight requests overlap
-                        # their device round trips, and point reads
-                        # keep getting slots
-                        resp = self._guard(
-                            lambda _r: self._enc_cop_resp(d.wait()), req)
-                finally:
-                    tracker.uninstall(tok)
-                if isinstance(resp, dict) and "error" not in resp:
-                    resp.setdefault("time_detail", tr.time_detail())
-                    resp.setdefault("scan_detail", tr.scan_detail())
+                resp = self._guard(
+                    lambda r: self.read_pool.run(
+                        lambda: fn(r), prio, deadline=dl,
+                        class_key=class_key), req)
+                d = resp.pop("__deferred", None) \
+                    if isinstance(resp, dict) else None
+                if d is not None:
+                    # async copr: the read-pool slot covered only
+                    # the dispatch; the D2H fetch resolves on the
+                    # endpoint's completion pool while THIS thread
+                    # parks here — N in-flight requests overlap
+                    # their device round trips, and point reads
+                    # keep getting slots.  The await_deferred span is
+                    # the umbrella the completion-side spans (d2h_wait,
+                    # host_materialize, coalesce_wait) decompose.
+                    def _await(_r):
+                        with tracker.span("await_deferred"):
+                            got = d.wait()
+                        return self._enc_cop_resp(got)
+                    resp = self._guard(_await, req)
             else:
                 resp = self._guard(fn, req)
         finally:
@@ -173,6 +227,58 @@ class KvService:
         m.GRPC_MSG_COUNTER.labels(
             method, "err" if resp.get("error") else "ok").inc()
         return resp
+
+    def _seal_traced(self, method: str, req: dict, resp: dict,
+                     tr) -> dict:
+        """Completion tail for every traced read: freeze the trace,
+        echo trace_id + TimeDetail/ScanDetail on the wire (INCLUDING
+        error responses — a deadline_exceeded or ServerIsBusy answer
+        must be debuggable from the response alone), fire the
+        slow-query log, and hand the trace to the retention buffer."""
+        tr.finish()
+        if isinstance(resp, dict):
+            resp.setdefault("time_detail", tr.time_detail())
+            resp.setdefault("scan_detail", tr.scan_detail())
+            resp.setdefault("trace_id", tr.trace_id)
+        err = resp.get("error") if isinstance(resp, dict) else None
+        kind = err.get("kind") if isinstance(err, dict) else None
+        total_ms = tr.total_ns() / 1e6
+        cc = self.node.config.coprocessor
+        thr = getattr(cc, "slow_log_threshold_ms", 0.0)
+        slow = thr > 0 and total_ms > thr
+        if slow:
+            self._slow_query_log(method, req, tr, total_ms, kind)
+        buf = getattr(self.node, "trace_buffer", None)
+        if buf is not None:
+            buf.record(
+                tr, class_key=req.get("__trace_class", method)
+                if isinstance(req, dict) else method,
+                error=err is not None,
+                late=kind == "deadline_exceeded",
+                shed=kind == "server_is_busy",
+                degraded="degraded" in tr.labels, slow=slow)
+        return resp
+
+    def _slow_query_log(self, method: str, req: dict, tr,
+                        total_ms: float, err_kind) -> None:
+        """TiKV ``slow_log!`` analog: ONE line per over-threshold
+        request, redacted (utils/log_redact.py) — keys render as
+        correlatable digests, never verbatim user data."""
+        from ..utils.log_redact import redact_key
+        key = None
+        if isinstance(req, dict):
+            key = req.get("__trace_range_start") or req.get("key") or \
+                req.get("start_key")
+        phases = sorted(tr.phases.items(), key=lambda kv: -kv[1])[:4]
+        top = " ".join(f"{k}={v / 1e6:.1f}ms" for k, v in phases)
+        labels = " ".join(f"{k}={v}" for k, v in tr.labels.items())
+        _slow_query_logger.warning(
+            "slow-query trace_id=%s method=%s total_ms=%.1f "
+            "wait_ms=%.1f scan_rows=%d key=%s err=%s [%s] [%s]",
+            tr.trace_id, method, total_ms, tr.wait_ns / 1e6,
+            tr.scan_rows,
+            redact_key(bytes(key)) if key is not None else "-",
+            err_kind or "-", top, labels)
 
     # ---------------------------------------------------------- txn KV
 
@@ -331,7 +437,9 @@ class KvService:
     # ---------------------------------------------------------- copr
 
     def _enc_cop_resp(self, resp) -> dict:
-        return {"rows": wire.enc_rows(resp.rows()),
+        with tracker.phase("resp_serialize"):
+            rows = wire.enc_rows(resp.rows())
+        return {"rows": rows,
                 "backend": resp.backend,
                 "elapsed_ns": resp.elapsed_ns,
                 "is_drained": resp.is_drained,
@@ -343,6 +451,13 @@ class KvService:
                     for s in resp.result.exec_summaries]}
 
     def Coprocessor(self, req: dict) -> dict:
+        # umbrella span over the handler (snapshot, backend routing,
+        # dispatch): endpoint overhead between the finer spans stays
+        # attributed instead of falling into the untracked residual
+        with tracker.span("copr_handler"):
+            return self._coprocessor(req)
+
+    def _coprocessor(self, req: dict) -> dict:
         tp = req.get("tp", REQ_TYPE_DAG)
         # handle() stashed its class-keying decode; fall back to a
         # fresh parse for direct callers (tests, batch_commands)
@@ -671,7 +786,13 @@ class KvService:
             if peer is None or \
                     peer.applied_engine < ent.get("applied_index", 0):
                 continue
-            out[rid] = self.node.resolved_ts.resolver(rid).advance(rts)
+            # str keys: wire.unpack runs msgpack's strict_map_key, so
+            # an int-keyed map makes every NON-EMPTY response fail
+            # client-side deserialization (the fan-out discards the
+            # body, but each failed decode logged an error and counted
+            # as a failed call)
+            out[str(rid)] = \
+                self.node.resolved_ts.resolver(rid).advance(rts)
         return {"advanced": out}
 
     # ---------------------------------------------- ImportSST service

@@ -133,12 +133,32 @@ class StatusServer:
                         cold["stream"] = cs.stats()
                     if cold:
                         body["cold_build"] = cold
+                    # causal tracing rollup: live knob values, the
+                    # retention buffer's occupancy, slow-query count,
+                    # and the device flight recorder's launch totals
+                    tb = getattr(node, "trace_buffer", None)
+                    if tb is not None:
+                        cc = node.config.coprocessor
+                        tracing = {
+                            "sample": cc.trace_sample,
+                            "slow_log_threshold_ms":
+                                cc.slow_log_threshold_ms,
+                            "buffer": tb.stats(),
+                        }
+                        fr = getattr(dr, "flight_recorder", None) \
+                            if dr is not None else None
+                        if fr is not None:
+                            tracing["flight_recorder"] = fr.stats()
+                        body["tracing"] = tracing
                     self._json(200, body)
                 elif path == "/config":
                     if outer._controller is None:
                         self._json(404, {"error": "no config controller"})
                     else:
                         self._json(200, outer._controller.cfg.to_dict())
+                elif path == "/debug/trace" or \
+                        path.startswith("/debug/trace/"):
+                    self._get_trace(path)
                 elif path.startswith("/region/"):
                     self._get_region(path)
                 elif path == "/fail_point":
@@ -183,6 +203,47 @@ class StatusServer:
                     self._json(200, memory_usage())
                 else:
                     self._json(404, {"error": f"no route {path}"})
+
+            def _get_trace(self, path: str):
+                """/debug/trace — recent/slowest/flagged trace index +
+                the device flight recorder; /debug/trace/<id> — full
+                span tree; ?format=chrome — Chrome trace-event JSON
+                (loads in Perfetto), follows-from-linked foreign spans
+                included while they remain in the buffer."""
+                node = outer._node
+                buf = getattr(node, "trace_buffer", None) \
+                    if node is not None else None
+                if buf is None:
+                    self._json(404, {"error": "no trace buffer"})
+                    return
+                if path.rstrip("/") == "/debug/trace":
+                    body = buf.index()
+                    dr = getattr(node, "device_runner", None)
+                    fr = getattr(dr, "flight_recorder", None) \
+                        if dr is not None else None
+                    if fr is not None:
+                        body["flight_recorder"] = {
+                            **fr.stats(),
+                            "recent": fr.items(limit=32)}
+                    self._json(200, body)
+                    return
+                trace_id = path[len("/debug/trace/"):].strip("/")
+                tr = buf.get(trace_id)
+                if tr is None:
+                    self._json(404, {
+                        "error": f"trace {trace_id!r} not retained"})
+                    return
+                fmt = ""
+                q = self.path.split("?", 1)
+                if len(q) == 2:
+                    for kv in q[1].split("&"):
+                        if kv.startswith("format="):
+                            fmt = kv[len("format="):]
+                if fmt == "chrome":
+                    from ..utils.trace import to_chrome
+                    self._json(200, to_chrome(tr, resolve=buf.get))
+                else:
+                    self._json(200, tr.to_dict())
 
             def _get_region(self, path: str):
                 if outer._node is None:

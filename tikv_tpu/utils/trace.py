@@ -1,0 +1,667 @@
+"""Causal request tracing: timestamped span trees across the async
+serving stack.
+
+Reference: TiKV ships exactly this layer — minitrace span tracing wired
+through the coprocessor/raftstore stack, the ``slow_log!`` macro, and
+the per-request TimeDetailV2 returned on the wire (components/tracker/
+src/lib.rs).  The flat per-request ``phases_ms`` dict this module grew
+out of had no timestamps, no nesting, and no visibility across the
+thread handoffs where warm-path time actually hides (read-pool queue →
+coalescer window → shared group dispatch → completion-pool D2H wait),
+so a 127ms p50 with 0.6ms of dispatch stayed unattributable.
+
+Model:
+
+- a :class:`Tracker` is one request's trace: a ``trace_id`` (client-
+  supplied or server-minted, echoed on the wire), a root ``rpc`` span,
+  and timestamped child spans with parent links.  The active (trace,
+  ambient-parent-span) pair rides a ``contextvars.ContextVar``;
+  ``adopt()`` re-activates a trace on another thread (completion pool,
+  coalescer dispatcher) so spans recorded there still land in the
+  request's tree — the handoff survives because the span records its
+  own thread id and the tree, not the thread, is the unit of identity;
+- ``phase(name)`` opens a child of the ambient span and nests (the
+  ambient moves for the duration); ``add_phase(name, ns)`` records a
+  retroactive span ending now (used where the measured interval ended
+  before a tracker context existed on the measuring thread, e.g. the
+  coalescer window park);
+- follows-from links (``link_from``) tie a coalesced group's single
+  shared dispatch span into every member's trace with occupancy and
+  lane index — "my request was slow because it stacked behind a
+  10M-row group-mate" is readable from one trace;
+- the TimeDetail/ScanDetail WIRE SHAPE is unchanged: ``phases_ms``
+  still accumulates name → ms (tests and dashboards keep working), the
+  span tree is additive.  Unsampled trackers (``coprocessor.
+  trace_sample``) skip span objects entirely and cost what the flat
+  tracker cost.
+
+:class:`TraceBuffer` retains finished traces for the status server's
+``/debug/trace`` surface with TAIL-BIASED retention: a bounded ring of
+recent traces, plus the slowest N per request class and every errored/
+late/shed/degraded request pinned past ring eviction — the traces an
+operator actually asks for are the ones that survive.  ``to_chrome()``
+exports one trace (plus any follows-from-linked foreign spans still in
+the buffer) as Chrome trace-event JSON that loads in Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from contextlib import contextmanager
+from typing import Optional
+
+# (trace, ambient parent span) — the span new phases nest under
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "tikv_tpu_trace", default=None)
+
+ROOT_SPAN_NAME = "rpc"
+UNTRACKED_NAME = "untracked"
+
+
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+class Span:
+    """One timed operation in a trace.  ``t1 is None`` while open.
+    ``links``: follows-from references into OTHER traces
+    ({trace_id, span_id}) — causal predecessors that are not parents."""
+
+    __slots__ = ("name", "span_id", "parent_id", "t0", "t1", "tid",
+                 "attrs", "links")
+
+    def __init__(self, name: str, span_id: int, parent_id,
+                 t0: int, tid: int):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = t0
+        self.t1: Optional[int] = None
+        self.tid = tid
+        self.attrs: Optional[dict] = None
+        self.links: Optional[list] = None
+
+    def to_dict(self, base_ns: int, end_ns: int) -> dict:
+        t1 = self.t1 if self.t1 is not None else end_ns
+        d = {"name": self.name, "span_id": self.span_id,
+             "parent_id": self.parent_id,
+             "start_us": round((self.t0 - base_ns) / 1e3, 1),
+             "dur_us": round(max(0, t1 - self.t0) / 1e3, 1)}
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.links:
+            d["follows_from"] = list(self.links)
+        return d
+
+
+class Tracker:
+    """One request's cost attribution + causal span tree.
+
+    Kept name (``Tracker``) and accumulation API so every existing
+    call site — and the TimeDetailV2/ScanDetailV2 wire shape — survive
+    the upgrade; the span tree is what's new.
+    """
+
+    __slots__ = ("trace_id", "sampled", "t0", "wall_t0", "t1",
+                 "wait_ns", "phases", "scan_rows", "scan_bytes",
+                 "labels", "_mu", "_next_id", "spans", "root")
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 sampled: bool = True):
+        self.trace_id = trace_id or new_trace_id()
+        self.sampled = sampled
+        self.t0 = time.perf_counter_ns()
+        self.wall_t0 = time.time()
+        self.t1: Optional[int] = None       # set by finish()
+        self.wait_ns = 0            # read-pool queue/slot wait
+        self.phases: dict[str, int] = {}    # name -> ns (wire shape)
+        self.scan_rows = 0          # processed versions / rows
+        self.scan_bytes = 0
+        self.labels: dict[str, str] = {}    # e.g. cache: hit|build
+        self._mu = threading.Lock()
+        self._next_id = 0
+        self.spans: list[Span] = []
+        self.root: Optional[Span] = None
+        if sampled:
+            self.root = self._new_span(ROOT_SPAN_NAME, None, self.t0)
+
+    # -- span tree --
+
+    def _new_span(self, name: str, parent_id, t0: Optional[int] = None
+                  ) -> Span:
+        with self._mu:
+            self._next_id += 1
+            sp = Span(name, self._next_id, parent_id,
+                      t0 if t0 is not None else time.perf_counter_ns(),
+                      threading.get_ident())
+            self.spans.append(sp)
+        return sp
+
+    def begin(self, name: str, parent: Optional[Span] = None,
+              t0: Optional[int] = None) -> Optional[Span]:
+        """Open a child span (of ``parent``, default the root); the
+        caller owns closing it by setting ``span.t1``.  None when the
+        trace is unsampled — callers treat the span as optional."""
+        if not self.sampled:
+            return None
+        pid = (parent.span_id if parent is not None
+               else (self.root.span_id if self.root is not None
+                     else None))
+        return self._new_span(name, pid, t0)
+
+    def end(self, span: Optional[Span],
+            t1: Optional[int] = None) -> None:
+        """Close ``span`` exactly once (idempotent: a second close is
+        ignored so a handoff race can never re-open or re-time it)."""
+        if span is not None and span.t1 is None:
+            span.t1 = t1 if t1 is not None else time.perf_counter_ns()
+
+    def annotate_span(self, span: Optional[Span], **attrs) -> None:
+        if span is None:
+            return
+        with self._mu:
+            if span.attrs is None:
+                span.attrs = {}
+            span.attrs.update(attrs)
+
+    def link_from(self, name: str, src_trace_id: str, src_span_id: int,
+                  parent: Optional[Span] = None, **attrs
+                  ) -> Optional[Span]:
+        """Record a follows-from link: this trace's causal predecessor
+        is span ``src_span_id`` of ``src_trace_id`` (a shared group
+        dispatch, typically).  Materialized as a zero-duration marker
+        span carrying the link + attrs (occupancy, lane index)."""
+        sp = self.begin(name, parent)
+        if sp is None:
+            return None
+        sp.t1 = sp.t0
+        sp.links = [{"trace_id": src_trace_id, "span_id": src_span_id}]
+        if attrs:
+            self.annotate_span(sp, **attrs)
+        return sp
+
+    def finish(self) -> None:
+        """Freeze the trace: total wall stops here, the root closes,
+        and any span left open (a handoff that never resolved) is
+        clamped so export/breakdown see a closed tree."""
+        if self.t1 is None:
+            self.t1 = time.perf_counter_ns()
+        with self._mu:
+            for sp in self.spans:
+                if sp.t1 is None:
+                    sp.t1 = self.t1
+
+    # -- accumulation (the PRE-SPAN API, kept verbatim) --
+
+    def add(self, name: str, ns: int) -> None:
+        with self._mu:
+            self.phases[name] = self.phases.get(name, 0) + int(ns)
+
+    def add_wait(self, ns: int) -> None:
+        self.wait_ns += int(ns)
+
+    def add_scan(self, rows: int, nbytes: int = 0) -> None:
+        self.scan_rows += int(rows)
+        self.scan_bytes += int(nbytes)
+
+    def label(self, key: str, value: str) -> None:
+        self.labels[key] = value
+
+    # -- serialization (TimeDetailV2 / ScanDetailV2 shape) --
+
+    def total_ns(self) -> int:
+        return (self.t1 if self.t1 is not None
+                else time.perf_counter_ns()) - self.t0
+
+    def time_detail(self) -> dict:
+        total = self.total_ns()
+        proc = total - self.wait_ns
+        d = {
+            "total_rpc_wall_ms": round(total / 1e6, 3),
+            "wait_wall_ms": round(self.wait_ns / 1e6, 3),
+            "process_wall_ms": round(proc / 1e6, 3),
+            "phases_ms": {k: round(v / 1e6, 3)
+                          for k, v in self.phases.items()},
+        }
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        return d
+
+    def scan_detail(self) -> dict:
+        return {
+            "processed_versions": self.scan_rows,
+            "processed_versions_size": self.scan_bytes,
+        }
+
+    # -- decomposition --
+
+    def breakdown(self) -> dict:
+        """Non-overlapping decomposition of the root wall into per-name
+        milliseconds + the explicit ``untracked`` residual.
+
+        Elementary-segment sweep: every instant of the root interval is
+        attributed to the INNERMOST span covering it (latest start wins
+        — a ``d2h_wait`` recorded by the completion worker takes the
+        segment from the service thread's ``await_deferred`` umbrella),
+        so the values sum exactly to ``total_rpc_wall_ms`` and sibling
+        overlap across threads cannot double-count.
+        """
+        end = self.t1 if self.t1 is not None else time.perf_counter_ns()
+        if self.root is None:
+            return {UNTRACKED_NAME: round((end - self.t0) / 1e6, 3)}
+        r0 = self.root.t0
+        r1 = self.root.t1 if self.root.t1 is not None else end
+        with self._mu:
+            spans = [s for s in self.spans if s is not self.root]
+        ivs = []
+        for s in spans:
+            t1 = s.t1 if s.t1 is not None else r1
+            a, b = max(s.t0, r0), min(t1, r1)
+            if b > a:
+                ivs.append((a, b, s))
+        pts = sorted({r0, r1, *(a for a, _, _ in ivs),
+                      *(b for _, b, _ in ivs)})
+        out: dict[str, int] = {}
+        covered = 0
+        for a, b in zip(pts, pts[1:]):
+            if b <= a:
+                continue
+            cover = [s for (x, y, s) in ivs if x <= a and y >= b]
+            if not cover:
+                continue
+            s = max(cover, key=lambda sp: (sp.t0, sp.span_id))
+            out[s.name] = out.get(s.name, 0) + (b - a)
+            covered += b - a
+        out[UNTRACKED_NAME] = max(0, (r1 - r0) - covered)
+        return {k: round(v / 1e6, 3) for k, v in out.items()}
+
+    def coverage(self) -> float:
+        """Fraction of the root wall decomposed into named spans
+        (1 − untracked/total); the ≥0.95 acceptance figure."""
+        bd = self.breakdown()
+        total = sum(bd.values())
+        if total <= 0:
+            return 1.0
+        return 1.0 - bd.get(UNTRACKED_NAME, 0.0) / total
+
+    def to_dict(self) -> dict:
+        end = self.t1 if self.t1 is not None else time.perf_counter_ns()
+        with self._mu:
+            spans = [s.to_dict(self.t0, end) for s in self.spans]
+        return {
+            "trace_id": self.trace_id,
+            "start_unix_s": round(self.wall_t0, 6),
+            "total_ms": round((end - self.t0) / 1e6, 3),
+            "labels": dict(self.labels),
+            "time_detail": self.time_detail(),
+            "scan_detail": self.scan_detail(),
+            "spans": spans,
+            "breakdown_ms": self.breakdown(),
+        }
+
+
+# ------------------------------------------------------------- context
+
+def install(trace_id: Optional[str] = None, sampled: bool = True
+            ) -> tuple[Tracker, contextvars.Token]:
+    """Create + activate a tracker; pair with :func:`uninstall`."""
+    tr = Tracker(trace_id=trace_id, sampled=sampled)
+    return tr, _current.set((tr, tr.root))
+
+
+def adopt(tr: Tracker, parent: Optional[Span] = None
+          ) -> contextvars.Token:
+    """Activate an EXISTING tracker on this thread; pair with
+    :func:`uninstall`.  The async coprocessor path hands the request's
+    tracker to a completion-pool worker so the deferred device fetch
+    still attributes into the request's TimeDetail and span tree.
+    ``parent``: ambient span new phases nest under (default: the
+    root) — the coalescer adopts the leader under its group_dispatch
+    span so the shared launch work nests where it belongs."""
+    return _current.set(
+        (tr, parent if parent is not None else tr.root))
+
+
+def uninstall(token: contextvars.Token) -> None:
+    _current.reset(token)
+
+
+def current() -> Optional[Tracker]:
+    got = _current.get()
+    return got[0] if got is not None else None
+
+
+def current_span() -> Optional[Span]:
+    got = _current.get()
+    return got[1] if got is not None else None
+
+
+@contextmanager
+def phase(name: str):
+    """Attribute the enclosed wall time to ``name`` on the active
+    tracker (no-op without one): accumulates into ``phases_ms`` AND —
+    when sampled — opens a nesting child span of the ambient span."""
+    got = _current.get()
+    if got is None:
+        yield None
+        return
+    tr, parent = got
+    t0 = time.perf_counter_ns()
+    sp = tr.begin(name, parent, t0) if tr.sampled else None
+    tok = _current.set((tr, sp)) if sp is not None else None
+    try:
+        yield tr
+    finally:
+        t1 = time.perf_counter_ns()
+        if tok is not None:
+            _current.reset(tok)
+        tr.end(sp, t1)
+        tr.add(name, t1 - t0)
+
+
+@contextmanager
+def span(name: str):
+    """Span-ONLY timing: records a child span but does NOT accumulate
+    into ``phases_ms`` — for umbrella intervals that other phases
+    decompose (``await_deferred`` over the completion-side spans,
+    ``group_fetch_wait`` over the shared d2h), so the flat phase dict
+    keeps its historical non-overlapping-sum-≤-total invariant."""
+    got = _current.get()
+    if got is None:
+        yield None
+        return
+    tr, parent = got
+    if not tr.sampled:
+        yield tr
+        return
+    sp = tr.begin(name, parent)
+    tok = _current.set((tr, sp))
+    try:
+        yield tr
+    finally:
+        _current.reset(tok)
+        tr.end(sp)
+
+
+def add_phase(name: str, ns: int) -> None:
+    """Retroactive attribution: ``ns`` of wall ENDING NOW (the interval
+    was measured on a thread that had no tracker context)."""
+    got = _current.get()
+    if got is None:
+        return
+    tr, parent = got
+    ns = max(0, int(ns))
+    tr.add(name, ns)
+    if tr.sampled:
+        now = time.perf_counter_ns()
+        sp = tr.begin(name, parent, now - ns)
+        tr.end(sp, now)
+
+
+def add_wait(ns: int) -> None:
+    got = _current.get()
+    if got is None:
+        return
+    tr, parent = got
+    tr.add_wait(ns)
+    if tr.sampled and ns > 0:
+        now = time.perf_counter_ns()
+        sp = tr.begin("read_pool_wait", parent, now - int(ns))
+        tr.end(sp, now)
+
+
+def add_scan(rows: int, nbytes: int = 0) -> None:
+    tr = current()
+    if tr is not None:
+        tr.add_scan(rows, nbytes)
+
+
+def label(key: str, value: str) -> None:
+    tr = current()
+    if tr is not None:
+        tr.label(key, value)
+
+
+def annotate(**attrs) -> None:
+    """Attach attributes to the innermost OPEN span of this context
+    (the device dispatch sites hang their flight-recorder entry here)."""
+    got = _current.get()
+    if got is None:
+        return
+    tr, sp = got
+    if sp is not None and sp is not tr.root:
+        tr.annotate_span(sp, **attrs)
+
+
+# ------------------------------------------------------- chrome export
+
+def to_chrome(tr: Tracker, resolve=None) -> dict:
+    """One trace as Chrome trace-event JSON (loads in Perfetto /
+    chrome://tracing).  Spans become complete ("X") events on per-
+    thread lanes; follows-from links become flow events ("s"→"f"), and
+    when ``resolve(trace_id)`` finds the linked foreign trace still in
+    the buffer, its target span is included on a peer process lane so
+    "stacked behind a group-mate" is visible in THIS trace's export.
+    Untracked residual segments are emitted as explicit slices."""
+    end = tr.t1 if tr.t1 is not None else time.perf_counter_ns()
+    events: list = []
+    tids: dict[int, int] = {}
+    with tr._mu:
+        spans = list(tr.spans)
+    # resolve follows-from targets FIRST: a linked foreign span (the
+    # shared group dispatch in the leader's trace) may predate this
+    # trace's start, and Chrome timestamps must stay non-negative — the
+    # export's time base is the earliest included instant
+    foreign: dict[tuple, Span] = {}
+    for sp in spans:
+        for link in (sp.links or ()):
+            key = (link["trace_id"], link["span_id"])
+            if key in foreign:
+                continue
+            src_tr = resolve(link["trace_id"]) if resolve is not None \
+                else None
+            if src_tr is None:
+                continue
+            with src_tr._mu:
+                src = next((s for s in src_tr.spans
+                            if s.span_id == link["span_id"]), None)
+            if src is not None:
+                foreign[key] = src
+    base = min([tr.t0] + [s.t0 for s in foreign.values()])
+
+    def lane(tid: int) -> int:
+        if tid not in tids:
+            tids[tid] = len(tids) + 1
+        return tids[tid]
+
+    def ts(ns: int) -> float:
+        return round((ns - base) / 1e3, 3)       # µs
+
+    events.append({"name": "process_name", "ph": "M", "pid": 1,
+                   "tid": 0, "ts": 0,
+                   "args": {"name": f"request {tr.trace_id}"}})
+    flow_id = 0
+    for sp in spans:
+        t1 = sp.t1 if sp.t1 is not None else end
+        args = {"span_id": sp.span_id, "parent_id": sp.parent_id}
+        if sp.attrs:
+            args.update(sp.attrs)
+        events.append({"name": sp.name, "ph": "X", "cat": "request",
+                       "pid": 1, "tid": lane(sp.tid), "ts": ts(sp.t0),
+                       "dur": round(max(0, t1 - sp.t0) / 1e3, 3),
+                       "args": args})
+        for link in (sp.links or ()):
+            flow_id += 1
+            src = foreign.get((link["trace_id"], link["span_id"]))
+            if src is not None:
+                s1 = src.t1 if src.t1 is not None else end
+                events.append({
+                    "name": f"{src.name} ({link['trace_id']})",
+                    "ph": "X", "cat": "linked", "pid": 2,
+                    "tid": lane(src.tid), "ts": ts(src.t0),
+                    "dur": round(max(0, s1 - src.t0) / 1e3, 3),
+                    "args": {"trace_id": link["trace_id"],
+                             "span_id": src.span_id,
+                             **(src.attrs or {})}})
+                events.append({"name": "follows_from", "ph": "s",
+                               "cat": "link", "id": flow_id, "pid": 2,
+                               "tid": lane(src.tid), "ts": ts(src.t0)})
+                events.append({"name": "follows_from", "ph": "f",
+                               "bp": "e", "cat": "link", "id": flow_id,
+                               "pid": 1, "tid": lane(sp.tid),
+                               "ts": ts(sp.t0)})
+    # explicit untracked residual slices (gaps no span covers)
+    if tr.root is not None:
+        r0 = tr.root.t0
+        r1 = tr.root.t1 if tr.root.t1 is not None else end
+        ivs = sorted((max(s.t0, r0),
+                      min(s.t1 if s.t1 is not None else r1, r1))
+                     for s in spans if s is not tr.root)
+        cursor = r0
+        for a, b in ivs:
+            if a > cursor:
+                events.append({"name": UNTRACKED_NAME, "ph": "X",
+                               "cat": "request", "pid": 1, "tid": 0,
+                               "ts": ts(cursor),
+                               "dur": round((a - cursor) / 1e3, 3),
+                               "args": {}})
+            cursor = max(cursor, b)
+        if r1 > cursor:
+            events.append({"name": UNTRACKED_NAME, "ph": "X",
+                           "cat": "request", "pid": 1, "tid": 0,
+                           "ts": ts(cursor),
+                           "dur": round((r1 - cursor) / 1e3, 3),
+                           "args": {}})
+    return {"displayTimeUnit": "ms", "traceEvents": events,
+            "otherData": {"trace_id": tr.trace_id,
+                          "labels": dict(tr.labels)}}
+
+
+# ------------------------------------------------------- trace buffer
+
+class TraceBuffer:
+    """Tail-biased retention of finished traces (/debug/trace).
+
+    Three stores, one lookup: a bounded RECENT ring (every sampled
+    request), the SLOWEST ``slow_keep`` per request class (pinned past
+    ring eviction — the per-class latency tail an operator actually
+    pages on), and every FLAGGED request (errored / late / shed /
+    degraded / slow-logged), ring-bounded separately.
+    """
+
+    CLASS_MAX = 32          # distinct classes retaining slow pins
+
+    def __init__(self, capacity: int = 256, slow_keep: int = 4):
+        self._mu = threading.Lock()
+        self._cap = max(4, int(capacity))
+        self._slow_keep = max(1, int(slow_keep))
+        self._recent: "OrderedDict[str, Tracker]" = OrderedDict()
+        # class -> [(total_ns, trace_id)] ascending; LRU over classes
+        self._slow: "OrderedDict[str, list]" = OrderedDict()
+        self._slow_traces: dict[str, Tracker] = {}
+        self._flagged: "OrderedDict[str, tuple]" = OrderedDict()
+        self.recorded = 0
+        self.slow_logged = 0
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._mu:
+            self._cap = max(4, int(capacity))
+            self._shrink_locked()
+
+    def _shrink_locked(self) -> None:
+        while len(self._recent) > self._cap:
+            self._recent.popitem(last=False)
+        while len(self._flagged) > self._cap:
+            tid, _ = self._flagged.popitem(last=False)
+
+    def record(self, tr: Tracker, class_key=None, error: bool = False,
+               late: bool = False, shed: bool = False,
+               degraded: bool = False, slow: bool = False) -> None:
+        if not tr.sampled:
+            if slow:
+                with self._mu:
+                    self.slow_logged += 1
+            return
+        total = tr.total_ns()
+        cls = str(class_key) if class_key is not None else "unclassed"
+        flags = [k for k, v in (("error", error), ("late", late),
+                                ("shed", shed), ("degraded", degraded),
+                                ("slow", slow)) if v]
+        with self._mu:
+            self.recorded += 1
+            if slow:
+                self.slow_logged += 1
+            self._recent[tr.trace_id] = tr
+            self._recent.move_to_end(tr.trace_id)
+            if flags:
+                self._flagged[tr.trace_id] = (tr, flags)
+            # slowest-N per class, classes LRU-bounded
+            heap = self._slow.setdefault(cls, [])
+            self._slow.move_to_end(cls)
+            heap.append((total, tr.trace_id))
+            heap.sort()
+            self._slow_traces[tr.trace_id] = tr
+            # clients may reuse a trace_id: an evicted heap entry must
+            # not strip the pin another live entry still references
+            while len(heap) > self._slow_keep:
+                _, evict = heap.pop(0)
+                if not self._slow_refs_locked(evict):
+                    self._slow_traces.pop(evict, None)
+            while len(self._slow) > self.CLASS_MAX:
+                _, old = self._slow.popitem(last=False)
+                for _, tid in old:
+                    if not self._slow_refs_locked(tid):
+                        self._slow_traces.pop(tid, None)
+            self._shrink_locked()
+
+    def _slow_refs_locked(self, trace_id: str) -> bool:
+        """Any live slow-heap entry still referencing ``trace_id``?
+        Bounded: ≤ CLASS_MAX classes × slow_keep entries."""
+        return any(tid == trace_id
+                   for heap in self._slow.values()
+                   for _, tid in heap)
+
+    def get(self, trace_id: str) -> Optional[Tracker]:
+        with self._mu:
+            tr = self._recent.get(trace_id)
+            if tr is None:
+                tr = self._slow_traces.get(trace_id)
+            if tr is None:
+                got = self._flagged.get(trace_id)
+                tr = got[0] if got is not None else None
+            return tr
+
+    def index(self) -> dict:
+        """Listing for /debug/trace: summaries only, newest first."""
+        def summ(tr: Tracker, flags=()) -> dict:
+            return {"trace_id": tr.trace_id,
+                    "total_ms": round(tr.total_ns() / 1e6, 3),
+                    "start_unix_s": round(tr.wall_t0, 3),
+                    "labels": dict(tr.labels),
+                    "spans": len(tr.spans),
+                    **({"flags": list(flags)} if flags else {})}
+        with self._mu:
+            recent = [summ(tr)
+                      for tr in reversed(self._recent.values())]
+            flagged = [summ(tr, flags)
+                       for tr, flags in
+                       reversed(self._flagged.values())]
+            slow = {cls: [{"trace_id": tid,
+                           "total_ms": round(ns / 1e6, 3)}
+                          for ns, tid in reversed(heap)]
+                    for cls, heap in self._slow.items()}
+        return {"recent": recent, "flagged": flagged,
+                "slowest_per_class": slow}
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"capacity": self._cap,
+                    "recent": len(self._recent),
+                    "flagged": len(self._flagged),
+                    "slow_classes": len(self._slow),
+                    "recorded": self.recorded,
+                    "slow_logged": self.slow_logged}

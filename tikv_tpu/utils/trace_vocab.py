@@ -1,0 +1,54 @@
+"""Registered span-name vocabulary for the causal tracing subsystem.
+
+Every ``tracker.phase(...)`` / ``add_phase(...)`` / ``begin(...)`` /
+``link_from(...)`` span name used anywhere in ``tikv_tpu/`` MUST appear
+here (tests/test_trace.py scans the source tree both ways, like the
+failpoint inventory): a typo'd phase label fails CI instead of silently
+forking the latency breakdown into two names no dashboard ever joins.
+The descriptions double as the README's span-vocabulary table — keep
+them one line each.
+"""
+
+from __future__ import annotations
+
+SPAN_VOCABULARY: dict[str, str] = {
+    # -- request envelope (server/service.py, utils/trace.py) --
+    "rpc": "root span: the whole RPC from admission to response",
+    "untracked": "synthesized residual: root wall no child span covers",
+    "admission": "umbrella: deadline/resource gating + class keying",
+    "plan_decode": "wire → DAGRequest decode (compile-class keying)",
+    "copr_handler": "umbrella: coprocessor handler (snapshot, "
+                    "routing, dispatch) — endpoint overhead between "
+                    "finer spans",
+    "read_pool_wait": "queue/slot wait inside the unified read pool",
+    "await_deferred": "service thread parked on the deferred device "
+                      "completion (decomposed by completion-side spans)",
+    "resp_serialize": "SelectResult rows → wire response encode",
+    # -- storage / host pipeline --
+    "kv_read": "point/scan MVCC read through Storage",
+    "snapshot": "raft lease read + engine snapshot acquisition",
+    "columnar_cache": "RegionColumnarCache lookup (hit/patch/build)",
+    "columnar_build": "full columnar line build from the MVCC snapshot",
+    "delta_apply": "committed-write delta patch onto a cached line",
+    "host_exec": "host (numpy) executor pipeline run",
+    "host_materialize": "host finalize: fetched tree → SelectResult",
+    # -- async serving stack --
+    "completion_queue_wait": "wait for a completion-pool worker slot",
+    "coalesce_wait": "time parked in a coalescer collection window",
+    "group_dispatch": "shared dispatch of one coalesced group "
+                      "(follows-from linked into every member trace)",
+    "group_fetch_wait": "member resolution joining the group's shared "
+                        "(memoized) fetch",
+    # -- device backend (device/runner.py) --
+    "device_dispatch": "kernel launch enqueue (flight-recorder attrs)",
+    "d2h_wait": "device→host transfer + sync wait",
+    "feed_upload": "cold H2D upload of the columnar feed",
+    "feed_patch": "delta-dirty span patch of a resident feed",
+    "shard_merge": "host-side merge of per-shard partial agg states",
+    "mesh_rebuild": "elastic degrade: re-mint serving on a submesh",
+    # -- cold path (device/mvcc.py, copr/stream_build.py) --
+    "mvcc_parse": "CF_WRITE → flat plane parse (native/host)",
+    "mvcc_resolve": "device segmented-argmax MVCC version resolution",
+    "stream_take": "cold-stream handoff wait at build time",
+    "h2d_stream": "streaming per-chunk H2D upload during the load",
+}

@@ -1,4 +1,5 @@
-"""Per-request tracker: TimeDetail/ScanDetail attribution.
+"""Per-request tracker: TimeDetail/ScanDetail attribution — now backed
+by the causal tracing subsystem in :mod:`tikv_tpu.utils.trace`.
 
 Reference: components/tracker/src/lib.rs:16,32-40 — TiKV allocates a
 tracker per request in a slab, layers attribute wall/wait/scan costs to
@@ -6,136 +7,34 @@ the current request through a task-local handle, and the accumulated
 TimeDetailV2/ScanDetailV2 return on the wire with every response, so a
 slow request can be decomposed from the response alone.
 
-Here the slab+token pair is a ``contextvars.ContextVar`` holding the
-active :class:`Tracker`: the service installs one per read RPC, every
-layer below (read pool admission, snapshot acquisition, columnar cache
-build, device feed upload / dispatch / readback, host execution) adds
-into it if present, and the service serializes ``time_detail`` /
-``scan_detail`` onto the response dict.  All hooks are no-ops when no
-tracker is installed, so internal callers pay one ContextVar.get().
+This module keeps the historical import surface (every layer does
+``from ..utils import tracker`` and calls ``phase``/``add_phase``/
+``add_wait``/``add_scan``/``label``/``install``/``adopt``) while the
+implementation lives in ``trace.py``: the same ``phase(...)`` call that
+used to bump a flat name→ns dict now ALSO opens a timestamped child
+span in the request's trace tree, ``adopt()`` carries the tree across
+thread handoffs (completion pool, coalescer dispatcher), and the
+TimeDetail wire shape is unchanged.  See trace.py for the span model,
+follows-from links, and the /debug/trace retention buffer.
 """
 
 from __future__ import annotations
 
-import contextvars
-import time
-from contextlib import contextmanager
-from typing import Optional
-
-_current: contextvars.ContextVar = contextvars.ContextVar(
-    "tikv_tpu_tracker", default=None)
-
-
-class Tracker:
-    """Accumulates one request's cost attribution."""
-
-    __slots__ = ("t0", "wait_ns", "phases", "scan_rows", "scan_bytes",
-                 "labels")
-
-    def __init__(self):
-        self.t0 = time.perf_counter_ns()
-        self.wait_ns = 0            # read-pool queue/slot wait
-        self.phases: dict[str, int] = {}    # name -> ns
-        self.scan_rows = 0          # processed versions / rows
-        self.scan_bytes = 0
-        self.labels: dict[str, str] = {}    # e.g. cache: hit|build
-
-    # -- accumulation --
-
-    def add(self, name: str, ns: int) -> None:
-        self.phases[name] = self.phases.get(name, 0) + int(ns)
-
-    def add_wait(self, ns: int) -> None:
-        self.wait_ns += int(ns)
-
-    def add_scan(self, rows: int, nbytes: int = 0) -> None:
-        self.scan_rows += int(rows)
-        self.scan_bytes += int(nbytes)
-
-    def label(self, key: str, value: str) -> None:
-        self.labels[key] = value
-
-    # -- serialization (TimeDetailV2 / ScanDetailV2 shape) --
-
-    def time_detail(self) -> dict:
-        total = time.perf_counter_ns() - self.t0
-        proc = total - self.wait_ns
-        d = {
-            "total_rpc_wall_ms": round(total / 1e6, 3),
-            "wait_wall_ms": round(self.wait_ns / 1e6, 3),
-            "process_wall_ms": round(proc / 1e6, 3),
-            "phases_ms": {k: round(v / 1e6, 3)
-                          for k, v in self.phases.items()},
-        }
-        if self.labels:
-            d["labels"] = dict(self.labels)
-        return d
-
-    def scan_detail(self) -> dict:
-        return {
-            "processed_versions": self.scan_rows,
-            "processed_versions_size": self.scan_bytes,
-        }
-
-
-def install() -> tuple[Tracker, contextvars.Token]:
-    """Create + activate a tracker; pair with :func:`uninstall`."""
-    tr = Tracker()
-    return tr, _current.set(tr)
-
-
-def adopt(tr: Tracker) -> contextvars.Token:
-    """Activate an EXISTING tracker on this thread; pair with
-    :func:`uninstall`.  The async coprocessor path hands the request's
-    tracker to a completion-pool worker so the deferred device fetch
-    still attributes into the request's TimeDetail (the installing
-    thread blocks on the deferred result meanwhile, so the two never
-    write concurrently)."""
-    return _current.set(tr)
-
-
-def uninstall(token: contextvars.Token) -> None:
-    _current.reset(token)
-
-
-def current() -> Optional[Tracker]:
-    return _current.get()
-
-
-@contextmanager
-def phase(name: str):
-    """Attribute the enclosed wall time to ``name`` on the active
-    tracker (no-op without one)."""
-    tr = _current.get()
-    if tr is None:
-        yield None
-        return
-    t0 = time.perf_counter_ns()
-    try:
-        yield tr
-    finally:
-        tr.add(name, time.perf_counter_ns() - t0)
-
-
-def add_phase(name: str, ns: int) -> None:
-    tr = _current.get()
-    if tr is not None:
-        tr.add(name, ns)
-
-
-def add_wait(ns: int) -> None:
-    tr = _current.get()
-    if tr is not None:
-        tr.add_wait(ns)
-
-
-def add_scan(rows: int, nbytes: int = 0) -> None:
-    tr = _current.get()
-    if tr is not None:
-        tr.add_scan(rows, nbytes)
-
-
-def label(key: str, value: str) -> None:
-    tr = _current.get()
-    if tr is not None:
-        tr.label(key, value)
+from .trace import (      # noqa: F401 — re-exported compat surface
+    Span,
+    TraceBuffer,
+    Tracker,
+    add_phase,
+    add_scan,
+    add_wait,
+    adopt,
+    annotate,
+    current,
+    current_span,
+    install,
+    label,
+    phase,
+    span,
+    to_chrome,
+    uninstall,
+)
