@@ -33,6 +33,12 @@ Configs (BASELINE.md + r4 additions):
       the cross-request batching proof (server/coalescer.py): batched
       P99 ≤ solo P99, mean batch occupancy > 1.5, zero late acks
       (# batch_occupancy= / # router= / # p99_batched_vs_solo= lines)
+  7.  PLAN-IR JOIN: 10M-probe × 1M-build inner equi-join as ONE mixed
+      plan (device scan+selection fused into the probe dispatch,
+      device hash join → late-materialized row-index pairs, host
+      group-by finalize) vs the host hash join on the same plan —
+      parity-gated everywhere, device ≥20× host gated on real TPU
+      (# join_backend= / # join_speedup= / # colocation_hits= lines)
 
 Latency decomposition: "device_sync_floor_ms" reports the cost of ONE
 tiny dispatch+fetch through the device transport — over a tunneled TPU
@@ -221,6 +227,183 @@ def run_pipelined(runner, dag, snap, n: int, n_threads: int = 8,
             "n_requests": n_reqs,
             "rows_per_sec": round(n_reqs * n / dt, 1),
             "total_ms": round(dt * 1e3, 1)}
+
+
+def build_join_pair(n_probe: int, n_build: int, seed: int = 11):
+    """Config-7 shape: a 10M-row probe table (uniform int keys over the
+    build domain + a ~50%-selective value column) against a 1M-row
+    build table with unique keys — the canonical fact×dim equi-join."""
+    from tikv_tpu.datatype import Column, EvalType, FieldType
+    from tikv_tpu.executors.columnar import ColumnarTable
+    from tikv_tpu.testing.fixture import Table, TableColumn
+
+    rng = np.random.default_rng(seed)
+    probe_t = Table(97, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("k", 2, FieldType.long()),
+        TableColumn("v", 3, FieldType.long()),
+    ))
+    ones_p = np.ones(n_probe, dtype=np.bool_)
+    probe = ColumnarTable.from_arrays(
+        probe_t, np.arange(n_probe, dtype=np.int64),
+        {"k": Column(EvalType.INT,
+                     rng.integers(0, n_build, n_probe).astype(np.int64),
+                     ones_p),
+         "v": Column(EvalType.INT,
+                     rng.integers(-1000, 1000, n_probe).astype(np.int64),
+                     ones_p)})
+    build_t = Table(98, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("bk", 2, FieldType.long()),
+        TableColumn("w", 3, FieldType.long()),
+    ))
+    ones_b = np.ones(n_build, dtype=np.bool_)
+    build = ColumnarTable.from_arrays(
+        build_t, np.arange(n_build, dtype=np.int64),
+        {"bk": Column(EvalType.INT,
+                      np.arange(n_build, dtype=np.int64), ones_b),
+         "w": Column(EvalType.INT,
+                     rng.integers(0, 64, n_build).astype(np.int64),
+                     ones_b)})
+    return probe_t, probe, build_t, build
+
+
+def _join_plan(probe_t, build_t):
+    """scan+sel (device leaf) → join (device) → group-by agg (host
+    finalize): the mixed host/device fragment plan in ONE request."""
+    from tikv_tpu.codec.keys import table_record_range
+    from tikv_tpu.copr import plan_ir as pir
+    from tikv_tpu.copr.dag import (
+        AggExprDesc, AggregationDesc, TableScanDesc,
+    )
+    from tikv_tpu.datatype import EvalType
+    from tikv_tpu.executors.ranges import KeyRange
+    from tikv_tpu.expr import Expr
+
+    def scan_node(t):
+        s, e = table_record_range(t.table_id)
+        return pir.ScanNode(
+            TableScanDesc(t.table_id,
+                          tuple(t.column_info(c.name)
+                                for c in t.columns)),
+            (KeyRange(s, e),))
+    ps, bs = scan_node(probe_t), scan_node(build_t)
+    sel = pir.SelectNode(ps, (
+        Expr.column(2, EvalType.INT) > Expr.const(0, EvalType.INT),))
+    join = pir.JoinNode(sel, bs, 1, 1)
+    agg = pir.AggNode(join, AggregationDesc(
+        (Expr.column(5, EvalType.INT),),        # build "w" (≤64 groups)
+        (AggExprDesc("count_star", None),
+         AggExprDesc("sum", Expr.column(2, EvalType.INT))),
+        False))
+    return pir.PlanRequest(agg)
+
+
+def run_join_bench(runner, n_probe: int, n_build: int, host_rows: int,
+                   iters: int):
+    """Config-7: the plan-IR device hash join (copr/plan_ir.py +
+    device/join.py) against the host hash join, same plan, mixed
+    host/device fragments in one request.  Parity-gated at the capped
+    size; the ≥20× device-vs-host gate applies on real TPU."""
+    import jax
+
+    from tikv_tpu.copr.endpoint import Endpoint
+
+    # the device join/sort/window kernels are single-device by
+    # construction (production multi-chip nodes reach them through
+    # placement slices): a whole-mesh bench runner would silently
+    # host-join, so the join leg runs on ONE chip explicitly
+    if getattr(runner, "_single", False):
+        jrunner = runner
+    else:
+        from tikv_tpu.device import DeviceRunner
+        from tikv_tpu.parallel import make_mesh
+        jrunner = DeviceRunner(mesh=make_mesh(jax.devices()[:1]))
+
+    def endpoint_for(psnap, bsnap, pt, bt):
+        snaps = {pt.table_id: psnap, bt.table_id: bsnap}
+
+        def provider(req):
+            return snaps[req.dag.executors[0].table_id]
+        return Endpoint(provider, device_runner=jrunner)
+
+    probe_t, probe, build_t, build = build_join_pair(n_probe, n_build)
+    preq = _join_plan(probe_t, build_t)
+    ep = endpoint_for(probe, build, probe_t, build_t)
+    box = {}
+
+    def run_device():
+        box["r"] = ep.handle_plan(preq, force_backend="device")
+
+    run_device()                    # warm: compile + build dictionary
+    # honesty gate: the "device" leg must actually serve device joins —
+    # an envelope miss silently host-joins even under force, and a
+    # speedup line measuring host-vs-host would be a lie
+    if ep.plan_executor.join_backends.get("device", 0) < 1:
+        raise RuntimeError(
+            "config-7 device leg served no device joins: "
+            f"{ep.plan_executor.join_backends}")
+    it_dev = max(2, iters // 3)
+    p50, p99, _best = measure(run_device, it_dev)
+    rps = n_probe / p50
+    pe = ep.plan_executor
+    dec = pe.router.stats()["decisions"]
+    joiner = jrunner.joiner() if hasattr(jrunner, "joiner") else None
+
+    # host baseline + parity at the capped size (the agg finalize keeps
+    # the compared output small while covering the join exactly)
+    n_host = min(n_probe, host_rows)
+    if n_host == n_probe:
+        pt_h, ph, bt_h, bh = probe_t, probe, build_t, build
+        preq_h = preq
+        ep_h = ep
+    else:
+        pt_h, ph, bt_h, bh = build_join_pair(n_host, n_build)
+        preq_h = _join_plan(pt_h, bt_h)
+        ep_h = endpoint_for(ph, bh, pt_h, bt_h)
+    dev_small = ep_h.handle_plan(preq_h, force_backend="device")
+    host_small = ep_h.handle_plan(preq_h, force_backend="host")
+    parity = sorted(dev_small.rows()) == sorted(host_small.rows())
+    hp50, _, _ = measure(
+        lambda: ep_h.handle_plan(preq_h, force_backend="host"),
+        max(2, iters // 4))
+    host_rps = n_host / hp50
+    speedup = rps / host_rps
+    on_tpu = jax.devices()[0].platform == "tpu"
+    placer = getattr(runner, "_placer", None) or \
+        getattr(jrunner, "_placer", None)
+    coloc = pe.stats().get("colocation_hits", 0)
+    out = {
+        "rows": n_probe,
+        "build_rows": n_build,
+        "backend": "plan",
+        "rows_per_sec": round(rps, 1),
+        "p50_ms": round(p50 * 1e3, 3),
+        "p99_ms": round(p99 * 1e3, 3),
+        "host_rows_per_sec": round(host_rps, 1),
+        "vs_baseline": round(speedup, 3),
+        "join_speedup": round(speedup, 3),
+        "join_parity": parity,
+        "speedup_gate_20x": (speedup >= 20.0) if on_tpu else None,
+        "fragments": dec,
+        "mixed_fragments": dec.get("join:device", 0) > 0 and
+        dec.get("host_ops:host", 0) > 0,
+        "colocation_hits": coloc,
+        "colocation_pins": placer.colocation_pins
+        if placer is not None else 0,
+    }
+    if joiner is not None:
+        js = joiner.stats()
+        out["join_backend_stats"] = {
+            k: js[k] for k in ("device_joins", "build_cache_hits",
+                               "build_cache_builds",
+                               "overflow_redispatches")}
+        out["join_backends"] = dict(pe.join_backends)
+    del probe, build
+    gc.collect()
+    return out
 
 
 def _bulk_load(c, node, table, n: int, groups: int = 1024) -> float:
@@ -1118,6 +1301,14 @@ def main() -> None:
     except Exception as e:      # noqa: BLE001 — bench must still report
         configs["2s_selection_sweep"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # 7: the plan-IR device hash join (10M probe × 1M build), mixed
+    # host/device fragments in one plan, vs the host hash join
+    try:
+        configs["7_join"] = run_join_bench(
+            runner, sz(10 * (1 << 20)), sz(1 << 20), host_rows, iters)
+    except Exception as e:      # noqa: BLE001 — bench must still report
+        configs["7_join"] = {"error": f"{type(e).__name__}: {e}"}
+
     # 6: the production path on a live server
     try:
         configs["6_production_path"] = run_production_path(runner, iters)
@@ -1198,6 +1389,30 @@ def main() -> None:
               f"host_bytes={pt['host_path_bytes']} "
               f"within_budget={pt['d2h_within_host_budget']} "
               f"p50={pt['p50_ms']}ms", file=sys.stderr)
+    # config-7 join adjudication — first-class lines so the device-join
+    # claim (backend mix, ≥20× TPU gate, co-location) survives artifact
+    # truncation
+    c7 = configs.get("7_join", {})
+    if "join_speedup" in c7:
+        jb = c7.get("join_backends", {})
+        js = c7.get("join_backend_stats", {})
+        print(f"# join_backend= device={jb.get('device', 0)} "
+              f"host={jb.get('host', 0)} "
+              f"degrade={jb.get('degrade', 0)} "
+              f"device_joins={js.get('device_joins', 0)} "
+              f"build_cache_hits={js.get('build_cache_hits', 0)} "
+              f"overflow={js.get('overflow_redispatches', 0)} "
+              f"mixed_fragments={c7['mixed_fragments']}",
+              file=sys.stderr)
+        print(f"# join_speedup= {c7['join_speedup']}x "
+              f"(device={c7['rows_per_sec']:,.0f} rows/s "
+              f"host={c7['host_rows_per_sec']:,.0f} rows/s) "
+              f"parity={c7['join_parity']} "
+              f"gate_20x={c7['speedup_gate_20x']}", file=sys.stderr)
+        print(f"# colocation_hits= {c7['colocation_hits']} "
+              f"(pins={c7['colocation_pins']})", file=sys.stderr)
+    elif c7:
+        print(f"# 7_join: {c7}", file=sys.stderr)
     conc = configs.get("6_production_path", {}).get("concurrent")
     if conc:
         print(f"# 6c_production_concurrent: {conc['n_inflight']} in-flight "

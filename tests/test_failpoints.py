@@ -373,13 +373,18 @@ def test_failpoint_inventory_resolves():
     # domains: device::slice_dead — persistent, per-slice-targeted
     # chip death (dispatch/fetch/canary all fail until healed) — and
     # device::mesh_rebuild, faulting the elastic-degrade rebuild
-    # itself so host is provably reachable as the ladder's last rung)
-    assert len(sites) >= 69, f"only {len(sites)} unique sites"
+    # itself so host is provably reachable as the ladder's last rung;
+    # ≥71 since the plan IR: device::join_dispatch — a device join
+    # fragment's probe dispatch fails and the executor host-joins
+    # THAT fragment only — and copr::plan_route, forcing the fragment
+    # router to place every fragment host)
+    assert len(sites) >= 71, f"only {len(sites)} unique sites"
     for dev_site in ("device::hbm_oom", "device::feed_corrupt",
                      "device::d2h_corrupt", "copr::coalesce_dispatch",
                      "copr::coalesce_window", "device::mvcc_resolve",
                      "device::shard_launch", "device::slice_dead",
-                     "device::mesh_rebuild"):
+                     "device::mesh_rebuild", "device::join_dispatch",
+                     "copr::plan_route"):
         assert dev_site in sites, f"missing fault site {dev_site}"
 
     nemesis_src = (root / "chaos" / "nemesis.py").read_text()

@@ -1,0 +1,682 @@
+"""Unified plan IR + device join/sort/window fragments (copr/plan_ir.py,
+device/join.py).
+
+Covers: wire round-trip of the operator-DAG encoding, linear-DAG
+embedding parity, randomized device-join vs host-join bit-parity
+(NULL-heavy keys, wide >15-col, tombstoned and version-bumped
+["delta-patched"] build sides, empty probe/build, skewed keys incl.
+the pair-capacity overflow re-dispatch), mixed host/device fragments
+in ONE plan, per-fragment failpoint degrade (``device::join_dispatch``
+host-joins that fragment only; ``copr::plan_route`` forces all-host),
+the SlicePlacer co-location hint (join pair pins to one slice), the
+coalescer's plan share class, sort/window parity, and the /health +
+metrics surface end to end over gRPC.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tikv_tpu.codec.keys import table_record_range
+from tikv_tpu.copr import plan_ir as pir
+from tikv_tpu.copr.dag import AggExprDesc, AggregationDesc, TableScanDesc
+from tikv_tpu.copr.endpoint import Endpoint
+from tikv_tpu.datatype import Column, EvalType, FieldType
+from tikv_tpu.device import DeviceRunner
+from tikv_tpu.executors.columnar import ColumnarTable
+from tikv_tpu.executors.ranges import KeyRange
+from tikv_tpu.expr import Expr
+from tikv_tpu.server import wire
+from tikv_tpu.testing.fixture import Table, TableColumn
+from tikv_tpu.utils import failpoint
+
+
+@pytest.fixture(autouse=True)
+def _fp_teardown():
+    yield
+    failpoint.teardown()
+
+
+@pytest.fixture(scope="module")
+def runner():
+    import jax
+
+    from tikv_tpu.parallel import make_mesh
+    return DeviceRunner(mesh=make_mesh(jax.devices()[:1]),
+                        chunk_rows=1 << 12)
+
+
+# ------------------------------------------------------------- fixtures
+
+
+def _int_table(table_id, names):
+    return Table(table_id, tuple(
+        [TableColumn("id", 1, FieldType.long(not_null=True),
+                     is_pk_handle=True)] +
+        [TableColumn(nm, 2 + i, FieldType.long())
+         for i, nm in enumerate(names)]))
+
+
+def _snap(table, n, cols):
+    return ColumnarTable.from_arrays(
+        table, np.arange(n, dtype=np.int64), cols)
+
+
+def _scan_node(table):
+    start, end = table_record_range(table.table_id)
+    return pir.ScanNode(
+        TableScanDesc(table.table_id,
+                      tuple(table.column_info(c.name)
+                            for c in table.columns)),
+        (KeyRange(start, end),))
+
+
+def _endpoint(runner, snaps, coalescer=None, threshold=1):
+    by_tid = {s.table.table_id if hasattr(s, "table") else tid: s
+              for tid, s in snaps.items()}
+
+    def provider(req):
+        return by_tid[req.dag.executors[0].table_id]
+    return Endpoint(provider, device_runner=runner,
+                    device_row_threshold=threshold,
+                    coalescer=coalescer)
+
+
+def _join_tables(seed, n_probe, n_build, key_lo=0, key_hi=200,
+                 null_p=0.1, build_alive_p=None, wide=False):
+    """→ (probe table, probe snap, build table, build snap)."""
+    rng = np.random.default_rng(seed)
+    pnames = [f"c{i}" for i in range(18)] if wide else ["k", "v"]
+    pt = _int_table(9200 + seed * 2, pnames)
+    cols = {}
+    for i, nm in enumerate(pnames):
+        if nm in ("k", "c0"):
+            cols[nm] = Column(
+                EvalType.INT,
+                rng.integers(key_lo, max(key_lo + 1, key_hi),
+                             n_probe).astype(np.int64),
+                rng.random(n_probe) > null_p)
+        else:
+            cols[nm] = Column(
+                EvalType.INT,
+                rng.integers(-100, 100, n_probe).astype(np.int64),
+                rng.random(n_probe) > (null_p if i % 3 else 0.0))
+    psnap = _snap(pt, n_probe, cols)
+    bt = _int_table(9201 + seed * 2, ["bk", "w"])
+    bsnap = _snap(bt, n_build, {
+        "bk": Column(EvalType.INT,
+                     rng.integers(key_lo, max(key_lo + 1, key_hi),
+                                  n_build).astype(np.int64),
+                     rng.random(n_build) > null_p),
+        "w": Column(EvalType.INT,
+                    rng.integers(0, 50, n_build).astype(np.int64),
+                    np.ones(n_build, np.bool_)),
+    })
+    if build_alive_p is not None:
+        bsnap = ColumnarTable(bt, bsnap.handles, bsnap.columns,
+                              alive=rng.random(n_build) < build_alive_p)
+    return pt, psnap, bt, bsnap
+
+
+def _join_plan(pt, bt, where_thr=None, key_col=1, agg=False):
+    ps, bs = _scan_node(pt), _scan_node(bt)
+    left = ps
+    if where_thr is not None:
+        vcol = 2 if len(pt.columns) <= 3 else 5
+        left = pir.SelectNode(ps, (
+            Expr.column(vcol, EvalType.INT) >
+            Expr.const(where_thr, EvalType.INT),))
+    join = pir.JoinNode(left, bs, key_col, 1)
+    root = join
+    if agg:
+        n_left = len(pt.columns)
+        root = pir.AggNode(join, AggregationDesc(
+            (Expr.column(n_left + 1, EvalType.INT),),       # build bk
+            (AggExprDesc("count_star", None),
+             AggExprDesc("sum", Expr.column(n_left + 2, EvalType.INT))),
+            False))
+    return pir.PlanRequest(root), ps, bs
+
+
+def _run_both(ep, preq):
+    host = ep.handle_plan(preq, force_backend="host")
+    dev = ep.handle_plan(preq, force_backend="device")
+    assert host.rows() == dev.rows(), \
+        (len(host.rows()), len(dev.rows()))
+    return host
+
+
+# ------------------------------------------------------------- wire/IR
+
+
+def test_plan_wire_roundtrip():
+    pt, _ps, bt, _bs = _join_tables(0, 10, 10)
+    preq, _, _ = _join_plan(pt, bt, where_thr=3, agg=True)
+    sort = pir.SortNode(preq.root, ((Expr.column(0, EvalType.INT),
+                                     True),))
+    win = pir.WindowNode(
+        sort, (Expr.column(0, EvalType.INT),),
+        ((Expr.column(1, EvalType.INT), False),),
+        (pir.WindowFuncDesc("row_number"),
+         pir.WindowFuncDesc("lag", Expr.column(1, EvalType.INT), 2)))
+    full = pir.PlanRequest(pir.LimitNode(win, 5),
+                           start_ts=42, output_offsets=(0, 1))
+    got = wire.dec_plan(wire.unpack(wire.pack(wire.enc_plan(full))))
+    assert got.plan_key() == full.plan_key()
+    assert got.start_ts == 42 and got.output_offsets == (0, 1)
+    assert len(got.scan_leaves()) == 2 and got.has_join()
+
+
+def test_class_key_is_const_and_ts_blind():
+    """The service-time EWMA / trace-buffer class: rotating constants
+    and fresh tsos share ONE class (DAGRequest.class_key discipline);
+    a structural change keys separately."""
+    pt, _ps, bt, _bs = _join_tables(21, 10, 10)
+    a, _, _ = _join_plan(pt, bt, where_thr=5)
+    b, _, _ = _join_plan(pt, bt, where_thr=99)
+    a2 = pir.PlanRequest(a.root, start_ts=777)
+    assert a.class_key() == b.class_key() == a2.class_key()
+    assert a.plan_key() != a2.plan_key()        # share key sees the ts
+    c, _, _ = _join_plan(pt, bt, where_thr=5, agg=True)
+    assert c.class_key() != a.class_key()
+
+
+def test_non_inner_join_rejected(runner):
+    pt, psnap, bt, bsnap = _join_tables(22, 50, 20)
+    ep = _endpoint(runner, {pt.table_id: psnap, bt.table_id: bsnap})
+    ps, bs = _scan_node(pt), _scan_node(bt)
+    preq = pir.PlanRequest(pir.JoinNode(ps, bs, 1, 1, "left"))
+    with pytest.raises(ValueError, match="join_type"):
+        ep.handle_plan(preq)
+
+
+def test_from_dag_embeds_linear_plans(runner):
+    """Any tipb-shaped DAGRequest embeds losslessly: the IR executes it
+    to the same result as the stock host pipeline."""
+    from tikv_tpu.executors.runner import BatchExecutorsRunner
+    from tikv_tpu.testing.dag import DagSelect
+    pt, psnap, _bt, _bs = _join_tables(1, 800, 10)
+    s = DagSelect.from_table(pt, ["id", "k", "v"])
+    dag = s.where(s.col("v") > 10).aggregate(
+        [s.col("k")], [("count_star", None), ("sum", s.col("v"))]
+    ).build()
+    preq = pir.from_dag(dag)
+    assert len(preq.scan_leaves()) == 1 and not preq.has_join()
+    ep = _endpoint(runner, {pt.table_id: psnap})
+    got = ep.handle_plan(preq, force_backend="host")
+    want = BatchExecutorsRunner(dag, psnap).handle_request()
+    assert sorted(got.rows()) == sorted(want.rows())
+    # the FULL tipb vocabulary embeds — partition-topn included
+    s2 = DagSelect.from_table(pt, ["id", "k", "v"])
+    dag2 = s2.partition_top_n((s2.col("k"),),
+                              ((s2.col("v"), True),), 3).build()
+    preq2 = pir.from_dag(dag2)
+    rt = wire.dec_plan(wire.unpack(wire.pack(wire.enc_plan(preq2))))
+    assert rt.plan_key() == preq2.plan_key()
+    got2 = ep.handle_plan(rt, force_backend="host")
+    want2 = BatchExecutorsRunner(dag2, psnap).handle_request()
+    assert sorted(got2.rows()) == sorted(want2.rows())
+
+
+# ------------------------------------------------------ join parity
+
+
+def test_randomized_join_parity(runner):
+    """Device join vs host join bit-parity across the nasty shapes:
+    NULL-heavy keys, wide >15-col probe, tombstoned build, duplicate/
+    skewed keys, fused probe predicates, with and without a host
+    finalize on top."""
+    shapes = [
+        _join_tables(2, 2000, 300),                         # baseline
+        _join_tables(3, 1500, 200, null_p=0.5),             # NULL-heavy
+        _join_tables(4, 1200, 150, wide=True),              # >15 cols
+        _join_tables(5, 1500, 300, build_alive_p=0.6),      # tombstones
+        _join_tables(6, 1000, 100, key_lo=0, key_hi=4),     # skewed dups
+    ]
+    for pt, psnap, bt, bsnap in shapes:
+        ep = _endpoint(runner, {pt.table_id: psnap,
+                                bt.table_id: bsnap})
+        for thr, agg in ((None, False), (-20, False), (0, True)):
+            preq, _, _ = _join_plan(pt, bt, where_thr=thr, agg=agg)
+            _run_both(ep, preq)
+    # int64 extremes: keys at the sentinel boundary must join exactly
+    pt, psnap, bt, bsnap = _join_tables(7, 64, 64, key_lo=0, key_hi=2)
+    big = np.iinfo(np.int64).max
+    psnap.columns[2].values[:8] = big
+    bsnap.columns[2].values[:4] = big
+    ep = _endpoint(runner, {pt.table_id: psnap, bt.table_id: bsnap})
+    preq, _, _ = _join_plan(pt, bt)
+    _run_both(ep, preq)
+
+
+def test_join_empty_sides(runner):
+    for n_probe, n_build in ((0, 100), (500, 0), (0, 0)):
+        pt, psnap, bt, bsnap = _join_tables(8, n_probe, n_build)
+        ep = _endpoint(runner, {pt.table_id: psnap,
+                                bt.table_id: bsnap})
+        preq, _, _ = _join_plan(pt, bt)
+        host = _run_both(ep, preq)
+        if n_probe == 0 or n_build == 0:
+            assert host.result.batch.num_rows == 0
+
+
+def test_join_overflow_redispatch(runner):
+    """A skew-heavy join whose pair count exceeds the initial pow2
+    capacity bucket re-dispatches at the EXACT on-device total — the
+    result is never truncated."""
+    rng = np.random.default_rng(9)
+    n_probe, n_build = 1000, 120
+    pt = _int_table(9301, ["k", "v"])
+    psnap = _snap(pt, n_probe, {
+        "k": Column(EvalType.INT, np.full(n_probe, 7, np.int64),
+                    np.ones(n_probe, np.bool_)),
+        "v": Column(EvalType.INT,
+                    rng.integers(-5, 5, n_probe).astype(np.int64),
+                    np.ones(n_probe, np.bool_))})
+    bt = _int_table(9302, ["bk", "w"])
+    bsnap = _snap(bt, n_build, {
+        "bk": Column(EvalType.INT, np.full(n_build, 7, np.int64),
+                     np.ones(n_build, np.bool_)),
+        "w": Column(EvalType.INT,
+                    rng.integers(0, 3, n_build).astype(np.int64),
+                    np.ones(n_build, np.bool_))})
+    ep = _endpoint(runner, {pt.table_id: psnap, bt.table_id: bsnap})
+    preq, _, _ = _join_plan(pt, bt, agg=True)   # 120k pairs > bucket
+    before = runner.joiner().overflow_redispatches
+    _run_both(ep, preq)
+    assert runner.joiner().overflow_redispatches > before
+
+
+def test_build_cache_version_and_teardown(runner):
+    """The build dictionary caches per (anchor, data version): a
+    version bump (the delta-patched build side) re-sorts from the new
+    host truth, and runner.drop_feed tears the anchor's join planes
+    down with the feed."""
+    pt, psnap, bt, bsnap = _join_tables(10, 600, 200)
+    bsnap.feed_version = 1
+    ep = _endpoint(runner, {pt.table_id: psnap, bt.table_id: bsnap})
+    preq, _, _ = _join_plan(pt, bt)
+    joiner = runner.joiner()
+    b0, h0 = joiner.build_cache_builds, joiner.build_cache_hits
+    _run_both(ep, preq)
+    assert joiner.build_cache_builds == b0 + 1
+    ep.handle_plan(preq, force_backend="device")
+    assert joiner.build_cache_hits > h0          # warm rerun
+    # "delta patch": mutate the build key column + bump the version —
+    # the next device join must re-sort and stay parity-exact
+    bsnap.columns[2].values[:50] = 999
+    bsnap.feed_version = 2
+    _run_both(ep, preq)
+    assert joiner.build_cache_builds == b0 + 2
+    # lifecycle teardown drops the anchor's cached planes
+    assert runner.drop_feed(bsnap) > 0
+    with joiner._mu:
+        assert not any(k[1] == id(bsnap) for k in joiner._cache)
+
+
+# ------------------------------------------- mixed routing + degrade
+
+
+def test_mixed_host_device_fragments_one_plan(runner):
+    """One request: device scan+join, host aggregation finalize — the
+    per-operator routing the per-plan surface cannot express."""
+    pt, psnap, bt, bsnap = _join_tables(11, 3000, 250)
+    ep = _endpoint(runner, {pt.table_id: psnap, bt.table_id: bsnap})
+    preq, _, _ = _join_plan(pt, bt, where_thr=0, agg=True)
+    resp = ep.handle_plan(preq, force_backend="device")
+    host = ep.handle_plan(preq, force_backend="host")
+    assert sorted(resp.rows()) == sorted(host.rows())
+    dec = ep.plan_executor.router.stats()["decisions"]
+    assert dec.get("join:device", 0) >= 1
+    assert dec.get("host_ops:host", 0) >= 1      # the host finalize
+    assert ep.plan_executor.join_backends.get("device", 0) >= 1
+
+
+def test_join_dispatch_failpoint_degrades_fragment_only(runner):
+    """device::join_dispatch fails the probe dispatch: the executor
+    host-joins THAT fragment only — the answer stays correct and the
+    degrade is counted per fragment, not per plan."""
+    pt, psnap, bt, bsnap = _join_tables(12, 1500, 200)
+    ep = _endpoint(runner, {pt.table_id: psnap, bt.table_id: bsnap})
+    preq, _, _ = _join_plan(pt, bt, where_thr=-50, agg=True)
+    want = ep.handle_plan(preq, force_backend="host").rows()
+    failpoint.cfg("device::join_dispatch", "return")
+    # NOT forced: the router picks device (cold model), the dispatch
+    # faults, the fragment degrades
+    got = ep.handle_plan(preq)
+    failpoint.remove("device::join_dispatch")
+    assert sorted(got.rows()) == sorted(want)
+    jb = ep.plan_executor.join_backends
+    assert jb.get("degrade", 0) >= 1
+    # forced-device parity requests surface the raw fault instead
+    failpoint.cfg("device::join_dispatch", "return")
+    with pytest.raises(Exception):
+        ep.handle_plan(preq, force_backend="device")
+
+
+def test_plan_route_failpoint_forces_host(runner):
+    pt, psnap, bt, bsnap = _join_tables(13, 1200, 150)
+    ep = _endpoint(runner, {pt.table_id: psnap, bt.table_id: bsnap})
+    preq, _, _ = _join_plan(pt, bt)
+    want = ep.handle_plan(preq, force_backend="host").rows()
+    failpoint.cfg("copr::plan_route", "return")
+    got = ep.handle_plan(preq)
+    failpoint.remove("copr::plan_route")
+    assert got.rows() == want
+    dec = ep.plan_executor.router.stats()["decisions"]
+    assert dec.get("join:device", 0) == 0
+
+
+# ------------------------------------------------------- sort / window
+
+
+def test_sort_parity_randomized(runner):
+    rng = np.random.default_rng(14)
+    pt, psnap, _bt, _bs = _join_tables(14, 1500, 10, null_p=0.4)
+    ep = _endpoint(runner, {pt.table_id: psnap})
+    ps = _scan_node(pt)
+    for keys in (
+        ((Expr.column(1, EvalType.INT), False),),
+        ((Expr.column(1, EvalType.INT), True),
+         (Expr.column(2, EvalType.INT), False)),
+        ((Expr.column(2, EvalType.INT), True),
+         (Expr.column(0, EvalType.INT), True)),
+    ):
+        preq = pir.PlanRequest(pir.SortNode(ps, keys))
+        host = ep.handle_plan(preq, force_backend="host")
+        dev = ep.handle_plan(preq, force_backend="device")
+        assert host.rows() == dev.rows()    # ORDER-sensitive equality
+    # REAL keys sort on device too (comparisons are exact)
+    rt = Table(9401, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("r", 2, FieldType.double())))
+    rsnap = _snap(rt, 900, {"r": Column(
+        EvalType.REAL, rng.normal(0, 100, 900),
+        rng.random(900) > 0.3)})
+    epr = _endpoint(runner, {rt.table_id: rsnap})
+    preq = pir.PlanRequest(pir.SortNode(
+        _scan_node(rt), ((Expr.column(1, EvalType.REAL), True),)))
+    assert epr.handle_plan(preq, force_backend="host").rows() == \
+        epr.handle_plan(preq, force_backend="device").rows()
+
+
+def test_keyless_sort_and_window_are_identity_not_empty(runner):
+    """A SortNode with no order keys is the identity (never zero
+    rows), and a window with neither partition nor order keys treats
+    the whole input as one segment — on BOTH routes."""
+    pt, psnap, _bt, _bs = _join_tables(20, 300, 10)
+    ep = _endpoint(runner, {pt.table_id: psnap})
+    ps = _scan_node(pt)
+    sp = pir.PlanRequest(pir.SortNode(ps, ()))
+    for force in ("host", "device"):
+        got = ep.handle_plan(sp, force_backend=force)
+        assert got.result.batch.num_rows == 300, force
+    wp = pir.PlanRequest(pir.WindowNode(
+        ps, (), (), (pir.WindowFuncDesc("row_number"),)))
+    host = ep.handle_plan(wp, force_backend="host")
+    dev = ep.handle_plan(wp, force_backend="device")
+    assert host.result.batch.num_rows == 300
+    assert host.rows() == dev.rows()
+
+
+def test_window_parity_and_real_fallback(runner):
+    pt, psnap, _bt, _bs = _join_tables(15, 1200, 10, null_p=0.3)
+    ep = _endpoint(runner, {pt.table_id: psnap})
+    ps = _scan_node(pt)
+    funcs = (pir.WindowFuncDesc("row_number"),
+             pir.WindowFuncDesc("count", Expr.column(2, EvalType.INT)),
+             pir.WindowFuncDesc("sum", Expr.column(2, EvalType.INT)),
+             pir.WindowFuncDesc("avg", Expr.column(2, EvalType.INT)),
+             pir.WindowFuncDesc("lag", Expr.column(2, EvalType.INT), 2),
+             pir.WindowFuncDesc("lead", Expr.column(2, EvalType.INT), 1))
+    win = pir.WindowNode(ps, (Expr.column(1, EvalType.INT),),
+                         ((Expr.column(0, EvalType.INT), False),), funcs)
+    preq = pir.PlanRequest(win)
+    host = ep.handle_plan(preq, force_backend="host")
+    dev = ep.handle_plan(preq, force_backend="device")
+    assert host.rows() == dev.rows()
+    assert runner.joiner().windows >= 1
+    # windows without PARTITION BY: one global segment
+    gw = pir.PlanRequest(pir.WindowNode(
+        ps, (), ((Expr.column(2, EvalType.INT), True),),
+        (pir.WindowFuncDesc("row_number"),
+         pir.WindowFuncDesc("sum", Expr.column(1, EvalType.INT)))))
+    assert ep.handle_plan(gw, force_backend="host").rows() == \
+        ep.handle_plan(gw, force_backend="device").rows()
+    # REAL running sum is OUTSIDE the device envelope (associative-scan
+    # rounding would fork parity): the device route falls back to the
+    # host twin and the answer still matches
+    rt = Table(9402, (
+        TableColumn("id", 1, FieldType.long(not_null=True),
+                    is_pk_handle=True),
+        TableColumn("g", 2, FieldType.long()),
+        TableColumn("r", 3, FieldType.double())))
+    rng = np.random.default_rng(16)
+    rsnap = _snap(rt, 400, {
+        "g": Column(EvalType.INT,
+                    rng.integers(0, 6, 400).astype(np.int64),
+                    np.ones(400, np.bool_)),
+        "r": Column(EvalType.REAL, rng.normal(0, 10, 400),
+                    np.ones(400, np.bool_))})
+    epr = _endpoint(runner, {rt.table_id: rsnap})
+    rw = pir.PlanRequest(pir.WindowNode(
+        _scan_node(rt), (Expr.column(1, EvalType.INT),),
+        ((Expr.column(0, EvalType.INT), False),),
+        (pir.WindowFuncDesc("sum", Expr.column(2, EvalType.REAL)),)))
+    assert epr.handle_plan(rw, force_backend="host").rows() == \
+        epr.handle_plan(rw, force_backend="device").rows()
+
+
+# ------------------------------------------------- co-location hints
+
+
+def test_colocation_hint_pins_join_pair():
+    """The decayed pair-frequency hint: once two anchors join often,
+    a new placement for one pins to the other's slice — the device
+    join runs where both feeds live (zero cross-slice transfers) and
+    the executor counts the co-location hit."""
+    import jax
+
+    from tikv_tpu.parallel import make_mesh
+    r8 = DeviceRunner(mesh=make_mesh(jax.devices()), placement=True,
+                      chunk_rows=1 << 12)
+    try:
+        placer = r8._placer
+        assert placer is not None and len(placer) == 8
+        pt, psnap, bt, bsnap = _join_tables(17, 900, 120)
+        # served joins feed the pair affinity past the threshold
+        for _ in range(3):
+            placer.note_join(psnap, bsnap)
+        ep = _endpoint(r8, {pt.table_id: psnap, bt.table_id: bsnap})
+        preq, _, _ = _join_plan(pt, bt)
+        host = ep.handle_plan(preq, force_backend="host")
+        dev = ep.handle_plan(preq, force_backend="device")
+        assert host.rows() == dev.rows()
+        assert placer.colocated(psnap, bsnap), placer.stats()
+        assert placer.colocation_pins >= 1
+        assert ep.plan_executor.colocation_hits >= 1
+        assert ep.plan_executor.join_backends.get("device", 0) >= 1
+    finally:
+        r8.close()
+
+
+# ----------------------------------------------------- plan share class
+
+
+def test_plan_share_class():
+    """Byte-identical concurrent join plans share ONE execution
+    through the coalescer's plan share class (submit_shared): late
+    arrivals park on the leader's future."""
+    from tikv_tpu.server.coalescer import RequestCoalescer
+
+    class _R:      # minimal runner surface the coalescer touches
+        def batch_class(self, dag, storage):
+            return None
+    coal = RequestCoalescer(_R())
+    entered = threading.Event()
+    release = threading.Event()
+    results = []
+
+    def leader_fn():
+        entered.set()
+        release.wait(5)
+        return ("result", 1)
+
+    def leader():
+        results.append(coal.submit_shared(("plan", "k"), leader_fn))
+
+    def sharer():
+        entered.wait(5)
+        results.append(coal.submit_shared(
+            ("plan", "k"), lambda: ("other", 2)))
+
+    t1 = threading.Thread(target=leader)
+    t2 = threading.Thread(target=sharer)
+    t1.start()
+    entered.wait(5)
+    t2.start()
+    # give the sharer time to park on the in-flight future
+    for _ in range(100):
+        if coal.plan_share_hits:
+            break
+        import time
+        time.sleep(0.01)
+    release.set()
+    t1.join(5)
+    t2.join(5)
+    assert results[0] == results[1] == ("result", 1)
+    assert coal.plan_share_hits == 1 and coal.plan_share_groups == 1
+    assert coal.stats()["plan_share_hits"] == 1
+
+
+def test_endpoint_routes_join_plans_through_share_class(runner):
+    from tikv_tpu.server.coalescer import RequestCoalescer
+    pt, psnap, bt, bsnap = _join_tables(18, 700, 90)
+    coal = RequestCoalescer(runner)
+    ep = _endpoint(runner, {pt.table_id: psnap, bt.table_id: bsnap},
+                   coalescer=coal)
+    preq, _, _ = _join_plan(pt, bt)
+    want = ep.handle_plan(preq, force_backend="host").rows()
+    got = ep.handle_plan(preq)          # unforced → share class
+    assert sorted(got.rows()) == sorted(want)
+    assert coal.plan_share_groups >= 1
+    ep.close()
+
+
+# ------------------------------------------------ observability surface
+
+
+def test_plan_health_and_metrics(runner):
+    from tikv_tpu.utils import metrics as m
+    pt, psnap, bt, bsnap = _join_tables(19, 1000, 100)
+    ep = _endpoint(runner, {pt.table_id: psnap, bt.table_id: bsnap})
+    preq, _, _ = _join_plan(pt, bt, where_thr=0)
+    ep.handle_plan(preq, force_backend="device")
+    ep.handle_plan(preq, force_backend="host")
+    st = ep.plan_executor.stats()
+    assert st["plans_served"] >= 2
+    assert st["join_backends"].get("device", 0) >= 1
+    assert st["join_backends"].get("host", 0) >= 1
+    assert "device_join" in st and \
+        st["device_join"]["device_joins"] >= 1
+    assert any(k.startswith("join:") for k in
+               st["router"]["decisions"])
+    assert m.DEVICE_JOIN_ROUTE_COUNTER.labels("device").value >= 1
+    assert m.COPR_PLAN_FRAGMENT_COUNTER.labels(
+        "join", "device").value >= 1
+    # the span names used by the plan path are registered vocabulary
+    from tikv_tpu.utils.trace_vocab import SPAN_VOCABULARY
+    for name in ("plan_route", "join_build", "join_probe",
+                 "sort_fragment", "window_fragment"):
+        assert name in SPAN_VOCABULARY
+
+
+# ------------------------------------------------------- gRPC e2e rig
+
+
+@pytest.fixture(scope="module")
+def rig():
+    import jax
+
+    from tikv_tpu.parallel import make_mesh
+    from tikv_tpu.raftstore.metapb import Store
+    from tikv_tpu.server import (
+        Node, PdServer, RemotePdClient, TikvServer, TxnClient,
+    )
+    from tikv_tpu.server.status_server import StatusServer
+    from tikv_tpu.testing.fixture import encode_table_row, int_table
+
+    device = DeviceRunner(mesh=make_mesh(jax.devices()[:1]))
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    node = Node("127.0.0.1:0", RemotePdClient(pd_addr),
+                device_runner=device, device_row_threshold=128)
+    srv = TikvServer(node)
+    node.addr = f"127.0.0.1:{srv.port}"
+    node.pd.put_store(Store(node.store_id, node.addr))
+    srv.start()
+    status = StatusServer("127.0.0.1:0", node=node,
+                          config_controller=node.config_controller)
+    status.start()
+    client = TxnClient(pd_addr)
+    probe_t = int_table(2, table_id=9470)
+    build_t = int_table(2, table_id=9471)
+    muts = []
+    for h in range(3000):
+        key, value = encode_table_row(
+            probe_t, h, {"c0": h % 97, "c1": (h * 31) % 500 - 250})
+        muts.append(("put", key, value))
+    for h in range(200):
+        key, value = encode_table_row(
+            build_t, h, {"c0": h % 97, "c1": h})
+        muts.append(("put", key, value))
+    client.txn_write(muts)
+    yield {"node": node, "client": client, "probe": probe_t,
+           "build": build_t,
+           "base_url": f"http://127.0.0.1:{status.port}"}
+    status.stop()
+    srv.stop()
+    pd_server.stop()
+
+
+def test_e2e_plan_join_over_grpc(rig):
+    """A join plan over the wire: client encodes the IR, the server
+    snapshots BOTH leaves, routes per fragment, joins, and the /health
+    plan_ir rollup reports it."""
+    c = rig["client"]
+    ts = c.tso()
+    pt, bt = rig["probe"], rig["build"]
+    ps, bs = _scan_node(pt), _scan_node(bt)
+    sel = pir.SelectNode(ps, (
+        Expr.column(2, EvalType.INT) > Expr.const(0, EvalType.INT),))
+    preq = pir.PlanRequest(
+        pir.JoinNode(sel, bs, 1, 1), start_ts=ts)
+    resp = c.coprocessor_plan(preq, trace_id="beefcafe01")
+    assert resp["backend"] == "plan"
+    assert resp["trace_id"] == "beefcafe01"
+    # parity against the forced-host route over the SAME snapshot ts
+    host = c.coprocessor_plan(preq, force_backend="host")
+    assert sorted(map(tuple, resp["rows"])) == \
+        sorted(map(tuple, host["rows"]))
+    # expected row count from first principles: keys collide on
+    # h % 97 and the fused selection keeps c1 = (h*31)%500-250 > 0
+    per_key = {}
+    for h in range(200):
+        per_key[h % 97] = per_key.get(h % 97, 0) + 1
+    want = sum(per_key.get(h % 97, 0) for h in range(3000)
+               if (h * 31) % 500 - 250 > 0)
+    assert len(resp["rows"]) == want and want > 0
+    # /health surfaces the per-fragment routing rollup
+    body = json.load(urllib.request.urlopen(
+        rig["base_url"] + "/health"))
+    assert "plan_ir" in body, sorted(body)
+    assert body["plan_ir"]["plans_served"] >= 2
+    assert any(k.startswith("join:")
+               for k in body["plan_ir"]["router"]["decisions"])

@@ -33,6 +33,7 @@ from .nemesis import (           # noqa: F401
     DEGRADE_SITES,
     DEVICE_FAULT_KINDS,
     FAULT_KINDS,
+    PLAN_FAULT_KINDS,
     Fault,
     Nemesis,
     generate_schedule,

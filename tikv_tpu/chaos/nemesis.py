@@ -47,6 +47,15 @@ DEVICE_FAULT_KINDS = ("hbm_squeeze", "feed_corrupt", "d2h_corrupt",
                       "shard_launch", "slice_dead", "chip_flap",
                       "device_degrade")
 
+# plan-IR faults (kept OUT of DEVICE_FAULT_KINDS so existing seeded
+# device-chaos schedules stay byte-identical): plan_fault arms BOTH
+# plan-path sites — device::join_dispatch (a device join fragment's
+# probe dispatch fails → the executor host-joins THAT fragment only)
+# and copr::plan_route (the fragment router is forced to route the
+# whole request host) — at a percentage, so mixed-fragment plans see
+# both the per-fragment degrade and the all-host path under chaos
+PLAN_FAULT_KINDS = ("plan_fault",)
+
 # the plain degrade-to-host failpoint sites the device_degrade nemesis
 # rotates over; the remaining device::* sites have dedicated kinds
 # above (the inventory test asserts the union covers EVERY device::*
@@ -122,6 +131,9 @@ def generate_schedule(seed: int, steps: int,
         elif kind == "device_degrade":
             out.append(_mk(kind, site=rng.choice(DEGRADE_SITES),
                            pct=rng.choice((25, 50, 100))))
+        elif kind == "plan_fault":
+            out.append(_mk(kind, pct=rng.choice((25, 50, 100)),
+                           route_pct=rng.choice((0, 25, 50))))
         else:   # pragma: no cover
             raise ValueError(kind)
     return out
@@ -250,6 +262,21 @@ class Nemesis:
         site = fault.param("site", DEGRADE_SITES[0])
         failpoint.cfg(site, f"{fault.param('pct', 100)}%return")
         self._heals.append(lambda s=site: failpoint.remove(s))
+
+    def _apply_plan_fault(self, fault: Fault) -> None:
+        """Plan-IR fault mix: device::join_dispatch fails a device
+        join fragment's probe dispatch at pct% — the plan executor
+        must host-join that FRAGMENT only, the plan's other fragments
+        keep their routes — while copr::plan_route (route_pct%) forces
+        whole-request host routing.  Answers stay correct under both."""
+        failpoint.cfg("device::join_dispatch",
+                      f"{fault.param('pct', 100)}%return")
+        rp = fault.param("route_pct", 0)
+        if rp:
+            failpoint.cfg("copr::plan_route", f"{rp}%return")
+        self._heals.append(
+            lambda: (failpoint.remove("device::join_dispatch"),
+                     failpoint.remove("copr::plan_route")))
 
     def _apply_disk_stall(self, fault: Fault) -> None:
         ms = fault.param("ms", 5)

@@ -1,11 +1,22 @@
-"""DAG plan descriptors — the pushed-down query fragment representation.
+"""DAG plan descriptors — the tipb-compatible LINEAR fragment surface.
 
 Reference: the ``tipb`` protobuf (DAGRequest, Executor, TableScan,
 IndexScan, Selection, Projection, Aggregation, TopN, Limit, ColumnInfo)
-consumed by runner.rs:181 ``build_executors``. We keep the same executor
-vocabulary — TiKV runs only *leaf* fragments (no Join/Window/Sort/Exchange,
-runner.rs:139-166) — as plain dataclasses; the wire encoding (msgpack) is
-handled in endpoint.py.
+consumed by runner.rs:181 ``build_executors``, kept as plain
+dataclasses; the wire encoding (msgpack) is handled in server/wire.py.
+
+The reference runs only *leaf* fragments — tipb deliberately omits
+Join/Window/Sort/Exchange (runner.rs:139-166) — and this module keeps
+that executor vocabulary EXACTLY, so every ``DAGRequest`` stays
+wire-compatible with a tipb-shaped client.  The operator boundary
+itself is no longer where execution stops: :mod:`tikv_tpu.copr.plan_ir`
+defines the IR SUPERSET — an operator DAG with Join, Sort and Window
+nodes and per-operator host/device routing — into which any DAGRequest
+embeds losslessly (``plan_ir.from_dag``) as one linear leaf fragment.
+A plan's leaf fragments compile back to DAGRequests (the routing unit
+the device runner and host pipeline already serve); only the
+join/sort/window nodes and the multi-scan envelope are the extension,
+carried on the wire as the ``plan`` request body beside ``dag``.
 """
 
 from __future__ import annotations

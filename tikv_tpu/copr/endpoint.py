@@ -135,6 +135,11 @@ class Endpoint:
         self.coalescer = coalescer
         if coalescer is not None:
             coalescer.bind(self)
+        # plan-IR executor (copr/plan_ir.py): lazily built — DAG-only
+        # traffic never pays for it.  Owns the per-fragment router and
+        # the join/sort/window execution (handle_plan).
+        self._plan_executor = None
+        self._plan_mu = threading.Lock()
         # deferred D2H fetches resolve on a small shared pool so N
         # in-flight requests overlap their transfer waits (handle_async)
         self._completion_workers = completion_workers
@@ -246,6 +251,74 @@ class Endpoint:
     def handle(self, req: CopRequest) -> CopResponse:
         """Synchronous unary execution: dispatch + wait in one call."""
         return self.handle_async(req).wait()
+
+    @property
+    def plan_executor(self):
+        with self._plan_mu:
+            if self._plan_executor is None:
+                from .plan_ir import PlanExecutor
+                self._plan_executor = PlanExecutor(self)
+            return self._plan_executor
+
+    def handle_plan(self, preq, force_backend: Optional[str] = None,
+                    resource_group: str = "default",
+                    request_source: str = "") -> CopResponse:
+        """Execute a plan-IR request (copr/plan_ir.py) — the operator
+        superset the linear DAG path cannot express (join/sort/window,
+        mixed per-fragment host/device routing).
+
+        One snapshot is acquired PER SCAN LEAF through the same
+        provider the unary path uses (a join's two sides each route by
+        their own first key range), the fragment router places each
+        fragment host/device, and byte-identical join plans share one
+        execution through the coalescer's plan share class."""
+        from ..resource_metering import GLOBAL_RECORDER, ResourceTagFactory
+        from ..utils import metrics as m
+        from ..utils import tracker
+        from ..utils.deadline import check_current as _dl_check
+        tag = ResourceTagFactory.tag(resource_group, request_source)
+        t0 = time.perf_counter_ns()
+        _dl_check("plan_admission")
+        with GLOBAL_RECORDER.attach(tag):
+            leaves = preq.scan_leaves()
+            storages = {}
+            anchors = []
+            for leaf in leaves:
+                sub = CopRequest(REQ_TYPE_DAG, DAGRequest(
+                    (leaf.scan,), tuple(leaf.ranges),
+                    start_ts=preq.start_ts))
+                storage = self._snapshot_provider(sub)
+                storages[id(leaf)] = storage
+                lineage = getattr(storage, "feed_lineage", None)
+                v = getattr(storage, "feed_version", None)
+                if lineage is not None and v is None:
+                    v = lineage.version
+                anchors.append((id(storage if lineage is None
+                                   else lineage), v))
+            ex = self.plan_executor
+
+            def run():
+                return ex.execute(preq, storages, force_backend)
+
+            coal = self.coalescer
+            if coal is not None and preq.has_join() and \
+                    force_backend is None and \
+                    hasattr(coal, "submit_shared"):
+                # join plans get a batch class: byte-identical plans
+                # over the same snapshot generations share ONE
+                # execution (the thundering-herd share-group semantics
+                # applied to the plan path)
+                result, scanned = coal.submit_shared(
+                    ("plan", preq.plan_key(), tuple(anchors)), run)
+            else:
+                result, scanned = run()
+            GLOBAL_RECORDER.record_read_keys(scanned)
+            tracker.add_scan(scanned)
+        tracker.label("backend", "plan")
+        elapsed = time.perf_counter_ns() - t0
+        m.COPR_REQ_COUNTER.labels("plan").inc()
+        m.COPR_REQ_DURATION.labels("plan").observe(elapsed / 1e9)
+        return CopResponse(result, elapsed, "plan")
 
     def _completion(self):
         with self._completion_mu:

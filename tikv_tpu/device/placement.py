@@ -44,6 +44,15 @@ probes re-admit the slice with a DECAYED (not reset) score, so the
 health penalty in the placement blend lets anchors trickle back —
 never a thundering re-pin.
 
+JOIN CO-LOCATION (plan IR, copr/plan_ir.py): every served join plan
+records its two feed anchors as a decayed PAIR FREQUENCY
+(:meth:`SlicePlacer.note_join`).  Once a pair's affinity clears
+``COLOCATE_AFFINITY``, a new placement for either anchor pins to the
+other's slice instead of the coolest one — "these two regions join
+often" expressed in the same decayed-score vocabulary as load — so
+the device hash join's build dictionary and probe feed co-reside and
+the probe dispatch mints zero cross-slice transfers.
+
 The placer is OFF by default (``DeviceRunner(placement=False)``) —
 single-chip deployments and whole-mesh benches never pay the routing
 indirection; ``coprocessor.device_placement`` turns it on for serving
@@ -78,6 +87,13 @@ LOAD_HALFLIFE_S = 30.0
 # stay O(1) per request, the O(slices·anchors) scan amortizes
 REBALANCE_EVERY = 64
 
+# decayed pair-frequency (served join plans, copr/plan_ir.py) above
+# which two anchors are treated as a JOIN PAIR: a new placement for
+# one prefers the other's slice, so the device join's build and probe
+# feeds co-reside and the probe dispatch mints zero cross-slice
+# transfers.  Decays with the same half-life as the load score.
+COLOCATE_AFFINITY = 2.0
+
 
 class SlicePlacer:
     """Per-slice sub-runners + the placement policy over them.
@@ -111,6 +127,12 @@ class SlicePlacer:
         self.places = 0
         self.moves = 0
         self.whole_mesh_routes = 0
+        # co-location hints: decayed pair-frequency of anchors that
+        # JOIN each other (note_join, fed by served join plans) —
+        # placement prefers pinning a join pair to ONE slice
+        self._pair_aff: dict[tuple[int, int], float] = {}
+        self._pair_t = time.monotonic()
+        self.colocation_pins = 0
         # chip failure domains: the parent's health board scores these
         # same slices; a trip drains the dead slice's anchors here
         self._board = parent._board
@@ -158,6 +180,65 @@ class SlicePlacer:
     def _dead_locked(self) -> frozenset:
         return self._board.quarantined_set() \
             if self._board is not None else frozenset()
+
+    # -- co-location hints (served join plans → pair affinity) --------
+
+    def _decay_pairs_locked(self) -> None:
+        now = time.monotonic()
+        dt = now - self._pair_t
+        if dt <= 0:
+            return
+        f = 0.5 ** (dt / LOAD_HALFLIFE_S)
+        if f < 0.999:
+            self._pair_aff = {k: v * f
+                              for k, v in self._pair_aff.items()
+                              if v * f > 0.05}
+            self._pair_t = now
+
+    def note_join(self, a, b) -> None:
+        """Record one served join between anchors ``a`` and ``b`` —
+        the decayed pair frequency the placement blend reads as 'these
+        two regions join often, pin them together'."""
+        if a is b:
+            return
+        key = (min(id(a), id(b)), max(id(a), id(b)))
+        with self._mu:
+            self._decay_pairs_locked()
+            self._pair_aff[key] = self._pair_aff.get(key, 0.0) + 1.0
+            while len(self._pair_aff) > 256:
+                # drop the weakest OTHER pair — never the pair just
+                # recorded, or at capacity a new hot pair would be
+                # evicted in the same call forever and its affinity
+                # could never accumulate past the co-location threshold
+                weakest = min((k for k in self._pair_aff if k != key),
+                              key=self._pair_aff.get)
+                del self._pair_aff[weakest]
+
+    def _partner_slice_locked(self, key: int,
+                              dead: frozenset) -> Optional[int]:
+        """The strongest join partner's placed slice (affinity ≥
+        COLOCATE_AFFINITY, partner placed, slice healthy) — where a
+        new placement for ``key`` should land."""
+        self._decay_pairs_locked()
+        best, best_aff = None, COLOCATE_AFFINITY
+        for (a, b), aff in self._pair_aff.items():
+            if aff < best_aff:
+                continue
+            other = b if a == key else (a if b == key else None)
+            if other is None:
+                continue
+            idx = self._placed.get(other)
+            if idx is not None and idx not in dead:
+                best, best_aff = idx, aff
+        return best
+
+    def colocated(self, a, b) -> bool:
+        """Are both anchors currently pinned to ONE healthy slice?"""
+        with self._mu:
+            ia = self._placed.get(id(a))
+            ib = self._placed.get(id(b))
+            return ia is not None and ia == ib and \
+                ia not in self._dead_locked()
 
     # -- routing ------------------------------------------------------
 
@@ -209,7 +290,16 @@ class SlicePlacer:
                 failover_from = idx
                 idx = None
             if idx is None:
-                idx = pick_slice(self._scores_locked(), exclude=dead)
+                # co-location hint first: a join pair's new member
+                # lands on its partner's slice (decayed affinity from
+                # served join plans), score-blind by design — the join
+                # saves more than a marginally cooler chip would
+                idx = self._partner_slice_locked(key, dead)
+                if idx is not None:
+                    self.colocation_pins += 1
+                    m.DEVICE_PLACEMENT_COUNTER.labels("colocate").inc()
+                else:
+                    idx = pick_slice(self._scores_locked(), exclude=dead)
                 try:
                     self._refs[key] = weakref.ref(
                         anchor, lambda _r, k=key: self._forget(k))
@@ -242,6 +332,14 @@ class SlicePlacer:
         with self._mu:
             self._placed.pop(key, None)
             self._refs.pop(key, None)
+            # a dead anchor's join-pair affinities die with it: a NEW
+            # object reusing the id must never inherit another
+            # region's co-location hint (same id-reuse guard as the
+            # joiner's weakref pruning)
+            if self._pair_aff:
+                self._pair_aff = {k: v
+                                  for k, v in self._pair_aff.items()
+                                  if key not in k}
 
     def forget(self, anchor) -> None:
         self._forget(id(anchor))
@@ -374,5 +472,7 @@ class SlicePlacer:
                 "whole_mesh_routes": self.whole_mesh_routes,
                 "failovers": self.failovers,
                 "drained": self.drained,
+                "colocation_pins": self.colocation_pins,
+                "join_pairs": len(self._pair_aff),
             }
         return out

@@ -45,6 +45,15 @@ Host decode never appears on this path: the scan feed is a columnar
 snapshot (executors/columnar.py). Small requests stay on the host numpy
 path (copr/endpoint.py routing) so p99 latency never pays device
 dispatch.
+
+Routing is PER FRAGMENT, not per plan: under the plan IR
+(copr/plan_ir.py) this runner serves individual leaf fragments of an
+operator DAG — the same request may run its scan+selection here, its
+join through the DeviceJoiner (device/join.py, reached via
+``joiner()``), and its aggregation finalize on the host pipeline.  The
+"whole plan picks one backend" framing this module's routing notes
+used to assume holds only for the linear DAGRequest surface; any
+degrade decision is now scoped to the fragment that faulted.
 """
 
 from __future__ import annotations
@@ -633,6 +642,11 @@ class DeviceRunner:
         # device-side MVCC resolution (device/mvcc.py): lazily built —
         # host-only deployments and sharded meshes never pay for it
         self._mvcc_resolver = None
+        # plan-IR join/sort/window kernels (device/join.py): lazily
+        # built — DAG-only deployments never pay for it.  Single-device
+        # by construction (the build dictionary commits to one chip);
+        # multi-chip nodes reach it through their placement slices.
+        self._joiner = None
         # hot-region → slice placement (device/placement.py): sharded
         # meshes opt in to scale-OUT routing — small regions pin to
         # single-device sub-runners spread by load, large feeds still
@@ -966,6 +980,19 @@ class DeviceRunner:
             from .mvcc import DeviceMvccResolver
             self._mvcc_resolver = DeviceMvccResolver(self)
         return self._mvcc_resolver
+
+    def joiner(self) -> "object":
+        """The runner's DeviceJoiner (plan-IR join/sort/window kernels,
+        device/join.py).  Single-device runners only — a whole-mesh
+        sharded runner's joins route host or to a placement slice (the
+        plan executor owns that choice)."""
+        if self._joiner is None:
+            from .join import DeviceJoiner
+            self._joiner = DeviceJoiner(self)
+            if self._arena.budget_bytes > 0:
+                # a budget set before the joiner existed binds it too
+                self._joiner.set_budget(self._arena.budget_bytes // 8)
+        return self._joiner
 
     # ------------------------------------------------------------------ plan
 
@@ -1655,6 +1682,11 @@ class DeviceRunner:
         the feeds that shard over every chip."""
         self._arena.budget_bytes = int(nbytes)
         self._arena.enforce()
+        if self._joiner is not None and nbytes > 0:
+            # the join build/probe cache (device/join.py) takes a fixed
+            # 1/8 slice of the node budget — the operator's HBM cap
+            # bounds join state too, not only the feed arena
+            self._joiner.set_budget(int(nbytes) // 8)
         if self._placer is not None:
             self._placer.set_hbm_budget(int(nbytes))
         degraded = self._degraded_sub()
@@ -1664,6 +1696,11 @@ class DeviceRunner:
 
     def hbm_stats(self) -> dict:
         out = self._arena.stats()
+        # join build/probe planes (device/join.py) are device-resident
+        # bytes too: reported beside the arena figure (bounded by their
+        # own slice of the budget, enforced in set_hbm_budget)
+        out["join_cache_bytes"] = self._joiner.resident_bytes() \
+            if self._joiner is not None else 0
         with self._quar_mu:
             out["quarantined"] = len(self._quarantined)
         subs = [r for r in self._placer.slices] \
@@ -1678,7 +1715,8 @@ class DeviceRunner:
             sub = r.hbm_stats()
             for k in ("resident_bytes", "resident_lines",
                       "pinned_lines", "pinned_bytes", "evictions",
-                      "rejections", "drops", "quarantined"):
+                      "rejections", "drops", "quarantined",
+                      "join_cache_bytes"):
                 out[k] = out.get(k, 0) + sub.get(k, 0)
         return out
 
@@ -1710,6 +1748,10 @@ class DeviceRunner:
             # die with the line too
             drop_cold()
         freed = self._arena.drop(anchor, reason=reason)
+        if self._joiner is not None:
+            # join build/probe planes anchored on the same lineage die
+            # with the feed — stale-epoch join state must not survive
+            freed += self._joiner.drop_anchor(anchor)
         if self._placer is not None:
             freed += self._placer.drop_feed_all(anchor, reason)
         degraded = self._degraded_sub()

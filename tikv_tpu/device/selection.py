@@ -46,7 +46,12 @@ Predicate constants are HOISTED into traced scalar parameters
 (``split_params``): the kernel cache key (``shape_key``) is const-blind,
 so repeated selections at differing thresholds/selectivities share ONE
 compile class per (plan shape, feed shape) — the reference's plan-cache
-discipline applied to the device JIT cache.
+discipline applied to the device JIT cache.  ``split_params`` is also
+the hoisting discipline of the device JOIN's fused probe pass
+(device/join.py): a join fragment's probe-side selection predicates
+evaluate inside the probe dispatch with their constants hoisted the
+same way, so rotating thresholds never mint new probe-kernel compile
+classes either.
 """
 
 from __future__ import annotations

@@ -1,4 +1,4 @@
-"""Pipeline builder + driver.
+"""Pipeline builder + driver — the host half of a PER-FRAGMENT route.
 
 Reference: tidb_query_executors/src/runner.rs — ``build_executors`` (:181)
 maps tipb Executor descriptors to BatchExecutor impls (scan must be first;
@@ -6,6 +6,16 @@ agg picks simple/fast-hash/slow-hash/stream by plan shape, :293-318), and
 ``BatchExecutorsRunner::handle_request`` (:498,:641) drives the pipeline
 with batch sizes growing 32 → (×2) → 1024 (:38-45), collecting exec
 summaries and encoding result chunks.
+
+Routing granularity: a whole request no longer picks host OR device
+once.  The endpoint's linear path still routes per DAGRequest, but
+under the plan IR (copr/plan_ir.py) this runner executes individual
+LEAF FRAGMENTS of a larger operator DAG — a device scan+join plan can
+hand its aggregation finalize here, and a faulted device fragment
+degrades to this pipeline per fragment, not per plan.  The executors
+themselves also run above in-memory batches (plan_ir.run_host_ops
+feeds them through a batch-source adapter) for the post-join/sort/
+window host finalize.
 """
 
 from __future__ import annotations

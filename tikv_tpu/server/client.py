@@ -656,6 +656,33 @@ class TxnClient:
         return self._call_leader(key, "Coprocessor", req,
                                  timeout=timeout)
 
+    def coprocessor_plan(self, preq, key_hint: Optional[bytes] = None,
+                         force_backend: Optional[str] = None,
+                         resource_group: str = "default",
+                         timeout: float = 30,
+                         deadline_ms: Optional[int] = None,
+                         trace_id: Optional[str] = None) -> dict:
+        """Plan-IR coprocessor request (copr/plan_ir.py): the operator
+        superset — join/sort/window fragments with per-operator
+        host/device routing.  Routes by the FIRST scan leaf's first
+        range; a join's two regions are expected co-located on one
+        node (the SlicePlacer co-location loop), which the single-node
+        and placement deployments guarantee."""
+        leaves = preq.scan_leaves()
+        key = key_hint if key_hint is not None else \
+            (leaves[0].ranges[0].start
+             if leaves and leaves[0].ranges else b"")
+        req = {"tp": 103, "plan": wire.enc_plan(preq),
+               "force_backend": force_backend,
+               "resource_group": resource_group}
+        if trace_id is not None:
+            req["trace_id"] = trace_id
+        if deadline_ms is not None:
+            req["deadline_ms"] = deadline_ms
+            timeout = min(timeout, deadline_ms / 1000.0)
+        return self._call_leader(key, "Coprocessor", req,
+                                 timeout=timeout)
+
     def coprocessor_paged(self, dag, paging_size: int,
                           key_hint: Optional[bytes] = None):
         """Iterate the unary paged protocol: yields one response dict

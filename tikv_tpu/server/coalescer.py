@@ -341,6 +341,15 @@ class RequestCoalescer:
         self.occupancy_sum = 0
         self.max_observed_occupancy = 0
         self.closes: dict[str, int] = {}
+        # plan-IR share class (endpoint.handle_plan): in-flight
+        # executions keyed by (plan identity, snapshot generations);
+        # a byte-identical concurrent join plan JOINS the running
+        # execution instead of dispatching its own — the ("share", ...)
+        # thundering-herd semantics applied to the plan path, without
+        # a collection window (the first arrival never waits)
+        self._shared: dict = {}
+        self.plan_share_hits = 0
+        self.plan_share_groups = 0
 
     # ------------------------------------------------------------ wiring
 
@@ -455,6 +464,39 @@ class RequestCoalescer:
         if inline:
             self._dispatch(g)
         return fut
+
+    # ------------------------------------------------------ plan share
+
+    def submit_shared(self, key, fn):
+        """Join plans' batch class: run ``fn`` once per concurrent
+        ``key`` — late arrivals park on the leader's future and share
+        its result (a failed leader fails every sharer; each caller's
+        own retry/degrade policy then applies).  The leader executes on
+        ITS OWN thread — no window, no added latency for serial
+        traffic."""
+        import concurrent.futures as cf
+        with self._mu:
+            fut = self._shared.get(key)
+            if fut is not None:
+                self.plan_share_hits += 1
+                leader = False
+            else:
+                fut = self._shared[key] = cf.Future()
+                self.plan_share_groups += 1
+                leader = True
+        if not leader:
+            return fut.result()
+        try:
+            result = fn()
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        else:
+            fut.set_result(result)
+            return result
+        finally:
+            with self._mu:
+                self._shared.pop(key, None)
 
     # ------------------------------------------------------- group close
 
@@ -705,6 +747,8 @@ class RequestCoalescer:
                 "max_occupancy": self.max_observed_occupancy,
                 "solo_degrade": self.solo_degrade,
                 "closes": dict(self.closes),
+                "plan_share_groups": self.plan_share_groups,
+                "plan_share_hits": self.plan_share_hits,
             }
         out["router"] = self.router.stats()
         return out

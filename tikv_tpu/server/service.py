@@ -172,6 +172,26 @@ class KvService:
                             dag_obj.ranges[0].start
                 except Exception:   # noqa: BLE001 — handler reports it
                     pass
+            elif method == "Coprocessor" and isinstance(req, dict) and \
+                    "plan" in req:
+                # plan-IR request (copr/plan_ir.py): same decode-once
+                # discipline — the plan identity keys the read pool's
+                # service-time EWMA and the trace-buffer class
+                try:
+                    with tracker.phase("plan_decode"):
+                        plan_obj = wire.dec_plan(req["plan"])
+                    req["__plan"] = plan_obj
+                    # const-blind, ts-blind class identity — keying the
+                    # EWMAs by plan_key() would mint a singleton class
+                    # per (constants, tso) and churn the bounded LRUs
+                    class_key = ("copr_plan", plan_obj.class_key())
+                    req["__trace_class"] = class_key
+                    leaves = plan_obj.scan_leaves()
+                    if leaves and leaves[0].ranges:
+                        req["__trace_range_start"] = \
+                            leaves[0].ranges[0].start
+                except Exception:   # noqa: BLE001 — handler reports it
+                    pass
         t0 = time.perf_counter()
         # the deadline rides a thread-local so the executor pipeline
         # (between batches) and the device dispatch path can shed
@@ -462,6 +482,15 @@ class KvService:
         # handle() stashed its class-keying decode; fall back to a
         # fresh parse for direct callers (tests, batch_commands)
         predec = req.pop("__dag", None)
+        if "plan" in req:
+            # plan-IR request: the operator superset (join/sort/window
+            # + mixed per-fragment routing, copr/plan_ir.py)
+            preq = req.pop("__plan", None) or wire.dec_plan(req["plan"])
+            resp = self.endpoint.handle_plan(
+                preq, force_backend=req.get("force_backend"),
+                resource_group=req.get("resource_group", "default"),
+                request_source=req.get("request_source", ""))
+            return self._enc_cop_resp(resp)
         if tp == 104:       # ANALYZE (endpoint.rs:275-312)
             from ..copr.analyze import AnalyzeReq
             dag = predec or wire.dec_dag(req["dag"])

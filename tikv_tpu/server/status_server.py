@@ -88,6 +88,14 @@ class StatusServer:
                         # occupancy, router decision mix, solo-degrade
                         # count
                         body["coalescer"] = coal.stats()
+                    pe = getattr(ep, "_plan_executor", None) \
+                        if ep is not None else None
+                    if pe is not None:
+                        # plan IR: per-fragment routing decisions +
+                        # wall EWMAs, join backend mix (device/host/
+                        # degrade), co-location hits, device joiner
+                        # cache/overflow rollup
+                        body["plan_ir"] = pe.stats()
                     dr = getattr(node, "device_runner", None)
                     if dr is not None and hasattr(dr, "selection_stats"):
                         # late-materialized selection: routing-decision

@@ -331,3 +331,169 @@ def dec_dag(d: dict):
 def enc_rows(rows) -> list:
     """Result rows → wire (floats/ints/bytes/None pass through msgpack)."""
     return [list(r) for r in rows]
+
+
+# -- plan IR (copr/plan_ir.py — the operator superset of tipb) --
+#
+# Leaf linear fragments reuse the exact tipb-shaped executor encoding
+# above (enc_dag's vocabulary is embedded per ScanNode/op), so any
+# plan a DAGRequest can express round-trips through either surface;
+# join/sort/window nodes are the extension.
+
+def enc_plan(preq) -> dict:
+    from ..copr import plan_ir as pir
+
+    def enc_scan_desc(scan) -> dict:
+        if isinstance(scan, pir.IndexScanDesc):
+            return {"k": "iscan", "table_id": scan.table_id,
+                    "index_id": scan.index_id, "desc": scan.desc,
+                    "unique": scan.unique,
+                    "cols": [{"id": c.col_id,
+                              "ft": enc_field_type(c.field_type),
+                              "pk": c.is_pk_handle}
+                             for c in scan.columns]}
+        return {"k": "tscan", "table_id": scan.table_id,
+                "desc": scan.desc,
+                "cols": [{"id": c.col_id,
+                          "ft": enc_field_type(c.field_type),
+                          "pk": c.is_pk_handle}
+                         for c in scan.columns]}
+
+    def enc_node(n) -> dict:
+        if isinstance(n, pir.ScanNode):
+            return {"k": "scan", "scan": enc_scan_desc(n.scan),
+                    "ranges": [{"s": r.start, "e": r.end}
+                               for r in n.ranges]}
+        if isinstance(n, pir.SelectNode):
+            return {"k": "sel", "child": enc_node(n.child),
+                    "conds": [enc_expr(e) for e in n.conditions]}
+        if isinstance(n, pir.ProjectNode):
+            return {"k": "proj", "child": enc_node(n.child),
+                    "exprs": [enc_expr(e) for e in n.exprs]}
+        if isinstance(n, pir.AggNode):
+            d = n.desc
+            return {"k": "agg", "child": enc_node(n.child),
+                    "streamed": d.streamed,
+                    "group_by": [enc_expr(e) for e in d.group_by],
+                    "aggs": [{"kind": a.kind,
+                              "arg": enc_expr(a.arg)
+                              if a.arg is not None else None}
+                             for a in d.aggs]}
+        if isinstance(n, pir.TopNNode):
+            return {"k": "topn", "child": enc_node(n.child),
+                    "limit": n.desc.limit,
+                    "order_by": [{"e": enc_expr(e), "desc": dsc}
+                                 for e, dsc in n.desc.order_by]}
+        if isinstance(n, pir.PartTopNNode):
+            return {"k": "ptopn", "child": enc_node(n.child),
+                    "limit": n.desc.limit,
+                    "partition_by": [enc_expr(e)
+                                     for e in n.desc.partition_by],
+                    "order_by": [{"e": enc_expr(e), "desc": dsc}
+                                 for e, dsc in n.desc.order_by]}
+        if isinstance(n, pir.LimitNode):
+            return {"k": "limit", "child": enc_node(n.child),
+                    "limit": n.limit}
+        if isinstance(n, pir.JoinNode):
+            return {"k": "join", "left": enc_node(n.left),
+                    "right": enc_node(n.right),
+                    "left_key": n.left_key, "right_key": n.right_key,
+                    "join_type": n.join_type}
+        if isinstance(n, pir.SortNode):
+            return {"k": "sort", "child": enc_node(n.child),
+                    "order_by": [{"e": enc_expr(e), "desc": dsc}
+                                 for e, dsc in n.order_by]}
+        if isinstance(n, pir.WindowNode):
+            return {"k": "window", "child": enc_node(n.child),
+                    "partition_by": [enc_expr(e)
+                                     for e in n.partition_by],
+                    "order_by": [{"e": enc_expr(e), "desc": dsc}
+                                 for e, dsc in n.order_by],
+                    "funcs": [{"kind": f.kind,
+                               "arg": enc_expr(f.arg)
+                               if f.arg is not None else None,
+                               "offset": f.offset}
+                              for f in n.funcs]}
+        raise ValueError(n)
+
+    return {"root": enc_node(preq.root), "start_ts": preq.start_ts,
+            "output_offsets": list(preq.output_offsets)
+            if preq.output_offsets is not None else None,
+            "encode_type": preq.encode_type}
+
+
+def dec_plan(d: dict):
+    from ..copr import plan_ir as pir
+    from ..copr.dag import (
+        AggExprDesc, AggregationDesc, ColumnInfo, IndexScanDesc,
+        PartitionTopNDesc, TableScanDesc, TopNDesc,
+    )
+    from ..executors.ranges import KeyRange
+
+    def dec_scan_desc(s):
+        cols = tuple(ColumnInfo(c["id"], dec_field_type(c["ft"]),
+                                c["pk"]) for c in s["cols"])
+        if s["k"] == "iscan":
+            return IndexScanDesc(s["table_id"], s["index_id"], cols,
+                                 s["desc"], s["unique"])
+        return TableScanDesc(s["table_id"], cols, s["desc"])
+
+    def dec_node(nd):
+        k = nd["k"]
+        if k == "scan":
+            return pir.ScanNode(
+                dec_scan_desc(nd["scan"]),
+                tuple(KeyRange(r["s"], r["e"]) for r in nd["ranges"]))
+        if k == "sel":
+            return pir.SelectNode(
+                dec_node(nd["child"]),
+                tuple(dec_expr(e) for e in nd["conds"]))
+        if k == "proj":
+            return pir.ProjectNode(
+                dec_node(nd["child"]),
+                tuple(dec_expr(e) for e in nd["exprs"]))
+        if k == "agg":
+            return pir.AggNode(dec_node(nd["child"]), AggregationDesc(
+                tuple(dec_expr(e) for e in nd["group_by"]),
+                tuple(AggExprDesc(a["kind"],
+                                  dec_expr(a["arg"])
+                                  if a["arg"] is not None else None)
+                      for a in nd["aggs"]),
+                nd["streamed"]))
+        if k == "topn":
+            return pir.TopNNode(dec_node(nd["child"]), TopNDesc(
+                tuple((dec_expr(o["e"]), o["desc"])
+                      for o in nd["order_by"]), nd["limit"]))
+        if k == "ptopn":
+            return pir.PartTopNNode(dec_node(nd["child"]),
+                                    PartitionTopNDesc(
+                tuple(dec_expr(e) for e in nd["partition_by"]),
+                tuple((dec_expr(o["e"]), o["desc"])
+                      for o in nd["order_by"]), nd["limit"]))
+        if k == "limit":
+            return pir.LimitNode(dec_node(nd["child"]), nd["limit"])
+        if k == "join":
+            return pir.JoinNode(dec_node(nd["left"]),
+                                dec_node(nd["right"]),
+                                nd["left_key"], nd["right_key"],
+                                nd.get("join_type", "inner"))
+        if k == "sort":
+            return pir.SortNode(dec_node(nd["child"]), tuple(
+                (dec_expr(o["e"]), o["desc"]) for o in nd["order_by"]))
+        if k == "window":
+            return pir.WindowNode(
+                dec_node(nd["child"]),
+                tuple(dec_expr(e) for e in nd["partition_by"]),
+                tuple((dec_expr(o["e"]), o["desc"])
+                      for o in nd["order_by"]),
+                tuple(pir.WindowFuncDesc(
+                    f["kind"],
+                    dec_expr(f["arg"]) if f["arg"] is not None else None,
+                    f.get("offset", 1)) for f in nd["funcs"]))
+        raise ValueError(nd)
+
+    return pir.PlanRequest(
+        dec_node(d["root"]), start_ts=d["start_ts"],
+        output_offsets=tuple(d["output_offsets"])
+        if d["output_offsets"] is not None else None,
+        encode_type=d["encode_type"])

@@ -417,6 +417,20 @@ DEVICE_PLACEMENT_COUNTER = REGISTRY.counter(
     "slice, move = rebalance dropped an anchor off a hot slice, "
     "whole_mesh = feed large enough to shard over every chip)",
     labels=("decision",))
+DEVICE_JOIN_ROUTE_COUNTER = REGISTRY.counter(
+    "tikv_device_join_route_total",
+    "plan-IR join fragment routing outcomes (device = one-dispatch "
+    "probe against the HBM-resident build dictionary, host = modeled "
+    "host win or outside the device envelope, degrade = device fault "
+    "fell back to the host join for that fragment only, "
+    "overflow_redispatch = pair capacity re-bucketed from the exact "
+    "on-device total)",
+    labels=("route",))
+COPR_PLAN_FRAGMENT_COUNTER = REGISTRY.counter(
+    "tikv_coprocessor_plan_fragment_total",
+    "plan-IR fragments by kind and routed backend (per-operator "
+    "host/device routing, copr/plan_ir.py FragmentRouter)",
+    labels=("kind", "backend"))
 SCHED_COMMANDS = REGISTRY.counter(
     "tikv_scheduler_commands_total", "txn scheduler commands",
     labels=("type",))

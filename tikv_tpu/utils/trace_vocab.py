@@ -46,6 +46,17 @@ SPAN_VOCABULARY: dict[str, str] = {
     "feed_patch": "delta-dirty span patch of a resident feed",
     "shard_merge": "host-side merge of per-shard partial agg states",
     "mesh_rebuild": "elastic degrade: re-mint serving on a submesh",
+    # -- plan IR (copr/plan_ir.py, device/join.py) --
+    "plan_route": "per-fragment host/device routing of a plan-IR "
+                  "request (FragmentRouter)",
+    "join_build": "build-side dictionary sort onto the device (key "
+                  "upload + one build dispatch, cached per anchor)",
+    "join_probe": "probe dispatch: fused selection + dictionary probe "
+                  "→ late-materialized row-index pairs D2H",
+    "sort_fragment": "sort fragment execution (device permutation or "
+                     "host stable sort) incl. the host gather",
+    "window_fragment": "window fragment execution (segmented scans "
+                       "over the partition-sorted view)",
     # -- cold path (device/mvcc.py, copr/stream_build.py) --
     "mvcc_parse": "CF_WRITE → flat plane parse (native/host)",
     "mvcc_resolve": "device segmented-argmax MVCC version resolution",
