@@ -33,6 +33,15 @@ Configs (BASELINE.md + r4 additions):
       the cross-request batching proof (server/coalescer.py): batched
       P99 ≤ solo P99, mean batch occupancy > 1.5, zero late acks
       (# batch_occupancy= / # router= / # p99_batched_vs_solo= lines)
+  6b2. TWO-TENANT SERVING: a latency-sensitive foreground tenant
+      (resource_group "fg": top-band point selections) vs an
+      aggressive background tenant ("bg": full-region hash-agg scans)
+      on one seeded schedule — the device-aware RU attribution proof
+      (resource_metering.py) and the measured baseline the future
+      enforcement PR's "fg P99 within 1.5× of solo while bg is
+      throttled, not starved" metric will be judged against
+      (# ru_by_tenant= / # ru_attribution_coverage= /
+      # hot_regions_topk= / # two_tenant= lines)
   7.  PLAN-IR JOIN: 10M-probe × 1M-build inner equi-join as ONE mixed
       plan (device scan+selection fused into the probe dispatch,
       device hash join → late-materialized row-index pairs, host
@@ -1100,6 +1109,243 @@ def run_concurrent_serving(device_runner, iters: int):
         pd_server.stop()
 
 
+def run_two_tenant_serving(device_runner, iters: int):
+    """Config 6b2: two-tenant serving — per-tenant/per-region RU
+    attribution under mixed OLTP + background-analytics load.
+
+    A foreground tenant (resource_group "fg", request_source "point":
+    top-band selections returning ≤2% of the feed — the dashboard
+    point-read shape) and an aggressive background tenant ("bg",
+    "scan": full-region hash-agg scans over every table) run the SAME
+    seeded schedule concurrently on a live gRPC node.  The foreground
+    runs once SOLO first: its solo P50/P99 is the measured baseline
+    the ROADMAP's enforcement PR ("fg P99 within 1.5× of solo while bg
+    is throttled, not starved") will be judged against.
+
+    What it proves (the metering tentpole): per-tag RU attribution
+    covers ≥95% of the total measured device launch wall + arena
+    bytes-resident-seconds (residual reported as the explicit untagged
+    entry), charges split group launches by occupancy share, and the
+    windowed top-k hot regions are visible at PD and in the recorder's
+    report.
+    """
+    import threading as _th
+
+    from tikv_tpu import resource_metering as _rm
+    from tikv_tpu.raftstore.metapb import Store
+    from tikv_tpu.resource_metering import (
+        GLOBAL_RECORDER,
+        ResourceTagFactory,
+        TagRecord,
+    )
+    from tikv_tpu.server import (
+        Node, PdServer, RemotePdClient, TikvServer, TxnClient,
+    )
+    from tikv_tpu.server.wire import RemoteError
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import int_table
+
+    n = int(os.environ.get("TIKV_TPU_BENCH_2T_ROWS", 1 << 17))
+    n_tables = int(os.environ.get("TIKV_TPU_BENCH_2T_TABLES", 2))
+    fg_clients = int(os.environ.get("TIKV_TPU_BENCH_2T_FG_CLIENTS", 8))
+    fg_reqs = int(os.environ.get("TIKV_TPU_BENCH_2T_FG_REQS", 6))
+    bg_clients = int(os.environ.get("TIKV_TPU_BENCH_2T_BG_CLIENTS", 2))
+    bg_reqs = int(os.environ.get("TIKV_TPU_BENCH_2T_BG_REQS", 4))
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    # threshold tracks the loaded size so scaled-down smoke runs still
+    # exercise the device charge sites the config exists to meter
+    node = Node("127.0.0.1:0", RemotePdClient(pd_addr),
+                device_runner=device_runner,
+                device_row_threshold=max(128, min(131072, n)))
+    node.config.raftstore.region_split_size_mb = 1 << 20
+    node.config.raftstore.region_max_size_mb = 1 << 20
+    srv = TikvServer(node)
+    node.addr = f"127.0.0.1:{srv.port}"
+    node.pd.put_store(Store(node.store_id, node.addr))
+    srv.start()
+    # tight window + immediate PD push so the hot-region report is
+    # observable within the bench run (restored in the finally)
+    GLOBAL_RECORDER.configure(window_s=0.5, report_interval_s=0.0)
+    try:
+        c = TxnClient(pd_addr)
+        tables = [int_table(2, table_id=9950 + i)
+                  for i in range(n_tables)]
+        for t in tables:
+            _bulk_load(c, node, t, n)
+        rng = np.random.default_rng(62)
+        fg_thr = [980 + int(x) for x in rng.integers(0, 16,
+                                                     fg_clients * fg_reqs)]
+        fg_tab = [int(x) for x in rng.integers(0, n_tables,
+                                               fg_clients * fg_reqs)]
+        bg_tab = [int(x) for x in rng.integers(0, n_tables,
+                                               bg_clients * bg_reqs)]
+
+        def fg_dag(i, ts):
+            s = DagSelect.from_table(tables[fg_tab[i]],
+                                     ["id", "c0", "c1"])
+            return s.where(s.col("c1") > fg_thr[i]).build(start_ts=ts)
+
+        def bg_dag(i, ts):
+            s = DagSelect.from_table(tables[bg_tab[i]],
+                                     ["id", "c0", "c1"])
+            return s.aggregate(
+                [s.col("c0")],
+                [("count_star", None), ("sum", s.col("c1"))]
+            ).build(start_ts=ts)
+
+        # warm every (table, plan-kind): cold builds + compiles happen
+        # OUTSIDE the measured phases
+        for ti in range(n_tables):
+            s = DagSelect.from_table(tables[ti], ["id", "c0", "c1"])
+            c.coprocessor(s.where(s.col("c1") > 980).build(
+                start_ts=c.tso()), timeout=600)
+            c.coprocessor(s.aggregate(
+                [s.col("c0")],
+                [("count_star", None), ("sum", s.col("c1"))]
+            ).build(start_ts=c.tso()), timeout=600)
+
+        def run_tenant(make, count, reqs, group, source, lat, errors):
+            def worker(ci):
+                for r in range(reqs):
+                    i = ci * reqs + r
+                    t0 = time.perf_counter()
+                    try:
+                        c.coprocessor(make(i, c.tso()), timeout=120,
+                                      resource_group=group,
+                                      request_source=source)
+                    except RemoteError as e:
+                        errors.append(e.kind)
+                        continue
+                    lat.append(time.perf_counter() - t0)
+            return [_th.Thread(target=worker, args=(ci,))
+                    for ci in range(count)]
+
+        def pcts(lat):
+            a = np.asarray(lat) if lat else np.asarray([0.0])
+            return (round(float(np.percentile(a, 50)) * 1e3, 3),
+                    round(float(np.percentile(a, 99)) * 1e3, 3))
+
+        # phase 1 — FOREGROUND SOLO: the enforcement PR's baseline
+        solo_lat, solo_err = [], []
+        ts = run_tenant(fg_dag, fg_clients, fg_reqs, "fg", "point",
+                        solo_lat, solo_err)
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        fg_solo_p50, fg_solo_p99 = pcts(solo_lat)
+
+        # phase 2 — MIXED: fg + bg concurrently, metering deltas
+        # bracketed around exactly this phase.  Roll (and thereby
+        # settle arena residency) BEFORE the base snapshot so solo-
+        # phase rent doesn't leak into the mixed-phase figures.
+        GLOBAL_RECORDER.roll_window(force=True)
+        fr = getattr(device_runner, "flight_recorder", None)
+        base_tot = GLOBAL_RECORDER.totals()
+        base_reg = GLOBAL_RECORDER.region_totals()
+        base_wall = fr.stats()["wall_s_total"] if fr else 0.0
+        fg_lat, fg_err = [], []
+        bg_lat, bg_err = [], []
+        ts = run_tenant(fg_dag, fg_clients, fg_reqs, "fg", "point",
+                        fg_lat, fg_err) + \
+            run_tenant(bg_dag, bg_clients, bg_reqs, "bg", "scan",
+                       bg_lat, bg_err)
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        mixed_wall = time.perf_counter() - t0
+        fg_p50, fg_p99 = pcts(fg_lat)
+        bg_p50, bg_p99 = pcts(bg_lat)
+
+        # settle residency + roll so the mixed phase's charges are in
+        # the report, then read the attribution deltas
+        GLOBAL_RECORDER.roll_window(force=True)
+        tot = GLOBAL_RECORDER.totals()
+        wall = (fr.stats()["wall_s_total"] - base_wall) if fr else 0.0
+
+        def delta(tag) -> TagRecord:
+            out = tot.get(tag, TagRecord()).copy()
+            prev = base_tot.get(tag)
+            if prev is not None:
+                neg = TagRecord()
+                neg.merge(prev)
+                for f in ("cpu_secs", "read_keys", "write_keys",
+                          "requests", "launch_s", "d2h_bytes",
+                          "byte_seconds", "host_s", "ru"):
+                    setattr(out, f,
+                            getattr(out, f) - getattr(neg, f))
+            return out
+
+        by_tenant: dict = {}
+        for tag in tot:
+            d = delta(tag)
+            if d.ru <= 0 and d.launch_s <= 0:
+                continue
+            ten = ResourceTagFactory.tenant(tag)
+            cur = by_tenant.setdefault(ten, TagRecord())
+            cur.merge(d)
+        coverage = _rm.coverage_from(tot, base_tot)
+        charged_wall = sum(delta(t).launch_s for t in tot)
+        # top-k hot regions over the WHOLE mixed phase (region-total
+        # deltas — the windowed report shows only the last roll) + the
+        # PD-side merge (pushed on the store heartbeat)
+        reg_tot = GLOBAL_RECORDER.region_totals()
+        hot_phase = []
+        for region, rec_now in reg_tot.items():
+            ru = rec_now.ru - base_reg.get(region, TagRecord()).ru
+            if ru > 0:
+                hot_phase.append({"region": region,
+                                  "ru": round(ru, 4)})
+        hot_phase.sort(key=lambda e: -e["ru"])
+        hot_phase = hot_phase[:8]
+        report = GLOBAL_RECORDER.report()
+        pd_cli = RemotePdClient(pd_addr)
+        pd_hot = {}
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                pd_hot = pd_cli.hot_regions(topk=8)
+            except Exception:   # noqa: BLE001 — report not pushed yet
+                pd_hot = {}
+            if pd_hot.get("regions"):
+                break
+            time.sleep(0.3)
+        return {
+            "rows": n, "tables": n_tables,
+            "fg_requests": fg_clients * fg_reqs,
+            "bg_requests": bg_clients * bg_reqs,
+            "fg_solo_p50_ms": fg_solo_p50,
+            "fg_solo_p99_ms": fg_solo_p99,
+            "fg_mixed_p50_ms": fg_p50, "fg_mixed_p99_ms": fg_p99,
+            "bg_p50_ms": bg_p50, "bg_p99_ms": bg_p99,
+            "fg_mixed_over_solo_p99": round(
+                fg_p99 / max(1e-9, fg_solo_p99), 3),
+            "mixed_wall_s": round(mixed_wall, 2),
+            "errors": {"fg_solo": len(solo_err), "fg": len(fg_err),
+                       "bg": len(bg_err)},
+            "ru_by_tenant": {t: r.summary()
+                             for t, r in sorted(
+                                 by_tenant.items(),
+                                 key=lambda kv: -kv[1].ru)},
+            "ru_attribution_coverage": round(coverage, 4),
+            "launch_wall_s": round(wall, 6),
+            "charged_launch_s": round(charged_wall, 6),
+            "hot_regions_topk": hot_phase,
+            "window_top_regions": report.get("top_regions", []),
+            "hot_tenants_topk": report.get("top_tenants", []),
+            "pd_hot_regions": pd_hot.get("regions", []),
+            "coverage_ge_95": bool(coverage >= 0.95),
+        }
+    finally:
+        GLOBAL_RECORDER.configure(window_s=5.0, report_interval_s=5.0)
+        srv.stop()
+        pd_server.stop()
+
+
 def run_selection_sweep(runner, n: int, iters: int):
     """Config 2s: selection selectivity sweep {0.1%, 1%, 10%, 50%, 99%}.
 
@@ -1331,6 +1577,16 @@ def main() -> None:
         configs["6b_concurrent_serving"] = {
             "error": f"{type(e).__name__}: {e}"}
 
+    # 6b2: two-tenant serving — per-tenant/per-region RU attribution
+    # (fg point reads vs bg full scans on one seeded schedule), the
+    # measured baseline for the future enforcement PR
+    try:
+        configs["6b2_two_tenant"] = run_two_tenant_serving(
+            runner, iters)
+    except Exception as e:      # noqa: BLE001 — bench must still report
+        configs["6b2_two_tenant"] = {
+            "error": f"{type(e).__name__}: {e}"}
+
     headline = configs["4_hash_agg"]
     print(json.dumps({
         "metric": "copr_hash_agg_rows_per_sec",
@@ -1349,7 +1605,8 @@ def main() -> None:
     print(f"# mesh= shape={ms['shape']} n_devices={ms['n_devices']} "
           f"platform={ms['platform']}", file=sys.stderr)
     for name, c in configs.items():
-        if name in ("2s_selection_sweep", "6b_concurrent_serving"):
+        if name in ("2s_selection_sweep", "6b_concurrent_serving",
+                    "6b2_two_tenant"):
             continue            # dedicated first-class lines below
         if "rows_per_sec" not in c:
             print(f"# {name}: {c}", file=sys.stderr)
@@ -1521,6 +1778,34 @@ def main() -> None:
                   file=sys.stderr)
     elif cs:
         print(f"# 6b_concurrent_serving: {cs}", file=sys.stderr)
+    # 6b2 adjudication — per-tenant RU attribution lines (the
+    # enforcement PR's baseline must survive artifact truncation)
+    tt = configs.get("6b2_two_tenant", {})
+    if "ru_by_tenant" in tt:
+        per = " ".join(
+            f"{t}={r['ru']}" for t, r in tt["ru_by_tenant"].items())
+        print(f"# ru_by_tenant= {per or 'none'}", file=sys.stderr)
+        print(f"# ru_attribution_coverage= "
+              f"{tt['ru_attribution_coverage']} "
+              f"launch_wall_s={tt['launch_wall_s']} "
+              f"charged_launch_s={tt['charged_launch_s']} "
+              f"ok={tt['coverage_ge_95']}", file=sys.stderr)
+        hot = " ".join(
+            f"r{e['region']}:{e['ru']}"
+            for e in tt["hot_regions_topk"]
+            if isinstance(e.get("region"), int))
+        print(f"# hot_regions_topk= {hot or 'none'} "
+              f"pd_visible={bool(tt['pd_hot_regions'])}",
+              file=sys.stderr)
+        print(f"# two_tenant= fg_solo_p50={tt['fg_solo_p50_ms']}ms "
+              f"fg_solo_p99={tt['fg_solo_p99_ms']}ms "
+              f"fg_mixed_p50={tt['fg_mixed_p50_ms']}ms "
+              f"fg_mixed_p99={tt['fg_mixed_p99_ms']}ms "
+              f"ratio={tt['fg_mixed_over_solo_p99']} "
+              f"bg_p50={tt['bg_p50_ms']}ms bg_p99={tt['bg_p99_ms']}ms",
+              file=sys.stderr)
+    elif tt:
+        print(f"# 6b2_two_tenant: {tt}", file=sys.stderr)
 
 
 if __name__ == "__main__":

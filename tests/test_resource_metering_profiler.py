@@ -65,8 +65,8 @@ def test_top_n_folds_into_others():
     for i in range(10):
         rec.record(f"tag{i}", cpu_secs=float(i), requests=1)
     rep = rec.harvest()
-    assert len(rep) == 4 and "others" in rep
-    assert rep["others"].requests == 7
+    assert len(rep) == 4 and "other" in rep
+    assert rep["other"].requests == 7
     assert "tag9" in rep and "tag0" not in rep
 
 
@@ -146,9 +146,15 @@ def test_status_server_routes():
         from tikv_tpu.resource_metering import GLOBAL_RECORDER
         GLOBAL_RECORDER.record("route-test", cpu_secs=0.5, requests=2)
         body = urllib.request.urlopen(
-            base + "/resource_metering", timeout=10).read()
+            base + "/resource_metering?format=json", timeout=10).read()
         rep = json.loads(body)
-        assert rep["route-test"]["requests"] == 2
+        assert rep["tags"]["route-test"]["requests"] >= 2
+        assert "ru" in rep["tags"]["route-test"]
+        assert "coverage" in rep and "window" in rep
+        # default format: the human-readable table
+        text = urllib.request.urlopen(
+            base + "/resource_metering", timeout=10).read().decode()
+        assert "route-test" in text and "coverage=" in text
         prof = urllib.request.urlopen(
             base + "/debug/pprof/profile?seconds=0.2", timeout=10).read()
         assert isinstance(prof, bytes)

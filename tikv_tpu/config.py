@@ -159,6 +159,34 @@ class ReadPoolConfig:
 
 
 @dataclass
+class ResourceMeteringConfig:
+    """[resource-metering]: device-aware RU attribution
+    (resource_metering.py + ru_model.py).  Every field is
+    online-updatable and visible in /health.
+
+    The windowed recorder rolls per-tag/per-region charges every
+    ``window_s``; the last window's top-``topk`` hot-tenant/hot-region
+    report serves /resource_metering and rides the store heartbeat to
+    PD every ``report_interval_s``.  ``max_resource_groups`` bounds
+    the live tag map (overflow + idle tags fold into "other").  The
+    ``ru_per_*`` weights are the linear cost model — see
+    ru_model.RuModel's table for the defaults' rationale."""
+
+    window_s: float = 5.0
+    topk: int = 8
+    max_resource_groups: int = 64
+    report_interval_s: float = 5.0
+    # RU weights (0 disables an axis); None in a TOML would be odd, so
+    # the dataclass carries the model defaults verbatim
+    ru_per_launch_s: float = 1000.0 / 3.0
+    ru_per_host_s: float = 1000.0 / 3.0
+    ru_per_d2h_mb: float = 16.0
+    ru_per_mb_s: float = 0.05
+    ru_per_read_key: float = 1.0 / 2048.0
+    ru_per_request: float = 0.125
+
+
+@dataclass
 class SecurityConfig:
     """[security]: TLS for every gRPC channel (components/security).
     The ONE definition — server/security.py builds its manager from
@@ -183,6 +211,8 @@ class TikvConfig:
     coprocessor: CoprocessorConfig = field(
         default_factory=CoprocessorConfig)
     readpool: ReadPoolConfig = field(default_factory=ReadPoolConfig)
+    resource_metering: ResourceMeteringConfig = field(
+        default_factory=ResourceMeteringConfig)
     security: SecurityConfig = field(default_factory=SecurityConfig)
 
     @staticmethod
@@ -215,6 +245,23 @@ class TikvConfig:
             raise ValueError("region-split-size must be <= region-max-size")
         if self.readpool.concurrency < 1:
             raise ValueError("readpool concurrency must be >= 1")
+        rm = self.resource_metering
+        if rm.window_s <= 0:
+            raise ValueError("resource-metering window-s must be > 0")
+        if rm.topk < 1 or rm.max_resource_groups < 1:
+            raise ValueError(
+                "resource-metering topk/max-resource-groups must be "
+                ">= 1")
+        if rm.report_interval_s < 0:
+            raise ValueError(
+                "resource-metering report-interval-s must be >= 0")
+        for f in dataclasses.fields(rm):
+            if f.name.startswith("ru_per_") and \
+                    getattr(rm, f.name) < 0:
+                # a negative weight would DECREMENT RU counters and
+                # corrupt every downstream total/report
+                raise ValueError(
+                    f"resource-metering {f.name} must be >= 0")
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -240,6 +287,16 @@ _ONLINE_FIELDS = {
     "coprocessor.slow_log_threshold_ms",
     "coprocessor.flight_recorder_depth",
     "readpool.concurrency",
+    "resource_metering.window_s",
+    "resource_metering.topk",
+    "resource_metering.max_resource_groups",
+    "resource_metering.report_interval_s",
+    "resource_metering.ru_per_launch_s",
+    "resource_metering.ru_per_host_s",
+    "resource_metering.ru_per_d2h_mb",
+    "resource_metering.ru_per_mb_s",
+    "resource_metering.ru_per_read_key",
+    "resource_metering.ru_per_request",
 }
 
 

@@ -272,7 +272,12 @@ class Endpoint:
         their own first key range), the fragment router places each
         fragment host/device, and byte-identical join plans share one
         execution through the coalescer's plan share class."""
-        from ..resource_metering import GLOBAL_RECORDER, ResourceTagFactory
+        from ..resource_metering import (
+            GLOBAL_RECORDER,
+            ResourceTagFactory,
+            region_of,
+            set_region,
+        )
         from ..utils import metrics as m
         from ..utils import tracker
         from ..utils.deadline import check_current as _dl_check
@@ -295,6 +300,11 @@ class Endpoint:
                     v = lineage.version
                 anchors.append((id(storage if lineage is None
                                    else lineage), v))
+            if storages:
+                # region attribution: bill the plan's device charges
+                # to its FIRST scan leaf's region (a join's probe side
+                # — the side that owns the big feed)
+                set_region(region_of(next(iter(storages.values()))))
             ex = self.plan_executor
 
             def run():
@@ -347,6 +357,8 @@ class Endpoint:
         from ..resource_metering import (
             GLOBAL_RECORDER,
             ResourceTagFactory,
+            region_of,
+            set_region,
         )
         from ..utils import tracker
         if req.tp != REQ_TYPE_DAG:
@@ -356,6 +368,10 @@ class Endpoint:
         t0 = time.perf_counter_ns()
         with GLOBAL_RECORDER.attach(tag):
             storage = self._snapshot_provider(req)
+            # region attribution: the snapshot resolved the feed
+            # anchor, so hot-region metering can bill this request's
+            # device charges to its region from here on
+            set_region(region_of(storage))
             backend = self._pick_backend(req, storage)
             tracker.label("backend", backend)
             if backend == "device" and self._mesh_label is not None:
@@ -442,10 +458,13 @@ class Endpoint:
             # TimeDetail
             cur = tracker.current()
 
+            reg = region_of(storage)
+
             def fetch():
                 tok = tracker.adopt(cur) if cur is not None else None
                 try:
-                    with GLOBAL_RECORDER.attach(tag, requests=0):
+                    with GLOBAL_RECORDER.attach(tag, requests=0,
+                                                region=reg):
                         return out.result()
                 finally:
                     if tok is not None:
@@ -459,10 +478,15 @@ class Endpoint:
     def _finish_response(self, d: "CopDeferred", result,
                          backend: str) -> CopResponse:
         """Shared completion tail: scanned-rows accounting + metrics."""
-        from ..resource_metering import GLOBAL_RECORDER, scanned_rows
+        from ..resource_metering import (
+            GLOBAL_RECORDER,
+            region_of,
+            scanned_rows,
+        )
         from ..utils import metrics as m
         from ..utils import tracker
-        with GLOBAL_RECORDER.attach(d.tag, requests=0):
+        with GLOBAL_RECORDER.attach(d.tag, requests=0,
+                                    region=region_of(d.storage)):
             if backend == "device" and not result.exec_summaries:
                 # the device feed always scans the whole snapshot; its
                 # results carry no per-operator summaries
@@ -480,7 +504,7 @@ class Endpoint:
 
     def _degrade_at_wait(self, d: "CopDeferred"):
         """Deferred-fetch failure → host pipeline (unless forced)."""
-        from ..resource_metering import GLOBAL_RECORDER
+        from ..resource_metering import GLOBAL_RECORDER, region_of
         from ..executors.runner import BatchExecutorsRunner
         from ..utils import tracker
         import logging
@@ -489,7 +513,8 @@ class Endpoint:
             exc_info=True)
         tracker.label("backend", "host")
         tracker.label("degraded", "fetch")
-        with GLOBAL_RECORDER.attach(d.tag, requests=0):
+        with GLOBAL_RECORDER.attach(d.tag, requests=0,
+                                    region=region_of(d.storage)):
             with tracker.phase("host_exec"):
                 return BatchExecutorsRunner(
                     d.req.dag, d.storage).handle_request()

@@ -316,7 +316,7 @@ class DeferredResult:
     """
 
     __slots__ = ("_runner", "_pending", "_dag", "_storage", "_mu",
-                 "_memo", "small", "_pin_anchor")
+                 "_memo", "small", "_pin_anchor", "_meter_ctx")
 
     def __init__(self, runner, pending: _Pending, dag, storage,
                  pin_anchor=None):
@@ -330,12 +330,19 @@ class DeferredResult:
         # feed-arena pin taken at dispatch; released exactly once when
         # the deferred fetch resolves (eviction must not race the D2H)
         self._pin_anchor = pin_anchor
+        # dispatch-time metering context: fetch-side charges (D2H
+        # bytes) attribute to the dispatching request/share-group no
+        # matter which completion worker runs the fetch
+        from .. import resource_metering as rm
+        self._meter_ctx = rm.current_context()
 
     def result(self):
+        from .. import resource_metering as rm
         with self._mu:
             if self._memo is None:
                 try:
-                    self._memo = ("ok", self._resolve())
+                    with rm.activate(self._meter_ctx):
+                        self._memo = ("ok", self._resolve())
                 except BaseException as e:      # noqa: BLE001 — memoized
                     self._memo = ("err", e)
                 finally:
@@ -398,7 +405,8 @@ class _GroupPending:
     the group's arena pin exactly once.
     """
 
-    __slots__ = ("_runner", "_pending", "_mu", "_memo", "_pin_anchor")
+    __slots__ = ("_runner", "_pending", "_mu", "_memo", "_pin_anchor",
+                 "_meter_ctx")
 
     def __init__(self, runner, pending: _Pending, pin_anchor=None):
         self._runner = runner
@@ -406,13 +414,20 @@ class _GroupPending:
         self._mu = threading.Lock()
         self._memo = None
         self._pin_anchor = pin_anchor
+        # group metering context captured at dispatch: the shared D2H
+        # charge splits by occupancy share across member tags from
+        # whichever member's completion worker joins the fetch first
+        from .. import resource_metering as rm
+        self._meter_ctx = rm.current_context()
 
     def fetch(self):
+        from .. import resource_metering as rm
         with self._mu:
             if self._memo is None:
                 try:
-                    self._memo = ("ok",
-                                  self._runner._finish(self._pending))
+                    with rm.activate(self._meter_ctx):
+                        self._memo = (
+                            "ok", self._runner._finish(self._pending))
                 except BaseException as e:  # noqa: BLE001 — memoized
                     if isinstance(e, _FallbackToHost):
                         # one strike for the shared fetch, not one per
@@ -2448,6 +2463,7 @@ class DeviceRunner:
         key) so the ``first_launch`` flag distinguishes a real
         cold-compile launch from a warm cache hit within the same plan
         kind."""
+        from .. import resource_metering as rm
         from ..utils import tracker
         rec = self.flight_recorder
         with tracker.phase("device_dispatch"):
@@ -2459,10 +2475,16 @@ class DeviceRunner:
                 ok = False
                 raise
             finally:
+                wall_s = time.perf_counter() - t0
+                # RU metering: every launch wall is charged to the
+                # ambient (tag, region) — a coalesced group's shared
+                # launch splits by occupancy share across member tags
+                # (resource_metering.charge_launch site resolution)
+                rm.charge_launch(wall_s)
                 if rec is not None:
                     entry = rec.note(
                         klass=klass, key=key,
-                        wall_s=time.perf_counter() - t0,
+                        wall_s=wall_s,
                         mesh=self._mesh_desc,
                         slice_id=self._slice_indices[0]
                         if len(self._slice_indices) == 1 else None,
@@ -2509,8 +2531,13 @@ class DeviceRunner:
                     x.copy_to_host_async()
                 except Exception:   # pragma: no cover - CPU arrays
                     pass
-            return jax.tree.unflatten(treedef,
-                                      [np.asarray(x) for x in leaves])
+            fetched = [np.asarray(x) for x in leaves]
+            # RU metering: the MEASURED transfer payload, charged once
+            # per physical D2H (a group's shared fetch splits across
+            # its members through the captured group context)
+            from .. import resource_metering as rm
+            rm.charge_d2h(sum(int(a.nbytes) for a in fetched))
+            return jax.tree.unflatten(treedef, fetched)
 
     # ------------------------------------------------------------ dispatch
 

@@ -129,6 +129,11 @@ class FlightRecorder:
         self.launches = 0
         self.first_launches = 0
         self.faults = 0
+        # cumulative measured launch wall: the resource-metering
+        # attribution-coverage denominator (every _dispatch_phase wall
+        # lands both here and in the RU recorder — charged wall /
+        # recorded wall is the ≥95% acceptance figure)
+        self.wall_s_total = 0.0
 
     def note(self, klass: str, key=None, wall_s: float = 0.0,
              mesh: str = "", slice_id=None, pinned_bytes: int = 0,
@@ -141,6 +146,7 @@ class FlightRecorder:
             while len(self._seen) > self.CLASS_SEEN_MAX:
                 self._seen.popitem(last=False)
             self.launches += 1
+            self.wall_s_total += wall_s
             if first:
                 self.first_launches += 1
             if not ok:
@@ -173,7 +179,8 @@ class FlightRecorder:
                     "recorded": len(self._ring),
                     "launches": self.launches,
                     "first_launches": self.first_launches,
-                    "faults": self.faults}
+                    "faults": self.faults,
+                    "wall_s_total": self.wall_s_total}
 
 
 # ------------------------------------------------- slice failure domains
@@ -461,7 +468,7 @@ class SliceHealthBoard:
 
 class _ArenaEntry:
     __slots__ = ("ref", "bucket", "nbytes", "hits", "tick", "pins",
-                 "gen")
+                 "gen", "owner_tag", "owner_region", "res_t0")
 
     def __init__(self, ref, gen: int):
         self.ref = ref
@@ -474,6 +481,12 @@ class _ArenaEntry:
         # against a dropped-and-rebuilt entry (same anchor, new entry)
         # can never strip a different dispatch's pin
         self.gen = gen
+        # RU residency attribution: the (resource_group, source) tag
+        # that last touched this anchor under a metering context owns
+        # its bytes-resident-seconds from res_t0 forward
+        self.owner_tag = None
+        self.owner_region = None
+        self.res_t0 = time.monotonic()
 
 
 class FeedArena:
@@ -498,6 +511,13 @@ class FeedArena:
         self._mu = threading.RLock()
         self._tick = 0
         self._gen = 0
+        # residency charges settled under _mu, flushed to the metering
+        # recorder OUTSIDE it: (owner_tag, owner_region, byte_seconds)
+        self._pending_res: list = []
+        # window-roll settlement: the recorder sweeps registered arenas
+        # so an idle feed still pays rent every metering window
+        from .. import resource_metering as _rm
+        _rm.GLOBAL_RECORDER.register_residency_source(self)
         # running resident-byte total, maintained at admit/drop/evict:
         # the per-request paths (admit, unpin) must not pay an
         # O(anchors) sum at the thousands-of-regions scale
@@ -516,6 +536,8 @@ class FeedArena:
     def bucket(self, anchor, create: bool = True) -> Optional[dict]:
         """The per-anchor cache dict (feeds + request memos), or None
         when the anchor cannot be tracked (not weak-referenceable)."""
+        from .. import resource_metering as _rm
+        ctx = _rm.current_context()
         key = id(anchor)
         with self._mu:
             ent = self._entries.get(key)
@@ -523,6 +545,7 @@ class FeedArena:
                 self._tick += 1
                 ent.hits += 1
                 ent.tick = self._tick
+                self._own_locked(ent, ctx, anchor)
                 return ent.bucket
             if not create:
                 return None
@@ -536,8 +559,57 @@ class FeedArena:
             ent = _ArenaEntry(ref, self._gen)
             ent.hits = 1
             ent.tick = self._tick
+            self._own_locked(ent, ctx, anchor)
             self._entries[key] = ent
             return ent.bucket
+
+    # -- residency metering -------------------------------------------
+
+    def _own_locked(self, ent: _ArenaEntry, ctx, anchor) -> None:
+        """A tagged toucher takes ownership of the anchor's residency;
+        accrual up to now settles to the PREVIOUS owner first (the
+        tag that parked the bytes pays for the parking)."""
+        if ctx is None or ctx.tag is None:
+            return
+        if ent.owner_tag != ctx.tag:
+            self._settle_entry_locked(ent, time.monotonic())
+            ent.owner_tag = ctx.tag
+        region = ctx.region if ctx.region is not None else \
+            getattr(anchor, "region_hint", None)
+        if region is not None:
+            ent.owner_region = region
+
+    def _settle_entry_locked(self, ent: _ArenaEntry,
+                             now: float) -> None:
+        dt = now - ent.res_t0
+        ent.res_t0 = now
+        if dt > 0 and ent.nbytes > 0:
+            self._pending_res.append(
+                (ent.owner_tag, ent.owner_region, ent.nbytes * dt))
+
+    def _flush_residency(self) -> None:
+        """Charge settled byte-seconds OUTSIDE the arena mutex."""
+        with self._mu:
+            if not self._pending_res:
+                return
+            pending, self._pending_res = self._pending_res, []
+        from .. import resource_metering as _rm
+        for tag, region, byte_s in pending:
+            _rm.GLOBAL_RECORDER.charge(
+                "arena::residency", byte_seconds=byte_s,
+                tag=tag if tag is not None else _rm.UNTAGGED,
+                region=region)
+
+    def settle_residency(self, recorder=None) -> None:
+        """Settle every entry's accrued bytes-resident-seconds up to
+        now — the metering window roll's sweep (``recorder`` is the
+        caller's handle, unused: charges flow through the global
+        recorder the arena registered with)."""
+        now = time.monotonic()
+        with self._mu:
+            for ent in self._entries.values():
+                self._settle_entry_locked(ent, now)
+        self._flush_residency()
 
     def _gc_drop(self, key: int) -> None:
         # backstop only: anchors with lifecycle owners are dropped
@@ -545,9 +617,15 @@ class FeedArena:
         with self._mu:
             ent = self._entries.pop(key, None)
             if ent is not None:
+                self._settle_entry_locked(ent, time.monotonic())
                 self._resident -= ent.nbytes
                 if ent.pins > 0:
                     self._pinned = max(0, self._pinned - ent.nbytes)
+        # deliberately NO residency flush here: this is a weakref GC
+        # callback and may fire on a thread already inside the
+        # metering recorder's lock (an allocation-triggered collection
+        # mid-charge) — the settlement stays queued in _pending_res
+        # and the next pin/drop/window-roll flush charges it
         self._publish()
 
     # -- pinning ------------------------------------------------------
@@ -562,10 +640,16 @@ class FeedArena:
             ent = self._entries.get(id(anchor))
             if ent is None:
                 return None
+            # pin-time sampling: settle accrued residency at every
+            # dispatch pin so a hot feed's rent lands in the same
+            # metering window its traffic does
+            self._settle_entry_locked(ent, time.monotonic())
             if ent.pins == 0:
                 self._pinned += ent.nbytes
             ent.pins += 1
-            return (id(anchor), ent.gen)
+            token = (id(anchor), ent.gen)
+        self._flush_residency()
+        return token
 
     def unpin(self, token) -> None:
         if token is None:
@@ -581,6 +665,7 @@ class FeedArena:
             # (a pinned entry admitted over the cap): sweep now
             if self.budget_bytes > 0:
                 self._evict_until_locked(self.budget_bytes)
+        self._flush_residency()
         self._publish()
 
     # -- admission / eviction ----------------------------------------
@@ -598,6 +683,9 @@ class FeedArena:
             if ent is None:
                 return False
             fresh = _bucket_nbytes(ent.bucket)
+            # settle at the OLD byte count before re-accounting: each
+            # residency interval is charged at the bytes actually held
+            self._settle_entry_locked(ent, time.monotonic())
             self._resident += fresh - ent.nbytes
             if ent.pins > 0:
                 # re-accounting a pinned entry moves the pinned total
@@ -633,6 +721,7 @@ class FeedArena:
                     self.rejections += 1
                     DEVICE_FEED_EVICTION_COUNTER.labels("reject").inc()
                     admitted = False
+        self._flush_residency()
         self._publish()
         return admitted
 
@@ -653,6 +742,7 @@ class FeedArena:
                     victim_key, victim = k, e
             if victim is None:
                 break
+            self._settle_entry_locked(victim, time.monotonic())
             self._entries.pop(victim_key, None)
             self._resident -= victim.nbytes
             self.evictions += 1
@@ -667,6 +757,7 @@ class FeedArena:
         with self._mu:
             evicted = self._evict_until_locked(self.budget_bytes) \
                 if self.budget_bytes > 0 else 0
+        self._flush_residency()
         self._publish()
         return evicted
 
@@ -680,11 +771,13 @@ class FeedArena:
             ent = self._entries.pop(id(anchor), None)
             freed = ent.nbytes if ent is not None else 0
             if ent is not None:
+                self._settle_entry_locked(ent, time.monotonic())
                 self._resident -= ent.nbytes
                 if ent.pins > 0:
                     self._pinned = max(0, self._pinned - ent.nbytes)
                 self.drops += 1
                 DEVICE_FEED_EVICTION_COUNTER.labels(reason).inc()
+        self._flush_residency()
         self._publish()
         return freed
 
@@ -696,6 +789,9 @@ class FeedArena:
         Stale pin tokens no-op at unpin (entry gone).  → bytes freed."""
         from ..utils.metrics import DEVICE_FEED_EVICTION_COUNTER
         with self._mu:
+            now = time.monotonic()
+            for ent in self._entries.values():
+                self._settle_entry_locked(ent, now)
             freed = self._resident
             n = len(self._entries)
             self._entries.clear()
@@ -704,6 +800,7 @@ class FeedArena:
             self.drops += n
             if n:
                 DEVICE_FEED_EVICTION_COUNTER.labels(reason).inc(n)
+        self._flush_residency()
         self._publish()
         return freed
 

@@ -197,6 +197,18 @@ class MockPd:
     def store_heartbeat(self, store_id: int, stats: dict) -> None:
         self.store_stats[store_id] = stats
 
+    def hot_regions(self, topk: int = 8) -> dict:
+        """Cluster-wide hot-region / hot-tenant RU view, merged from
+        the resource-metering reports riding store heartbeats
+        (scheduler.merge_hot_reports) — the load signal the
+        enforcement layer and the SlicePlacer consume, and what the
+        reference PD's hot-region scheduler reads."""
+        from .scheduler import merge_hot_reports
+        with self._lock:
+            stats = dict(self.store_stats)
+        return {"regions": merge_hot_reports(stats, "region", topk),
+                "tenants": merge_hot_reports(stats, "tag", topk)}
+
     def set_gc_safe_point(self, ts: int) -> None:
         self._safe_point = ts
 

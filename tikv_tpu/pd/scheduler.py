@@ -107,6 +107,40 @@ def drain_receivers(scores: Sequence[float],
     return [order[j % len(order)] for j in range(k)]
 
 
+def merge_hot_reports(stats_by_store: Mapping[int, dict],
+                      key: str, topk: int = 8) -> list[dict]:
+    """Merge the per-store resource-metering reports riding store
+    heartbeats into one cluster-wide top-k list.
+
+    ``key`` is ``"region"`` or ``"tag"`` (hot regions vs hot tenants).
+    Entries are the recorder's window summaries ({key, ru, launch_ms,
+    ...}); the same region/tag reported by several stores sums its RU
+    and keeps the per-store attribution under ``stores``.  PURE — unit
+    tests pin the fold, and the SlicePlacer can call it on any report
+    map without a PD handle (hot-region RU as a placement load
+    signal)."""
+    merged: dict = {}
+    for store_id, stats in stats_by_store.items():
+        rep = (stats or {}).get("resource_metering") or {}
+        top = rep.get("top_regions" if key == "region"
+                      else "top_tenants") or ()
+        for ent in top:
+            k = ent.get(key)
+            if k is None:
+                continue
+            cur = merged.get(k)
+            if cur is None:
+                cur = merged[k] = {key: k, "ru": 0.0, "stores": {}}
+            ru = float(ent.get("ru", 0.0))
+            cur["ru"] = round(cur["ru"] + ru, 4)
+            # str keys: the report rides the PD wire and msgpack's
+            # strict_map_key rejects int-keyed maps client-side (the
+            # CheckLeader lesson)
+            cur["stores"][str(store_id)] = ent
+    out = sorted(merged.values(), key=lambda e: -e["ru"])
+    return out[:max(1, topk)]
+
+
 def slice_scores(occupancy: Mapping[int, float],
                  load: Mapping[int, float], n_slices: int,
                  occupancy_weight: float = 1.0,

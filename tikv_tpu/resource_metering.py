@@ -1,15 +1,61 @@
-"""Per-request CPU / keys attribution by resource tag.
+"""Device-aware resource metering: per-tenant / per-region RU
+attribution of the resources this system is actually short on.
 
 Reference: components/resource_metering/ — a ``ResourceTagFactory``
-stamps every request with its resource-group / request-source tag, thread
-``SubRecorder``s sample per-tag CPU (recorder/sub_recorder/cpu.rs) and
-logical work (summary.rs: read keys), and a reporter aggregates windows,
-keeping the top-N consumers and folding the rest into an ``others``
-bucket before publishing (reporter/, pubsub.rs).
+stamps every request with its (resource_group, request_source) tag,
+thread ``SubRecorder``s sample per-tag costs, and a reporter aggregates
+windows, keeping the top-N consumers and folding the rest into an
+``other`` bucket before publishing to PD (reporter/, pubsub.rs).  The
+reference meters CPU and read keys; here CPU is nearly free and the
+binding constraints (Jouppi ISCA 2017, PAPERS.md) are device launch
+wall, the D2H link, HBM residency and host service time — so those are
+the metered axes, each charged from a MEASURED cost at a registered
+charge site (:data:`~tikv_tpu.ru_model.CHARGE_SITES`) and priced into
+request units by :mod:`tikv_tpu.ru_model`.
 
-Here the tag rides a contextvar (the Python analog of the reference's
-thread-local tag cell), CPU comes from ``time.thread_time`` deltas
-around the attached scope, and subscribers get per-window reports.
+Model:
+
+- a :class:`MeterContext` (tag, region, group members) rides a
+  ``contextvars.ContextVar`` AND is stamped onto the request's trace
+  :class:`~tikv_tpu.utils.trace.Tracker`, so attribution survives the
+  same thread handoffs the PR 11 ``adopt()`` machinery carries spans
+  across (gRPC thread → read pool → coalescer dispatcher →
+  completion-pool D2H worker) — a charge lands on the request that
+  caused the work no matter which thread measures it, exactly once;
+- a coalesced group's shared launch charges through a GROUP context
+  (``group_scope``): the measured wall splits by occupancy share
+  across every member's tag — never dumped on the leader — and a group
+  that fails before launching charges nothing, so the members' solo
+  retries are the only launches billed (exactly-once under failover);
+- :class:`FeedArena <tikv_tpu.device.supervisor.FeedArena>` residency
+  charges bytes-resident-seconds per anchor to the tag that owns the
+  feed (last tagged toucher), settled by pin-time sampling plus a
+  window-roll sweep (``register_residency_source``);
+- charges with no resolvable tag go to the explicit ``untagged``
+  entry — the attribution residual is REPORTED, never silently
+  dropped — and ``attribution_coverage`` is the ≥95% acceptance
+  figure;
+- the per-tag map is BOUNDED: beyond ``max_resource_groups`` live tags
+  new tags aggregate into ``other`` (reference reporter behavior),
+  idle tags fold into ``other`` on window roll, and a tag-count gauge
+  watches the bound;
+- windows roll every ``resource_metering.window_s``; the last window's
+  top-k hot-tenant/hot-region report serves the rebuilt
+  ``/resource_metering`` status route and rides the store heartbeat to
+  PD (``maybe_report``), where ``MockPd.hot_regions`` merges it
+  cluster-wide (the load signal the SlicePlacer and the enforcement PR
+  consume).
+
+Every knob (window_s, topk, max_resource_groups, report_interval_s,
+RU weights) is online-updatable through ``[resource-metering]`` in
+config.py and visible in ``/health``.
+
+Scope note: ``GLOBAL_RECORDER`` is PROCESS-global (the charge sites —
+runner dispatch, arena, read pool — have no node handle), matching the
+one-store-per-process production shape.  In-process multi-node rigs
+(tests) share one recorder: charges from every node mix into one
+window and the paced PD report rides whichever node's heartbeat fires
+first, so per-STORE attribution in a shared process is approximate.
 """
 
 from __future__ import annotations
@@ -17,24 +63,152 @@ from __future__ import annotations
 import contextvars
 import threading
 import time
-from dataclasses import dataclass, field
+import weakref
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Optional
 
-_CURRENT_TAG: contextvars.ContextVar = contextvars.ContextVar(
-    "resource_tag", default=None)
+from .ru_model import CHARGE_SITES, GLOBAL_MODEL  # noqa: F401 — re-export
+
+# explicit attribution residual + bounded-map fold target
+UNTAGGED = "untagged"
+OTHER_TAG = "other"
+
+# windows a tag may sit idle in the cumulative map before folding into
+# OTHER_TAG (satellite: rotating request_source strings must not grow
+# the map without bound)
+IDLE_WINDOWS = 8
+
+
+class MeterContext:
+    """The ambient attribution target: one (tag, region) — or, for a
+    coalesced group dispatch, the member list a shared charge splits
+    across as ``(tag, region, tracker)`` triples."""
+
+    __slots__ = ("tag", "region", "members")
+
+    def __init__(self, tag: Optional[str], region=None, members=None):
+        self.tag = tag
+        self.region = region
+        self.members = members
+
+
+_CURRENT_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "resource_meter_ctx", default=None)
+
+
+def current_context() -> Optional[MeterContext]:
+    """The active meter context: the contextvar when a scope is open on
+    this thread, else the one stamped on the active trace Tracker —
+    which is how attribution survives ``adopt()`` thread handoffs."""
+    ctx = _CURRENT_CTX.get()
+    if ctx is not None:
+        return ctx
+    from .utils import trace as _trace
+    tr = _trace.current()
+    if tr is not None:
+        return getattr(tr, "meter_ctx", None)
+    return None
+
+
+@contextmanager
+def activate(ctx: Optional[MeterContext]):
+    """Re-activate a CAPTURED context (DeferredResult/_GroupPending
+    snapshot their dispatch-time context so the fetch-side charges —
+    D2H bytes — attribute to the dispatching request/group no matter
+    which completion worker runs them)."""
+    if ctx is None:
+        yield
+        return
+    tok = _CURRENT_CTX.set(ctx)
+    try:
+        yield
+    finally:
+        _CURRENT_CTX.reset(tok)
+
+
+def region_of(storage) -> Optional[int]:
+    """The region a storage's device feed anchors to (FeedLineage
+    region_hint), or None for anonymous/test snapshots."""
+    lineage = getattr(storage, "feed_lineage", None)
+    if lineage is not None:
+        return getattr(lineage, "region_hint", None)
+    return getattr(storage, "region_hint", None)
+
+
+def set_region(region) -> None:
+    """Refine the ACTIVE context's region in place (the endpoint
+    learns the region only once the snapshot resolves, after the tag
+    scope opened) — the ctx object is shared with the tracker stamp,
+    so the refinement survives thread handoffs too."""
+    if region is None:
+        return
+    ctx = current_context()
+    if ctx is not None:
+        ctx.region = region
+
+
+def bind_request(resource_group: Optional[str],
+                 request_source: str = "") -> None:
+    """Stamp the active trace Tracker with the request's meter context
+    and ``resource_group`` label — the service calls this at admission
+    so every downstream charge site (and the slow-query log, and
+    /debug/trace/<id>) can answer "who paid for this"."""
+    from .utils import trace as _trace
+    tr = _trace.current()
+    if tr is None:
+        return
+    tag = ResourceTagFactory.tag(resource_group or "default",
+                                 request_source or "")
+    if getattr(tr, "meter_ctx", None) is None:
+        tr.meter_ctx = MeterContext(tag)
+    tr.label("resource_group", resource_group or "default")
 
 
 @dataclass
 class TagRecord:
+    """One tag's (or one region's) accumulated charges.  The first
+    four fields keep the historical CPU/keys shape; the device axes
+    and the priced RU total are the PR 13 extension."""
+
     cpu_secs: float = 0.0
     read_keys: int = 0
     write_keys: int = 0
     requests: int = 0
+    launch_s: float = 0.0
+    d2h_bytes: float = 0.0
+    byte_seconds: float = 0.0
+    host_s: float = 0.0
+    ru: float = 0.0
 
     def merge(self, other: "TagRecord") -> None:
         self.cpu_secs += other.cpu_secs
         self.read_keys += other.read_keys
         self.write_keys += other.write_keys
         self.requests += other.requests
+        self.launch_s += other.launch_s
+        self.d2h_bytes += other.d2h_bytes
+        self.byte_seconds += other.byte_seconds
+        self.host_s += other.host_s
+        self.ru += other.ru
+
+    def copy(self) -> "TagRecord":
+        out = TagRecord()
+        out.merge(self)
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "ru": round(self.ru, 4),
+            "launch_ms": round(self.launch_s * 1e3, 3),
+            "d2h_mb": round(self.d2h_bytes / (1 << 20), 4),
+            "resident_mb_s": round(self.byte_seconds / (1 << 20), 4),
+            "host_ms": round(self.host_s * 1e3, 3),
+            "cpu_ms": round(self.cpu_secs * 1e3, 3),
+            "read_keys": self.read_keys,
+            "write_keys": self.write_keys,
+            "requests": self.requests,
+        }
 
 
 class ResourceTagFactory:
@@ -46,59 +220,172 @@ class ResourceTagFactory:
             source: str = "") -> str:
         return f"{resource_group}|{source}" if source else resource_group
 
+    @staticmethod
+    def tenant(tag: Optional[str]) -> str:
+        """The resource_group half of a tag (metric label / PD fold)."""
+        if not tag:
+            return UNTAGGED
+        return tag.split("|", 1)[0]
+
 
 class Recorder:
-    """Accumulates per-tag records; ``attach`` scopes attribution."""
+    """Windowed per-tag + per-region charge accumulation (module doc).
 
-    def __init__(self, max_tags: int = 100):
-        self._lock = threading.Lock()
-        self._records: dict[str, TagRecord] = {}
+    ``attach`` scopes attribution (the legacy CPU/keys API, kept
+    verbatim); ``charge`` lands one measured cost on the ambient — or
+    an explicit — (tag, region); ``roll_window``/``harvest`` close the
+    window; ``maybe_report`` paces the PD push.
+    """
+
+    # live-tag hard cap headroom over the report fold: the reporter
+    # keeps max_tags named, but insert-time folding at exactly that
+    # bound would mis-fold a burst that harvest() could still rank
+    HARD_CAP_FACTOR = 2
+    REGION_MAX = 512
+
+    def __init__(self, max_tags: int = 100, window_s: float = 5.0,
+                 topk: int = 8, report_interval_s: float = 5.0):
+        # RLock: charges can be reached from GC-triggered weakref
+        # callbacks (arena teardown) on whatever thread happens to be
+        # allocating — same-thread re-entry must never self-deadlock
+        # the lock every charge site in the process serializes on
+        self._lock = threading.RLock()
+        self._records: dict[str, TagRecord] = {}        # current window
+        self._regions: dict = {}                        # current window
+        self._totals: dict[str, TagRecord] = {}         # since start
+        self._region_totals: dict = {}
+        self._idle: dict[str, int] = {}     # consecutive idle windows
+        # incrementally-maintained set(_records) | set(_totals): the
+        # per-charge bound check must be O(1), not an O(tags) scan
+        # under the recorder lock on the launch/D2H hot paths
+        self._live: set = set()
         self._max_tags = max_tags
+        self.window_s = float(window_s)
+        self.topk = int(topk)
+        self.report_interval_s = float(report_interval_s)
+        self._window_t0 = time.monotonic()
+        self._last_push = 0.0
+        self._last_report: dict = {}
         self._subs: list = []
+        self._res_sources: "weakref.WeakSet" = weakref.WeakSet()
+        self.windows_rolled = 0
+        self.reports_built = 0
+        self.unknown_sites = 0
 
-    # -- attribution ----------------------------------------------------
+    # -- config -------------------------------------------------------
+
+    def configure(self, window_s: Optional[float] = None,
+                  topk: Optional[int] = None,
+                  max_resource_groups: Optional[int] = None,
+                  report_interval_s: Optional[float] = None) -> None:
+        with self._lock:
+            if window_s is not None:
+                self.window_s = max(0.05, float(window_s))
+            if topk is not None:
+                self.topk = max(1, int(topk))
+            if max_resource_groups is not None:
+                self._max_tags = max(1, int(max_resource_groups))
+            if report_interval_s is not None:
+                self.report_interval_s = max(0.0,
+                                             float(report_interval_s))
+
+    @property
+    def max_tags(self) -> int:
+        return self._max_tags
+
+    def _hard_cap(self) -> int:
+        return max(self.HARD_CAP_FACTOR * self._max_tags, 16)
+
+    # -- attribution scope (legacy API, context upgraded) -------------
 
     class _Scope:
-        def __init__(self, rec: "Recorder", tag: str, requests: int = 1):
+        def __init__(self, rec: "Recorder", tag: str, requests: int = 1,
+                     region=None):
             self._rec = rec
-            self._tag = tag
+            self._ctx = MeterContext(tag, region)
             self._requests = requests
             self._token = None
             self._t0 = 0.0
 
         def __enter__(self):
-            self._token = _CURRENT_TAG.set(self._tag)
+            self._token = _CURRENT_CTX.set(self._ctx)
+            # stamp the trace so the context survives adopt() handoffs;
+            # a later scope carrying a region refines an earlier
+            # region-less stamp of the SAME tag (the endpoint attaches
+            # before the snapshot resolves the region)
+            from .utils import trace as _trace
+            tr = _trace.current()
+            if tr is not None:
+                cur = getattr(tr, "meter_ctx", None)
+                if cur is None or (self._ctx.region is not None and
+                                   cur.tag == self._ctx.tag):
+                    tr.meter_ctx = self._ctx
             self._t0 = time.thread_time()
             return self
 
         def __exit__(self, *exc):
             dt = time.thread_time() - self._t0
-            _CURRENT_TAG.reset(self._token)
-            self._rec.record(self._tag, cpu_secs=dt,
-                             requests=self._requests)
+            _CURRENT_CTX.reset(self._token)
+            self._rec.record(self._ctx.tag, cpu_secs=dt,
+                             requests=self._requests,
+                             region=self._ctx.region)
             return False
 
-    def attach(self, tag: str, requests: int = 1) -> "_Scope":
+    def attach(self, tag: str, requests: int = 1,
+               region=None) -> "_Scope":
         """Scope attribution to ``tag``.  ``requests=0``: a follow-up
         scope of an already-counted request (the async coprocessor path
         attaches once per stage — dispatch, deferred fetch, completion —
         but the request must count once)."""
-        return Recorder._Scope(self, tag, requests)
+        return Recorder._Scope(self, tag, requests, region)
 
     @staticmethod
     def current_tag():
-        return _CURRENT_TAG.get()
+        ctx = current_context()
+        return ctx.tag if ctx is not None else None
+
+    @contextmanager
+    def group_scope(self, members):
+        """Attribution context for a coalesced group's SHARED work:
+        ``members`` is a sequence of ``(tag, region, tracker)`` triples
+        — launch/D2H charges made under this scope split by occupancy
+        share across every member instead of landing on the leader."""
+        members = tuple(members)
+        lead = members[0] if members else (None, None, None)
+        ctx = MeterContext(lead[0], lead[1], members)
+        tok = _CURRENT_CTX.set(ctx)
+        try:
+            yield ctx
+        finally:
+            _CURRENT_CTX.reset(tok)
+
+    # -- charging -----------------------------------------------------
 
     def record(self, tag=None, cpu_secs: float = 0.0,
                read_keys: int = 0, write_keys: int = 0,
-               requests: int = 0) -> None:
-        tag = tag if tag is not None else (_CURRENT_TAG.get() or "default")
-        with self._lock:
-            rec = self._records.get(tag)
-            if rec is None:
-                rec = self._records[tag] = TagRecord()
-            rec.merge(TagRecord(cpu_secs, read_keys, write_keys,
-                                requests))
+               requests: int = 0, region=None) -> None:
+        """Legacy CPU/keys accumulation — now also priced into RU
+        (read_keys + request base cost) and mirrored per region.
+        Scanned keys land on the ``copr::scan`` site; the request base
+        cost and CPU/write-key legacy axes land on ``copr::request``
+        so the scanned-keys metric series stays pure."""
+        if tag is None or region is None:
+            ctx = current_context()
+            if ctx is not None:
+                tag = tag if tag is not None else ctx.tag
+                region = region if region is not None else ctx.region
+        from .utils import trace as _trace
+        tracker = _trace.current()
+        if read_keys:
+            ru = GLOBAL_MODEL.ru(read_keys=read_keys)
+            self._land("copr::scan", tag, region,
+                       TagRecord(0.0, read_keys, 0, 0, ru=ru), ru,
+                       tracker)
+        if requests or cpu_secs or write_keys:
+            ru = GLOBAL_MODEL.ru(requests=requests)
+            self._land("copr::request", tag, region,
+                       TagRecord(cpu_secs, 0, write_keys, requests,
+                                 ru=ru), ru, tracker)
 
     def record_read_keys(self, n: int) -> None:
         self.record(read_keys=n)
@@ -106,35 +393,414 @@ class Recorder:
     def record_write_keys(self, n: int) -> None:
         self.record(write_keys=n)
 
-    # -- reporting ------------------------------------------------------
+    def charge(self, site: str, *, launch_s: float = 0.0,
+               d2h_bytes: float = 0.0, byte_seconds: float = 0.0,
+               host_s: float = 0.0, read_keys: int = 0,
+               requests: int = 0, tag=None, region=None,
+               split: bool = False) -> float:
+        """Land one MEASURED cost on the ambient (or explicit) target;
+        → RU charged.  ``split=True`` under a :meth:`group_scope`
+        divides every quantity by the member count and charges each
+        member — the shared-launch occupancy split.  Unknown sites are
+        counted, never raised (the charge runs in dispatch ``finally``
+        blocks; the vocabulary CI scan is the enforcement)."""
+        if site not in CHARGE_SITES:
+            with self._lock:
+                self.unknown_sites += 1
+        explicit = tag is not None
+        ctx = None if explicit else current_context()
+        members = ctx.members if (split and ctx is not None and
+                                  ctx.members) else None
+        if members:
+            # requests are deliberately NOT split: the request count
+            # is attributed once per member at attach time (a shared
+            # launch is one launch, not one request per member) —
+            # a split charge carrying requests would multiply the
+            # per-request base RU by the occupancy
+            n = len(members)
+            total = 0.0
+            for i, (m_tag, m_region, m_tr) in enumerate(members):
+                keys = read_keys // n + (1 if i < read_keys % n else 0)
+                total += self._charge_one(
+                    site, m_tag, m_region, m_tr,
+                    launch_s / n, d2h_bytes / n, byte_seconds / n,
+                    host_s / n, keys, 0)
+            return total
+        tr = None
+        if not explicit:
+            # per-request RU accumulation rides the AMBIENT trace only
+            # when the attribution did too — an explicit-tag charge
+            # (arena residency flushed on someone else's thread) must
+            # never bill an unrelated request's trace
+            from .utils import trace as _trace
+            tr = _trace.current()
+            if ctx is not None:
+                tag = ctx.tag
+                if region is None:
+                    region = ctx.region
+        return self._charge_one(site, tag, region, tr, launch_s,
+                                d2h_bytes, byte_seconds, host_s,
+                                read_keys, requests)
+
+    def _charge_one(self, site, tag, region, tracker, launch_s,
+                    d2h_bytes, byte_seconds, host_s, read_keys,
+                    requests) -> float:
+        ru = GLOBAL_MODEL.ru(launch_s=launch_s, d2h_bytes=d2h_bytes,
+                             byte_seconds=byte_seconds, host_s=host_s,
+                             read_keys=read_keys, requests=requests)
+        add = TagRecord(0.0, read_keys, 0, requests, launch_s,
+                        d2h_bytes, byte_seconds, host_s, ru)
+        self._land(site, tag, region, add, ru, tracker)
+        return ru
+
+    def _land(self, site, tag, region, add: TagRecord, ru: float,
+              tracker) -> None:
+        from .utils.metrics import RU_CHARGE_COUNTER, RU_TENANT_COUNTER
+        with self._lock:
+            tag = self._fold_tag_locked(tag)
+            self._live.add(tag)
+            rec = self._records.get(tag)
+            if rec is None:
+                rec = self._records[tag] = TagRecord()
+            rec.merge(add)
+            if region is not None:
+                if region not in self._regions and \
+                        len(self._regions) >= self.REGION_MAX:
+                    region = "other"
+                reg = self._regions.get(region)
+                if reg is None:
+                    reg = self._regions[region] = TagRecord()
+                reg.merge(add)
+        if ru:
+            RU_CHARGE_COUNTER.labels(site).inc(ru)
+            RU_TENANT_COUNTER.labels(
+                ResourceTagFactory.tenant(tag)).inc(ru)
+            if tracker is not None:
+                add_ru = getattr(tracker, "add_ru", None)
+                if add_ru is not None:
+                    add_ru(ru)
+
+    def _fold_tag_locked(self, tag) -> str:
+        """Bound the live-tag set: a NEW tag arriving with the map at
+        the hard cap aggregates into ``other`` (reference reporter
+        behavior) — rotating request_source strings cannot grow the
+        map without bound.  O(1): the live set is maintained
+        incrementally, never recounted on the charge path."""
+        if tag is None:
+            return UNTAGGED
+        if tag in self._live:
+            return tag
+        if len(self._live) >= self._hard_cap():
+            return OTHER_TAG
+        return tag
+
+    # -- residency sources --------------------------------------------
+
+    def register_residency_source(self, source) -> None:
+        """``source.settle_residency(recorder)`` runs on every window
+        roll (weakly held — arenas die with their runners); the
+        FeedArena registers itself so bytes-resident-seconds are
+        settled at least once per window even with zero pin traffic.
+        Add and snapshot both run under the recorder lock: a degraded-
+        submesh rebuild minting an arena mid-roll must not race the
+        WeakSet iteration."""
+        with self._lock:
+            self._res_sources.add(source)
+
+    def _settle_sources(self) -> None:
+        with self._lock:
+            sources = list(self._res_sources)
+        for src in sources:
+            try:
+                src.settle_residency(self)
+            except Exception:   # noqa: BLE001 — metering must not
+                pass            # poison the roll
+
+    # -- windows / reporting ------------------------------------------
 
     def subscribe(self, callback) -> None:
-        """callback(report: dict[tag, TagRecord]) per harvest — the
-        pubsub seam (reference pubsub.rs datasinks)."""
+        """callback(report: dict[tag, TagRecord]) per window close —
+        the pubsub seam (reference pubsub.rs datasinks)."""
         self._subs.append(callback)
 
     def harvest(self) -> dict:
-        """Drain the window: top max_tags by CPU stay named, the tail
-        folds into ``others`` (reference reporter keeps
-        max_resource_groups and aggregates the rest)."""
+        """Close the window NOW and return its per-tag records: top
+        ``max_tags`` by (RU, CPU) stay named, the tail folds into
+        ``other`` (reference reporter behavior).  The drained window
+        also merges into the cumulative totals and refreshes the
+        top-k report."""
+        return self.roll_window(force=True)["_window_records"]
+
+    def roll_window(self, force: bool = False) -> Optional[dict]:
+        """Close the current window if due (or ``force``): settle
+        residency, merge into totals, evict idle tags, build the top-k
+        hot-tenant/hot-region report.  → the report, or None when the
+        window has not elapsed."""
         with self._lock:
-            records = self._records
+            if not force and \
+                    time.monotonic() - self._window_t0 < self.window_s:
+                return None
+        self._settle_sources()
+        now = time.monotonic()
+        with self._lock:
+            elapsed = now - self._window_t0
+            if not force and elapsed < self.window_s:
+                # another roller won the race while we settled: bail
+                # instead of draining the near-empty gap window and
+                # overwriting its report
+                return None
+            window = self._records
+            regions = self._regions
             self._records = {}
-        if len(records) > self._max_tags:
-            ranked = sorted(records.items(),
-                            key=lambda kv: -kv[1].cpu_secs)
-            kept = dict(ranked[:self._max_tags])
-            others = TagRecord()
-            for _tag, rec in ranked[self._max_tags:]:
-                others.merge(rec)
-            kept["others"] = others
-            records = kept
+            self._regions = {}
+            self._window_t0 = now
+            self.windows_rolled += 1
+            # merge into cumulative totals + idle accounting
+            for tag, rec in window.items():
+                tot = self._totals.get(tag)
+                if tot is None:
+                    tot = self._totals[tag] = TagRecord()
+                tot.merge(rec)
+                self._idle[tag] = 0
+            for tag in list(self._totals):
+                if tag in window or tag in (OTHER_TAG, UNTAGGED):
+                    continue
+                self._idle[tag] = self._idle.get(tag, 0) + 1
+                if self._idle[tag] >= IDLE_WINDOWS:
+                    # fold the idle tag's history into "other": the
+                    # map stays bounded under rotating sources while
+                    # the totals stay sum-exact
+                    other = self._totals.get(OTHER_TAG)
+                    if other is None:
+                        other = self._totals[OTHER_TAG] = TagRecord()
+                    other.merge(self._totals.pop(tag))
+                    self._idle.pop(tag, None)
+                    self._live.discard(tag)
+                    self._live.add(OTHER_TAG)
+            for region, rec in regions.items():
+                tot = self._region_totals.get(region)
+                if tot is None:
+                    if len(self._region_totals) >= self.REGION_MAX:
+                        region = "other"
+                        tot = self._region_totals.get(region)
+                    if tot is None:
+                        tot = self._region_totals[region] = TagRecord()
+                tot.merge(rec)
+            report = self._build_report_locked(window, regions,
+                                               elapsed)
+            self._last_report = report
+            folded = self._legacy_fold(window)
+            report["_window_records"] = folded
+            self.reports_built += 1
+        self._publish_gauge()
         for cb in list(self._subs):
-            cb(records)
-        return records
+            cb(folded)
+        return report
+
+    def _legacy_fold(self, window: dict) -> dict:
+        """harvest()'s wire shape: top ``max_tags`` named, tail folded
+        into ``other`` (ranked by RU, then CPU — the legacy CPU-only
+        ranking preserved for un-priced records)."""
+        if len(window) <= self._max_tags:
+            return window
+        ranked = sorted(window.items(),
+                        key=lambda kv: (-kv[1].ru, -kv[1].cpu_secs))
+        kept = dict(ranked[:self._max_tags])
+        other = kept.pop(OTHER_TAG, None) or TagRecord()
+        for _tag, rec in ranked[self._max_tags:]:
+            other.merge(rec)
+        kept[OTHER_TAG] = other
+        return kept
+
+    def _build_report_locked(self, window: dict, regions: dict,
+                             elapsed: float) -> dict:
+        def top(records: dict, key_name: str) -> list:
+            ranked = sorted(
+                ((k, r) for k, r in records.items() if k != UNTAGGED),
+                key=lambda kv: -kv[1].ru)
+            return [{key_name: k, **r.summary()}
+                    for k, r in ranked[:self.topk]]
+
+        untag = window.get(UNTAGGED)
+        return {
+            "ts": round(time.time(), 3),
+            "window_s": round(elapsed, 3),
+            "top_tenants": top(window, "tag"),
+            "top_regions": top(regions, "region"),
+            # the attribution residual, always an EXPLICIT entry
+            "untagged": untag.summary() if untag is not None else None,
+            "total_ru": round(sum(r.ru for r in window.values()), 4),
+            "tags": len(window),
+        }
+
+    def maybe_report(self) -> Optional[dict]:
+        """Heartbeat-path pacing: roll the window when due; → the
+        latest report when ``report_interval_s`` has elapsed since the
+        last push (the store heartbeat attaches it for PD), else
+        None."""
+        self.roll_window()
+        now = time.monotonic()
+        with self._lock:
+            if not self._last_report:
+                return None
+            if now - self._last_push < self.report_interval_s:
+                return None
+            self._last_push = now
+            return {k: v for k, v in self._last_report.items()
+                    if not k.startswith("_")}
+
+    def report(self) -> dict:
+        """The last rolled window's top-k report (status route)."""
+        with self._lock:
+            return {k: v for k, v in self._last_report.items()
+                    if not k.startswith("_")}
+
+    # -- snapshots / coverage -----------------------------------------
+
+    def totals(self, include_window: bool = True) -> dict:
+        """Cumulative per-tag records (deep copies).  The live window
+        is folded in by default so deltas taken mid-window are exact."""
+        with self._lock:
+            out = {t: r.copy() for t, r in self._totals.items()}
+            if include_window:
+                for t, r in self._records.items():
+                    tot = out.get(t)
+                    if tot is None:
+                        tot = out[t] = TagRecord()
+                    tot.merge(r)
+            return out
+
+    def region_totals(self, include_window: bool = True) -> dict:
+        with self._lock:
+            out = {k: r.copy() for k, r in self._region_totals.items()}
+            if include_window:
+                for k, r in self._regions.items():
+                    tot = out.get(k)
+                    if tot is None:
+                        tot = out[k] = TagRecord()
+                    tot.merge(r)
+            return out
+
+    def attribution_coverage(self, base: Optional[dict] = None,
+                             totals: Optional[dict] = None) -> float:
+        """Fraction of measured device launch wall + arena
+        bytes-resident-seconds attributed to a NAMED tag (``other``
+        counts — it is attributed, just folded; ``untagged`` is the
+        residual).  RU-weighted so the two axes compose; ``base`` is a
+        prior :meth:`totals` snapshot to diff against (bench phases),
+        ``totals`` an already-taken snapshot (status surfaces avoid a
+        second deep copy under the recorder lock)."""
+        return coverage_from(totals if totals is not None
+                             else self.totals(), base)
+
+    # -- observability ------------------------------------------------
+
+    def _publish_gauge(self) -> None:
+        from .utils.metrics import RU_TAG_GAUGE
+        with self._lock:
+            n = len(self._live)
+        RU_TAG_GAUGE.set(n)
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = self._live
+            untag = self._totals.get(UNTAGGED, TagRecord()).copy()
+            uw = self._records.get(UNTAGGED)
+            if uw is not None:
+                untag.merge(uw)
+            return {
+                "window_s": self.window_s,
+                "topk": self.topk,
+                "max_resource_groups": self._max_tags,
+                "report_interval_s": self.report_interval_s,
+                "tags": len(live),
+                "windows_rolled": self.windows_rolled,
+                "unknown_sites": self.unknown_sites,
+                "untagged_ru": round(untag.ru, 4),
+            }
+
+    def health_stats(self) -> dict:
+        out = self.stats()
+        out["model"] = GLOBAL_MODEL.describe()
+        out["last_report"] = self.report()
+        out["coverage"] = round(self.attribution_coverage(), 4)
+        return out
+
+
+def coverage_from(totals: dict, base: Optional[dict] = None) -> float:
+    """RU-weighted launch+residency attribution coverage over a totals
+    snapshot (optionally diffed against ``base``)."""
+    w = GLOBAL_MODEL.weights()
+
+    def axes(rec: TagRecord) -> float:
+        return (w["ru_per_launch_s"] * rec.launch_s +
+                w["ru_per_mb_s"] * rec.byte_seconds / (1 << 20))
+
+    tagged = untagged = 0.0
+    for tag, rec in totals.items():
+        v = axes(rec)
+        if base is not None and tag in base:
+            v -= axes(base[tag])
+        if tag == UNTAGGED:
+            untagged += v
+        else:
+            tagged += v
+    if base is not None:
+        # a base tag absent from totals idle-folded into "other"
+        # between the snapshots — its pre-base mass now sits in the
+        # tagged pool and must come back out, or the delta coverage
+        # is inflated by history that predates the base
+        for tag, rec in base.items():
+            if tag in totals:
+                continue
+            v = axes(rec)
+            if tag == UNTAGGED:
+                untagged -= v
+            else:
+                tagged -= v
+    total = tagged + untagged
+    if total <= 0:
+        return 1.0
+    return tagged / total
 
 
 GLOBAL_RECORDER = Recorder()
+
+
+# ------------------------------------------------- runner charge seams
+#
+# The device runner calls these from its dispatch/fetch hot paths; the
+# site resolution (solo vs group-split) lives HERE so every launch
+# site stays one line and the charge-site literals stay scannable.
+
+
+def charge_launch(wall_s: float) -> None:
+    """One measured kernel-launch wall from ``_dispatch_phase``: a
+    SHARED group launch (occupancy > 1) splits by occupancy share
+    across member tags under the group site; a singleton group (the
+    coalescer's idle bypass) and a plain solo dispatch bill the single
+    tag as an ordinary launch."""
+    ctx = current_context()
+    if ctx is not None and ctx.members:
+        if len(ctx.members) > 1:
+            GLOBAL_RECORDER.charge("copr::coalesce_dispatch",
+                                   launch_s=wall_s, split=True)
+        else:
+            GLOBAL_RECORDER.charge("device::launch", launch_s=wall_s,
+                                   split=True)
+    else:
+        GLOBAL_RECORDER.charge("device::launch", launch_s=wall_s)
+
+
+def charge_d2h(nbytes: int) -> None:
+    """Measured D2H payload bytes from ``_readback`` (one charge per
+    physical transfer; a group's shared fetch splits across members)."""
+    if nbytes <= 0:
+        return
+    ctx = current_context()
+    GLOBAL_RECORDER.charge("device::d2h", d2h_bytes=float(nbytes),
+                           split=ctx is not None and
+                           bool(ctx.members))
 
 
 def scanned_rows(result) -> int:

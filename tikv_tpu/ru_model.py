@@ -1,0 +1,150 @@
+"""The RU cost model: measured resource charges → one request-unit figure.
+
+Reference: TiDB/TiKV resource_control prices heterogeneous work in
+Request Units (the resource_group RU config: ~1 RU per 3 ms of CPU or
+per 64 KiB read) so one budget can govern CPU-bound point reads and
+IO-bound scans together.  This deployment's scarce resources are not
+CPU (Jouppi et al., PAPERS.md): they are device launch wall, the D2H
+link, HBM residency, and host service time under a read-pool slot — so
+the model prices exactly those axes, each charged from a MEASURED cost
+at its charge site (see :data:`CHARGE_SITES`), never from a static
+request estimate.
+
+The default weights (all online-updatable through
+``[resource-metering]`` in config.py):
+
+====================  =====================  ===========================
+axis                  weight (default)       rationale
+====================  =====================  ===========================
+device launch wall    333⅓ RU/s              1 RU ≈ 3 ms of chip time —
+                                             device seconds priced like
+                                             the reference prices CPU
+host service wall     333⅓ RU/s              same price: a read-pool
+                                             slot is the host's chip
+D2H transfer          16 RU/MB               1 RU ≈ 64 KiB over the
+                                             narrow link (the reference
+                                             read-byte price applied to
+                                             the transfer that is this
+                                             system's IO)
+HBM residency         0.05 RU/(MB·s)         capacity rent: a feed
+                                             parked for 20 s pays ~1
+                                             RU/MB — background tenants
+                                             pay for squatting
+read keys             1/2048 RU/key          logical work floor (≈1 RU
+                                             per 64 KiB at ~32 B/row)
+requests              0.125 RU/req           per-request base cost
+                                             (admission, decode, seal)
+====================  =====================  ===========================
+
+The model is deliberately LINEAR and stateless: enforcement (the
+ROADMAP's fair-share-coalescing PR) needs charges that sum across
+window rolls, PD stores, and tenant folds without re-normalization.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# -------------------------------------------------------- charge sites
+#
+# Every RU charge in tikv_tpu/ names one of these sites as a LITERAL
+# first argument (``GLOBAL_RECORDER.charge("device::launch", ...)``).
+# tests/test_ru_metering.py scans the source tree both ways — an
+# unregistered or typo'd charge site fails tier-1, exactly like the
+# failpoint and span-vocabulary inventories.  Descriptions double as
+# the README's charge-site table.
+
+CHARGE_SITES: dict[str, str] = {
+    "device::launch": "solo kernel-launch wall, measured at the "
+                      "runner's _dispatch_phase (every launch site)",
+    "copr::coalesce_dispatch": "a coalesced group's SHARED launch "
+                               "wall, split by occupancy share across "
+                               "member tags — never dumped on the "
+                               "leader",
+    "device::d2h": "measured device→host transfer bytes at _readback "
+                   "(split across members for a group's shared fetch)",
+    "arena::residency": "HBM bytes-resident-seconds per feed anchor, "
+                        "charged to the anchor's owning tag by "
+                        "pin-time sampling + window-roll settlement",
+    "read_pool::host": "host service wall under a read-pool slot "
+                       "(keyed by the request's class_key EWMA "
+                       "identity)",
+    "copr::scan": "logical read keys scanned by a coprocessor "
+                  "request (summary.rs scanned-keys discipline)",
+    "copr::request": "per-request base cost (admission/decode/seal) "
+                     "plus the legacy CPU/write-key attribution — "
+                     "kept apart from copr::scan so the scanned-keys "
+                     "series stays pure",
+}
+
+
+class RuModel:
+    """Online-updatable linear RU pricing (module doc table)."""
+
+    DEFAULTS = {
+        "ru_per_launch_s": 1000.0 / 3.0,
+        "ru_per_host_s": 1000.0 / 3.0,
+        "ru_per_d2h_mb": 16.0,
+        "ru_per_mb_s": 0.05,
+        "ru_per_read_key": 1.0 / 2048.0,
+        "ru_per_request": 0.125,
+    }
+
+    def __init__(self, **weights):
+        self._mu = threading.Lock()
+        self._w = dict(self.DEFAULTS)
+        if weights:
+            self.set_weights(**weights)
+
+    def set_weights(self, **weights) -> dict:
+        """Update one or more weights; unknown names raise (the config
+        manager must not silently drop a typo'd knob).  → live dict."""
+        with self._mu:
+            for k, v in weights.items():
+                if v is None:
+                    continue
+                if k not in self._w:
+                    raise ValueError(f"unknown RU weight {k!r}")
+                if float(v) < 0:
+                    # negative prices would decrement the RU counters
+                    # and corrupt every total/report downstream
+                    raise ValueError(f"RU weight {k} must be >= 0")
+                self._w[k] = float(v)
+            return dict(self._w)
+
+    def weights(self) -> dict:
+        with self._mu:
+            return dict(self._w)
+
+    def ru(self, launch_s: float = 0.0, d2h_bytes: float = 0.0,
+           byte_seconds: float = 0.0, host_s: float = 0.0,
+           read_keys: float = 0.0, requests: float = 0.0) -> float:
+        """Price one charge (or one accumulated record) in RU."""
+        with self._mu:
+            w = self._w
+            return (w["ru_per_launch_s"] * launch_s +
+                    w["ru_per_host_s"] * host_s +
+                    w["ru_per_d2h_mb"] * (d2h_bytes / (1 << 20)) +
+                    w["ru_per_mb_s"] * (byte_seconds / (1 << 20)) +
+                    w["ru_per_read_key"] * read_keys +
+                    w["ru_per_request"] * requests)
+
+    def describe(self) -> dict:
+        """The documented cost-model table for /health and the README
+        (axis → weight), plus the unit conventions."""
+        w = self.weights()
+        return {
+            "unit": "RU",
+            "weights": w,
+            "axes": {
+                "launch_s": "device kernel-launch wall (seconds)",
+                "host_s": "host service wall under a read-pool slot",
+                "d2h_bytes": "device→host transfer payload",
+                "byte_seconds": "HBM bytes-resident-seconds",
+                "read_keys": "logical keys scanned",
+                "requests": "request count",
+            },
+        }
+
+
+GLOBAL_MODEL = RuModel()

@@ -585,25 +585,37 @@ class RequestCoalescer:
         lead_tok = tracker.adopt(
             lead_tr, parent=gsp if span_tr is lead_tr else None) \
             if lead_tr is not None else None
+        # RU metering: the group's shared launch + D2H charge through
+        # a GROUP context, splitting by occupancy share across member
+        # tags instead of landing on the leader.  The deferred handles
+        # capture this context at dispatch, so the shared fetch's
+        # D2H-bytes charge splits the same way from whichever
+        # completion worker joins first.
+        from ..resource_metering import GLOBAL_RECORDER, region_of
+        meter_members = tuple(
+            (m.tag, region_of(m.storage), m.tracker) for m in members)
         t0 = time.perf_counter()
         try:
-            if fail_point("copr::coalesce_dispatch") is not None:
-                raise _BatchUnavailable("copr::coalesce_dispatch")
-            if group.key[0] == "stack" and size > 1:
-                handle = self._runner.handle_batched(
-                    [(m.dag, m.storage) for m in members])
-                resolvers = [
-                    (lambda i=i, h=handle: h.member_result(i))
-                    for i in range(size)]
-            else:
-                # singleton / identical-plan share: one solo dispatch,
-                # its (memoized, thread-safe) fetch serves every member
-                d = self._runner.handle_request(
-                    members[0].dag, members[0].storage, deferred=True)
-                if isinstance(d, DeferredResult):
-                    resolvers = [d.result] * size
+            with GLOBAL_RECORDER.group_scope(meter_members):
+                if fail_point("copr::coalesce_dispatch") is not None:
+                    raise _BatchUnavailable("copr::coalesce_dispatch")
+                if group.key[0] == "stack" and size > 1:
+                    handle = self._runner.handle_batched(
+                        [(m.dag, m.storage) for m in members])
+                    resolvers = [
+                        (lambda i=i, h=handle: h.member_result(i))
+                        for i in range(size)]
                 else:
-                    resolvers = [(lambda r=d: r)] * size
+                    # singleton / identical-plan share: one solo
+                    # dispatch, its (memoized, thread-safe) fetch
+                    # serves every member
+                    d = self._runner.handle_request(
+                        members[0].dag, members[0].storage,
+                        deferred=True)
+                    if isinstance(d, DeferredResult):
+                        resolvers = [d.result] * size
+                    else:
+                        resolvers = [(lambda r=d: r)] * size
         except Exception:   # noqa: BLE001 — incl. _BatchUnavailable
             # the batched LAUNCH failed: a failed group must never fail
             # its members — each retries as a solo dispatch (and any
@@ -635,13 +647,24 @@ class RequestCoalescer:
 
     def _solo_fallback(self, members) -> None:
         from ..device.runner import DeferredResult
+        from ..resource_metering import GLOBAL_RECORDER, region_of
         with self._mu:
             self.solo_degrade += len(members)
         for m in members:
             t_ns = time.perf_counter_ns()
             try:
-                d = self._runner.handle_request(m.dag, m.storage,
-                                                deferred=True)
+                # the failed group charged nothing (no launch ran);
+                # each solo retry charges ITS member's tag — never the
+                # leader's, never double (exactly-once under failover)
+                if m.tag is not None:
+                    with GLOBAL_RECORDER.attach(
+                            m.tag, requests=0,
+                            region=region_of(m.storage)):
+                        d = self._runner.handle_request(
+                            m.dag, m.storage, deferred=True)
+                else:
+                    d = self._runner.handle_request(m.dag, m.storage,
+                                                    deferred=True)
             except Exception as e:      # noqa: BLE001
                 # surfaces at the member's wait(): the endpoint applies
                 # its degrade-to-host policy there, per member
@@ -658,7 +681,7 @@ class RequestCoalescer:
         """Hand the member's resolution (shared fetch join + its own
         host gather) to the completion pool; its result lands on the
         member's future for CopDeferred.wait()."""
-        from ..resource_metering import GLOBAL_RECORDER
+        from ..resource_metering import GLOBAL_RECORDER, region_of
         from ..utils import tracker
 
         def task():
@@ -675,7 +698,9 @@ class RequestCoalescer:
                 # the rest it IS the wait on the memo
                 with tracker.span("group_fetch_wait"):
                     if m.tag is not None:
-                        with GLOBAL_RECORDER.attach(m.tag, requests=0):
+                        with GLOBAL_RECORDER.attach(
+                                m.tag, requests=0,
+                                region=region_of(m.storage)):
                             return resolve()
                     return resolve()
             finally:

@@ -13,6 +13,7 @@ events the drive thread fires.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from collections import deque
@@ -546,8 +547,29 @@ class Node:
                 config.coprocessor.flight_recorder_depth > 0:
             device_runner.flight_recorder.set_depth(
                 config.coprocessor.flight_recorder_depth)
+        # device-aware resource metering (resource_metering.py): the
+        # process-global recorder adopts this node's knobs + RU
+        # weights; the store-heartbeat loop paces the windowed top-k
+        # hot-region/hot-tenant report to PD (maybe_report)
+        self._metering_cfg(
+            {f.name: getattr(config.resource_metering, f.name)
+             for f in dataclasses.fields(config.resource_metering)})
         # online reconfig (online_config ConfigManager registrations)
         self.config_controller.register("coprocessor", self._copr_cfg)
+        self.config_controller.register("resource_metering",
+                                        self._metering_cfg)
+
+    def _metering_cfg(self, diff: dict) -> None:
+        from ..resource_metering import GLOBAL_RECORDER
+        from ..ru_model import GLOBAL_MODEL
+        GLOBAL_RECORDER.configure(
+            window_s=diff.get("window_s"),
+            topk=diff.get("topk"),
+            max_resource_groups=diff.get("max_resource_groups"),
+            report_interval_s=diff.get("report_interval_s"))
+        GLOBAL_MODEL.set_weights(
+            **{k: v for k, v in diff.items()
+               if k.startswith("ru_per_")})
 
     def _copr_cfg(self, diff: dict) -> None:
         # tracing knobs: trace_sample / slow_log_threshold_ms are read
@@ -759,6 +781,14 @@ class Node:
                             self._exec_operator(region.id, op)
                     hb = {"region_count": len(leaders)}
                     hb.update(self.health.stats())
+                    # windowed top-k hot-region/hot-tenant RU report
+                    # rides the store heartbeat to PD (the reference
+                    # resource_metering reporter's PD push), paced by
+                    # resource_metering.report_interval_s
+                    from ..resource_metering import GLOBAL_RECORDER
+                    rep = GLOBAL_RECORDER.maybe_report()
+                    if rep is not None:
+                        hb["resource_metering"] = rep
                     self._refresh_feature_gate()
                     self._gc_manager_tick()
                     self.pd.store_heartbeat(self.store_id, hb)

@@ -82,6 +82,11 @@ class PdService:
         self.pd.store_heartbeat(req["store_id"], req.get("stats", {}))
         return {}
 
+    def HotRegions(self, req: dict) -> dict:
+        """Cluster-wide hot-region/hot-tenant RU view merged from the
+        resource-metering reports on store heartbeats."""
+        return self.pd.hot_regions(req.get("topk", 8))
+
     def GetGcSafePoint(self, req: dict) -> dict:
         return {"safe_point": self.pd.get_gc_safe_point()}
 
@@ -183,6 +188,9 @@ class RemotePdClient:
 
     def store_heartbeat(self, store_id: int, stats: dict) -> None:
         self._call("StoreHeartbeat", {"store_id": store_id, "stats": stats})
+
+    def hot_regions(self, topk: int = 8) -> dict:
+        return self._call("HotRegions", {"topk": topk})
 
     def get_gc_safe_point(self) -> int:
         return self._call("GetGcSafePoint", {})["safe_point"]

@@ -165,6 +165,25 @@ class ReadPool:
                     return fn()
                 finally:
                     dt = time.perf_counter() - t0
+                    # RU metering: host service wall under this slot,
+                    # charged to the request's tag/region (the context
+                    # the service stamped on the trace at admission —
+                    # the same class_key identity that keys the EWMA
+                    # below keys the enforcement PR's per-class cost
+                    # model).  Deferred device fetches are NOT in this
+                    # figure: the slot covers only the dispatch, and
+                    # the device axes charge at their own sites.
+                    # This prices SLOT OCCUPANCY, deliberately: a solo
+                    # device request's dispatch enqueue runs under the
+                    # slot and is billed here ON TOP of its
+                    # device::launch charge (it consumes both scarce
+                    # resources at once), while a coalesced member's
+                    # dispatch runs on the coalescer thread and holds
+                    # no slot — batching genuinely costs the host less
+                    # and the RU figures say so.
+                    from ..resource_metering import GLOBAL_RECORDER
+                    GLOBAL_RECORDER.charge("read_pool::host",
+                                           host_s=dt)
                     with self._mu:
                         self.running -= 1
                         self.ema_service_time = dt if \

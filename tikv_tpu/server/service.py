@@ -147,6 +147,14 @@ class KvService:
                 else None
             rgm = self.node.resource_groups
             rgm.charge_request(group)
+            # RU metering: stamp the request's (resource_group,
+            # request_source) tag onto its trace at admission — every
+            # downstream charge site (device launch, D2H, read-pool
+            # service, arena residency ownership) resolves attribution
+            # through this stamp across thread handoffs
+            from ..resource_metering import bind_request
+            bind_request(group, req.get("request_source", "")
+                         if isinstance(req, dict) else "")
             # read-pool compile-class key: the pool's service-time EWMA
             # is keyed by the request's COST SHAPE, not just "a read" —
             # for coprocessor requests the const-blind plan class (a
@@ -256,6 +264,13 @@ class KvService:
         must be debuggable from the response alone), fire the
         slow-query log, and hand the trace to the retention buffer."""
         tr.finish()
+        # RU accounting seal: the trace (and through it the slow-query
+        # line and /debug/trace/<id>) answers "who paid for this" —
+        # resource_group was labeled at admission, the RU total
+        # accumulated across every charge site this request hit
+        from ..utils.metrics import RU_REQUEST_HISTOGRAM
+        tr.label("ru", f"{tr.ru:.4f}")
+        RU_REQUEST_HISTOGRAM.observe(tr.ru)
         if isinstance(resp, dict):
             resp.setdefault("time_detail", tr.time_detail())
             resp.setdefault("scan_detail", tr.scan_detail())

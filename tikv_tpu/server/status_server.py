@@ -158,6 +158,13 @@ class StatusServer:
                         if fr is not None:
                             tracing["flight_recorder"] = fr.stats()
                         body["tracing"] = tracing
+                    # device-aware RU metering rollup: live knobs +
+                    # cost-model weights (all online-updatable), tag
+                    # bound, last windowed top-k report, attribution
+                    # coverage
+                    from ..resource_metering import GLOBAL_RECORDER
+                    body["resource_metering"] = \
+                        GLOBAL_RECORDER.health_stats()
                     self._json(200, body)
                 elif path == "/config":
                     if outer._controller is None:
@@ -178,14 +185,7 @@ class StatusServer:
                         if node is not None else []
                     self._json(200, groups)
                 elif path == "/resource_metering":
-                    from ..resource_metering import GLOBAL_RECORDER
-                    report = GLOBAL_RECORDER.harvest()
-                    self._json(200, {
-                        tag: {"cpu_secs": r.cpu_secs,
-                              "read_keys": r.read_keys,
-                              "write_keys": r.write_keys,
-                              "requests": r.requests}
-                        for tag, r in report.items()})
+                    self._get_resource_metering()
                 elif path == "/debug/pprof/profile":
                     # ?seconds=N (default 1): folded-stacks CPU profile
                     # (status_server profile.rs dump_one_cpu_profile)
@@ -211,6 +211,73 @@ class StatusServer:
                     self._json(200, memory_usage())
                 else:
                     self._json(404, {"error": f"no route {path}"})
+
+            def _get_resource_metering(self):
+                """Per-tag RU breakdown + windowed top-k hot tenants/
+                regions.  Default: a human-readable text table;
+                ``?format=json``: the machine shape (what PD receives,
+                plus cumulative per-tag totals and the attribution
+                coverage figure)."""
+                from ..resource_metering import GLOBAL_RECORDER
+                rec = GLOBAL_RECORDER
+                # roll an overdue window so the route is live without
+                # waiting for a store heartbeat (standalone servers)
+                rec.roll_window()
+                raw = rec.totals()      # ONE snapshot serves both the
+                totals = {t: r.summary()    # table and the coverage
+                          for t, r in sorted(raw.items(),
+                                             key=lambda kv: -kv[1].ru)}
+                body = {
+                    "config": rec.stats(),
+                    "coverage": round(
+                        rec.attribution_coverage(totals=raw), 4),
+                    "window": rec.report(),
+                    "tags": totals,
+                }
+                fmt = ""
+                q = self.path.split("?", 1)
+                if len(q) == 2:
+                    for kv in q[1].split("&"):
+                        if kv.startswith("format="):
+                            fmt = kv[len("format="):]
+                if fmt == "json":
+                    self._json(200, body)
+                    return
+                lines = ["# resource metering — per-tag RU "
+                         "attribution (?format=json for the machine "
+                         "shape)",
+                         f"coverage={body['coverage']} "
+                         f"tags={body['config']['tags']} "
+                         f"window_s={body['config']['window_s']} "
+                         f"topk={body['config']['topk']}",
+                         "",
+                         f"{'tag':<32}{'ru':>12}{'launch_ms':>12}"
+                         f"{'d2h_mb':>10}{'res_mb_s':>10}"
+                         f"{'host_ms':>10}{'keys':>10}{'reqs':>8}"]
+                for tag, s in totals.items():
+                    lines.append(
+                        f"{tag:<32}{s['ru']:>12}{s['launch_ms']:>12}"
+                        f"{s['d2h_mb']:>10}{s['resident_mb_s']:>10}"
+                        f"{s['host_ms']:>10}{s['read_keys']:>10}"
+                        f"{s['requests']:>8}")
+                win = body["window"]
+                if win:
+                    lines.append("")
+                    lines.append(f"window top-{body['config']['topk']} "
+                                 f"(rolled {win.get('window_s')}s, "
+                                 f"total_ru={win.get('total_ru')}):")
+                    for ent in win.get("top_tenants") or ():
+                        lines.append(f"  tenant {ent['tag']}: "
+                                     f"ru={ent['ru']}")
+                    for ent in win.get("top_regions") or ():
+                        lines.append(f"  region {ent['region']}: "
+                                     f"ru={ent['ru']}")
+                    if win.get("untagged"):
+                        lines.append(
+                            f"  untagged residual: "
+                            f"ru={win['untagged']['ru']}")
+                self._reply(200, ("\n".join(lines) + "\n").encode(),
+                            "text/plain; charset=utf-8")
 
             def _get_trace(self, path: str):
                 """/debug/trace — recent/slowest/flagged trace index +

@@ -431,6 +431,31 @@ COPR_PLAN_FRAGMENT_COUNTER = REGISTRY.counter(
     "plan-IR fragments by kind and routed backend (per-operator "
     "host/device routing, copr/plan_ir.py FragmentRouter)",
     labels=("kind", "backend"))
+RU_CHARGE_COUNTER = REGISTRY.counter(
+    "tikv_resource_metering_ru_total",
+    "request units charged, by charge site (ru_model.CHARGE_SITES: "
+    "device::launch / copr::coalesce_dispatch = group launch split by "
+    "occupancy share / device::d2h / arena::residency / "
+    "read_pool::host / copr::scan)",
+    labels=("site",))
+RU_TENANT_COUNTER = REGISTRY.counter(
+    "tikv_resource_metering_tenant_ru_total",
+    "request units charged per tenant (the resource_group half of the "
+    "tag; bounded by the recorder's max_resource_groups fold — "
+    "overflow and idle tags aggregate into 'other', unattributable "
+    "charges into the explicit 'untagged' residual)",
+    labels=("tenant",))
+RU_TAG_GAUGE = REGISTRY.gauge(
+    "tikv_resource_metering_tags",
+    "live (resource_group, request_source) tags in the metering "
+    "recorder — bounded: beyond max_resource_groups new tags fold "
+    "into 'other', idle tags fold on window roll")
+RU_REQUEST_HISTOGRAM = REGISTRY.histogram(
+    "tikv_resource_metering_request_ru",
+    "request units charged per read RPC (sealed with the trace; the "
+    "per-tenant fair-share enforcement PR's admission input)",
+    buckets=(0.125, 0.25, 0.5, 1, 2, 4, 8, 16, 32, 64, 128, 256,
+             512, 1024))
 SCHED_COMMANDS = REGISTRY.counter(
     "tikv_scheduler_commands_total", "txn scheduler commands",
     labels=("type",))

@@ -108,7 +108,8 @@ class Tracker:
 
     __slots__ = ("trace_id", "sampled", "t0", "wall_t0", "t1",
                  "wait_ns", "phases", "scan_rows", "scan_bytes",
-                 "labels", "_mu", "_next_id", "spans", "root")
+                 "labels", "_mu", "_next_id", "spans", "root",
+                 "meter_ctx", "ru")
 
     def __init__(self, trace_id: Optional[str] = None,
                  sampled: bool = True):
@@ -126,6 +127,12 @@ class Tracker:
         self._next_id = 0
         self.spans: list[Span] = []
         self.root: Optional[Span] = None
+        # resource metering (tikv_tpu/resource_metering.py): the
+        # request's MeterContext rides the tracker across adopt()
+        # handoffs, and every RU charged to this request accumulates
+        # here (sealed into the trace labels + slow-query line)
+        self.meter_ctx = None
+        self.ru = 0.0
         if sampled:
             self.root = self._new_span(ROOT_SPAN_NAME, None, self.t0)
 
@@ -210,6 +217,13 @@ class Tracker:
 
     def label(self, key: str, value: str) -> None:
         self.labels[key] = value
+
+    def add_ru(self, ru: float) -> None:
+        """Accumulate request units charged to this request (called by
+        the metering recorder from whichever thread measured the cost —
+        the same exactly-once discipline the span handoffs follow)."""
+        with self._mu:
+            self.ru += float(ru)
 
     # -- serialization (TimeDetailV2 / ScanDetailV2 shape) --
 
