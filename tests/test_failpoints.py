@@ -377,14 +377,18 @@ def test_failpoint_inventory_resolves():
     # ≥71 since the plan IR: device::join_dispatch — a device join
     # fragment's probe dispatch fails and the executor host-joins
     # THAT fragment only — and copr::plan_route, forcing the fragment
-    # router to place every fragment host)
-    assert len(sites) >= 71, f"only {len(sites)} unique sites"
+    # router to place every fragment host; ≥72 since multi-tenant
+    # resource control: copr::rc_throttle — force-throttle a named
+    # resource group (value = group; bare return = every group) at
+    # the RU-priced read-pool admission gate, so the shed path and
+    # its group-derived retry_after_ms are steerable without a load)
+    assert len(sites) >= 72, f"only {len(sites)} unique sites"
     for dev_site in ("device::hbm_oom", "device::feed_corrupt",
                      "device::d2h_corrupt", "copr::coalesce_dispatch",
                      "copr::coalesce_window", "device::mvcc_resolve",
                      "device::shard_launch", "device::slice_dead",
                      "device::mesh_rebuild", "device::join_dispatch",
-                     "copr::plan_route"):
+                     "copr::plan_route", "copr::rc_throttle"):
         assert dev_site in sites, f"missing fault site {dev_site}"
 
     nemesis_src = (root / "chaos" / "nemesis.py").read_text()

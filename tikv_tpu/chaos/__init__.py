@@ -16,7 +16,9 @@ same message scrambling decisions.
 from .invariants import (        # noqa: F401
     InvariantViolation,
     RaftStateTracker,
+    check_bg_not_starved,
     check_conservation,
+    check_fg_latency_bounded,
     check_goodput,
     check_hbm_within_budget,
     check_mesh_serves_degraded,
@@ -34,6 +36,7 @@ from .nemesis import (           # noqa: F401
     DEVICE_FAULT_KINDS,
     FAULT_KINDS,
     PLAN_FAULT_KINDS,
+    TENANT_FAULT_KINDS,
     Fault,
     Nemesis,
     generate_schedule,

@@ -240,6 +240,59 @@ def check_mesh_serves_degraded(records, device_floor: float = 0.5
             "rung instead of its healthy submesh")
 
 
+def _pct(values, q: float) -> float:
+    """Nearest-rank percentile over a non-empty sequence (no numpy —
+    the invariants module stays dependency-free)."""
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, round(q / 100.0 * (len(s) - 1))))
+    return s[idx]
+
+
+def check_fg_latency_bounded(fg_results, baseline_p99_s: float,
+                             factor: float = 1.5,
+                             slack_s: float = 0.05) -> None:
+    """Multi-tenant resource-control contract, foreground half: with
+    a background group storming, the foreground group's P99 stays
+    within ``factor`` of its measured SOLO baseline (+``slack_s`` of
+    scheduling noise) — the enforcement sites actually isolated the
+    latency tenant instead of letting the storm monopolize the
+    coalescer lanes, the arena, and the read-pool slots."""
+    lats = [r["elapsed"] for r in fg_results if r.get("ok")]
+    if not lats:
+        raise InvariantViolation(
+            "no foreground requests served during the storm — the "
+            "latency tenant was starved outright")
+    p99 = _pct(lats, 99)
+    bound = factor * baseline_p99_s + slack_s
+    if p99 > bound:
+        raise InvariantViolation(
+            f"foreground P99 {p99 * 1e3:.1f}ms exceeds "
+            f"{factor}x solo baseline "
+            f"{baseline_p99_s * 1e3:.1f}ms (+{slack_s * 1e3:.0f}ms "
+            "slack) under a background storm — enforcement failed to "
+            "protect the latency tenant")
+
+
+def check_bg_not_starved(bg_results,
+                         min_served_fraction: float = 0.2) -> None:
+    """Multi-tenant resource-control contract, background half: a
+    throttled group is THROTTLED, not starved — at least
+    ``min_served_fraction`` of its requests eventually complete
+    (deferral re-parks and the shed hint's retry-after both promise
+    forward progress; zero completions means something dropped work
+    on the floor)."""
+    if not bg_results:
+        raise InvariantViolation("no background requests attempted")
+    ok = sum(1 for r in bg_results if r.get("ok"))
+    frac = ok / len(bg_results)
+    if ok == 0 or frac < min_served_fraction:
+        raise InvariantViolation(
+            f"background group served only {frac:.0%} "
+            f"({ok}/{len(bg_results)}) of its requests (floor "
+            f"{min_served_fraction:.0%}) — throttling degenerated "
+            "into starvation")
+
+
 def check_goodput(results, floor: float) -> None:
     """The served fraction stays above ``floor`` during the brownout —
     fail-slow must not degrade into fail-stop."""

@@ -56,6 +56,19 @@ DEVICE_FAULT_KINDS = ("hbm_squeeze", "feed_corrupt", "d2h_corrupt",
 # both the per-fragment degrade and the all-host path under chaos
 PLAN_FAULT_KINDS = ("plan_fault",)
 
+# multi-tenant faults (their own tuple, same seeded-schedule-stability
+# reason): tenant_storm floods ONE resource group's RU ledger — a
+# burst of measured charges lands on the storm group through the
+# metering recorder, driving its token bucket deep into debt exactly
+# as a real request flood would have priced it — while the foreground
+# group keeps serving.  Every enforcement site (coalescer DWFQ, arena
+# eviction bias, read-pool shed) must then throttle the storm group
+# WITHOUT starving it (check_bg_not_starved) and hold the foreground
+# group's latency bounded (check_fg_latency_bounded).  The
+# copr::rc_throttle failpoint is the surgical sibling: force-throttle
+# one named group with no load at all.
+TENANT_FAULT_KINDS = ("tenant_storm",)
+
 # the plain degrade-to-host failpoint sites the device_degrade nemesis
 # rotates over; the remaining device::* sites have dedicated kinds
 # above (the inventory test asserts the union covers EVERY device::*
@@ -134,6 +147,9 @@ def generate_schedule(seed: int, steps: int,
         elif kind == "plan_fault":
             out.append(_mk(kind, pct=rng.choice((25, 50, 100)),
                            route_pct=rng.choice((0, 25, 50))))
+        elif kind == "tenant_storm":
+            out.append(_mk(kind, group="storm",
+                           ru=rng.choice((2000.0, 5000.0, 10000.0))))
         else:   # pragma: no cover
             raise ValueError(kind)
     return out
@@ -262,6 +278,30 @@ class Nemesis:
         site = fault.param("site", DEGRADE_SITES[0])
         failpoint.cfg(site, f"{fault.param('pct', 100)}%return")
         self._heals.append(lambda s=site: failpoint.remove(s))
+
+    def _apply_tenant_storm(self, fault: Fault) -> None:
+        """One tenant's request flood, modeled at the RU ledger: a
+        burst of measured host-wall charges lands on the storm group
+        through the metering recorder — the same stream the resource
+        controller's token buckets drain from — so the group goes
+        into debt exactly as if the flood's requests had run, without
+        needing a gRPC client stack inside the in-process harness.
+        The enforcement sites then see a flooding tenant (deep debt,
+        high recent-RU rate) while the foreground workload keeps
+        serving; heal is organic (the bucket refills at the group's
+        share — throttled, not starved, by construction)."""
+        from ..resource_metering import (
+            GLOBAL_RECORDER,
+            ResourceTagFactory,
+        )
+        from ..ru_model import GLOBAL_MODEL
+        group = fault.param("group", "storm")
+        ru = float(fault.param("ru", 5000.0))
+        w = GLOBAL_MODEL.weights()["ru_per_host_s"]
+        host_s = ru / w if w > 0 else 0.0
+        tag = ResourceTagFactory.tag(group, "storm")
+        with GLOBAL_RECORDER.attach(tag, requests=0):
+            GLOBAL_RECORDER.charge("read_pool::host", host_s=host_s)
 
     def _apply_plan_fault(self, fault: Fault) -> None:
         """Plan-IR fault mix: device::join_dispatch fails a device

@@ -187,6 +187,30 @@ class ResourceMeteringConfig:
 
 
 @dataclass
+class ResourceControlConfig:
+    """[resource-control]: multi-tenant enforcement of the RU charges
+    ``[resource-metering]`` measures (resource_control.py).  Every
+    field is online-updatable and visible in /health and at
+    /resource_control.
+
+    ``groups`` maps resource-group names to ``{share, burst,
+    priority}`` specs: ``share`` is the group's token-bucket refill
+    rate in RU/s (the unit the ru_model prices every measured charge
+    in), ``burst`` the bucket cap in RU (0 = 2× share), ``priority``
+    one of low/medium/high (high never sheds at the read pool and
+    never counts as throttled in the coalescer's DWFQ).  Groups not
+    named here get ``default_share``/``default_burst``.  A typo'd
+    group key, a non-positive share, or an unknown priority tier
+    fails validation (the negative-RU-weight guard applied to group
+    specs)."""
+
+    enabled: bool = False
+    default_share: float = 500.0
+    default_burst: float = 0.0          # 0 = 2x share
+    groups: dict = field(default_factory=dict)
+
+
+@dataclass
 class SecurityConfig:
     """[security]: TLS for every gRPC channel (components/security).
     The ONE definition — server/security.py builds its manager from
@@ -213,6 +237,8 @@ class TikvConfig:
     readpool: ReadPoolConfig = field(default_factory=ReadPoolConfig)
     resource_metering: ResourceMeteringConfig = field(
         default_factory=ResourceMeteringConfig)
+    resource_control: ResourceControlConfig = field(
+        default_factory=ResourceControlConfig)
     security: SecurityConfig = field(default_factory=SecurityConfig)
 
     @staticmethod
@@ -262,6 +288,19 @@ class TikvConfig:
                 # corrupt every downstream total/report
                 raise ValueError(
                     f"resource-metering {f.name} must be >= 0")
+        rc = self.resource_control
+        if rc.default_share <= 0:
+            raise ValueError(
+                "resource-control default-share must be > 0")
+        if rc.default_burst < 0:
+            raise ValueError(
+                "resource-control default-burst must be >= 0")
+        # group-spec vocabulary guard: a typo'd key, non-positive
+        # share, or unknown priority tier fails HERE, never silently
+        # mis-configures an enforcement site (resource_control.py
+        # owns the one validator both paths share)
+        from .resource_control import validate_group_specs
+        validate_group_specs(rc.groups)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -297,6 +336,10 @@ _ONLINE_FIELDS = {
     "resource_metering.ru_per_mb_s",
     "resource_metering.ru_per_read_key",
     "resource_metering.ru_per_request",
+    "resource_control.enabled",
+    "resource_control.default_share",
+    "resource_control.default_burst",
+    "resource_control.groups",
 }
 
 

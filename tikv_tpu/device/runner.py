@@ -1718,6 +1718,9 @@ class DeviceRunner:
             if self._joiner is not None else 0
         with self._quar_mu:
             out["quarantined"] = len(self._quarantined)
+        # per-tenant residency (resource_control enforcement surface):
+        # whose bytes sit in HBM right now, by owning resource group
+        out["residency_by_tenant"] = self._arena.residency_by_tenant()
         subs = [r for r in self._placer.slices] \
             if self._placer is not None else []
         degraded = self._degraded_sub()
@@ -1733,6 +1736,9 @@ class DeviceRunner:
                       "rejections", "drops", "quarantined",
                       "join_cache_bytes"):
                 out[k] = out.get(k, 0) + sub.get(k, 0)
+            for t, b in sub.get("residency_by_tenant", {}).items():
+                out["residency_by_tenant"][t] = \
+                    out["residency_by_tenant"].get(t, 0) + b
         return out
 
     def arena_items(self) -> list:

@@ -727,19 +727,54 @@ class FeedArena:
 
     def _evict_until_locked(self, budget: int,
                             protect_key: Optional[int] = None) -> int:
-        """Evict unpinned entries (lowest frequency, then oldest
-        recency) until resident bytes fit ``budget``.  Caller holds
-        ``_mu``.  Returns entries evicted."""
+        """Evict unpinned entries until resident bytes fit ``budget``.
+        Caller holds ``_mu``.  Returns entries evicted.
+
+        Victim order is lowest-frequency, then oldest-recency — unless
+        multi-tenant resource control is on (resource_control.py), in
+        which case the owning tag's standing is folded in FIRST: an
+        entry whose tenant is OVER its HBM residency share (the
+        ``arena::residency`` owners the metering records) evicts
+        before any under-share tenant's entry, ranked by the owner's
+        RU debt within each class — a background scanner's feeds die
+        first and a latency tenant's hot set is protected up to its
+        share.  Work-conserving by construction: the bias only
+        engages under budget pressure, so an over-share tenant keeps
+        using slack capacity until someone actually needs it."""
         from ..utils.metrics import DEVICE_FEED_EVICTION_COUNTER
         evicted = 0
+        rc = tenant_bytes = standing = None
+        if self._total_locked() > budget:
+            from ..resource_control import GLOBAL_CONTROLLER
+            from ..resource_metering import ResourceTagFactory as _rtf
+            if GLOBAL_CONTROLLER.enabled:
+                rc = GLOBAL_CONTROLLER
+                tenant_bytes = {}
+                for e in self._entries.values():
+                    if e.nbytes > 0:
+                        t = _rtf.tenant(e.owner_tag)
+                        tenant_bytes[t] = \
+                            tenant_bytes.get(t, 0) + e.nbytes
+                # ONE controller-lock round trip per sweep: per-tenant
+                # (byte limit, RU debt) snapshot — per-entry scoring
+                # below is pure dict math under the arena mutex, and
+                # only the victim's tenant needs bytes re-tallied
+                standing = rc.hbm_standing(tenant_bytes, budget)
+        evicted_by_tenant: dict = {}
         while self._total_locked() > budget:
-            victim_key = victim = None
+            victim_key = victim = victim_rank = None
             for k, e in self._entries.items():
                 if k == protect_key or e.pins > 0 or e.nbytes <= 0:
                     continue
-                if victim is None or \
-                        (e.hits, e.tick) < (victim.hits, victim.tick):
-                    victim_key, victim = k, e
+                if standing is not None:
+                    t = _rtf.tenant(e.owner_tag)
+                    limit, debt = standing.get(t, (float("inf"), 0.0))
+                    rank = (0 if tenant_bytes.get(t, 0) > limit
+                            else 1, -debt, e.hits, e.tick)
+                else:
+                    rank = (e.hits, e.tick)
+                if victim_rank is None or rank < victim_rank:
+                    victim_key, victim, victim_rank = k, e, rank
             if victim is None:
                 break
             self._settle_entry_locked(victim, time.monotonic())
@@ -748,6 +783,23 @@ class FeedArena:
             self.evictions += 1
             evicted += 1
             DEVICE_FEED_EVICTION_COUNTER.labels("budget").inc()
+            if standing is not None:
+                t = _rtf.tenant(victim.owner_tag)
+                tenant_bytes[t] = max(
+                    0, tenant_bytes.get(t, 0) - victim.nbytes)
+                evicted_by_tenant[t] = \
+                    evicted_by_tenant.get(t, 0) + 1
+        if standing is not None and evicted:
+            # one controller-lock round trip for the whole sweep's
+            # eviction telemetry (mirrors the hbm_standing read side)
+            rc.note_evictions(evicted_by_tenant)
+            # the protection figure: under-share tenants' bytes still
+            # resident after a sweep that evicted over-share state
+            protected = sum(
+                b for t, b in tenant_bytes.items()
+                if b > 0 and b <= standing.get(
+                    t, (float("inf"), 0.0))[0])
+            rc.note_protected(protected)
         return evicted
 
     def enforce(self) -> int:
@@ -823,6 +875,21 @@ class FeedArena:
     def resident_lines(self) -> int:
         with self._mu:
             return len(self._entries)
+
+    def residency_by_tenant(self) -> dict:
+        """Resident bytes per owning tenant (the resource_group half
+        of the ``arena::residency`` owner tags) — the enforcement
+        surface's per-group HBM view, rolled up into the runner's
+        hbm_stats and the /resource_control route."""
+        from ..resource_metering import ResourceTagFactory
+        with self._mu:
+            out: dict = {}
+            for e in self._entries.values():
+                if e.nbytes <= 0:
+                    continue
+                t = ResourceTagFactory.tenant(e.owner_tag)
+                out[t] = out.get(t, 0) + e.nbytes
+            return out
 
     def items(self) -> list:
         """Snapshot of (anchor, bucket) pairs with live anchors — the

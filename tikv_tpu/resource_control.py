@@ -1,0 +1,701 @@
+"""Multi-tenant resource control — ENFORCEMENT of the RU charges
+``resource_metering.py`` measures.
+
+Reference: TiDB/TiKV resource_control (``ResourceGroupManager`` +
+``ResourceLimiter``: named groups with RU budgets consulted by the
+read pool and scheduler) — the resource-group scheduler applied to
+this deployment's scarce resources.  PR 13 shipped the measurement
+half: per-(resource_group, request_source) RU charged at every
+scarce-resource site — device launch wall, D2H bytes, HBM
+bytes-resident-seconds, host slot wall — with ≥95% attribution
+coverage.  This module turns that ledger into decisions at the three
+places contention actually happens:
+
+1. **Weighted fair-share in the coalescer window** — each resource
+   group owns a token bucket refilled at its configured ``share``
+   (RU/s, the same unit the :mod:`~tikv_tpu.ru_model` prices charges
+   in) and capped at ``burst``.  When a collection window closes,
+   stacked-group membership is chosen by DEFICIT-WEIGHTED FAIR
+   QUEUING (:meth:`ResourceController.select_stacked`) over the
+   parked members' groups instead of FIFO, so one tenant's members
+   can never monopolize a stacked dispatch.  A throttled member is
+   DEFERRED to the next window (the coalescer re-parks it) — never
+   silently dropped — and deadline-urgent members are always
+   selected, so the deadline-aware close guarantee (zero late acks)
+   survives enforcement.  Selection is work-conserving: slack lanes
+   go to throttled groups rather than running empty.
+
+2. **Tenant-aware arena eviction** —
+   :meth:`~tikv_tpu.device.supervisor.FeedArena._evict_until_locked`
+   folds the owning tag's RU debt and the group's HBM residency
+   share (the ``arena::residency`` owners PR 13 records) into victim
+   selection: an over-share background tenant's feeds evict first
+   and an under-share latency tenant's hot feeds are protected up to
+   its share.  Over-share tenants may still use slack capacity —
+   eviction bias engages only under budget pressure.
+
+3. **RU-priced shed in the read pool** — admission compares the
+   request's GROUP RU debt and the group's recent-RU-rate EWMA
+   against its share instead of one global service-time EWMA
+   (:meth:`ResourceController.admit`); an over-budget background
+   request sheds with a ``retry_after_ms`` derived from the group's
+   token-bucket refill time, and the ``ServerIsBusy`` response
+   carries the group name.  Work-conserving here too: an over-budget
+   group is shed only while the pool actually has contention.
+
+The controller is PROCESS-global (:data:`GLOBAL_CONTROLLER`) for the
+same reason the metering recorder is: the enforcement sites — the
+arena's eviction sweep, the read pool's admission gate, the
+coalescer's dispatch — have no node handle, matching the
+one-store-per-process production shape.  It subscribes to the
+recorder's charge stream (``Recorder.subscribe_charges``), so every
+measured RU debit lands on the paying group's bucket the instant the
+charge is recorded — the bucket refills from configured shares and
+drains from MEASURED costs, never from static request estimates.
+
+Config lives in ``[resource-control]`` (config.py
+``ResourceControlConfig``): ``enabled``, per-group ``share`` /
+``burst`` / ``priority`` tiers, ``default-share`` for unconfigured
+groups — all online-updatable through the PR 13 config-manager
+pattern, visible at ``/resource_control`` and in the ``/health``
+rollup.  The ``copr::rc_throttle`` failpoint force-throttles a named
+group (bare ``return`` = every group) for fault injection; the
+``tenant_storm`` nemesis kind floods one group's ledger while a
+foreground group serves.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from .resource_metering import GLOBAL_RECORDER, ResourceTagFactory
+from .ru_model import GLOBAL_MODEL
+from .utils.failpoint import (
+    fail_point,
+    is_armed as fp_is_armed,
+    peek_value as fp_peek_value,
+)
+
+PRIORITIES = ("low", "medium", "high")
+# the per-group config vocabulary: a typo'd key fails validation (the
+# PR 13 negative-RU-weight guard applied to group specs)
+GROUP_SPEC_KEYS = ("share", "burst", "priority")
+
+# recent-RU-rate EWMA time constant: an impulse of X RU lifts the rate
+# figure by X/tau immediately and decays with ~tau seconds of memory —
+# fast enough to see a storm inside one collection window, slow enough
+# that one big scan does not read as a sustained flood
+RATE_TAU_S = 2.0
+
+
+def validate_group_specs(groups) -> None:
+    """Validate a ``[resource-control]`` groups mapping: unknown keys,
+    non-positive shares, negative bursts, and unknown priority tiers
+    all raise (a TOML typo must fail at validation, never silently
+    mis-configure an enforcement site)."""
+    if not isinstance(groups, dict):
+        raise ValueError("resource-control groups must be a table of "
+                         "{group: {share, burst, priority}}")
+    for name, spec in groups.items():
+        if not isinstance(name, str) or not name:
+            raise ValueError(
+                f"resource-control group name {name!r} must be a "
+                "non-empty string")
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"resource-control group {name!r} must be a table "
+                f"(got {type(spec).__name__})")
+        unknown = set(spec) - set(GROUP_SPEC_KEYS)
+        if unknown:
+            raise ValueError(
+                f"resource-control group {name!r}: unknown key(s) "
+                f"{sorted(unknown)} (vocabulary: "
+                f"{', '.join(GROUP_SPEC_KEYS)})")
+        share = spec.get("share")
+        if share is not None and (
+                isinstance(share, bool) or
+                not isinstance(share, (int, float)) or share <= 0):
+            raise ValueError(
+                f"resource-control group {name!r}: share must be a "
+                f"number > 0 (got {share!r})")
+        burst = spec.get("burst")
+        if burst is not None and (
+                isinstance(burst, bool) or
+                not isinstance(burst, (int, float)) or burst < 0):
+            raise ValueError(
+                f"resource-control group {name!r}: burst must be a "
+                f"number >= 0 (got {burst!r})")
+        prio = spec.get("priority")
+        if prio is not None and prio not in PRIORITIES:
+            raise ValueError(
+                f"resource-control group {name!r}: priority must be "
+                f"one of {PRIORITIES} (got {prio!r})")
+
+
+class GroupState:
+    """One resource group's live enforcement state: a token bucket
+    refilled at ``share`` RU/s (capped at ``burst``; debt allowed —
+    work admitted on slack still bills), a decayed recent-RU-rate
+    figure, the group's DWFQ deficit, and per-action counters.
+
+    All mutation happens under the owning controller's lock.
+    """
+
+    # debt floor: a group can owe at most this many bursts — bounds
+    # the recovery time after a work-conserving slack binge
+    DEBT_BURSTS = 4.0
+
+    __slots__ = ("name", "share", "burst", "priority", "configured",
+                 "tokens", "_last", "deficit", "ru_rate", "_rate_t",
+                 "consumed_ru", "throttles", "deferrals", "sheds",
+                 "evictions")
+
+    def __init__(self, name: str, share: float, burst: float = 0.0,
+                 priority: str = "medium", configured: bool = False):
+        self.name = name
+        self.share = float(share)
+        self.burst = float(burst)
+        self.priority = priority
+        self.configured = configured
+        self.tokens = self.burst_cap()
+        self._last = time.monotonic()
+        self.deficit = 0.0
+        self.ru_rate = 0.0
+        self._rate_t = self._last
+        self.consumed_ru = 0.0
+        self.throttles = 0
+        self.deferrals = 0
+        self.sheds = 0
+        self.evictions = 0
+
+    def burst_cap(self) -> float:
+        """burst = 0 means "2× share": one second of full-rate
+        backlog absorbed without throttling."""
+        return self.burst if self.burst > 0 else 2.0 * self.share
+
+    def _refill(self, now: float) -> None:
+        dt = now - self._last
+        if dt > 0:
+            self.tokens = min(self.burst_cap(),
+                              self.tokens + dt * self.share)
+            self._last = now
+
+    def _decay_rate(self, now: float) -> None:
+        dt = now - self._rate_t
+        if dt > 0:
+            self.ru_rate *= math.exp(-dt / RATE_TAU_S)
+            self._rate_t = now
+
+    def debit(self, ru: float, now: float) -> None:
+        """One measured charge lands: drain the bucket (debt-floored)
+        and bump the decayed RU-rate figure."""
+        self._refill(now)
+        self.tokens = max(-self.DEBT_BURSTS * self.burst_cap(),
+                          self.tokens - ru)
+        self.consumed_ru += ru
+        self._decay_rate(now)
+        self.ru_rate += ru / RATE_TAU_S
+
+    def debt(self, now: float) -> float:
+        self._refill(now)
+        return max(0.0, -self.tokens)
+
+    def throttled(self, now: float) -> bool:
+        """Out of tokens and not a high-priority tier — the state the
+        coalescer's DWFQ treats as slack-only and the read pool's
+        admission sheds under contention."""
+        if self.priority == "high":
+            return False
+        self._refill(now)
+        return self.tokens <= 0.0
+
+    def refill_ms(self, need: float, now: float) -> int:
+        """Milliseconds until ``need`` tokens are available — the
+        group-derived ``retry_after_ms`` a shed response carries."""
+        self._refill(now)
+        missing = need - self.tokens
+        if missing <= 0 or self.share <= 0:
+            return 1
+        return max(1, int(1000.0 * missing / self.share))
+
+    def stats(self, now: float) -> dict:
+        self._refill(now)
+        self._decay_rate(now)
+        return {
+            "share": self.share,
+            "burst": self.burst_cap(),
+            "priority": self.priority,
+            "configured": self.configured,
+            "tokens": round(self.tokens, 3),
+            "debt": round(max(0.0, -self.tokens), 3),
+            "ru_rate_ewma": round(self.ru_rate, 3),
+            "consumed_ru": round(self.consumed_ru, 3),
+            "throttles": self.throttles,
+            "deferrals": self.deferrals,
+            "sheds": self.sheds,
+            "evictions": self.evictions,
+        }
+
+
+class ResourceController:
+    """The enforcement half of multi-tenant resource control (module
+    doc).  One per process (:data:`GLOBAL_CONTROLLER`); disabled by
+    default — every API degrades to a no-op so the unconfigured hot
+    paths pay one boolean check."""
+
+    # bounded live-group map (the recorder's tag-fold discipline):
+    # request-supplied group names beyond the cap share one overflow
+    # state at the default share instead of growing without bound
+    MAX_GROUPS = 128
+    OVERFLOW = "_overflow"
+    # a member deferred this many windows is force-selected next time
+    # regardless of fairness — DWFQ guarantees progress, this bounds
+    # the tail against adversarial share ratios
+    MAX_DEFERS = 8
+    # DWFQ deficit clamp: a long-idle group must not bank unbounded
+    # credit (or debt) against the next contended window
+    DEFICIT_CLAMP = 8.0
+
+    def __init__(self, enabled: bool = False,
+                 default_share: float = 500.0,
+                 default_burst: float = 0.0):
+        self._mu = threading.Lock()
+        self.enabled = bool(enabled)
+        self.default_share = float(default_share)
+        self.default_burst = float(default_burst)
+        self._groups: dict[str, GroupState] = {}
+        self.forced_throttles = 0
+        # last eviction sweep's under-share survivor bytes + how many
+        # sweeps exercised protection (the "protected-bytes" surface)
+        self.protected_bytes = 0
+        self.protect_events = 0
+
+    # -- config -------------------------------------------------------
+
+    def configure(self, enabled: Optional[bool] = None,
+                  default_share: Optional[float] = None,
+                  default_burst: Optional[float] = None,
+                  groups: Optional[dict] = None) -> None:
+        """Apply an online config diff (Node's ``resource_control``
+        manager).  Validates before touching any state — a rejected
+        diff leaves the controller exactly as it was."""
+        if default_share is not None and float(default_share) <= 0:
+            raise ValueError("resource-control default-share must be "
+                             "> 0")
+        if default_burst is not None and float(default_burst) < 0:
+            raise ValueError("resource-control default-burst must be "
+                             ">= 0")
+        if groups is not None:
+            validate_group_specs(groups)
+        with self._mu:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if default_share is not None:
+                self.default_share = float(default_share)
+            if default_burst is not None:
+                self.default_burst = float(default_burst)
+            if groups is not None:
+                for name, spec in groups.items():
+                    g = self._groups.get(name)
+                    if g is None:
+                        # a NEW group starts with its OWN full burst
+                        # in hand (building it at defaults and then
+                        # clamping would open it at a fraction of its
+                        # configured depth)
+                        g = self._groups[name] = GroupState(
+                            name,
+                            float(spec.get("share",
+                                           self.default_share)),
+                            float(spec.get("burst",
+                                           self.default_burst)),
+                            spec.get("priority", "medium"),
+                            configured=True)
+                        continue
+                    g.share = float(spec.get("share",
+                                             self.default_share))
+                    g.burst = float(spec.get("burst",
+                                             self.default_burst))
+                    g.priority = spec.get("priority", "medium")
+                    g.configured = True
+                    # re-clamp the bucket to the new cap so a share
+                    # cut takes effect now, not after a full drain
+                    g.tokens = min(g.tokens, g.burst_cap())
+                for name, g in self._groups.items():
+                    if g.configured and name not in groups:
+                        # no longer configured: revert to defaults,
+                        # keep the counters (history survives reconfig)
+                        g.share = self.default_share
+                        g.burst = self.default_burst
+                        g.priority = "medium"
+                        g.configured = False
+            if default_share is not None or default_burst is not None:
+                for g in self._groups.values():
+                    if not g.configured:
+                        g.share = self.default_share
+                        g.burst = self.default_burst
+                        g.tokens = min(g.tokens, g.burst_cap())
+
+    def _group_locked(self, name: str) -> GroupState:
+        g = self._groups.get(name)
+        if g is None:
+            if len(self._groups) >= self.MAX_GROUPS:
+                name = self.OVERFLOW
+                g = self._groups.get(name)
+                if g is not None:
+                    return g
+            g = self._groups[name] = GroupState(
+                name, self.default_share, self.default_burst)
+        return g
+
+    @staticmethod
+    def tenant_of(tag: Optional[str]) -> str:
+        """The resource_group half of a metering tag (the bucket and
+        HBM-share key; ``None`` → the explicit untagged tenant)."""
+        return ResourceTagFactory.tenant(tag)
+
+    # -- the RU debit stream ------------------------------------------
+
+    def on_charge(self, site: str, tag: Optional[str],
+                  ru: float) -> None:
+        """Recorder charge listener: every measured RU debits the
+        paying group's bucket.  Disabled → free (one branch)."""
+        if not self.enabled or ru <= 0:
+            return
+        tenant = ResourceTagFactory.tenant(tag)
+        now = time.monotonic()
+        with self._mu:
+            self._group_locked(tenant).debit(ru, now)
+
+    def debt(self, tenant: str) -> float:
+        """The group's current RU debt (0 when disabled) — the arena's
+        eviction tiebreaker."""
+        if not self.enabled:
+            return 0.0
+        now = time.monotonic()
+        with self._mu:
+            return self._group_locked(tenant).debt(now)
+
+    # an RU rate below this is idle noise, not an active tenant
+    ACTIVE_RU_RATE = 0.5
+
+    def _contended_locked(self, now: float) -> bool:
+        """Is more than one group actively consuming?  The scarce
+        resources here are DEVICE-side (launch stream, HBM, D2H), so a
+        read pool with free slots does not mean no contention — two
+        tenants with live recent-RU rates are competing for the same
+        serialized dispatch stream by construction.  One active group
+        means the whole box is its slack: work-conserving, no shed."""
+        active = 0
+        for g in self._groups.values():
+            g._decay_rate(now)
+            if g.ru_rate > self.ACTIVE_RU_RATE:
+                active += 1
+                if active >= 2:
+                    return True
+        return False
+
+    # -- enforcement site 3: read-pool admission ----------------------
+
+    def admit(self, group_name: Optional[str], *,
+              pool_busy: bool = False) -> tuple:
+        """RU-priced admission for one request: → ``(ok,
+        retry_after_ms, reason)``.
+
+        Sheds when the group's bucket is in DEBT and its recent-RU
+        rate exceeds its share — but only under pool contention
+        (work-conserving: an over-budget group on an idle pool still
+        serves).  High-priority groups never shed here.  The
+        ``copr::rc_throttle`` failpoint (value = group name; bare
+        ``return`` = every group) force-throttles regardless of the
+        enabled flag — fault injection must not need a config edit.
+        """
+        name = group_name or "default"
+        if fp_is_armed("copr::rc_throttle"):
+            # filter on the TARGET group before firing: a
+            # count-limited "1*return(bg)" must not be burned by some
+            # other group's request reaching this gate first
+            target = fp_peek_value("copr::rc_throttle")
+            if (not target or str(target) == name) and \
+                    fail_point("copr::rc_throttle") is not None:
+                now = time.monotonic()
+                with self._mu:
+                    self.forced_throttles += 1
+                    g = self._group_locked(name)
+                    g.sheds += 1
+                    hint = g.refill_ms(GLOBAL_MODEL.ru(requests=1),
+                                       now)
+                self._note(name, "shed")
+                return False, hint, (
+                    f"resource group {name!r} force-throttled "
+                    "(copr::rc_throttle)")
+        if not self.enabled:
+            return True, 0, ""
+        now = time.monotonic()
+        with self._mu:
+            g = self._group_locked(name)
+            if g.priority == "high":
+                return True, 0, ""
+            g._refill(now)
+            g._decay_rate(now)
+            if not pool_busy and not self._contended_locked(now):
+                return True, 0, ""      # work-conserving slack
+            # over budget = the bucket is in DEBT: measured charges
+            # outran the share's refill past the full burst depth.  A
+            # SOLVENT group — tokens in hand, however fast its recent
+            # rate — is never shed: burst exists precisely to absorb
+            # above-share spikes (the recent-RU EWMA is reported in
+            # the verdict and drives the contention gate, not the
+            # shed itself)
+            if g.tokens > 0.0:
+                return True, 0, ""
+            g.throttles += 1
+            g.sheds += 1
+            debt = max(0.0, -g.tokens)
+            rate = g.ru_rate
+            share = g.share
+            hint = g.refill_ms(GLOBAL_MODEL.ru(requests=1), now)
+        self._note(name, "shed")
+        return False, hint, (
+            f"resource group {name!r} over budget: {debt:.1f} RU debt, "
+            f"{rate:.1f} RU/s recent vs {share:.1f} RU/s share")
+
+    # -- enforcement site 1: coalescer stacked-lane selection ---------
+
+    def select_stacked(self, members, capacity: int, *,
+                       window_s: float = 0.0,
+                       reserve_s: float = 0.0) -> tuple:
+        """Deficit-weighted fair queuing over a closed group's parked
+        members: → ``(selected, deferred)``.
+
+        ``members`` carry ``.tag`` / ``.deadline_at`` / ``.rc_defers``
+        (the coalescer's ``_Member``).  Deadline-urgent members — those
+        that could not afford another collection window — are ALWAYS
+        selected (the zero-late-acks contract outranks fairness), as
+        are members already deferred :data:`MAX_DEFERS` times.  The
+        remaining lanes fill by DWFQ over the members' groups, shares
+        as weights, with throttled groups eligible only for slack
+        lanes (work-conserving).  Everyone not selected is deferred —
+        the caller re-parks them into the key's next window; nothing
+        is ever dropped here."""
+        members = list(members)
+        if not self.enabled or len(members) <= 1 or capacity <= 0:
+            return members, []
+        now = time.monotonic()
+        tenants = {ResourceTagFactory.tenant(m.tag) for m in members}
+
+        def urgent(m) -> bool:
+            # the zero-late-acks contract outranks fairness AND the
+            # lane bound: a member that cannot afford another window,
+            # or one already deferred MAX_DEFERS times, dispatches now
+            return (getattr(m, "rc_defers", 0) >= self.MAX_DEFERS or
+                    window_s <= 0.0 or
+                    (m.deadline_at is not None and
+                     m.deadline_at - now <
+                     reserve_s + 2.0 * window_s))
+
+        if len(tenants) <= 1:
+            # one tenant owns every lane: deferring below capacity
+            # would add latency without freeing a lane for anyone
+            # else (work-conserving) — but the lane bound still holds
+            # for a deferral-merged group that outgrew capacity
+            # (urgent members are exempt even from the trim: re-parked
+            # members land at the back of the next group and must not
+            # be starved behind fresh arrivals window after window)
+            if len(members) <= capacity:
+                return members, []
+            must = [m for m in members if urgent(m)]
+            rest = [m for m in members if not urgent(m)]
+            fill = max(0, capacity - len(must))
+            sel, deferred = must + rest[:fill], rest[fill:]
+            with self._mu:
+                g = self._group_locked(next(iter(tenants)))
+                for m in deferred:
+                    m.rc_defers = getattr(m, "rc_defers", 0) + 1
+                    g.deferrals += 1
+            for m in deferred:
+                self._note(ResourceTagFactory.tenant(m.tag), "defer")
+            return sel, deferred
+        selected: list = []
+        queues: dict[str, deque] = {}
+        for m in members:
+            if urgent(m):
+                selected.append(m)
+            else:
+                t = ResourceTagFactory.tenant(m.tag)
+                queues.setdefault(t, deque()).append(m)
+        slots = capacity - len(selected)
+        with self._mu:
+            # share fractions are computed over EVERY tenant present
+            # in the group (urgent members included): a throttled
+            # tenant left alone in the electable queues must not read
+            # as "100% of the shares" just because its competitor's
+            # member went urgent
+            states = {t: self._group_locked(t) for t in tenants}
+            throttled = {t for t in queues
+                         if states[t].throttled(now)}
+            # lane quota for THROTTLED tenants: enforcement here IS
+            # the deferral — a group in RU debt gets only its
+            # share-proportional slice of the stacked lanes per
+            # window (never less than one: throttled, not starved)
+            # while a solvent tenant shares the dispatch with it, so
+            # its stacked throughput is paced down to the share its
+            # bucket refills at.  Solvent tenants are never capped
+            # (they paid), and a single-tenant group skipped
+            # enforcement above entirely (work-conserving: the whole
+            # dispatch is its slack).
+            wsum = sum(g.share for g in states.values()) or 1.0
+            quota = {t: max(1, int(states[t].share / wsum *
+                                   max(1, capacity)))
+                     for t in throttled}
+            taken = {t: 0 for t in queues}
+            rings = ([t for t in queues if t not in throttled],
+                     sorted(throttled))
+            for ring_i, ring in enumerate(rings):
+                while slots > 0:
+                    live = [t for t in ring if queues[t] and
+                            (ring_i == 0 or taken[t] < quota[t])]
+                    if not live:
+                        break
+                    lsum = sum(states[t].share for t in live) or 1.0
+                    for t in live:
+                        g = states[t]
+                        g.deficit = min(self.DEFICIT_CLAMP,
+                                        g.deficit + g.share / lsum)
+                    pick = max(live,
+                               key=lambda t: (states[t].deficit, t))
+                    states[pick].deficit = max(-self.DEFICIT_CLAMP,
+                                               states[pick].deficit
+                                               - 1.0)
+                    selected.append(queues[pick].popleft())
+                    taken[pick] += 1
+                    slots -= 1
+            deferred = [m for q in queues.values() for m in q]
+            for m in deferred:
+                m.rc_defers = getattr(m, "rc_defers", 0) + 1
+                states[ResourceTagFactory.tenant(m.tag)].deferrals += 1
+        for m in deferred:
+            self._note(ResourceTagFactory.tenant(m.tag), "defer")
+        return selected, deferred
+
+    # -- enforcement site 2: arena eviction bias ----------------------
+
+    def hbm_standing(self, tenant_bytes: dict,
+                     capacity: int) -> dict:
+        """Per-sweep scoring snapshot for the arena's tenant-aware
+        eviction: ``{tenant: (limit_bytes, ru_debt)}`` in ONE
+        controller-lock acquisition — the sweep runs under the arena
+        mutex and must not pay a cross-lock round trip per entry per
+        eviction.  ``limit_bytes`` is the tenant's share-fraction of
+        the budget; a tenant is over share while its resident bytes
+        exceed it."""
+        if not self.enabled or capacity <= 0:
+            return {t: (float("inf"), 0.0) for t in tenant_bytes}
+        now = time.monotonic()
+        with self._mu:
+            shares = {t: self._group_locked(t).share
+                      for t in tenant_bytes}
+            debts = {t: self._group_locked(t).debt(now)
+                     for t in tenant_bytes}
+        wsum = sum(shares.values())
+        if wsum <= 0:
+            return {t: (float("inf"), debts[t]) for t in tenant_bytes}
+        return {t: ((shares[t] / wsum) * capacity, debts[t])
+                for t in tenant_bytes}
+
+    def note_evictions(self, counts: dict) -> None:
+        """Tenant-biased evictions from ONE arena sweep, tallied in a
+        single controller-lock acquisition — the sweep runs under the
+        arena mutex and must not pay a cross-lock round trip per
+        victim (the hbm_standing discipline, write side)."""
+        from .utils.metrics import RC_ACTION_COUNTER
+        if not self.enabled or not counts:
+            return
+        folded = []
+        with self._mu:
+            for tenant, n in counts.items():
+                g = self._group_locked(tenant)
+                g.evictions += n
+                folded.append((g.name, n))
+        for name, n in folded:
+            RC_ACTION_COUNTER.labels(name, "evict").inc(n)
+
+    def note_protected(self, nbytes: int) -> None:
+        """An eviction sweep finished with ``nbytes`` of under-share
+        tenants' feeds left resident while over-share state was
+        evicted — the protection actually held."""
+        from .utils.metrics import RC_PROTECTED_BYTES_GAUGE
+        with self._mu:
+            self.protected_bytes = int(nbytes)
+            self.protect_events += 1
+        RC_PROTECTED_BYTES_GAUGE.set(int(nbytes))
+
+    # -- observability ------------------------------------------------
+
+    def _note(self, group: str, action: str) -> None:
+        from .utils.metrics import RC_ACTION_COUNTER
+        with self._mu:
+            if group not in self._groups:
+                # the group's STATE was folded into the overflow
+                # entry (bounded map) — its metric series must fold
+                # the same way, or request-supplied group strings
+                # mint unbounded label children
+                group = self.OVERFLOW
+        RC_ACTION_COUNTER.labels(group, action).inc()
+
+    def stats(self) -> dict:
+        from .utils.metrics import RC_TOKENS_GAUGE
+        now = time.monotonic()
+        with self._mu:
+            groups = {name: g.stats(now)
+                      for name, g in sorted(self._groups.items())}
+            out = {
+                "enabled": self.enabled,
+                "default_share": self.default_share,
+                "default_burst": self.default_burst,
+                "groups": groups,
+                "throttles": sum(g.throttles
+                                 for g in self._groups.values()),
+                "deferrals": sum(g.deferrals
+                                 for g in self._groups.values()),
+                "sheds": sum(g.sheds for g in self._groups.values()),
+                "evictions": sum(g.evictions
+                                 for g in self._groups.values()),
+                "forced_throttles": self.forced_throttles,
+                "protected_bytes": self.protected_bytes,
+                "protect_events": self.protect_events,
+            }
+        for name, g in groups.items():
+            RC_TOKENS_GAUGE.labels(name).set(g["tokens"])
+        return out
+
+    def health_stats(self) -> dict:
+        return self.stats()
+
+    def reset(self) -> None:
+        """Drop every group state and disable — test teardown (the
+        controller is process-global; one test's shares must not
+        leak into the next).  Dead groups' gauge series retire with
+        them (the registry remove() discipline)."""
+        from .utils.metrics import RC_TOKENS_GAUGE
+        with self._mu:
+            self.enabled = False
+            self.default_share = 500.0
+            self.default_burst = 0.0
+            names = list(self._groups)
+            self._groups.clear()
+            self.forced_throttles = 0
+            self.protected_bytes = 0
+            self.protect_events = 0
+        for n in names:
+            RC_TOKENS_GAUGE.remove(n)
+
+
+GLOBAL_CONTROLLER = ResourceController()
+
+# every measured charge the metering recorder lands debits the paying
+# group's bucket — the ledger IS the drain side of enforcement
+GLOBAL_RECORDER.subscribe_charges(GLOBAL_CONTROLLER.on_charge)

@@ -43,8 +43,13 @@ Model:
   top-k hot-tenant/hot-region report serves the rebuilt
   ``/resource_metering`` status route and rides the store heartbeat to
   PD (``maybe_report``), where ``MockPd.hot_regions`` merges it
-  cluster-wide (the load signal the SlicePlacer and the enforcement PR
-  consume).
+  cluster-wide (the load signal the SlicePlacer consumes);
+- every landed charge is also streamed to registered charge listeners
+  (``subscribe_charges``): :mod:`tikv_tpu.resource_control` drains its
+  per-group token buckets from exactly this stream, so the enforcement
+  sites (coalescer fair-share, tenant-aware arena eviction, RU-priced
+  read-pool shed) act on the same measured figures this module
+  reports — measurement and enforcement cannot drift apart.
 
 Every knob (window_s, topk, max_resource_groups, report_interval_s,
 RU weights) is online-updatable through ``[resource-metering]`` in
@@ -267,6 +272,11 @@ class Recorder:
         self._last_push = 0.0
         self._last_report: dict = {}
         self._subs: list = []
+        # per-charge listeners (fn(site, tag, ru)), called OUTSIDE the
+        # recorder lock: the resource controller drains its token
+        # buckets from this stream — the measured ledger IS the debit
+        # side of enforcement (resource_control.py)
+        self._charge_subs: list = []
         self._res_sources: "weakref.WeakSet" = weakref.WeakSet()
         self.windows_rolled = 0
         self.reports_built = 0
@@ -479,6 +489,11 @@ class Recorder:
                 add_ru = getattr(tracker, "add_ru", None)
                 if add_ru is not None:
                     add_ru(ru)
+            for fn in self._charge_subs:
+                try:
+                    fn(site, tag, ru)
+                except Exception:   # noqa: BLE001 — a listener must
+                    pass            # not poison the charge path
 
     def _fold_tag_locked(self, tag) -> str:
         """Bound the live-tag set: a NEW tag arriving with the map at
@@ -522,6 +537,12 @@ class Recorder:
         """callback(report: dict[tag, TagRecord]) per window close —
         the pubsub seam (reference pubsub.rs datasinks)."""
         self._subs.append(callback)
+
+    def subscribe_charges(self, callback) -> None:
+        """callback(site, tag, ru) per landed charge, called outside
+        the recorder lock — the resource controller's debit stream
+        (resource_control.GLOBAL_CONTROLLER registers here)."""
+        self._charge_subs.append(callback)
 
     def harvest(self) -> dict:
         """Close the window NOW and return its per-tag records: top

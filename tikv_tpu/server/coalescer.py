@@ -270,7 +270,7 @@ class _Member:
     """One request parked in a collection window."""
 
     __slots__ = ("dag", "storage", "future", "tracker", "tag",
-                 "deadline_at", "t_submit_ns")
+                 "deadline_at", "t_submit_ns", "rc_defers")
 
     def __init__(self, dag, storage, future, tracker, tag, deadline_at):
         self.dag = dag
@@ -280,6 +280,9 @@ class _Member:
         self.tag = tag
         self.deadline_at = deadline_at
         self.t_submit_ns = time.perf_counter_ns()
+        # collection windows this member was DWFQ-deferred past
+        # (resource_control.select_stacked bounds it at MAX_DEFERS)
+        self.rc_defers = 0
 
 
 class _Group:
@@ -341,6 +344,10 @@ class RequestCoalescer:
         self.occupancy_sum = 0
         self.max_observed_occupancy = 0
         self.closes: dict[str, int] = {}
+        # resource-control deferrals: members a closed group's DWFQ
+        # selection re-parked into the key's next window (never
+        # dropped — they dispatch later, solo, or at shutdown inline)
+        self.rc_deferrals = 0
         # plan-IR share class (endpoint.handle_plan): in-flight
         # executions keyed by (plan identity, snapshot generations);
         # a byte-identical concurrent join plan JOINS the running
@@ -553,6 +560,29 @@ class RequestCoalescer:
             _BatchUnavailable,
         )
         members = group.members
+        # resource control (resource_control.py): stacked-group
+        # membership is chosen by deficit-weighted fair queuing over
+        # the parked members' groups instead of FIFO — one tenant's
+        # members can never monopolize a stacked dispatch.  Members
+        # the DWFQ passes over are DEFERRED into the key's next
+        # window (never dropped), deadline-urgent members are always
+        # selected (the zero-late-acks close guarantee outranks
+        # fairness), and the selection is work-conserving (throttled
+        # groups ride slack lanes).  Disabled controller → one branch.
+        if group.key[0] == "stack" and len(members) > 1 and \
+                not self._shutdown:
+            # (_shutdown re-checked under the lock in _defer_members;
+            # a teardown-time group must dispatch whole — re-selecting
+            # members a shutdown requeue just handed back would loop)
+            from ..resource_control import GLOBAL_CONTROLLER as _rc
+            if _rc.enabled:
+                reserve = max(self.RESERVE_FLOOR_S,
+                              8.0 * self.router.launch_ewma)
+                members, deferred = _rc.select_stacked(
+                    members, self.max_group,
+                    window_s=self.window_s, reserve_s=reserve)
+                if deferred:
+                    self._defer_members(group.key, deferred)
         size = len(members)
         COPR_BATCH_OCCUPANCY.observe(size)
         with self._mu:
@@ -677,6 +707,52 @@ class RequestCoalescer:
                 resolve = (lambda r=d: r)
             self._complete(m, resolve, t_ns - m.t_submit_ns)
 
+    def _defer_members(self, key, members) -> None:
+        """Re-park DWFQ-deferred members into ``key``'s next
+        collection window.  The member object (future, tracker, tag,
+        submit time) travels whole, so its MeterContext and trace
+        survive the deferral and its coalesce_wait keeps accumulating;
+        the request-base RU was charged once at admission and is NOT
+        re-charged on re-admission (exactly-once across deferral).
+        A teardown racing the requeue dispatches inline instead —
+        a parked member is never abandoned."""
+        now = time.monotonic()
+        reserve = max(self.RESERVE_FLOOR_S,
+                      8.0 * self.router.launch_ewma)
+        inline = None
+        with self._cv:
+            self.rc_deferrals += len(members)
+            # the members return to PARKED state: the close that
+            # counted them in-flight is being partially unwound
+            self._inflight = max(0, self._inflight - len(members))
+            if self._shutdown:
+                g = _Group(key, now)
+                g.members.extend(members)
+                g.closed = True
+                self._inflight += len(members)
+                inline = g
+            else:
+                g = self._open.get(key)
+                if g is None or g.closed:
+                    g = _Group(key, now + self.window_s)
+                    self._open[key] = g
+                g.members.extend(members)
+                for m in members:
+                    if m.deadline_at is not None:
+                        rem = m.deadline_at - now
+                        g.close_at = min(
+                            g.close_at, m.deadline_at - reserve,
+                            now + self.WAIT_FRACTION * rem)
+                if len(g.members) >= self.max_group:
+                    # the size contract holds for deferral-merged
+                    # groups too; the next dispatch's selection
+                    # re-paces throttled surplus (and select_stacked
+                    # enforces the lane bound even single-tenant)
+                    self._close_locked(g, "size")
+                self._cv.notify()
+        if inline is not None:
+            self._dispatch(inline)
+
     def _complete(self, m: _Member, resolve, wait_ns: int) -> None:
         """Hand the member's resolution (shared fetch join + its own
         host gather) to the completion pool; its result lands on the
@@ -771,6 +847,7 @@ class RequestCoalescer:
                     self.occupancy_sum / groups, 3) if groups else 0.0,
                 "max_occupancy": self.max_observed_occupancy,
                 "solo_degrade": self.solo_degrade,
+                "rc_deferrals": self.rc_deferrals,
                 "closes": dict(self.closes),
                 "plan_share_groups": self.plan_share_groups,
                 "plan_share_hits": self.plan_share_hits,

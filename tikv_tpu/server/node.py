@@ -554,10 +554,28 @@ class Node:
         self._metering_cfg(
             {f.name: getattr(config.resource_metering, f.name)
              for f in dataclasses.fields(config.resource_metering)})
+        # multi-tenant resource control (resource_control.py): the
+        # process-global controller adopts this node's [resource-
+        # control] knobs — per-group shares/bursts/priority tiers
+        # enforced at the coalescer window, the feed arena's eviction
+        # sweep, and the read pool's admission gate
+        self._rc_cfg(
+            {f.name: getattr(config.resource_control, f.name)
+             for f in dataclasses.fields(config.resource_control)})
         # online reconfig (online_config ConfigManager registrations)
         self.config_controller.register("coprocessor", self._copr_cfg)
         self.config_controller.register("resource_metering",
                                         self._metering_cfg)
+        self.config_controller.register("resource_control",
+                                        self._rc_cfg)
+
+    def _rc_cfg(self, diff: dict) -> None:
+        from ..resource_control import GLOBAL_CONTROLLER
+        GLOBAL_CONTROLLER.configure(
+            enabled=diff.get("enabled"),
+            default_share=diff.get("default_share"),
+            default_burst=diff.get("default_burst"),
+            groups=diff.get("groups"))
 
     def _metering_cfg(self, diff: dict) -> None:
         from ..resource_metering import GLOBAL_RECORDER

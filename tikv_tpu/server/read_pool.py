@@ -26,7 +26,16 @@ Overload defense on top of the watermark:
   EWMA for unseen classes: a 10M-row hash-agg and a point-select no
   longer share one figure, so shed decisions and ``retry_after_ms``
   hints reflect the actual cost mix instead of whichever shape ran
-  last.
+  last;
+- RU-priced PER-GROUP shedding (resource_control.py): admission also
+  compares the request's resource group's RU debt and recent-RU-rate
+  EWMA against its configured share — the one-figure-for-everyone
+  framing stops here: a background scan group deep in measured RU
+  debt sheds (with a ``retry_after_ms`` derived from ITS token
+  bucket's refill time, and the ``ServerIsBusy`` response carrying
+  the group name) while a latency group's requests keep flowing.
+  Work-conserving: an over-budget group is shed only while the pool
+  actually has contention, and high-priority groups never shed here.
 """
 
 from __future__ import annotations
@@ -45,11 +54,16 @@ from ..utils.metrics import (
 
 class ServerIsBusy(Exception):
     def __init__(self, reason: str = "read pool saturated",
-                 retry_after_ms: int = 0):
+                 retry_after_ms: int = 0,
+                 resource_group: "str | None" = None):
         super().__init__(reason)
         self.reason = reason
         # queue-depth-derived backoff hint (0 = none); rides the wire
         self.retry_after_ms = retry_after_ms
+        # RU-priced per-group shed (resource_control.py): the group
+        # that was over budget — rides the wire so a client can tell
+        # "my group is throttled" from "the whole store is busy"
+        self.resource_group = resource_group
 
 
 class ReadPool:
@@ -72,6 +86,7 @@ class ReadPool:
         self.served = 0
         self.rejected = 0
         self.deadline_shed = 0
+        self.rc_shed = 0        # RU-priced per-group rejections
         self.running = 0
         self.running_peak = 0
         self.ema_service_time = 0.0
@@ -110,7 +125,8 @@ class ReadPool:
         return max(1, int(1000.0 * ema * waiting / self._max_concurrency))
 
     def run(self, fn, priority: str = "normal",
-            deadline: "Deadline | None" = None, class_key=None):
+            deadline: "Deadline | None" = None, class_key=None,
+            resource_group=None):
         """Execute ``fn`` under the pool's concurrency cap.
 
         Raises ServerIsBusy when the pending watermark is exceeded
@@ -121,6 +137,10 @@ class ReadPool:
         unservable).  ``class_key`` selects the per-compile-class EWMA
         for the shed comparison and the retry hint; the observed
         service time updates both that class and the global figure.
+        ``resource_group`` feeds the RU-priced per-group admission
+        gate (resource_control.py): an over-budget group sheds under
+        pool contention with a retry hint derived from its own token
+        bucket's refill time.
         """
         if deadline is not None:
             deadline.check("read_pool")      # expired: typed shed
@@ -136,6 +156,31 @@ class ReadPool:
                     f"remaining budget {rem * 1e3:.1f}ms < ema service "
                     f"time {ema * 1e3:.1f}ms",
                     retry_after_ms=self.retry_after_ms(class_key))
+        # RU-priced per-group admission (enforcement site 3, module
+        # doc), AFTER the deadline gate: an already-expired request
+        # must get the typed deadline shed, never a retryable busy
+        # its group's refill time would make it sleep on.  Before the
+        # watermark: an over-budget group is shed before it can
+        # occupy pending-queue headroom, and the copr::rc_throttle
+        # failpoint fires even for requests the watermark would
+        # admit.  Gated on one attribute read + a non-firing
+        # failpoint peek — the shipped default (controller off, site
+        # cold) pays no extra lock round trip.
+        from ..resource_control import GLOBAL_CONTROLLER as _rc
+        from ..utils.failpoint import is_armed as _fp_armed
+        if _rc.enabled or _fp_armed("copr::rc_throttle"):
+            with self._mu:
+                busy = (self._pending - self.running) > 0 or \
+                    self.running >= self._max_concurrency
+            ok, rc_hint, rc_reason = _rc.admit(resource_group,
+                                               pool_busy=busy)
+            if not ok:
+                with self._mu:
+                    self.rc_shed += 1
+                    self.rejected += 1
+                raise ServerIsBusy(rc_reason, retry_after_ms=rc_hint,
+                                   resource_group=resource_group
+                                   or "default")
         with self._mu:
             if self._closed:
                 raise ServerIsBusy("read pool shut down")
@@ -239,6 +284,7 @@ class ReadPool:
                     "pending": max(0, self._pending - self.running),
                     "served": self.served, "rejected": self.rejected,
                     "deadline_shed": self.deadline_shed,
+                    "rc_shed": self.rc_shed,
                     "ema_service_time_ms":
                         round(self.ema_service_time * 1e3, 3),
                     "ema_classes": len(self._class_ema)}

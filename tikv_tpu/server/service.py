@@ -210,7 +210,8 @@ class KvService:
                 resp = self._guard(
                     lambda r: self.read_pool.run(
                         lambda: fn(r), prio, deadline=dl,
-                        class_key=class_key), req)
+                        class_key=class_key,
+                        resource_group=group), req)
                 d = resp.pop("__deferred", None) \
                     if isinstance(resp, dict) else None
                 if d is not None:

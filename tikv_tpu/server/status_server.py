@@ -165,6 +165,13 @@ class StatusServer:
                     from ..resource_metering import GLOBAL_RECORDER
                     body["resource_metering"] = \
                         GLOBAL_RECORDER.health_stats()
+                    # multi-tenant resource control rollup: per-group
+                    # tokens/debt/share, throttle + deferral + shed
+                    # counters, protected-bytes (enforcement of the
+                    # charges the metering rollup above measures)
+                    from ..resource_control import GLOBAL_CONTROLLER
+                    body["resource_control"] = \
+                        GLOBAL_CONTROLLER.health_stats()
                     self._json(200, body)
                 elif path == "/config":
                     if outer._controller is None:
@@ -186,6 +193,8 @@ class StatusServer:
                     self._json(200, groups)
                 elif path == "/resource_metering":
                     self._get_resource_metering()
+                elif path == "/resource_control":
+                    self._get_resource_control()
                 elif path == "/debug/pprof/profile":
                     # ?seconds=N (default 1): folded-stacks CPU profile
                     # (status_server profile.rs dump_one_cpu_profile)
@@ -276,6 +285,60 @@ class StatusServer:
                         lines.append(
                             f"  untagged residual: "
                             f"ru={win['untagged']['ru']}")
+                self._reply(200, ("\n".join(lines) + "\n").encode(),
+                            "text/plain; charset=utf-8")
+
+            def _get_resource_control(self):
+                """Per-group enforcement state: share/burst/priority,
+                live token level + RU debt, recent-RU rate, throttle/
+                deferral/shed/eviction counters, protected-bytes.
+                Default: a text table; ``?format=json``: the machine
+                shape (what /health embeds), plus the device runner's
+                per-tenant HBM residency when one is attached."""
+                from ..resource_control import GLOBAL_CONTROLLER
+                body = GLOBAL_CONTROLLER.stats()
+                node = outer._node
+                dr = getattr(node, "device_runner", None) \
+                    if node is not None else None
+                if dr is not None and hasattr(dr, "hbm_stats"):
+                    body["residency_by_tenant"] = \
+                        dr.hbm_stats().get("residency_by_tenant", {})
+                fmt = ""
+                q = self.path.split("?", 1)
+                if len(q) == 2:
+                    for kv in q[1].split("&"):
+                        if kv.startswith("format="):
+                            fmt = kv[len("format="):]
+                if fmt == "json":
+                    self._json(200, body)
+                    return
+                lines = ["# resource control — per-group enforcement "
+                         "(?format=json for the machine shape)",
+                         f"enabled={body['enabled']} "
+                         f"default_share={body['default_share']} "
+                         f"deferrals={body['deferrals']} "
+                         f"sheds={body['sheds']} "
+                         f"evictions={body['evictions']} "
+                         f"protected_bytes={body['protected_bytes']}",
+                         "",
+                         f"{'group':<24}{'share':>10}{'burst':>10}"
+                         f"{'prio':>8}{'tokens':>12}{'debt':>10}"
+                         f"{'ru/s':>10}{'shed':>7}{'defer':>7}"
+                         f"{'evict':>7}"]
+                for name, g in body["groups"].items():
+                    lines.append(
+                        f"{name:<24}{g['share']:>10}{g['burst']:>10}"
+                        f"{g['priority']:>8}{g['tokens']:>12}"
+                        f"{g['debt']:>10}{g['ru_rate_ewma']:>10}"
+                        f"{g['sheds']:>7}{g['deferrals']:>7}"
+                        f"{g['evictions']:>7}")
+                res = body.get("residency_by_tenant") or {}
+                if res:
+                    lines.append("")
+                    lines.append("HBM residency by tenant:")
+                    for t, b in sorted(res.items(),
+                                       key=lambda kv: -kv[1]):
+                        lines.append(f"  {t}: {b} bytes")
                 self._reply(200, ("\n".join(lines) + "\n").encode(),
                             "text/plain; charset=utf-8")
 

@@ -143,6 +143,11 @@ def _enc_error_body(e: Exception) -> dict:
             # queue-depth-derived backoff hint: clients sleep THIS
             # long instead of blind exponential jitter
             out["retry_after_ms"] = e.retry_after_ms
+        if getattr(e, "resource_group", None):
+            # RU-priced per-group shed (resource_control.py): the
+            # client learns WHICH group is over budget, not just
+            # "the store is busy"
+            out["resource_group"] = e.resource_group
         return out
     from ..utils.deadline import DeadlineExceeded
     if isinstance(e, DeadlineExceeded):

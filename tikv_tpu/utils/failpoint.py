@@ -132,6 +132,40 @@ def hits(name: str) -> int:
     return _hit_counts.get(name, 0)
 
 
+def is_armed(name: str) -> bool:
+    """Non-firing peek: is an action chain configured for ``name``?
+    Unlike :func:`fail_point` this never consumes a count-limited
+    action — gate code uses it to skip a whole instrumented branch
+    when the site is cold (and the subsystem is otherwise off)."""
+    reg = _registry
+    return reg is not None and name in reg
+
+
+def peek_value(name: str):
+    """The next pending action's argument, WITHOUT firing: sites that
+    filter on the argument (``copr::rc_throttle`` matches it against
+    a group name) must decide relevance first and only then call
+    :func:`fail_point` — otherwise a count-limited targeted action is
+    burned by traffic it was never aimed at.  None when unarmed,
+    exhausted, or the action carries no argument."""
+    reg = _registry
+    chain = reg.get(name) if reg else None
+    if not chain:
+        return None
+    for action in chain:
+        if callable(action):
+            return None
+        if action.cnt is not None and action.fired >= action.cnt:
+            continue
+        # only a ``return`` action's argument is a value the site can
+        # filter on — a sleep(50)/delay(5) arg misread as a filter
+        # would silently disable the site for every caller
+        if action.task != "return":
+            continue
+        return action.arg
+    return None
+
+
 class _Return:
     __slots__ = ("value",)
 

@@ -453,9 +453,26 @@ RU_TAG_GAUGE = REGISTRY.gauge(
 RU_REQUEST_HISTOGRAM = REGISTRY.histogram(
     "tikv_resource_metering_request_ru",
     "request units charged per read RPC (sealed with the trace; the "
-    "per-tenant fair-share enforcement PR's admission input)",
+    "resource controller's admission input — resource_control.py)",
     buckets=(0.125, 0.25, 0.5, 1, 2, 4, 8, 16, 32, 64, 128, 256,
              512, 1024))
+RC_ACTION_COUNTER = REGISTRY.counter(
+    "tikv_resource_control_actions_total",
+    "resource-control enforcement actions per group "
+    "(resource_control.py: shed = RU-priced read-pool rejection, "
+    "defer = coalescer DWFQ deferral to the next window, evict = "
+    "tenant-biased arena eviction)",
+    labels=("group", "action"))
+RC_TOKENS_GAUGE = REGISTRY.gauge(
+    "tikv_resource_control_tokens",
+    "resource-control token-bucket level per group (negative = RU "
+    "debt; refills at the group's configured share)",
+    labels=("group",))
+RC_PROTECTED_BYTES_GAUGE = REGISTRY.gauge(
+    "tikv_resource_control_protected_bytes",
+    "under-share tenants' HBM feed bytes left resident by the last "
+    "tenant-aware eviction sweep that evicted over-share state — the "
+    "latency tenant's working set the share protected")
 SCHED_COMMANDS = REGISTRY.counter(
     "tikv_scheduler_commands_total", "txn scheduler commands",
     labels=("type",))

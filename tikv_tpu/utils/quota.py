@@ -11,6 +11,15 @@ plus 1 RU per 4 KiB touched.  A group's token bucket refills at
 ``ru_per_sec``; callers over budget BLOCK until tokens accrue (the
 reference's limiter queues futures the same way), so a runaway
 analytical group cannot starve the default group's point reads.
+
+Scope note: this is the legacy FRONT-END quota (simple bytes/requests
+estimate, blocking).  The device-aware enforcement layer lives in
+:mod:`tikv_tpu.resource_control` — token buckets drained by the
+MEASURED RU charges of :mod:`tikv_tpu.resource_metering` (launch
+wall, D2H, HBM residency, host wall), acting non-blockingly at the
+coalescer window, the feed arena's eviction sweep, and the read
+pool's admission gate.  Groups configured here (POST
+/resource_groups) and there ([resource-control]) are independent.
 """
 
 from __future__ import annotations
