@@ -381,14 +381,20 @@ def test_failpoint_inventory_resolves():
     # resource control: copr::rc_throttle — force-throttle a named
     # resource group (value = group; bare return = every group) at
     # the RU-priced read-pool admission gate, so the shed path and
-    # its group-derived retry_after_ms are steerable without a load)
-    assert len(sites) >= 72, f"only {len(sites)} unique sites"
+    # its group-derived retry_after_ms are steerable without a load;
+    # ≥73 since the microsecond warm path: copr::fastpath — the
+    # compiled request fast path's force-miss / force-full-decode /
+    # corrupt-fingerprint arms (value = miss|full|corrupt), proving
+    # every arm falls back to the full decode path instead of ever
+    # serving a mis-extracted template)
+    assert len(sites) >= 73, f"only {len(sites)} unique sites"
     for dev_site in ("device::hbm_oom", "device::feed_corrupt",
                      "device::d2h_corrupt", "copr::coalesce_dispatch",
                      "copr::coalesce_window", "device::mvcc_resolve",
                      "device::shard_launch", "device::slice_dead",
                      "device::mesh_rebuild", "device::join_dispatch",
-                     "copr::plan_route", "copr::rc_throttle"):
+                     "copr::plan_route", "copr::rc_throttle",
+                     "copr::fastpath"):
         assert dev_site in sites, f"missing fault site {dev_site}"
 
     nemesis_src = (root / "chaos" / "nemesis.py").read_text()

@@ -34,6 +34,7 @@ from .nemesis import (           # noqa: F401
     CRASH_SITES,
     DEGRADE_SITES,
     DEVICE_FAULT_KINDS,
+    FASTPATH_FAULT_KINDS,
     FAULT_KINDS,
     PLAN_FAULT_KINDS,
     TENANT_FAULT_KINDS,

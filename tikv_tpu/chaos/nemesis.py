@@ -69,6 +69,18 @@ PLAN_FAULT_KINDS = ("plan_fault",)
 # one named group with no load at all.
 TENANT_FAULT_KINDS = ("tenant_storm",)
 
+# microsecond-warm-path faults (own tuple, seeded-schedule stability):
+# fastpath_fault arms the copr::fastpath site with one of its three
+# arms — force-miss (every request takes the full decode path),
+# force-full-decode (same, but counted distinctly so a schedule can
+# tell deliberate bypass from template misses), or corrupt-fingerprint
+# (a cached template's fixed segment is bit-flipped IN PLACE before
+# matching).  The invariant under all three: wrong answers are
+# IMPOSSIBLE — the corrupted/missed template can only fail to match,
+# which routes the request to the full decode path; chaos schedules
+# assert responses stay byte-equal to an unfaulted control.
+FASTPATH_FAULT_KINDS = ("fastpath_fault",)
+
 # the plain degrade-to-host failpoint sites the device_degrade nemesis
 # rotates over; the remaining device::* sites have dedicated kinds
 # above (the inventory test asserts the union covers EVERY device::*
@@ -150,6 +162,10 @@ def generate_schedule(seed: int, steps: int,
         elif kind == "tenant_storm":
             out.append(_mk(kind, group="storm",
                            ru=rng.choice((2000.0, 5000.0, 10000.0))))
+        elif kind == "fastpath_fault":
+            out.append(_mk(kind, arm=rng.choice(("miss", "full",
+                                                 "corrupt")),
+                           pct=rng.choice((25, 50, 100))))
         else:   # pragma: no cover
             raise ValueError(kind)
     return out
@@ -278,6 +294,17 @@ class Nemesis:
         site = fault.param("site", DEGRADE_SITES[0])
         failpoint.cfg(site, f"{fault.param('pct', 100)}%return")
         self._heals.append(lambda s=site: failpoint.remove(s))
+
+    def _apply_fastpath_fault(self, fault: Fault) -> None:
+        """Arm one copr::fastpath arm (FASTPATH_FAULT_KINDS doc): the
+        fast path must fall back to the full decode path under every
+        arm — a corrupted template can only fail to match, never
+        mis-extract, so wrong answers are impossible by construction
+        (the chaos run's answer-parity invariant asserts it)."""
+        arm = fault.param("arm", "miss")
+        pct = fault.param("pct", 100)
+        failpoint.cfg("copr::fastpath", f"{pct}%return({arm})")
+        self._heals.append(lambda: failpoint.remove("copr::fastpath"))
 
     def _apply_tenant_storm(self, fault: Fault) -> None:
         """One tenant's request flood, modeled at the RU ledger: a

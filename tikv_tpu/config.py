@@ -151,6 +151,15 @@ class CoprocessorConfig:
     trace_buffer: int = 256
     slow_log_threshold_ms: float = 1000.0
     flight_recorder_depth: int = 256
+    # microsecond warm path (server/fastpath.py + server/coalescer.py):
+    # fastpath_classes bounds the learned wire-template cache (0
+    # disables the compiled request fast path entirely — every request
+    # takes the full decode pipeline); dispatch_pipeline enables the
+    # coalescer's back-to-back dispatcher (collection overlaps the
+    # in-flight launch, and a drained device is fed the oldest open
+    # group early instead of waiting out its window)
+    fastpath_classes: int = 64
+    dispatch_pipeline: bool = True
 
 
 @dataclass
@@ -325,6 +334,8 @@ _ONLINE_FIELDS = {
     "coprocessor.trace_buffer",
     "coprocessor.slow_log_threshold_ms",
     "coprocessor.flight_recorder_depth",
+    "coprocessor.fastpath_classes",
+    "coprocessor.dispatch_pipeline",
     "readpool.concurrency",
     "resource_metering.window_s",
     "resource_metering.topk",

@@ -52,6 +52,11 @@ class CopRequest:
     # request_source — resource_metering tag.rs)
     resource_group: str = "default"
     request_source: str = ""
+    # fast-path learning channel (server/fastpath.py): when the service
+    # wants to learn a wire template from this request, it installs a
+    # dict here and the endpoint/node fill in what the execution
+    # learned (storage, backend, route decision, batch key, region)
+    fp_learn: Optional[dict] = None
 
 
 @dataclass
@@ -383,6 +388,8 @@ class Endpoint:
                     return BatchExecutorsRunner(
                         req.dag, storage).handle_request()
 
+            if req.fp_learn is not None:
+                req.fp_learn.update(storage=storage, backend=backend)
             if req.paging_size > 0:
                 backend = "host"    # pages are a host-pipeline contract
                 tracker.label("backend", "host")
@@ -411,6 +418,18 @@ class Endpoint:
             if self.coalescer is not None and req.force_backend is None:
                 decision, bkey, hint = self.coalescer.route(req.dag,
                                                             storage)
+                if req.fp_learn is not None:
+                    req.fp_learn.update(decision=decision, bkey=bkey)
+                    if decision in ("device_batched", "device_solo"):
+                        est = getattr(storage, "estimated_rows", None)
+                        n = est() if callable(est) else None
+                        req.fp_learn["n_est"] = n
+                        try:
+                            req.fp_learn["d2h_bytes"] = \
+                                self.coalescer.router._d2h_bytes(
+                                    req.dag, n)
+                        except Exception:   # noqa: BLE001 — model only
+                            pass
                 if decision == "shed":
                     from ..server.read_pool import ServerIsBusy
                     raise ServerIsBusy(
@@ -426,54 +445,127 @@ class Endpoint:
                     return CopDeferred(self, req, storage, tag, t0,
                                        backend, future=fut)
                 # device_solo falls through to the direct dispatch
-            try:
-                if self._supports_deferred():
-                    out = self._device_runner.handle_request(
-                        req.dag, storage, deferred=True)
-                else:
-                    out = self._device_runner.handle_request(req.dag,
-                                                             storage)
-            except Exception:
-                # a device fault (dispatch failure, runtime error,
-                # unreachable accelerator) degrades the query to the
-                # host pipeline instead of failing it; only an explicit
-                # force_backend="device" (parity tests) surfaces it
-                if req.force_backend == "device":
-                    raise
-                import logging
-                logging.getLogger(__name__).warning(
-                    "device backend failed; degrading to host",
-                    exc_info=True)
-                tracker.label("backend", "host")
-                tracker.label("degraded", "dispatch")
-                return CopDeferred(self, req, storage, tag, t0, "host",
-                                   result=host_exec())
-            from ..device.runner import DeferredResult
-            if not isinstance(out, DeferredResult):
-                # host fallback / zero rows / cold build: already done
-                return CopDeferred(self, req, storage, tag, t0, backend,
-                                   result=out)
-            # the request's tracker rides to the completion worker so
-            # d2h_wait/host_materialize still land in this request's
-            # TimeDetail
-            cur = tracker.current()
+            elif req.fp_learn is not None:
+                req.fp_learn.update(decision="device_solo", bkey=None)
+            return self._dispatch_device_solo(req, storage, tag, t0,
+                                              backend)
 
-            reg = region_of(storage)
-
-            def fetch():
-                tok = tracker.adopt(cur) if cur is not None else None
-                try:
-                    with GLOBAL_RECORDER.attach(tag, requests=0,
-                                                region=reg):
-                        return out.result()
-                finally:
-                    if tok is not None:
-                        tracker.uninstall(tok)
-
-            fut = self._completion().submit(
-                fetch, priority="high" if out.small else "normal")
+    def _dispatch_device_solo(self, req: CopRequest, storage, tag,
+                              t0: int, backend: str) -> "CopDeferred":
+        """The direct (uncoalesced) device dispatch tail shared by
+        ``handle_async`` and the fast path: enqueue the kernel, hand
+        the D2H fetch to the completion pool, degrade to host on a
+        dispatch fault (unless the caller forced the device)."""
+        from ..resource_metering import GLOBAL_RECORDER, region_of
+        from ..utils import tracker
+        try:
+            if self._supports_deferred():
+                out = self._device_runner.handle_request(
+                    req.dag, storage, deferred=True)
+            else:
+                out = self._device_runner.handle_request(req.dag,
+                                                         storage)
+        except Exception:
+            # a device fault (dispatch failure, runtime error,
+            # unreachable accelerator) degrades the query to the
+            # host pipeline instead of failing it; only an explicit
+            # force_backend="device" (parity tests) surfaces it
+            if req.force_backend == "device":
+                raise
+            import logging
+            logging.getLogger(__name__).warning(
+                "device backend failed; degrading to host",
+                exc_info=True)
+            tracker.label("backend", "host")
+            tracker.label("degraded", "dispatch")
+            from ..executors.runner import BatchExecutorsRunner
+            with tracker.phase("host_exec"):
+                result = BatchExecutorsRunner(
+                    req.dag, storage).handle_request()
+            return CopDeferred(self, req, storage, tag, t0, "host",
+                               result=result)
+        from ..device.runner import DeferredResult
+        if not isinstance(out, DeferredResult):
+            # host fallback / zero rows / cold build: already done
             return CopDeferred(self, req, storage, tag, t0, backend,
-                               future=fut)
+                               result=out)
+        # the request's tracker rides to the completion worker so
+        # d2h_wait/host_materialize still land in this request's
+        # TimeDetail
+        cur = tracker.current()
+
+        reg = region_of(storage)
+
+        def fetch():
+            tok = tracker.adopt(cur) if cur is not None else None
+            try:
+                with GLOBAL_RECORDER.attach(tag, requests=0,
+                                            region=reg):
+                    return out.result()
+            finally:
+                if tok is not None:
+                    tracker.uninstall(tok)
+
+        fut = self._completion().submit(
+            fetch, priority="high" if out.small else "normal")
+        return CopDeferred(self, req, storage, tag, t0, backend,
+                           future=fut)
+
+    def handle_async_fast(self, req: CopRequest, storage, ent,
+                          consts) -> "CopDeferred":
+        """Fast-path dispatch (server/fastpath.py): the decode products
+        are pre-bound on the class entry ``ent`` and ``storage`` is the
+        already-validated warm columnar snapshot — no provider walk, no
+        plan re-analysis.  Everything LIVE is still consulted: the cost
+        router's measured launch/backlog figures (via ``route_fast``),
+        the deadline, and the degrade-to-host contract, so a fast-path
+        request sheds, overflows to host, batches, and fails over
+        exactly like its slow-path twin."""
+        from ..resource_metering import (
+            GLOBAL_RECORDER,
+            region_of,
+            set_region,
+        )
+        from ..utils import tracker
+        from ..utils.deadline import check_current as _dl_check
+        t0 = time.perf_counter_ns()
+        tag = ent.tag
+        with GLOBAL_RECORDER.attach(tag):
+            set_region(region_of(storage))
+            tracker.label("backend", "device")
+            if self._mesh_label is not None:
+                tracker.label("mesh", self._mesh_label)
+            _dl_check("device_dispatch")
+            coal = self.coalescer
+            if coal is not None:
+                bkey = None
+                if coal.enabled:
+                    bkey = ent.bkey if ent.share_fill is None \
+                        else ent.share_fill(consts)
+                decision, bkey, hint = coal.router.route_fast(
+                    ent.n_est, ent.d2h_bytes, bkey)
+                if decision == "shed":
+                    from ..server.read_pool import ServerIsBusy
+                    raise ServerIsBusy(
+                        "device router: remaining budget below modeled "
+                        "request cost", retry_after_ms=hint)
+                if decision == "host":
+                    # live backlog overflow: the learned-device class
+                    # still diverts to the host pipeline under device
+                    # pile-up, exactly as the slow path would
+                    tracker.label("backend", "host")
+                    from ..executors.runner import BatchExecutorsRunner
+                    with tracker.phase("host_exec"):
+                        result = BatchExecutorsRunner(
+                            req.dag, storage).handle_request()
+                    return CopDeferred(self, req, storage, tag, t0,
+                                       "host", result=result)
+                if decision == "device_batched" and bkey is not None:
+                    fut = coal.submit(bkey, req.dag, storage, tag=tag)
+                    return CopDeferred(self, req, storage, tag, t0,
+                                       "device", future=fut)
+            return self._dispatch_device_solo(req, storage, tag, t0,
+                                              "device")
 
     def _finish_response(self, d: "CopDeferred", result,
                          backend: str) -> CopResponse:

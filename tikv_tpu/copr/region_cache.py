@@ -999,6 +999,44 @@ class RegionColumnarCache:
         lock_src.check_locks(dag.ranges, start_ts)
         return ent
 
+    def get_fast(self, snap, base_key: tuple, ranges,
+                 start_ts: int) -> Optional[MvccColumnarSnapshot]:
+        """Warm-hit-only lookup for the compiled request fast path
+        (server/fastpath.py): ``base_key`` was derived ONCE at class
+        learn time, so a repeat request pays one dict probe instead of
+        re-deriving the key from its (skipped) plan decode.  Returns
+        None whenever the snapshot's region/epoch no longer matches
+        the learned key or the line cannot serve warm — the caller
+        falls back to the full ceremony (build/bridge/park included),
+        never builds here.  Raises KeyIsLocked exactly as ``get``
+        does: the fast path must see blocking locks."""
+        region = getattr(snap, "region", None)
+        data_index = getattr(snap, "data_index", None)
+        if region is None or data_index is None or \
+                (region.id, region.epoch.version) != base_key[:2]:
+            return None
+        with self._lock:
+            line = self._lines.get(base_key)
+            got = self._lookup_locked(line, data_index, start_ts)
+            if got is None:
+                return None
+            ent, lock_src = got
+            self._lines.move_to_end(base_key)
+            self.hits += 1
+            self._count("hit")
+        lock_src.check_locks(ranges, start_ts)
+        return ent
+
+    def is_current(self, base_key: tuple, snap) -> bool:
+        """Non-building peek: is ``snap`` still the line's NEWEST
+        generation?  The fast path pre-validates its learned storage
+        with this before charging a request to the fast leg; any
+        generation bump (delta patch, rebuild, epoch sweep) answers
+        False and the class re-learns through the slow path."""
+        with self._lock:
+            line = self._lines.get(base_key)
+            return line is not None and line.snap is snap
+
     def _lookup_locked(self, line, data_index: int, start_ts: int):
         """→ (entry, lock_source) or None.  ``lock_source`` carries the
         blocking-lock set to check the request against — the line's

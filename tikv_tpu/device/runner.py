@@ -279,19 +279,131 @@ class _GuardedMeta:
         return v
 
 
+class _PinnedStager:
+    """Pre-registered pinned-host D2H landing buffers.
+
+    On TPU the blocking half of a readback is ``np.asarray(x)``: the
+    runtime allocates fresh host memory and synchronously drains the
+    transfer into it, per request.  This stager instead appends a
+    jitted identity program with ``out_shardings`` pinned to the
+    device's ``pinned_host`` memory space to the DISPATCH stream: the
+    device→host copy executes asynchronously as part of the launch
+    train, lands in runtime-managed pinned (page-locked) host buffers,
+    and the later ``np.asarray`` at fetch time reads settled host
+    memory instead of paying the sync round trip.  One staging program
+    is compiled per (shape, dtype, device) — shapes are already
+    pow2/9-8-geometric capacity buckets (``_pad_rows``), so the
+    registration set is bounded exactly like the feed compile classes.
+
+    Probed once per shape class: backends without the memories API
+    (CPU jax — where ``np.asarray`` is zero-copy anyway) or sharded
+    leaves disable themselves and the readback path is unchanged.
+    """
+
+    _MAX_CLASSES = 256
+
+    def __init__(self, memory_kind: str = "pinned_host"):
+        # "pinned_host" on TPU; tests exercise the staging mechanics on
+        # CPU with "unpinned_host" (the only host space CPU jax has)
+        self.memory_kind = memory_kind
+        self._mu = threading.Lock()
+        self._fns: dict = {}        # class key -> jitted fn | None
+        self.enabled: Optional[bool] = None     # None = unprobed
+        self.staged = 0
+        self.staged_bytes = 0
+        self.classes = 0
+
+    def _fn_for(self, x):
+        try:
+            sharding = x.sharding
+            devices = getattr(sharding, "_device_assignment", None) or \
+                tuple(sharding.device_set)
+            if len(devices) != 1:
+                return None         # sharded leaf: leave to GSPMD
+            dev = devices[0]
+            key = (x.shape, str(x.dtype), dev.id)
+        except Exception:   # noqa: BLE001 — not a jax array
+            return None
+        with self._mu:
+            if key in self._fns:
+                return self._fns[key]
+            if len(self._fns) >= self._MAX_CLASSES:
+                # registration full: pass the leaf through rather than
+                # compiling (and immediately forgetting) a staging
+                # program per request — the cap is a backstop far above
+                # the bucketed shape population, so hitting it means a
+                # shape explosion, not a workload to optimize
+                return None
+        fn = None
+        try:
+            from jax.sharding import SingleDeviceSharding
+            out = SingleDeviceSharding(dev, memory_kind=self.memory_kind)
+            fn = jax.jit(lambda a: a, out_shardings=out)
+            fn(x)                   # probe: compiles + runs once
+            self.enabled = True
+        except Exception:   # noqa: BLE001 — memories API unsupported
+            fn = None
+            if self.enabled is None:
+                self.enabled = False
+        with self._mu:
+            if len(self._fns) < self._MAX_CLASSES:
+                self._fns[key] = fn
+            if fn is not None:
+                self.classes += 1
+        return fn
+
+    def stage(self, tree):
+        """Stage every single-device leaf of ``tree`` to pinned host
+        memory; leaves that cannot stage pass through untouched."""
+        if self.enabled is False:
+            return tree
+
+        def one(x):
+            fn = self._fn_for(x)
+            if fn is None:
+                return x
+            try:
+                y = fn(x)
+            except Exception:   # noqa: BLE001 — degrade to direct D2H
+                return x
+            with self._mu:
+                self.staged += 1
+                self.staged_bytes += int(getattr(x, "nbytes", 0))
+            return y
+
+        return jax.tree.map(one, tree)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"enabled": bool(self.enabled),
+                    "probed": self.enabled is not None,
+                    "staged": self.staged,
+                    "staged_bytes": self.staged_bytes,
+                    "classes": self.classes}
+
+
+# process-wide: pinned host memory is a per-device runtime resource,
+# and the jit cache keys on the concrete device — safe to share across
+# runners (slice sub-runners included)
+HOST_STAGER = _PinnedStager()
+
+
 class _Pending:
     """A dispatched device request: output pytree still on device plus
     the host finalize that turns the fetched numpy tree into a
-    SelectResult.  ``copy_to_host_async`` is issued for every leaf at
-    construction, so the D2H transfer streams while the caller decides
-    when (and on which thread) to block — the seam the async serving
-    path pipelines on.  ``small``: the fetch is KBs (agg states), so a
-    completion pool may prioritize it over bulk candidate readbacks.
+    SelectResult.  Leaves are staged to pinned host memory at
+    construction when the backend supports it (:class:`_PinnedStager`)
+    and ``copy_to_host_async`` is issued for every leaf, so the D2H
+    transfer streams while the caller decides when (and on which
+    thread) to block — the seam the async serving path pipelines on.
+    ``small``: the fetch is KBs (agg states), so a completion pool may
+    prioritize it over bulk candidate readbacks.
     """
 
     __slots__ = ("tree", "finalize", "small")
 
     def __init__(self, tree, finalize, small: bool = True):
+        tree = HOST_STAGER.stage(tree)
         self.tree = tree
         self.finalize = finalize
         self.small = small
@@ -595,7 +707,12 @@ class DeviceRunner:
         # a chip is quarantined; None = full mesh healthy
         self._degraded: Optional[tuple] = None
         self._degrade_mu = threading.Lock()
+        # keyed by const-SENSITIVE plan_key: rotating constants mint a
+        # fresh analysis each, so the cache is bounded (FIFO) — the
+        # const-blind kernel caches below are what keep compile classes
+        # logarithmic; this only memoizes the host-side plan walk
         self._plan_cache: dict = {}
+        self._plan_cache_max = 4096
         self._kernel_cache: dict = {}
         # dispatch serialization: two threads launching multi-device
         # executables concurrently can interleave their per-device
@@ -1230,6 +1347,15 @@ class DeviceRunner:
         if key in self._plan_cache:
             return self._plan_cache[key]
         plan = self._analyze_uncached(dag)
+        if len(self._plan_cache) >= self._plan_cache_max:
+            # unlocked callers race this FIFO evict (read-pool threads,
+            # dispatcher, completion workers): pop defensively — a lost
+            # race transiently overshoots the bound by a thread or two,
+            # which is fine; raising on the dispatch path is not
+            try:
+                self._plan_cache.pop(next(iter(self._plan_cache)), None)
+            except (StopIteration, KeyError, RuntimeError):
+                pass
         self._plan_cache[key] = plan
         return plan
 
@@ -1708,6 +1834,10 @@ class DeviceRunner:
         if degraded is not None:
             degraded._arena.budget_bytes = int(nbytes)
             degraded._arena.enforce()
+
+    def pinned_readback_stats(self) -> dict:
+        """Pinned D2H staging pool rollup (/health fastpath)."""
+        return HOST_STAGER.stats()
 
     def hbm_stats(self) -> dict:
         out = self._arena.stats()

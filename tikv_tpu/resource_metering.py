@@ -170,6 +170,20 @@ def bind_request(resource_group: Optional[str],
     tr.label("resource_group", resource_group or "default")
 
 
+def bind_request_tag(tag: str, resource_group: Optional[str]) -> None:
+    """``bind_request`` with a PRE-RESOLVED tag: the fast path's class
+    entries (server/fastpath.py) cache the (resource_group,
+    request_source) tag at learn time — the per-class MeterContext
+    template — so a hit stamps attribution without re-deriving it."""
+    from .utils import trace as _trace
+    tr = _trace.current()
+    if tr is None:
+        return
+    if getattr(tr, "meter_ctx", None) is None:
+        tr.meter_ctx = MeterContext(tag)
+    tr.label("resource_group", resource_group or "default")
+
+
 @dataclass
 class TagRecord:
     """One tag's (or one region's) accumulated charges.  The first

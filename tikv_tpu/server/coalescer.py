@@ -249,6 +249,45 @@ class CostRouter:
             return self._note(DEVICE_BATCHED), key, 0
         return self._note(DEVICE_SOLO), None, 0
 
+    def route_fast(self, n, d2h_bytes: float, key) -> tuple:
+        """``route()`` for the compiled fast path (server/fastpath.py):
+        the PER-PLAN modeled figures — estimated rows ``n`` and the
+        D2H payload — were computed on the class's slow-path learn
+        request and ride the class entry, so a hit pays no plan
+        re-analysis; every LIVE figure (launch EWMA, occupancy,
+        backlog, the open window, the deadline) is read exactly as
+        ``route()`` reads it, so shed / host-overflow / batching
+        decisions keep tracking the measured load.  The learned D2H
+        figure can lag a drifting selectivity EWMA by up to one
+        re-learn; the drift only shifts the host-vs-device comparison,
+        never correctness, and any invalidation re-anchors it."""
+        from ..utils import deadline as dl_mod
+        coal = self._coalescer
+        with self._mu:
+            launch = self.launch_ewma
+            occ = max(1.0, self.occupancy_ewma)
+        busy = coal.busy()
+        d2h_s = d2h_bytes / self.D2H_BYTES_PER_S
+        cost_solo = launch * (1.0 + busy) + d2h_s
+        cost_batched = (launch * (1.0 + busy / coal.max_group) / occ +
+                        d2h_s) if key is not None else float("inf")
+        cost_host = n * self._host_s_per_row(launch) if n \
+            else float("inf")
+        wait = coal.expected_wait_s(key) if key is not None else 0.0
+        best = min(cost_solo, cost_batched + wait, cost_host)
+        dl = dl_mod.current()
+        rem = dl.remaining() if dl is not None else None
+        if rem is not None and rem < best * self.SHED_MARGIN:
+            hint = max(1, int(best * 1e3))
+            return self._note(SHED), None, hint
+        if cost_host * self.HOST_BIAS < min(cost_solo, cost_batched):
+            return self._note(HOST), None, 0
+        if key is not None and (
+                rem is None or
+                rem > 2.0 * self.SHED_MARGIN * cost_solo):
+            return self._note(DEVICE_BATCHED), key, 0
+        return self._note(DEVICE_SOLO), None, 0
+
     def _note(self, decision: str) -> str:
         COPR_ROUTER_COUNTER.labels(decision).inc()
         from ..utils import tracker
@@ -319,7 +358,7 @@ class RequestCoalescer:
     WAIT_FRACTION = 0.25
 
     def __init__(self, runner, window_ms: float = 2.0,
-                 max_group: int = 16):
+                 max_group: int = 16, pipeline: bool = True):
         self._runner = runner
         self.window_s = max(0.0, window_ms) / 1e3
         self.max_group = max(1, int(max_group))
@@ -331,6 +370,21 @@ class RequestCoalescer:
         self._open: dict = {}
         self._ready: deque = deque()
         self._thread: Optional[threading.Thread] = None
+        self._dispatcher: Optional[threading.Thread] = None
+        # persistent back-to-back dispatcher: collection (window
+        # management, on the collector thread) overlaps launch staging
+        # (feed/kernel lookup + enqueue, on the dispatcher thread), so
+        # while one group's launch is being staged or is in flight the
+        # next group is already collecting — and the moment the device
+        # runs DRY (nothing staged, nothing unresolved) the dispatcher
+        # feeds it the oldest open group early ("pipeline" close)
+        # instead of letting it idle out a collection window.  Closing
+        # early is always deadline-safe; it trades a little occupancy
+        # for never leaving the device idle while members wait — the
+        # X100 hyper-pipelining rule applied to the dispatch stream.
+        # Gated with idle_bypass: deterministic-window tests switch
+        # both off.
+        self.pipeline = bool(pipeline)
         self._shutdown = False
         # members closed-for-dispatch whose futures have not resolved;
         # drives the idle-bypass busy signal
@@ -466,7 +520,14 @@ class RequestCoalescer:
                     reason = "idle"
                 if reason is not None:
                     self._close_locked(g, reason)
-                self._cv.notify()
+                # notify_all, not notify: TWO threads wait on this
+                # condition (collector + dispatcher) and a lone notify
+                # may wake only the dispatcher — which has nothing to
+                # stage — while the collector sleeps out a stale
+                # timeout past a freshly TIGHTENED close_at (a 2s-
+                # budget member joining a 10s window must wake the
+                # collector, or it acks late)
+                self._cv.notify_all()
         member.future.add_done_callback(self._on_member_done)
         if inline:
             self._dispatch(g)
@@ -517,39 +578,71 @@ class RequestCoalescer:
         self._inflight += len(g.members)
         self.closes[reason] = self.closes.get(reason, 0) + 1
         COPR_COALESCE_CLOSE_COUNTER.labels(reason).inc()
+        self._cv.notify_all()   # wake the dispatcher for the new group
 
     def _on_member_done(self, _fut) -> None:
         with self._mu:
             self._inflight = max(0, self._inflight - 1)
+            if self._inflight == 0:
+                # the device just ran dry: the dispatcher may feed it
+                # an open group early (pipeline close)
+                self._cv.notify_all()
 
     def _ensure_thread(self) -> None:
         if self._thread is None:
             self._thread = threading.Thread(
-                target=self._loop, daemon=True, name="copr-coalescer")
+                target=self._collect_loop, daemon=True,
+                name="copr-coalescer")
             self._thread.start()
+        if self._dispatcher is None:
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name="copr-dispatcher")
+            self._dispatcher.start()
 
-    def _loop(self) -> None:
+    def _collect_loop(self) -> None:
+        """Window management only: close groups whose time is up; the
+        dispatcher thread stages their launches — collection of group
+        N+1 proceeds while group N's launch is being staged."""
         while True:
             with self._cv:
+                if self._shutdown:
+                    return
+                now = time.monotonic()
+                nxt = None
+                for g in list(self._open.values()):
+                    if g.close_at <= now:
+                        self._close_locked(
+                            g, "window" if g.close_at >=
+                            g.window_close_at else "deadline")
+                    elif nxt is None or g.close_at < nxt:
+                        nxt = g.close_at
+                self._cv.wait(None if nxt is None
+                              else max(1e-4, nxt - now))
+
+    def _dispatch_loop(self) -> None:
+        """The hot loop: stage closed groups' launches back-to-back;
+        when nothing is staged or unresolved, feed the oldest open
+        group early instead of idling (module/init rationale)."""
+        while True:
+            g = None
+            with self._cv:
                 while not self._ready:
-                    now = time.monotonic()
-                    nxt = None
-                    for g in list(self._open.values()):
-                        if g.close_at <= now:
-                            self._close_locked(
-                                g, "window" if g.close_at >=
-                                g.window_close_at else "deadline")
-                        elif nxt is None or g.close_at < nxt:
-                            nxt = g.close_at
-                    if self._ready:
-                        break
                     if self._shutdown:
                         return
-                    self._cv.wait(None if nxt is None
-                                  else max(1e-4, nxt - now))
-                batch = list(self._ready)
-                self._ready.clear()
-            for g in batch:
+                    if self.pipeline and self.idle_bypass and \
+                            self._inflight == 0 and self._open:
+                        cand = min(
+                            (og for og in self._open.values()
+                             if og.members),
+                            key=lambda og: og.close_at, default=None)
+                        if cand is not None:
+                            self._close_locked(cand, "pipeline")
+                            break
+                    self._cv.wait()
+                if self._ready:
+                    g = self._ready.popleft()
+            if g is not None:
                 self._dispatch(g)
 
     # ---------------------------------------------------------- dispatch
@@ -749,7 +842,7 @@ class RequestCoalescer:
                     # re-paces throttled surplus (and select_stacked
                     # enforces the lane bound even single-tenant)
                     self._close_locked(g, "size")
-                self._cv.notify()
+                self._cv.notify_all()   # wake BOTH loops (submit note)
         if inline is not None:
             self._dispatch(inline)
 
@@ -817,9 +910,10 @@ class RequestCoalescer:
             for g in list(self._open.values()):
                 self._close_locked(g, "shutdown")
             self._cv.notify_all()
-            t = self._thread
-        if t is not None:
-            t.join(timeout=5.0)
+            threads = [self._thread, self._dispatcher]
+        for t in threads:
+            if t is not None:
+                t.join(timeout=5.0)
         # belt and braces for stop-under-load: if the dispatcher died
         # (or the join timed out) with groups still queued, dispatch
         # them inline — a parked member's future must NEVER be left

@@ -481,7 +481,8 @@ class Node:
             coalescer = RequestCoalescer(
                 device_runner,
                 window_ms=config.coprocessor.coalesce_window_ms,
-                max_group=config.coprocessor.coalesce_max_group)
+                max_group=config.coprocessor.coalesce_max_group,
+                pipeline=config.coprocessor.dispatch_pipeline)
         self.endpoint = Endpoint(self._copr_snapshot,
                                  device_runner=device_runner,
                                  device_row_threshold=device_row_threshold,
@@ -542,6 +543,16 @@ class Node:
         from ..utils.trace import TraceBuffer
         self.trace_buffer = TraceBuffer(
             capacity=config.coprocessor.trace_buffer)
+        # compiled request fast path (server/fastpath.py): per-class
+        # wire templates learned from slow-path requests; repeat-shape
+        # requests skip msgpack/DAG decode and jump to the coalescer.
+        # Useful only in front of the device backend (learn() admits
+        # device-routed classes), but constructed unconditionally —
+        # capacity 0 disables
+        from .fastpath import FastPathCache
+        self.fastpath = FastPathCache(
+            capacity=config.coprocessor.fastpath_classes
+            if device_runner is not None else 0)
         if device_runner is not None and \
                 hasattr(device_runner, "flight_recorder") and \
                 config.coprocessor.flight_recorder_depth > 0:
@@ -569,6 +580,15 @@ class Node:
         self.config_controller.register("resource_control",
                                         self._rc_cfg)
 
+    def _fastpath_config_changed(self) -> None:
+        """Any applied online-config diff retires every learned
+        fast-path template (routing thresholds, windows, shares and
+        tracing knobs all feed decisions a template pre-bound); one
+        slow-path request per class re-learns them."""
+        fp = getattr(self, "fastpath", None)
+        if fp is not None:
+            fp.bump_config_gen()
+
     def _rc_cfg(self, diff: dict) -> None:
         from ..resource_control import GLOBAL_CONTROLLER
         GLOBAL_CONTROLLER.configure(
@@ -576,6 +596,7 @@ class Node:
             default_share=diff.get("default_share"),
             default_burst=diff.get("default_burst"),
             groups=diff.get("groups"))
+        self._fastpath_config_changed()
 
     def _metering_cfg(self, diff: dict) -> None:
         from ..resource_metering import GLOBAL_RECORDER
@@ -588,11 +609,21 @@ class Node:
         GLOBAL_MODEL.set_weights(
             **{k: v for k, v in diff.items()
                if k.startswith("ru_per_")})
+        self._fastpath_config_changed()
 
     def _copr_cfg(self, diff: dict) -> None:
         # tracing knobs: trace_sample / slow_log_threshold_ms are read
         # live off the config tree by the service per request; only the
         # bounded stores need an explicit poke
+        if "fastpath_classes" in diff and \
+                getattr(self, "fastpath", None) is not None and \
+                self.device_runner is not None:
+            self.fastpath.configure(capacity=int(
+                diff["fastpath_classes"]))
+        if "dispatch_pipeline" in diff and \
+                self.endpoint.coalescer is not None:
+            self.endpoint.coalescer.pipeline = \
+                bool(diff["dispatch_pipeline"])
         if "trace_buffer" in diff:
             self.trace_buffer.set_capacity(int(diff["trace_buffer"]))
         if "flight_recorder_depth" in diff and \
@@ -668,6 +699,7 @@ class Node:
             coal.configure(
                 window_ms=diff.get("coalesce_window_ms"),
                 max_group=diff.get("coalesce_max_group"))
+        self._fastpath_config_changed()
 
     def _read_index_check(self, read_ts: int, region) -> bool:
         """Leader-side async-commit guard for replica reads: bump
@@ -962,8 +994,39 @@ class Node:
             with tracker.phase("columnar_cache"):
                 ent = self.copr_cache.get(snap, req.dag)
             if ent is not None:
+                learn = getattr(req, "fp_learn", None)
+                if learn is not None:
+                    # fast-path learning (server/fastpath.py): the
+                    # snapshot's region identity anchors the template's
+                    # pre-derived cache key — an epoch bump or split
+                    # changes it and the learned class misses
+                    learn["region"] = snap.region.id
+                    learn["epoch_version"] = snap.region.epoch.version
                 return ent
         return MvccScanStorage(MvccReader(snap), req.dag.start_ts)
+
+    def fastpath_snapshot(self, ent, start_ts: int):
+        """Slim per-request snapshot ceremony for a fast-path hit
+        (server/fastpath.py): the same safety steps ``_copr_snapshot``
+        runs — async-commit max_ts bump, in-memory lock check, raft
+        LEASE read — with everything derivable pre-derived on the
+        class entry (key hint, ranges, columnar cache key).  Returns
+        the current warm columnar snapshot or None (cold line, epoch
+        moved): the caller then takes the full ceremony with its
+        already-decoded DAG — parity, never staleness."""
+        from ..utils import tracker
+        cm = self.storage.concurrency_manager
+        cm.update_max_ts(start_ts)
+        if ent.ranges:
+            cm.read_ranges_check(ent.ranges, start_ts)
+        else:
+            cm.read_range_check(None, None, start_ts)
+        with tracker.phase("snapshot"):
+            snap = self.raft_kv.snapshot(
+                SnapContext(key_hint=ent.key_hint))
+        with tracker.phase("columnar_cache"):
+            return self.copr_cache.get_fast(snap, ent.base_key,
+                                            ent.ranges, start_ts)
 
     # ---------------------------------------------------------- admin ops
 

@@ -25,11 +25,16 @@ class _GenericHandler(grpc.GenericRpcHandler):
     batch_commands (service/kv.rs:921, the bidirectional mux)."""
 
     def __init__(self, prefix: str, dispatch, stream_dispatch=None,
-                 batch_dispatch=None):
+                 batch_dispatch=None, raw_dispatch=None):
         self._prefix = prefix
         self._dispatch = dispatch
         self._stream_dispatch = stream_dispatch
         self._batch_dispatch = batch_dispatch
+        # methods served from RAW wire bytes (no eager unpack): the
+        # coprocessor fast path template-matches the bytes and only
+        # falls back to a full decode on a miss; responses may come
+        # back pre-packed (wire.pack_response passes bytes through)
+        self._raw_dispatch = raw_dispatch or {}
 
     def service(self, handler_call_details):
         name = handler_call_details.method
@@ -53,6 +58,15 @@ class _GenericHandler(grpc.GenericRpcHandler):
             return grpc.stream_stream_rpc_method_handler(
                 batch, request_deserializer=wire.unpack,
                 response_serializer=wire.pack)
+
+        if method in self._raw_dispatch:
+            fn = self._raw_dispatch[method]
+
+            def raw_unary(raw: bytes, ctx, fn=fn):
+                return fn(method, raw)
+            return grpc.unary_unary_rpc_method_handler(
+                raw_unary, request_deserializer=lambda b: b,
+                response_serializer=wire.pack_response)
 
         def unary(req: dict, ctx) -> dict:
             return self._dispatch(method, req)
@@ -84,7 +98,10 @@ class TikvServer:
                     "Cdc": self.service.cdc_stream,
                     "Backup": self.service.backup_stream,
                 },
-                batch_dispatch=self.service.batch_commands),))
+                batch_dispatch=self.service.batch_commands,
+                raw_dispatch={
+                    "Coprocessor": self.service.handle_raw,
+                }),))
         from .security import bind_port
         self.port = bind_port(self._server, node.addr)
         assert self.port, f"cannot bind {node.addr}"

@@ -4,7 +4,13 @@ Reference: src/server/service/kv.rs — the ``Tikv`` service:
 ``handle_request!``-expanded unary KV RPCs (:251-410), ``coprocessor``
 (:493), raft ingress (:684,737), plus the admin surface that backs
 tikv-ctl (src/server/service/debug.rs).  Handlers are transport-agnostic
-callables dict → dict; server.py binds them to gRPC methods.
+callables dict → dict; server.py binds them to gRPC methods — EXCEPT
+the unary Coprocessor RPC, which is bound at the RAW-BYTES level
+(``handle_raw``): a repeat-shape request is served by the compiled
+fast path (server/fastpath.py) without ever decoding its body, and
+only a template miss pays the historical decode-per-request pipeline
+(which then doubles as the template learner).  Responses may come back
+pre-packed (wire.pack_response passes bytes through).
 """
 
 from __future__ import annotations
@@ -113,6 +119,193 @@ class KvService:
         finally:
             tracker.uninstall(tok)
         return self._seal_traced(method, req, resp, tr)
+
+    def handle_raw(self, method: str, raw: bytes):
+        """RAW-bytes entry for unary Coprocessor RPCs (server.py binds
+        the gRPC deserializer to identity for them): the compiled fast
+        path (server/fastpath.py) template-matches the bytes first —
+        a hit skips ``wire.unpack`` + the DAG decode + plan
+        re-analysis and returns a PRE-PACKED response body; any miss
+        falls back to the full decode pipeline, which doubles as the
+        template learner for the next repeat of the shape."""
+        fp = getattr(self.node, "fastpath", None)
+        if self.paused or method != "Coprocessor" or fp is None or \
+                not fp.enabled:
+            return self.handle(method, wire.unpack(raw))
+        out = self._fastpath_serve(fp, raw)
+        if out is not None:
+            return out
+        req = wire.unpack(raw)
+        learnable = isinstance(req, dict) and "dag" in req and \
+            "plan" not in req and req.get("force_backend") is None and \
+            not req.get("paging_size") and \
+            req.get("resume_token") is None and \
+            req.get("tp", REQ_TYPE_DAG) == REQ_TYPE_DAG
+        if learnable:
+            # learning channel: the endpoint/node fill in what the
+            # execution decides (storage, backend, route, region)
+            req["__fp_learn"] = {}
+        resp = self.handle(method, req)
+        learn = req.pop("__fp_learn", None) if isinstance(req, dict) \
+            else None
+        if learn and learn.get("storage") is not None and \
+                isinstance(resp, dict) and not resp.get("error"):
+            try:
+                # learn from a FRESH unpack: the executed dict was
+                # mutated by the handlers (stashes popped, keys added)
+                fp.learn(raw, wire.unpack(raw), learn)
+            except Exception:   # noqa: BLE001 — learning is optional
+                logging.getLogger(__name__).warning(
+                    "fastpath learn failed", exc_info=True)
+        return resp
+
+    def _fastpath_serve(self, fp, raw: bytes):
+        """One fast-path attempt → packed response bytes (hit), an
+        error dict (hit that errored — the server packs it), or None
+        (no template / failed validation: take the full decode path).
+        """
+        ent, values = fp.find(raw)
+        if ent is None:
+            return None
+        # pre-commit generation guard (before any RU is charged, so
+        # the full-decode fallback never double-charges): the learned
+        # storage must still be its cache line's NEWEST generation —
+        # a delta patch, rebuild, epoch sweep or eviction since learn
+        # retires the entry and this request re-learns
+        storage = ent.storage()
+        if storage is None or not self.node.copr_cache.is_current(
+                ent.base_key, storage):
+            fp.drop(ent, "generation")
+            return None
+        consts = []
+        start_ts = 0
+        deadline_ms = None
+        tid = None
+        for slot, v in zip(ent.template.slots, values):
+            k = slot.kind
+            if k == "const":
+                consts.append(v)
+            elif k == "start_ts":
+                start_ts = v
+            elif k == "deadline_ms":
+                deadline_ms = v
+            else:
+                tid = v
+        # trace install mirrors handle(): a client-sent id forces
+        # sampling; a garbage id is re-minted server-side
+        if tid is not None and not (0 < len(tid) <= 64 and
+                                    _TRACE_ID_RE.fullmatch(tid)):
+            tid = None
+        sample = getattr(self.node.config.coprocessor,
+                         "trace_sample", 1.0)
+        sampled = tid is not None or sample >= 1.0 or \
+            (sample > 0.0 and random.random() < sample)
+        tr, tok = tracker.install(trace_id=tid, sampled=sampled)
+        try:
+            env, result = self._fastpath_dispatch(
+                fp, ent, storage, consts, start_ts, deadline_ms)
+        finally:
+            tracker.uninstall(tok)
+        synth = {"__trace_class": ent.trace_class}
+        if ent.range_start is not None:
+            synth["__trace_range_start"] = ent.range_start
+        env = self._seal_traced("Coprocessor", synth, env, tr)
+        if result is None:
+            return env      # error response: dict, server packs it
+        from .fastpath import encode_response
+        return encode_response(env, result)
+
+    def _fastpath_dispatch(self, fp, ent, storage, consts,
+                           start_ts: int, deadline_ms):
+        """The fast leg of ``_dispatch_rpc``: pre-bound admission →
+        read-pool slot → validated snapshot → coalescer/solo dispatch
+        → await outside the slot.  → (response env dict, SelectResult
+        or None on error)."""
+        from ..utils import deadline as dl_mod
+        from ..utils import metrics as m
+        from ..utils.deadline import Deadline, DeadlineExceeded
+        method = "Coprocessor"
+        t0 = time.perf_counter()
+        group = ent.resource_group
+        rgm = self.node.resource_groups
+        # the fastpath span is the END-TO-END umbrella of the fast leg
+        # (admission template, slot, dispatch, await): finer spans —
+        # snapshot, device_dispatch, await_deferred, coalesce_wait —
+        # nest inside it, and a warm trace still decomposes ≥95% of a
+        # now-much-shorter wall
+        with tracker.span("fastpath"):
+            tracker.label("fastpath", "hit")
+            dl = None
+            if deadline_ms is not None:
+                dl = Deadline.after_ms(deadline_ms)
+                try:
+                    dl.check("admission")
+                except DeadlineExceeded as e:
+                    m.GRPC_MSG_COUNTER.labels(method, "err").inc()
+                    return {"error": wire.enc_error(e)}, None
+            rgm.charge_request(group)
+            # pre-bound MeterContext template: the tag was resolved at
+            # learn time; attribution still rides the trace across
+            # every thread handoff exactly as on the slow path
+            from ..resource_metering import bind_request_tag
+            bind_request_tag(ent.tag, group)
+            dag = ent.make_dag(consts, start_ts)
+
+            def dispatch():
+                creq = CopRequest(REQ_TYPE_DAG, dag,
+                                  resource_group=ent.resource_group,
+                                  request_source=ent.request_source)
+                got = self.node.fastpath_snapshot(ent, start_ts)
+                if got is None or got is not storage:
+                    # the generation moved between the pre-commit
+                    # check and the slot (a racing write/split): serve
+                    # the CURRENT data through the full ceremony — the
+                    # decoded DAG is in hand, so only the wire decode
+                    # stays skipped — and retire the entry for
+                    # re-learn
+                    fp.drop(ent, "generation")
+                    fp.note_fallback("generation")
+                    tracker.label("fastpath", "fallback")
+                    return self.endpoint.handle_async(creq)
+                fp.note_hit(ent)
+                return self.endpoint.handle_async_fast(creq, got, ent,
+                                                       consts)
+
+            dl_tok = dl_mod.install(dl) if dl is not None else None
+            resp = None
+            env = None
+            try:
+                try:
+                    d = self.read_pool.run(
+                        dispatch, "normal", deadline=dl,
+                        class_key=ent.class_key, resource_group=group)
+                    with tracker.span("await_deferred"):
+                        resp = d.wait()
+                except Exception as e:  # noqa: BLE001 — ride the wire
+                    env = {"error": wire.enc_error(e)}
+            finally:
+                if dl is not None:
+                    dl_mod.uninstall(dl_tok)
+        if resp is not None and dl is not None and dl.expired():
+            # work finished past its budget: never ack expired work
+            m.DEADLINE_SHED_COUNTER.labels("completion").inc()
+            env = {"error": wire.enc_error(DeadlineExceeded(
+                "completion", overrun_ms=-dl.remaining() * 1e3))}
+            resp = None
+        if resp is None:
+            m.GRPC_MSG_DURATION.labels(method).observe(
+                time.perf_counter() - t0)
+            m.GRPC_MSG_COUNTER.labels(method, "err").inc()
+            return env, None
+        result = resp.result
+        nbytes = 32 * result.batch.num_rows     # slow-path row estimate
+        if nbytes:
+            rgm.charge_request(group, bytes_touched=nbytes, requests=0)
+        env = self._cop_envelope(resp)
+        m.GRPC_MSG_DURATION.labels(method).observe(
+            time.perf_counter() - t0)
+        m.GRPC_MSG_COUNTER.labels(method, "ok").inc()
+        return env, result
 
     def _dispatch_rpc(self, method: str, fn, req: dict, prio) -> dict:
         from ..utils import deadline as dl_mod
@@ -472,11 +665,13 @@ class KvService:
 
     # ---------------------------------------------------------- copr
 
-    def _enc_cop_resp(self, resp) -> dict:
-        with tracker.phase("resp_serialize"):
-            rows = wire.enc_rows(resp.rows())
-        return {"rows": rows,
-                "backend": resp.backend,
+    @staticmethod
+    def _cop_envelope(resp) -> dict:
+        """The non-rows response fields, shared by the slow path's
+        ``_enc_cop_resp`` and the fast leg's streaming encoder — ONE
+        definition of the field set and order, so the two legs cannot
+        silently diverge on the byte-parity contract."""
+        return {"backend": resp.backend,
                 "elapsed_ns": resp.elapsed_ns,
                 "is_drained": resp.is_drained,
                 "resume_token": resp.resume_token,
@@ -485,6 +680,11 @@ class KvService:
                      "iters": s.num_iterations,
                      "time_ns": s.time_processed_ns}
                     for s in resp.result.exec_summaries]}
+
+    def _enc_cop_resp(self, resp) -> dict:
+        with tracker.phase("resp_serialize"):
+            rows = wire.enc_rows(resp.rows())
+        return {"rows": rows, **self._cop_envelope(resp)}
 
     def Coprocessor(self, req: dict) -> dict:
         # umbrella span over the handler (snapshot, backend routing,
@@ -525,12 +725,20 @@ class KvService:
                 dag.executors[0], dag.ranges, dag.start_ts))
         assert tp == REQ_TYPE_DAG, tp
         dag = predec or wire.dec_dag(req["dag"])
+        learn = req.get("__fp_learn")
+        if learn is not None:
+            # fast-path learning (server/fastpath.py): hand the
+            # decoded DAG + compile-class key to the template learner;
+            # the endpoint/node fill in storage/route/region below
+            learn["dag"] = dag
+            learn["class_key"] = req.get("__trace_class")
         creq = CopRequest(
             REQ_TYPE_DAG, dag, req.get("force_backend"),
             paging_size=req.get("paging_size", 0),
             resume_token=req.get("resume_token"),
             resource_group=req.get("resource_group", "default"),
-            request_source=req.get("request_source", ""))
+            request_source=req.get("request_source", ""),
+            fp_learn=learn)
         # dispatch under the read-pool slot, await outside it: handle()
         # resolves the "__deferred" marker after the slot is released
         d = self.endpoint.handle_async(creq)

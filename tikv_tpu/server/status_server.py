@@ -88,6 +88,18 @@ class StatusServer:
                         # occupancy, router decision mix, solo-degrade
                         # count
                         body["coalescer"] = coal.stats()
+                    fp = getattr(node, "fastpath", None)
+                    if fp is not None and hasattr(fp, "stats"):
+                        # microsecond warm path: learned wire-template
+                        # classes, hit/miss/bypass/fallback/invalidate
+                        # counts by reason, plus the pinned D2H
+                        # staging pool when the backend supports it
+                        body["fastpath"] = fp.stats()
+                        drp = getattr(node, "device_runner", None)
+                        if drp is not None and \
+                                hasattr(drp, "pinned_readback_stats"):
+                            body["fastpath"]["pinned_readback"] = \
+                                drp.pinned_readback_stats()
                     pe = getattr(ep, "_plan_executor", None) \
                         if ep is not None else None
                     if pe is not None:

@@ -377,8 +377,18 @@ COPR_COALESCE_CLOSE_COUNTER = REGISTRY.counter(
     "tikv_coprocessor_coalesce_group_close_total",
     "coalescer group closes by trigger (size = max_group reached, "
     "window = collection window expired, deadline = tightest member "
-    "budget pressure, failpoint = copr::coalesce_window, shutdown)",
+    "budget pressure, pipeline = back-to-back dispatcher fed an idle "
+    "device early, failpoint = copr::coalesce_window, shutdown)",
     labels=("reason",))
+COPR_FASTPATH_COUNTER = REGISTRY.counter(
+    "tikv_coprocessor_fastpath_total",
+    "compiled request fast path outcomes (server/fastpath.py): hit = "
+    "served from a learned wire template, miss = no/failed template "
+    "match (full decode), bypass = ineligible shape or copr::fastpath "
+    "arm, fallback = validated entry raced a generation change mid-"
+    "request (served via full ceremony), invalidate = entry retired "
+    "(epoch/config/generation), learn = template admitted",
+    labels=("outcome", "reason"))
 DEVICE_MESH_SHARDS = REGISTRY.gauge(
     "tikv_device_mesh_shards",
     "devices in the runner's (range, tile) mesh (1 = single-chip; the "

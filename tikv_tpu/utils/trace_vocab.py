@@ -21,6 +21,11 @@ SPAN_VOCABULARY: dict[str, str] = {
                     "routing, dispatch) — endpoint overhead between "
                     "finer spans",
     "read_pool_wait": "queue/slot wait inside the unified read pool",
+    "fastpath": "umbrella: the compiled fast-path leg end to end — "
+                "template admission, pre-bound metering, constant-"
+                "stamped DAG, slot, dispatch, await (server/"
+                "fastpath.py; the fastpath label names which leg — "
+                "hit/fallback — served)",
     "await_deferred": "service thread parked on the deferred device "
                       "completion (decomposed by completion-side spans)",
     "resp_serialize": "SelectResult rows → wire response encode",
