@@ -409,9 +409,11 @@ def _expect(rows_by_handle, lo=None, hi=None):
 
 
 def test_lifecycle_teardown_split_and_role_change():
-    """Split (epoch change) and leader loss eagerly invalidate the
-    region's columnar lines AND device feeds — and the accounting shows
-    it on /health and /metrics."""
+    """Split (epoch change) eagerly invalidates the region's columnar
+    lines AND device feeds; leader loss DEMOTES the line to a replica
+    feed (kept resident + delta-patched for stale serving) and leader
+    gain promotes it warm — and the accounting shows all of it on
+    /health and /metrics."""
     pytest.importorskip("grpc")
     rig = _make_server_rig()
     try:
@@ -453,13 +455,27 @@ def test_lifecycle_teardown_split_and_role_change():
         assert sorted(right["rows"]) == _expect(model, 200, 400)
         check_no_stale_epoch(node)
 
-        # LEADER LOSS on one region: its line tears down eagerly (the
-        # same observer event peer.py fires on a real transfer)
+        # LEADER LOSS on one region: with replicated device serving
+        # the line is NOT torn down — it demotes to a replica feed
+        # (kept resident, still delta-patched, serving stale reads),
+        # and a later leader gain promotes it back WARM (scrub-digest
+        # re-verify, no columnar_build)
         lines = node.copr_cache.stats()["resident_lines"]
         assert lines >= 1
         rid = node.copr_cache.stats()["lines"][0]["region"]
+        sup = node.device_supervisor
+        demo0, promo0 = sup.demotions, sup.promotions
         node.raft_store.coprocessor_host.notify_role_change(rid, False)
-        assert node.copr_cache.stats()["resident_lines"] < lines
+        assert node.copr_cache.stats()["resident_lines"] == lines, \
+            "demotion must keep the line resident as a replica feed"
+        assert sup.demotions == demo0 + 1
+        node.raft_store.coprocessor_host.notify_role_change(rid, True)
+        assert sup.promotions == promo0 + 1
+        assert sup.promotion_rebuilds == 0
+        assert node.copr_cache.stats()["resident_lines"] == lines, \
+            "warm promotion must not invalidate the line"
+        # the split's stale-epoch teardown above is the lifecycle
+        # invalidation the rollup accounts
         assert node.device_supervisor.stats()[
             "lifecycle_invalidations"] >= 1
 

@@ -386,15 +386,22 @@ def test_failpoint_inventory_resolves():
     # compiled request fast path's force-miss / force-full-decode /
     # corrupt-fingerprint arms (value = miss|full|corrupt), proving
     # every arm falls back to the full decode path instead of ever
-    # serving a mis-extracted template)
-    assert len(sites) >= 73, f"only {len(sites)} unique sites"
+    # serving a mis-extracted template; ≥75 since replicated device
+    # serving: device::replica_stale — force the follower stale-read
+    # freshness gate to refuse with DataIsNotReady as if the replica
+    # lagged the resolved-ts watermark, so hedge fall-through and
+    # refusal accounting are steerable without real lag — and
+    # copr::replica_promote, failing the leader-gain promotion's
+    # scrub-digest re-verify so the rebuild fallback path is provable)
+    assert len(sites) >= 75, f"only {len(sites)} unique sites"
     for dev_site in ("device::hbm_oom", "device::feed_corrupt",
                      "device::d2h_corrupt", "copr::coalesce_dispatch",
                      "copr::coalesce_window", "device::mvcc_resolve",
                      "device::shard_launch", "device::slice_dead",
                      "device::mesh_rebuild", "device::join_dispatch",
                      "copr::plan_route", "copr::rc_throttle",
-                     "copr::fastpath"):
+                     "copr::fastpath", "device::replica_stale",
+                     "copr::replica_promote"):
         assert dev_site in sites, f"missing fault site {dev_site}"
 
     nemesis_src = (root / "chaos" / "nemesis.py").read_text()

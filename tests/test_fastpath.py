@@ -620,3 +620,102 @@ def test_pinned_stager_mechanics_on_host_space():
         assert st.classes == 2
     else:               # pragma: no cover - older jax
         assert tree["x"] is x
+
+
+# -------------------------------------------- decode / plan tiers
+
+
+def test_e2e_fastpath_indexscan_decode_tier(rig):
+    """IndexScan classes learn a DECODE-tier template: a repeat skips
+    ``wire.unpack`` + ``dec_dag`` but replays the FULL serving
+    ceremony, so parity holds against a forced full-decode control
+    and fresh writes are visible without any invalidation (nothing
+    snapshot-bound is cached)."""
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import index_entries
+    c = rig["client"]
+    table = int_table(1, table_id=9611)
+    muts = []
+    for h in range(800):
+        row = {"c0": (h * 7) % 300 - 150}
+        key, value = encode_table_row(table, h, row)
+        muts.append(("put", key, value))
+        muts.extend(("put", k, v) for k, v in index_entries(
+            table, h, row))
+    c.txn_write(muts)
+
+    def ask(thr):
+        s = DagSelect.from_index(table, "c0", with_handle=True)
+        dag = s.where(s.col("c0") > thr).build(start_ts=c.tso())
+        return c.coprocessor(dag, deadline_ms=30_000, timeout=60)
+
+    ask(0)          # learn (host route → decode tier)
+    base = _fp(rig).stats()
+    assert base["tiers"].get("decode", 0) >= 1, base
+    for thr in (-100, -3, 57, 120):
+        fast = ask(thr)
+        failpoint.cfg("copr::fastpath", "return(miss)")
+        try:
+            slow = ask(thr)
+        finally:
+            failpoint.remove("copr::fastpath")
+        assert fast["rows"] == slow["rows"], thr
+        assert len(fast["rows"]) > 0
+    st = _fp(rig).stats()
+    assert st["hit"] - base["hit"] >= 4, (base, st)
+
+
+def test_e2e_fastpath_plan_tier(rig):
+    """Plan-IR classes learn a PLAN-tier template: one decoded
+    PlanRequest is cached per wire shape, repeats re-stamp only the
+    TSO — parity against the full decode path, and a CHANGED plan
+    constant is a structural miss (constants are class identity),
+    never a mis-extraction."""
+    from tikv_tpu.codec.keys import table_record_range
+    from tikv_tpu.copr import plan_ir as pir
+    from tikv_tpu.copr.dag import TableScanDesc
+    from tikv_tpu.datatype import EvalType
+    from tikv_tpu.executors.ranges import KeyRange
+    from tikv_tpu.expr import Expr
+    c = rig["client"]
+    table = int_table(2, table_id=9612)
+    rows = [(h, {"c0": h % 97, "c1": (h * 31) % 500 - 250})
+            for h in range(1200)]
+    _load(rig, table, rows)
+    start, end = table_record_range(table.table_id)
+    scan = pir.ScanNode(
+        TableScanDesc(table.table_id,
+                      tuple(table.column_info(col.name)
+                            for col in table.columns)),
+        (KeyRange(start, end),))
+
+    def plan(thr):
+        return pir.PlanRequest(pir.SelectNode(scan, (
+            Expr.column(2, EvalType.INT) >
+            Expr.const(thr, EvalType.INT),)), start_ts=c.tso())
+
+    def ask(thr):
+        return c.coprocessor_plan(plan(thr), deadline_ms=30_000,
+                                  timeout=60)
+
+    ask(40)         # learn the thr=40 shape
+    base = _fp(rig).stats()
+    assert base["tiers"].get("plan", 0) >= 1, base
+    # repeats of the SAME shape (only the TSO rotates) hit
+    for _ in range(3):
+        fast = ask(40)
+        failpoint.cfg("copr::fastpath", "return(miss)")
+        try:
+            slow = ask(40)
+        finally:
+            failpoint.remove("copr::fastpath")
+        assert fast["rows"] == slow["rows"]
+        assert len(fast["rows"]) > 0
+    st = _fp(rig).stats()
+    assert st["hit"] - base["hit"] >= 3, (base, st)
+    # a different constant is a DIFFERENT class: first ask misses
+    # (learns a sibling), answers stay correct
+    other = ask(-10)
+    assert len(other["rows"]) > len(fast["rows"])
+    st2 = _fp(rig).stats()
+    assert st2["tiers"].get("plan", 0) >= 2, st2

@@ -1,0 +1,468 @@
+"""Replicated device serving: follower feed replicas, warm failover,
+and hedged device reads.
+
+Followers mint + delta-patch their OWN columnar lines from applied
+state and serve coprocessor reads under the resolved-ts watermark
+(``stale_read`` — kvproto semantics, DataIsNotReady on a lagging
+replica).  Leadership changes PROMOTE an already-patched follower feed
+(scrub-digest re-verify, never a ``columnar_build`` on the serving
+path), and the client's adaptive-P95 hedge gains a warm device-backed
+follower leg.
+
+Covers: follower delta-patch parity vs the leader over NULL-heavy,
+tombstoned and wide (>15-col) tables; promotion-under-churn with zero
+cold rebuilds across the failover window; the hedged warm follower leg
+beating a browned-out leader on the same request sequence; a
+resolved-ts-lagging replica refusing and the hedge falling through to
+the leader; and a gRPC e2e leader kill with /health + /metrics
+assertions on the survivor.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tikv_tpu.chaos import (
+    check_no_cold_rebuild_on_serving_path,
+    check_replica_read_correctness,
+)
+from tikv_tpu.server import RemoteError, wire
+from tikv_tpu.testing.dag import DagSelect
+from tikv_tpu.testing.fixture import (
+    encode_table_row,
+    int_table,
+    table_record_key,
+)
+from tikv_tpu.utils import failpoint
+
+
+@pytest.fixture(scope="module")
+def net():
+    """One PD + three device-backed tikv-servers over loopback gRPC,
+    region 1 replicated onto all three stores, a StatusServer per
+    node (the failover test asserts /health on a SURVIVOR)."""
+    import jax
+
+    from tikv_tpu.device.runner import DeviceRunner
+    from tikv_tpu.parallel import make_mesh
+    from tikv_tpu.raftstore.metapb import Store
+    from tikv_tpu.server import (
+        Node,
+        PdServer,
+        RemotePdClient,
+        TikvServer,
+        TxnClient,
+    )
+    from tikv_tpu.server.status_server import StatusServer
+
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    servers, statuses = [], {}
+    for _ in range(3):
+        device = DeviceRunner(mesh=make_mesh(jax.devices()[:1]))
+        node = Node("127.0.0.1:0", RemotePdClient(pd_addr),
+                    device_runner=device, device_row_threshold=128)
+        srv = TikvServer(node)
+        node.addr = f"127.0.0.1:{srv.port}"
+        node.pd.put_store(Store(node.store_id, node.addr))
+        srv.start()
+        status = StatusServer("127.0.0.1:0", node=node,
+                              config_controller=node.config_controller)
+        status.start()
+        servers.append(srv)
+        statuses[node.store_id] = status
+    client = TxnClient(pd_addr)
+    for srv in servers[1:]:
+        client.add_peer(1, srv.node.store_id)
+    yield {"pd": pd_server, "servers": servers, "client": client,
+           "pd_addr": pd_addr, "statuses": statuses}
+    for status in statuses.values():
+        status.stop()
+    for srv in servers:
+        srv.stop()
+    pd_server.stop()
+
+
+# ------------------------------------------------------------- helpers
+
+
+def _region1_leader(servers):
+    for srv in servers:
+        peer = srv.node.raft_store.peers.get(1)
+        if peer is not None and peer.is_leader():
+            return srv
+    raise AssertionError("no leader for region 1")
+
+
+def _followers(servers):
+    leader = _region1_leader(servers)
+    return [s for s in servers if s is not leader]
+
+
+def _sel(table, thr, ts, cols=None):
+    s = DagSelect.from_table(
+        table, cols or [c.name for c in table.columns])
+    return s.where(s.col(cols[-1] if cols else "c1") > thr) \
+        .build(start_ts=ts)
+
+
+def _load(client, table, rows):
+    muts = []
+    for h, row in rows:
+        key, value = encode_table_row(table, h, row)
+        muts.append(("put", key, value))
+    client.txn_write(muts)
+
+
+def _stale_req(dag):
+    return {"tp": 103, "dag": wire.enc_dag(dag), "force_backend": None,
+            "paging_size": 0, "resume_token": None,
+            "resource_group": "default", "request_source": "",
+            "stale_read": True}
+
+
+def _replica_ask(client, dag, store_id=None, deadline=10.0):
+    """Follower stale-read with a resolved-ts catch-up wait: the
+    CheckLeader fan-out runs on the drive-loop cadence, so a snapshot
+    ts minted 'now' takes a beat to be covered by the watermark."""
+    end = time.monotonic() + deadline
+    while True:
+        try:
+            if store_id is None:
+                return client.coprocessor_replica(dag, timeout=60)
+            return client._store_call(store_id, "Coprocessor",
+                                      _stale_req(dag), 60)
+        except RemoteError as e:
+            if e.kind != "data_is_not_ready" or time.monotonic() > end:
+                raise
+            time.sleep(0.05)
+
+
+def _by_store(net):
+    return {s.node.store_id: s for s in net["servers"]}
+
+
+# --------------------------------------- follower delta-patch parity
+
+
+def test_follower_parity_null_heavy(net):
+    """Follower device read == leader read over a ~50%-NULL table, and
+    the follower's line is DELTA-PATCHED (same stream as the leader's)
+    — post-write parity at a fresh snapshot ts with no re-mint."""
+    c = net["client"]
+    table = int_table(2, table_id=9701)
+    rng = np.random.default_rng(42)
+    rows = []
+    for h in range(1500):
+        row = {}
+        if rng.random() > 0.5:
+            row["c0"] = int(rng.integers(-500, 500))
+        if rng.random() > 0.2:
+            row["c1"] = int(rng.integers(-1000, 1000))
+        rows.append((h, row))
+    _load(c, table, rows)
+    ts0 = c.tso()
+    dag = _sel(table, 0, ts0)
+    leader_r = c.coprocessor(dag, deadline_ms=30_000, timeout=60)
+    follow_r = _replica_ask(c, dag)
+    check_replica_read_correctness(leader_r["rows"], follow_r["rows"])
+    assert len(leader_r["rows"]) > 0
+
+    # delta: new rows land through raft; the follower's applied state
+    # publishes the same per-region deltas — the next stale read must
+    # see them (patch, not rebuild)
+    _load(c, table, [(10_000 + i, {"c0": 1, "c1": 999})
+                     for i in range(40)])
+    ts1 = c.tso()
+    dag1 = _sel(table, 0, ts1)
+    leader_r1 = c.coprocessor(dag1, deadline_ms=30_000, timeout=60)
+    follow_r1 = _replica_ask(c, dag1)
+    check_replica_read_correctness(leader_r1["rows"], follow_r1["rows"])
+    assert len(leader_r1["rows"]) == len(leader_r["rows"]) + 40
+
+
+def test_follower_parity_tombstoned(net):
+    """Deleted rows disappear from the follower's answer exactly as
+    they do from the leader's — tombstone deltas patch the feed."""
+    c = net["client"]
+    table = int_table(2, table_id=9702)
+    _load(c, table, [(h, {"c0": h % 7, "c1": h % 100})
+                     for h in range(1200)])
+    c.txn_write([("delete", table_record_key(table.table_id, h), None)
+                 for h in range(0, 1200, 3)])
+    ts0 = c.tso()
+    dag = _sel(table, 10, ts0)
+    leader_r = c.coprocessor(dag, deadline_ms=30_000, timeout=60)
+    follow_r = _replica_ask(c, dag)
+    check_replica_read_correctness(leader_r["rows"], follow_r["rows"])
+    # a second wave of tombstones, read back at a fresh ts
+    c.txn_write([("delete", table_record_key(table.table_id, h), None)
+                 for h in range(1, 1200, 3)])
+    ts1 = c.tso()
+    dag1 = _sel(table, 10, ts1)
+    leader_r1 = c.coprocessor(dag1, deadline_ms=30_000, timeout=60)
+    follow_r1 = _replica_ask(c, dag1)
+    check_replica_read_correctness(leader_r1["rows"], follow_r1["rows"])
+    assert len(leader_r1["rows"]) < len(leader_r["rows"])
+
+
+def test_follower_parity_wide_table(net):
+    """>15-col rows (map16 row header) ride the follower feed with
+    full parity — wide tiles patch like narrow ones."""
+    c = net["client"]
+    table = int_table(17, table_id=9703)
+    cols = [col.name for col in table.columns]
+    _load(c, table, [(h, {f"c{i}": (h * 31 + i) % 400 - 200
+                          for i in range(17)})
+                     for h in range(900)])
+    ts0 = c.tso()
+    dag = _sel(table, -50, ts0, cols=cols)
+    leader_r = c.coprocessor(dag, deadline_ms=30_000, timeout=60)
+    follow_r = _replica_ask(c, dag)
+    check_replica_read_correctness(leader_r["rows"], follow_r["rows"])
+    assert len(leader_r["rows"]) > 0
+    # the serving store accounted the replica read + feed
+    served = [s for s in net["servers"]
+              if s.node.replica_serving_stats()["replica_reads"] > 0]
+    assert served, "no store accounted a follower device read"
+
+
+# --------------------------------------------- promotion under churn
+
+
+def test_promotion_under_churn_zero_rebuilds(net):
+    """Leader transfer onto a store with a live replica feed: the feed
+    is PROMOTED (resolved-ts catch-up + scrub-digest re-verify) and
+    serves leader reads across churn with ZERO cold builds in the
+    failover window — never a ``columnar_build``."""
+    c = net["client"]
+    servers = net["servers"]
+    table = int_table(2, table_id=9704)
+    _load(c, table, [(h, {"c0": h % 11, "c1": (h * 13) % 500 - 250})
+                     for h in range(1500)])
+    ts0 = c.tso()
+    dag = _sel(table, 0, ts0)
+    expect = c.coprocessor(dag, deadline_ms=30_000, timeout=60)
+
+    old_leader = _region1_leader(servers)
+    target = _followers(servers)[0]
+    # pre-warm: the follower's FIRST stale read mints its line — a
+    # cold build OFF the serving path, before the failover window
+    got = _replica_ask(c, dag, store_id=target.node.store_id)
+    check_replica_read_correctness(expect["rows"], got["rows"])
+
+    before = dict(target.node.copr_cache.stats())
+    promos0 = target.node.device_supervisor.promotions
+    # churn: writes keep landing while leadership moves
+    _load(c, table, [(20_000 + i, {"c0": 1, "c1": 400})
+                     for i in range(50)])
+    peer = next(p for p in
+                old_leader.node.raft_store.region_peer(1).region.peers
+                if p.store_id == target.node.store_id)
+    old_leader.node.transfer_leader(1, peer.id)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if _region1_leader(servers) is target:
+            break
+        time.sleep(0.05)
+    assert _region1_leader(servers) is target, "transfer did not land"
+    _load(c, table, [(21_000 + i, {"c0": 2, "c1": 401})
+                     for i in range(50)])
+
+    ts1 = c.tso()
+    r = c.coprocessor(_sel(table, 0, ts1), deadline_ms=30_000,
+                      timeout=60)
+    after = dict(target.node.copr_cache.stats())
+    sup = target.node.device_supervisor
+    check_no_cold_rebuild_on_serving_path(before, after, supervisor=sup)
+    assert sup.promotions > promos0, "leader gain did not promote"
+    assert sup.promotion_rebuilds == 0
+    assert old_leader.node.device_supervisor.demotions >= 1, \
+        "demoted leader must keep its lines as a replica feed"
+    # correctness across the window: every churn row visible
+    assert len(r["rows"]) == len(expect["rows"]) + 100
+
+
+# ------------------------------------------------- hedged device leg
+
+
+def test_hedged_warm_follower_beats_browned_leader(net):
+    """Same request sequence, same seed: against a browned-out leader
+    the hedged client's warm follower leg wins and the wall clock
+    beats the unhedged leader-only run — with identical answers."""
+    from tikv_tpu.server import TxnClient
+
+    c = net["client"]
+    servers = net["servers"]
+    table = int_table(2, table_id=9705)
+    _load(c, table, [(h, {"c0": h % 17, "c1": (h * 7) % 600 - 300})
+                     for h in range(1500)])
+    ts0 = c.tso()
+    thrs = [-200, -50, 0, 120]
+    # warm the follower feed for this table before the brownout
+    _replica_ask(c, _sel(table, thrs[0], ts0))
+
+    leader = _region1_leader(servers)
+    leader.node.raft_store.slow_down(0.15)
+    try:
+        t0 = time.monotonic()
+        cold = [c.coprocessor(_sel(table, t, ts0), timeout=60)
+                for t in thrs]
+        t_unhedged = time.monotonic() - t0
+
+        hc = TxnClient(net["pd_addr"], hedge_reads=True)
+        try:
+            won0 = hc.hedges_won
+            t0 = time.monotonic()
+            warm = [hc.coprocessor(_sel(table, t, ts0), timeout=60)
+                    for t in thrs]
+            t_hedged = time.monotonic() - t0
+        finally:
+            hc.close()
+    finally:
+        leader.node.raft_store.slow_down(0.0)
+
+    for a, b in zip(cold, warm):
+        check_replica_read_correctness(a["rows"], b["rows"])
+    assert hc.hedges_won > won0, "warm follower leg never won"
+    assert t_hedged < t_unhedged, (t_hedged, t_unhedged)
+
+
+def test_lagging_replica_refuses_and_falls_through(net):
+    """The resolved-ts gate: a read_ts beyond the watermark gets
+    DataIsNotReady; an armed ``device::replica_stale`` failpoint forces
+    the same refusal; and the hedged client falls through to the
+    leader — correct answers, never a stale serve."""
+    from tikv_tpu.server import TxnClient
+    from tikv_tpu.storage.txn_types import compose_ts
+    from tikv_tpu.utils.metrics import HEDGE_COUNTER
+
+    c = net["client"]
+    servers = net["servers"]
+    table = int_table(2, table_id=9706)
+    _load(c, table, [(h, {"c0": h % 5, "c1": h % 50})
+                     for h in range(400)])
+    ts0 = c.tso()
+    baseline = _replica_ask(c, _sel(table, 5, ts0))
+
+    # (a) far-future read_ts: beyond any possible watermark → refuse
+    future = compose_ts(int(time.time() * 1000) + 60_000, 0)
+    with pytest.raises(RemoteError) as ei:
+        c.coprocessor_replica(_sel(table, 5, future))
+    assert ei.value.kind == "data_is_not_ready"
+
+    # (b) the failpoint forces the refusal even below the watermark
+    refused0 = sum(s.node.replica_serving_stats()["refused"]
+                   for s in servers)
+    failpoint.cfg("device::replica_stale", "return")
+    try:
+        with pytest.raises(RemoteError) as ei:
+            c.coprocessor_replica(_sel(table, 5, ts0))
+        assert ei.value.kind == "data_is_not_ready"
+
+        # (c) hedged fall-through: the follower leg refuses, the
+        # leader leg answers — correct rows, refusal accounted
+        stale_refused0 = \
+            HEDGE_COUNTER.labels("copr_stale_refused").value
+        hc = TxnClient(net["pd_addr"], hedge_reads=True)
+        leader = _region1_leader(servers)
+        leader.node.raft_store.slow_down(0.12)
+        try:
+            r = hc.coprocessor(_sel(table, 5, ts0), timeout=60)
+        finally:
+            leader.node.raft_store.slow_down(0.0)
+            hc.close()
+        check_replica_read_correctness(baseline["rows"], r["rows"])
+        assert HEDGE_COUNTER.labels("copr_stale_refused").value > \
+            stale_refused0, "refusal leg not accounted"
+    finally:
+        failpoint.remove("device::replica_stale")
+    refused1 = sum(s.node.replica_serving_stats()["refused"]
+                   for s in servers)
+    assert refused1 > refused0
+
+
+# ------------------------------------------ leader kill (runs LAST)
+
+
+def test_leader_kill_warm_failover_e2e(net):
+    """Crash-kill the leader store mid-serving: a survivor with an
+    already-patched replica feed takes over with a WARM promotion —
+    zero cold builds on the serving path, correct answers, and the
+    /health + /metrics surfaces on the survivor show the rollup.
+    Destroys a node: must run last in this module."""
+    c = net["client"]
+    servers = net["servers"]
+    table = int_table(2, table_id=9707)
+    _load(c, table, [(h, {"c0": h % 23, "c1": (h * 3) % 700 - 350})
+                     for h in range(1500)])
+    ts0 = c.tso()
+    dag = _sel(table, 0, ts0)
+    expect = c.coprocessor(dag, deadline_ms=30_000, timeout=60)
+
+    leader = _region1_leader(servers)
+    survivors = [s for s in servers if s is not leader]
+    # pre-warm BOTH survivors' feeds — whichever wins the election
+    # must promote warm, not rebuild
+    for s in survivors:
+        got = _replica_ask(c, dag, store_id=s.node.store_id)
+        check_replica_read_correctness(expect["rows"], got["rows"])
+    before = {s.node.store_id: dict(s.node.copr_cache.stats())
+              for s in survivors}
+
+    # kill: no cooperation, no handoff — raft elects a survivor
+    servers.remove(leader)
+    leader.stop()
+    deadline = time.monotonic() + 15
+    new_leader = None
+    while time.monotonic() < deadline:
+        try:
+            new_leader = _region1_leader(survivors)
+            break
+        except AssertionError:
+            time.sleep(0.05)
+    assert new_leader is not None, "no new leader elected after kill"
+
+    # first calls may still route to the dead store's address until the
+    # breaker trips and leadership is re-resolved — retry like client-go
+    ts1 = c.tso()
+    r = None
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            r = c.coprocessor(_sel(table, 0, ts1), deadline_ms=30_000,
+                              timeout=60)
+            break
+        except Exception:   # noqa: BLE001 — dead-store transport error
+            c._invalidate_region(dag.ranges[0].start)
+            time.sleep(0.1)
+    assert r is not None, "no successful read after leader kill"
+    check_replica_read_correctness(expect["rows"], r["rows"])
+
+    sid = new_leader.node.store_id
+    after = dict(new_leader.node.copr_cache.stats())
+    sup = new_leader.node.device_supervisor
+    check_no_cold_rebuild_on_serving_path(before[sid], after,
+                                          supervisor=sup)
+    assert sup.promotions >= 1
+    assert sup.promotion_rebuilds == 0
+
+    # /health on the SURVIVOR: the replica_serving rollup
+    status = net["statuses"][sid]
+    body = json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{status.port}/health"))
+    rollup = body["replica_serving"]
+    assert rollup["promotions"] >= 1
+    assert rollup["promotion_rebuilds"] == 0
+    assert rollup["replica_reads"] >= 1
+
+    # /metrics: the feed gauge + promotion counter are exported
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{status.port}/metrics").read().decode()
+    assert "tikv_device_replica_feeds" in text
+    assert "tikv_device_replica_promotion_total" in text
+    assert 'tikv_device_replica_promotion_total{outcome="warm"}' in text

@@ -181,9 +181,10 @@ def test_windowed_topk_report_shape():
         GLOBAL_MODEL.ru(launch_s=0.06), rel=1e-3)
     # the rolled report serves report() until the next roll
     assert rec.report()["top_tenants"] == rep["top_tenants"]
-    # maybe_report paces by report_interval_s
+    # maybe_report paces by report_interval_s (push far enough into
+    # the monotonic past — 0.0 only works once uptime > interval)
     rec.report_interval_s = 3600.0
-    rec._last_push = 0.0
+    rec._last_push = time.monotonic() - 7200.0
     first = rec.maybe_report()
     assert first is not None and "top_tenants" in first
     assert rec.maybe_report() is None       # interval not elapsed

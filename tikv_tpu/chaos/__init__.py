@@ -22,12 +22,14 @@ from .invariants import (        # noqa: F401
     check_goodput,
     check_hbm_within_budget,
     check_mesh_serves_degraded,
+    check_no_cold_rebuild_on_serving_path,
     check_no_late_acks,
     check_no_lost_acks,
     check_no_quarantined_dispatch,
     check_no_stale_epoch,
     check_read_correctness,
     check_replica_consistency,
+    check_replica_read_correctness,
     check_scrub_clean,
 )
 from .nemesis import (           # noqa: F401
@@ -37,6 +39,7 @@ from .nemesis import (           # noqa: F401
     FASTPATH_FAULT_KINDS,
     FAULT_KINDS,
     PLAN_FAULT_KINDS,
+    REPLICA_FAULT_KINDS,
     TENANT_FAULT_KINDS,
     Fault,
     Nemesis,

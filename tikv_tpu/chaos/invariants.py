@@ -293,6 +293,48 @@ def check_bg_not_starved(bg_results,
             "into starvation")
 
 
+def check_no_cold_rebuild_on_serving_path(before, after,
+                                          supervisor=None) -> None:
+    """Warm-failover contract: across a failover window (leader kill
+    or transfer, slice trip/drain, store quarantine) the serving path
+    minted NO cold columnar line — promotion re-verified the already-
+    patched replica feed against its scrub digests, it never ran a
+    ``columnar_build``.  ``before``/``after`` are
+    ``RegionColumnarCache.stats()`` snapshots bracketing the window;
+    ``supervisor`` (optional) additionally proves no promotion failed
+    digest re-verify and fell back to an invalidating rebuild."""
+    for ctr in ("misses", "rebuilds", "device_builds"):
+        if after.get(ctr, 0) > before.get(ctr, 0):
+            raise InvariantViolation(
+                f"cold build on the serving path: cache counter "
+                f"{ctr!r} grew {before.get(ctr, 0)} -> "
+                f"{after.get(ctr, 0)} across the failover window")
+    if supervisor is not None and \
+            getattr(supervisor, "promotion_rebuilds", 0):
+        raise InvariantViolation(
+            f"{supervisor.promotion_rebuilds} promotion(s) failed "
+            "scrub-digest re-verify and fell back to an invalidating "
+            "rebuild during the failover window")
+
+
+def check_replica_read_correctness(leader_rows, follower_rows) -> None:
+    """Replica-read answer parity: a follower-served coprocessor read
+    at read_ts ≤ resolved_ts returns EXACTLY what the leader serves
+    for the same request at the same timestamp — the resolved-ts gate
+    plus the shared per-region delta stream make follower feeds
+    indistinguishable from the leader's, and any divergence is a
+    consistency hole, not a performance bug."""
+    if len(leader_rows) != len(follower_rows):
+        raise InvariantViolation(
+            f"replica read row-count mismatch: leader "
+            f"{len(leader_rows)} != follower {len(follower_rows)}")
+    for i, (a, b) in enumerate(zip(leader_rows, follower_rows)):
+        if a != b:
+            raise InvariantViolation(
+                f"replica read diverged at row {i}: leader {a!r} != "
+                f"follower {b!r}")
+
+
 def check_goodput(results, floor: float) -> None:
     """The served fraction stays above ``floor`` during the brownout —
     fail-slow must not degrade into fail-stop."""

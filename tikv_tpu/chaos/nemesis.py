@@ -81,6 +81,21 @@ TENANT_FAULT_KINDS = ("tenant_storm",)
 # assert responses stay byte-equal to an unfaulted control.
 FASTPATH_FAULT_KINDS = ("fastpath_fault",)
 
+# replicated-device-serving faults (own tuple, seeded-schedule
+# stability): leader_kill crash-kills the region's CURRENT leader
+# store and restarts it over its surviving engine (resolved at apply
+# time, like leader_isolate — but the process actually dies), so the
+# election hands leadership to a follower whose already-patched
+# replica feed must be PROMOTED warm (resolved-ts catch-up + scrub-
+# digest re-verify) — never re-minted on the serving path
+# (check_no_cold_rebuild_on_serving_path).  replica_lag arms
+# device::replica_stale at a percentage so the follower stale-read
+# freshness gate refuses with DataIsNotReady — hedged device legs and
+# direct replica reads must fall through to the leader with byte-
+# identical answers (check_replica_read_correctness), never serve
+# from behind the resolved-ts watermark.
+REPLICA_FAULT_KINDS = ("leader_kill", "replica_lag")
+
 # the plain degrade-to-host failpoint sites the device_degrade nemesis
 # rotates over; the remaining device::* sites have dedicated kinds
 # above (the inventory test asserts the union covers EVERY device::*
@@ -166,6 +181,10 @@ def generate_schedule(seed: int, steps: int,
             out.append(_mk(kind, arm=rng.choice(("miss", "full",
                                                  "corrupt")),
                            pct=rng.choice((25, 50, 100))))
+        elif kind == "leader_kill":
+            out.append(_mk(kind))   # leader resolved at apply time
+        elif kind == "replica_lag":
+            out.append(_mk(kind, pct=rng.choice((25, 50, 100))))
         else:   # pragma: no cover
             raise ValueError(kind)
     return out
@@ -305,6 +324,28 @@ class Nemesis:
         pct = fault.param("pct", 100)
         failpoint.cfg("copr::fastpath", f"{pct}%return({arm})")
         self._heals.append(lambda: failpoint.remove("copr::fastpath"))
+
+    def _apply_leader_kill(self, fault: Fault) -> None:
+        """Crash-kill the CURRENT leader store of ``region_id`` and
+        restart it over its surviving engine — the election that
+        follows hands leadership to a follower, and the device layer
+        must promote that follower's already-patched replica feed
+        instead of cold-building a new line on the serving path."""
+        sid = self.cluster.leader_store(self.region_id)
+        if sid is None:
+            sid = self.rng.choice(sorted(self.cluster.stores))
+        self.cluster.restart_store(sid)
+
+    def _apply_replica_lag(self, fault: Fault) -> None:
+        """Lagging replica: device::replica_stale forces the follower
+        stale-read freshness gate to refuse (DataIsNotReady) at pct% —
+        hedged device legs and direct replica reads must fall through
+        to the leader, never answer from behind the resolved-ts
+        watermark."""
+        pct = fault.param("pct", 100)
+        failpoint.cfg("device::replica_stale", f"{pct}%return")
+        self._heals.append(
+            lambda: failpoint.remove("device::replica_stale"))
 
     def _apply_tenant_storm(self, fault: Fault) -> None:
         """One tenant's request flood, modeled at the RU ledger: a

@@ -52,6 +52,11 @@ class CopRequest:
     # request_source — resource_metering tag.rs)
     resource_group: str = "default"
     request_source: str = ""
+    # kvproto Context.stale_read: serve from THIS replica's applied
+    # state with no consensus round trip, gated at the node on
+    # dag.start_ts ≤ the region's resolved-ts watermark (DataIsNotReady
+    # on miss) — the follower device-serving read path
+    stale_read: bool = False
     # fast-path learning channel (server/fastpath.py): when the service
     # wants to learn a wire template from this request, it installs a
     # dict here and the endpoint/node fill in what the execution

@@ -68,9 +68,13 @@ device-state supervisor, device/supervisor.py):
 
 - **lifecycle invalidation** — :meth:`RegionColumnarCache.
   invalidate_region` drops a region's lines on split/merge/epoch
-  change (superseded epochs only), leader loss, snapshot apply and
-  peer destroy, instead of letting stale-epoch lines age out of the
-  LRU;
+  change (superseded epochs only), snapshot apply and peer destroy,
+  instead of letting stale-epoch lines age out of the LRU.  Leader
+  loss is NOT a teardown event: the demoted store's lines stay
+  resident as replica feeds — still patched by the delta stream
+  (follower applies publish too) and served through the resolved-ts
+  stale-read gate — so a later leader transfer back is a warm
+  promotion, not a rebuild;
 - **explicit feed teardown** — every retirement path (lifecycle,
   LRU eviction, rebuild replacement, failed bridge) fires the
   ``on_line_retired`` callback with the line's FeedLineage, which the
@@ -884,6 +888,14 @@ class RegionColumnarCache:
         from ..utils.metrics import COPR_RESIDENT_LINES
         COPR_RESIDENT_LINES.set(len(self._lines))
 
+    def region_resident(self, region_id: int) -> int:
+        """Live lines keyed to ``region_id`` (any epoch) — the warm-
+        failover precondition: a leader-gain promotion is warm only
+        when this store already holds delta-patched lines for the
+        region (device/supervisor.py ``on_role_change``)."""
+        with self._lock:
+            return sum(1 for key in self._lines if key[0] == region_id)
+
     # -- lifecycle teardown ---------------------------------------------
 
     def _retire(self, line) -> None:
@@ -906,10 +918,13 @@ class RegionColumnarCache:
                           keep_epoch: Optional[int] = None) -> int:
         """Eagerly drop ``region_id``'s lines — the lifecycle teardown
         entry point (split/merge/epoch change pass ``keep_epoch`` =
-        the surviving epoch version; leader loss / snapshot apply /
-        peer destroy drop everything).  Superseded-epoch lines can
-        never be hit again (the key embeds the epoch), so without this
-        they would linger until LRU pressure or GC."""
+        the surviving epoch version; snapshot apply / peer destroy /
+        failed promotion drop everything — leader loss deliberately
+        does NOT call this anymore: demoted lines stay resident as
+        replica feeds, patched by the same delta stream and served
+        through the resolved-ts stale-read gate).  Superseded-epoch
+        lines can never be hit again (the key embeds the epoch), so
+        without this they would linger until LRU pressure or GC."""
         dropped = []
         with self._lock:
             if keep_epoch is not None:

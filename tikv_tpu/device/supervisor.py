@@ -16,11 +16,15 @@ discipline, PAPERS.md):
 
 - **lifecycle-correct** — :class:`DeviceStateSupervisor` registers on
   the raftstore's CoprocessorHost: split/merge/epoch change
-  (``on_region_changed``), leader loss (``on_role_change``), snapshot
-  apply (``on_data_replaced``) and peer destroy (``on_peer_destroyed``)
-  eagerly invalidate the matching ``RegionColumnarCache`` lines, whose
-  retirement callback drops the device feeds — stale-epoch state is
-  torn down at the event, not aged out.
+  (``on_region_changed``), snapshot apply (``on_data_replaced``) and
+  peer destroy (``on_peer_destroyed``) eagerly invalidate the matching
+  ``RegionColumnarCache`` lines, whose retirement callback drops the
+  device feeds — stale-epoch state is torn down at the event, not aged
+  out.  Role flips (``on_role_change``) instead drive the REPLICA-FEED
+  state machine: a demoted leader's lines stay resident as follower
+  feeds (same delta stream patches them; the resolved-ts gate serves
+  them), and a leader gain over a warm feed is a PROMOTION — a
+  scrub-digest re-verify, never a ``columnar_build``.
 
 - **audited** — per-plane content digests recorded at feed build/patch
   time (position-weighted sums, odd weights so any single-element
@@ -968,6 +972,13 @@ class DeviceStateSupervisor(Observer):
         self.quarantines = 0
         self.lifecycle_invalidations = 0
         self._last_scrub: dict = {}
+        # replica-feed state machine (warm failover): regions whose
+        # lines this store keeps as follower feeds — demoted leaders
+        # plus regions that served a stale device read
+        self._replica_feed_regions: set = set()
+        self.promotions = 0             # leader gains over a warm feed
+        self.promotion_rebuilds = 0     # promotions that failed verify
+        self.demotions = 0              # leader losses (feed retained)
 
     # -- lifecycle events (CoprocessorHost observer) ------------------
     #
@@ -985,14 +996,127 @@ class DeviceStateSupervisor(Observer):
             self._note_invalidations(n)
 
     def on_role_change(self, region_id: int, is_leader: bool) -> None:
-        """Leader loss: this node stops serving the region's copr reads
-        from its maintained line; tear it down rather than letting a
-        stale-epoch line age out (re-election rebuilds cheaply)."""
-        if is_leader or self._cache is None:
+        """Role flips drive the replica-feed state machine, not a
+        teardown.
+
+        **Leader loss** (demotion): the region's lines STAY resident
+        as replica feeds.  The DeltaSink observes follower applies
+        too, so the same per-region delta stream keeps them patched,
+        and they serve any coprocessor read at ``read_ts ≤
+        resolved_ts`` through the stale-read gate.  (Before replicated
+        serving this eagerly invalidated — a leader transfer cost a
+        multi-second cold re-mint on transfer back.)
+
+        **Leader gain** over a warm feed (promotion): resolved-ts
+        catch-up already happened continuously via the delta stream,
+        so promotion is only a scrub-digest re-verify of the region's
+        resident planes — never a ``columnar_build``.  Only a digest
+        divergence (or the ``copr::replica_promote`` failpoint) falls
+        back to invalidation + cold rebuild.
+        """
+        if self._cache is None:
             return
+        if not is_leader:
+            with self._mu:
+                self.demotions += 1
+                self._replica_feed_regions.add(region_id)
+            self._publish_replica_feeds()
+            return
+        with self._mu:
+            was_replica = region_id in self._replica_feed_regions
+            self._replica_feed_regions.discard(region_id)
+        self._publish_replica_feeds()
+        if was_replica or (hasattr(self._cache, "region_resident") and
+                           self._cache.region_resident(region_id)):
+            self.promote_region(region_id)
+
+    def note_replica_feed(self, region_id: int) -> None:
+        """A stale device read served from this store's line: the line
+        is now a live replica feed (node.py ``_note_replica_read``)."""
+        with self._mu:
+            self._replica_feed_regions.add(region_id)
+        self._publish_replica_feeds()
+
+    def _publish_replica_feeds(self) -> None:
+        from ..utils.metrics import DEVICE_REPLICA_FEEDS
+        with self._mu:
+            n = len(self._replica_feed_regions)
+        DEVICE_REPLICA_FEEDS.set(n)
+
+    def promote_region(self, region_id: int) -> bool:
+        """Warm promotion of an already-patched replica feed to leader
+        serving state.  Returns True when the feed survived verify.
+
+        The feed's content is re-verified against the digests recorded
+        at build/patch time (the same audit the background scrubber
+        runs) so a leader never serves from a silently-corrupted
+        replica plane.  On divergence — or when chaos arms
+        ``copr::replica_promote`` — the region's lines invalidate and
+        the next request pays the cold rebuild, counted separately so
+        the no-cold-rebuild invariant can tell a failed verify from a
+        broken warm path."""
+        from ..utils import tracker
+        from ..utils.metrics import DEVICE_REPLICA_PROMOTION_COUNTER
+        ok = fail_point("copr::replica_promote") is None
+        if ok:
+            with tracker.phase("replica_promote"):
+                ok = self._verify_region_digests(region_id)
+        with self._mu:
+            self.promotions += 1
+            if not ok:
+                self.promotion_rebuilds += 1
+        if ok:
+            DEVICE_REPLICA_PROMOTION_COUNTER.labels("warm").inc()
+            return True
+        DEVICE_REPLICA_PROMOTION_COUNTER.labels("rebuild").inc()
         n = self._cache.invalidate_region(region_id)
         if n:
             self._note_invalidations(n)
+        return False
+
+    def _verify_region_digests(self, region_id: int) -> bool:
+        """Digest re-verify of one region's resident feeds (the scrub
+        audit, targeted): snapshot each feed's (planes, digests) pair
+        under the runner's dispatch lock, re-hash on device, compare.
+        A diverged anchor quarantines exactly as a scrub hit would.
+        No runner (host-only node) → trivially clean."""
+        runner = self._runner
+        if runner is None or not hasattr(runner, "arena_items"):
+            return True
+        dispatch_mu = getattr(runner, "_dispatch_mu", None)
+        out = {"lines": 0, "planes": 0, "divergences": 0,
+               "quarantined_regions": []}
+        clean = True
+        for anchor, bucket in runner.arena_items():
+            if getattr(anchor, "region_hint", None) != region_id:
+                continue
+            feeds = []
+            if dispatch_mu is not None:
+                dispatch_mu.acquire()
+            try:
+                for v in list(bucket.values()):
+                    if isinstance(v, dict) and "flat" in v and \
+                            v.get("digests") is not None:
+                        feeds.append((v["flat"], v["digests"],
+                                      v.get("n_live", 0)))
+            finally:
+                if dispatch_mu is not None:
+                    dispatch_mu.release()
+            diverged = False
+            for flat, digests, n in feeds:
+                for arr, want in zip(flat, digests):
+                    got = int(np.asarray(runner.device_digest(arr, n)))
+                    out["planes"] += 1
+                    if got != int(np.asarray(want)):
+                        diverged = True
+                        break
+                if diverged:
+                    break
+            if diverged:
+                clean = False
+                out["divergences"] += 1
+                self._quarantine(runner, anchor, out)
+        return clean
 
     def on_data_replaced(self, region_id: int, index: int) -> None:
         """Snapshot apply replaced the region's data wholesale: the
@@ -1192,6 +1316,10 @@ class DeviceStateSupervisor(Observer):
                 "scrub_divergences": self.scrub_divergences,
                 "quarantines": self.quarantines,
                 "lifecycle_invalidations": self.lifecycle_invalidations,
+                "replica_feeds": len(self._replica_feed_regions),
+                "promotions": self.promotions,
+                "promotion_rebuilds": self.promotion_rebuilds,
+                "demotions": self.demotions,
                 "last_scrub": dict(self._last_scrub),
             }
         if self._runner is not None and hasattr(self._runner,

@@ -36,7 +36,8 @@ class PdClient(Protocol):
 
     def ask_split(self, region: Region) -> tuple[int, list[int]]: ...
 
-    def store_heartbeat(self, store_id: int, stats: dict) -> None: ...
+    def store_heartbeat(self, store_id: int,
+                        stats: dict) -> Optional[dict]: ...
 
     def get_gc_safe_point(self) -> int: ...
 
@@ -194,8 +195,20 @@ class MockPd:
 
     # -- misc --
 
-    def store_heartbeat(self, store_id: int, stats: dict) -> None:
-        self.store_stats[store_id] = stats
+    def store_heartbeat(self, store_id: int, stats: dict
+                        ) -> Optional[dict]:
+        """Record store stats; the RESPONSE carries replica-feed
+        placement (kvproto StoreHeartbeatResponse as the operator
+        channel): hot regions this store should keep a warm follower
+        feed for, spread across peer stores under per-store HBM
+        budgets (scheduler.replica_feed_targets)."""
+        with self._lock:
+            self.store_stats[store_id] = stats
+            try:
+                targets = self.scheduler.replica_feed_targets()
+            except Exception:   # noqa: BLE001 — placement is advisory
+                return None
+        return {"replica_feed_regions": targets.get(store_id, [])}
 
     def hot_regions(self, topk: int = 8) -> dict:
         """Cluster-wide hot-region / hot-tenant RU view, merged from

@@ -141,6 +141,54 @@ def merge_hot_reports(stats_by_store: Mapping[int, dict],
     return out[:max(1, topk)]
 
 
+def spread_replica_feeds(hot_regions: Sequence[dict],
+                         region_peers: Mapping[int, Sequence[int]],
+                         hbm_budget: Mapping[int, float],
+                         hbm_resident: Mapping[int, float],
+                         feed_bytes: float = 0.0,
+                         exclude: Sequence[int] = ()) -> dict:
+    """Replica-feed placement: which stores should keep a WARM follower
+    feed for each hot region — the SlicePlacer scoring generalized one
+    level up, from mesh slices inside a node to stores across the
+    cluster.
+
+    ``hot_regions`` is ``merge_hot_reports(..., "region")`` output
+    (hot-region RU, hottest first); ``region_peers`` maps each region to
+    the stores holding its raft peers (a feed can only be minted from
+    local applied state); ``hbm_budget`` / ``hbm_resident`` are the
+    per-store device figures riding store heartbeats.  Every peer store
+    with projected HBM headroom for ``feed_bytes`` gets the region —
+    the point of replication is a hot region serving from EVERY chip
+    that holds its data — but a store past its budget is skipped
+    (residency is then arbitrated at runtime by the FeedArena's
+    tenant-share eviction, not over-promised here), and ``exclude``
+    (slow/quarantined stores) never receives.  Hottest regions claim
+    headroom first, so under pressure the budget goes to the regions
+    where a replica chip pays best.  PURE — unit tests pin decisions.
+
+    → {store_id: [region_id, ...]} in claim order.
+    """
+    projected = {sid: float(hbm_resident.get(sid, 0.0))
+                 for sid in hbm_budget}
+    out: dict = {}
+    for ent in hot_regions:
+        rid = ent.get("region")
+        if rid is None:
+            continue
+        for sid in sorted(region_peers.get(rid, ()),
+                          key=lambda s: projected.get(s, 0.0)):
+            if sid in exclude:
+                continue
+            budget = float(hbm_budget.get(sid, 0.0))
+            if budget <= 0.0:
+                continue
+            if projected.get(sid, 0.0) + feed_bytes > budget:
+                continue
+            projected[sid] = projected.get(sid, 0.0) + feed_bytes
+            out.setdefault(sid, []).append(rid)
+    return out
+
+
 def slice_scores(occupancy: Mapping[int, float],
                  load: Mapping[int, float], n_slices: int,
                  occupancy_weight: float = 1.0,
@@ -176,6 +224,30 @@ class Scheduler:
             if stats.get("slow_score", 1.0) >= self.slow_score_threshold:
                 out.add(sid)
         return out
+
+    def replica_feed_targets(self, topk: int = 8,
+                             feed_bytes: float = 0.0) -> dict:
+        """Store → hot regions it should keep warm replica feeds for
+        (rides the store-heartbeat RESPONSE, the same channel region
+        heartbeats use for operators).  Fed by the hot-region RU
+        reports and bounded by the per-store HBM figures both riding
+        store heartbeats; slow stores never receive.  Called with the
+        PD lock held (from store_heartbeat)."""
+        stats = self._pd.store_stats
+        hot = merge_hot_reports(stats, "region", topk)
+        region_peers = {
+            rid: [p.store_id for p in info.region.peers
+                  if not p.is_learner]
+            for rid, info in self._pd._regions.items()}
+        budget = {}
+        resident = {}
+        for sid, st in stats.items():
+            hbm = (st or {}).get("device_hbm") or {}
+            budget[sid] = float(hbm.get("budget_bytes", 0.0))
+            resident[sid] = float(hbm.get("resident_bytes", 0.0))
+        return spread_replica_feeds(hot, region_peers, budget, resident,
+                                    feed_bytes=feed_bytes,
+                                    exclude=self.slow_stores())
 
     def _replica_counts(self, regions) -> dict:
         """Replica count per store, INCLUDING planned moves: an

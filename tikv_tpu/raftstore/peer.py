@@ -13,9 +13,13 @@ for tests and the in-process cluster harness.
 Read path, fastest first: leader LEASE local reads
 (store/worker/read.rs LocalReader — ``local_read`` here, served by
 raftkv.py without a proposal or log barrier while the lease holds),
-then ReadIndex barriers (``propose_read`` / ``replica_read`` for
-followers), which remain the correctness backstop whenever the lease
-cannot vouch.
+then follower STALE reads (``stale_snapshot`` — any replica, no
+consensus round trip, gated on ``read_ts ≤ resolved_ts`` by the
+service layer; the replicated device-serving path answers coprocessor
+reads from the follower's own delta-patched columnar feed through this
+snapshot), then ReadIndex barriers (``propose_read`` /
+``replica_read`` for followers), which remain the correctness backstop
+whenever neither the lease nor the watermark can vouch.
 """
 
 from __future__ import annotations

@@ -653,8 +653,100 @@ class TxnClient:
             # executor batches, and before the device dispatch
             req["deadline_ms"] = deadline_ms
             timeout = min(timeout, deadline_ms / 1000.0)
+        if self.hedge_reads and not paging_size and resume_token is None:
+            # a snapshot read at a fixed start_ts is idempotent, so
+            # the adaptive-P95 hedge applies — and the second leg is
+            # now a WARM one: a follower replica answering from its
+            # own device feed (paged requests carry resume state and
+            # stay leader-only)
+            return self._hedged_coprocessor(key, req, timeout)
         return self._call_leader(key, "Coprocessor", req,
                                  timeout=timeout)
+
+    def _hedged_coprocessor(self, key: bytes, req: dict,
+                            timeout: float) -> dict:
+        """Leader coprocessor read, hedged to a follower REPLICA FEED
+        after the adaptive delay (the `_hedged_get` machinery at the
+        coprocessor layer).  The second leg used to be a cold host
+        read on the leader's sibling; with replicated device serving
+        it is a ``stale_read`` coprocessor call the follower answers
+        from its own delta-patched columnar line — warm device work,
+        not a cold rebuild.  A follower whose resolved-ts watermark
+        lags the request's start_ts refuses with DataIsNotReady and
+        the hedge falls through to the leader leg; per-store circuit
+        breakers gate both legs unchanged."""
+        import concurrent.futures as cf
+        from ..utils.metrics import HEDGE_COUNTER
+        region, leader = self._lookup_region(key)
+        pool = self._hedge_executor()
+        f_leader = pool.submit(self._call_leader, key, "Coprocessor",
+                               req, 8, timeout)
+        try:
+            r = f_leader.result(timeout=self.hedge_delay())
+            HEDGE_COUNTER.labels("copr_leader_fast").inc()
+            return r
+        except cf.TimeoutError:
+            pass
+        except wire.RemoteError as e:
+            if e.kind == "key_is_locked":
+                raise   # resolution, not hedging, unblocks this read
+        followers = [p for p in region.peers
+                     if (leader is None or p.store_id != leader.store_id)
+                     and not p.is_learner]
+        if not followers:
+            return f_leader.result(timeout=timeout + 1)
+        self.hedges_fired += 1
+        HEDGE_COUNTER.labels("copr_fired").inc()
+        target = followers[self.hedges_fired % len(followers)]
+        stale = dict(req)
+        stale["stale_read"] = True
+        f_follow = pool.submit(self._store_call, target.store_id,
+                               "Coprocessor", stale, timeout)
+        done, _ = cf.wait({f_leader, f_follow}, timeout=timeout + 1,
+                          return_when=cf.FIRST_COMPLETED)
+        order = sorted([f_leader, f_follow],
+                       key=lambda f: (f not in done, f is f_follow))
+        for fut in order:
+            try:
+                r = fut.result(timeout=timeout + 1)
+                if fut is f_follow:
+                    self.hedges_won += 1
+                    HEDGE_COUNTER.labels("copr_follower_won").inc()
+                else:
+                    HEDGE_COUNTER.labels("copr_leader_won").inc()
+                return r
+            except wire.RemoteError as e:
+                if fut is f_follow and e.kind == "data_is_not_ready":
+                    # lagging replica refused (resolved-ts gate): the
+                    # leader leg is the consistent fallback
+                    HEDGE_COUNTER.labels("copr_stale_refused").inc()
+                continue
+            except Exception:   # noqa: BLE001 — try the other leg
+                continue
+        return f_leader.result(timeout=timeout + 1)
+
+    def coprocessor_replica(self, dag, key_hint: Optional[bytes] = None,
+                            resource_group: str = "default",
+                            request_source: str = "",
+                            timeout: float = 10) -> dict:
+        """Direct follower device read (``stale_read`` coprocessor):
+        served from the follower's own columnar line under the
+        resolved-ts watermark.  Raises ``data_is_not_ready`` when the
+        watermark lags the snapshot ts — callers wanting the fallback
+        use the hedged path (``hedge_reads=True``)."""
+        key = key_hint if key_hint is not None else \
+            (dag.ranges[0].start if dag.ranges else b"")
+        region, leader = self._lookup_region(key)
+        followers = [p for p in region.peers
+                     if (leader is None or p.store_id != leader.store_id)
+                     and not p.is_learner]
+        target = followers[0] if followers else leader
+        req = {"tp": 103, "dag": wire.enc_dag(dag),
+               "force_backend": None, "paging_size": 0,
+               "resume_token": None, "resource_group": resource_group,
+               "request_source": request_source, "stale_read": True}
+        return self._store_call(target.store_id, "Coprocessor", req,
+                                timeout=timeout)
 
     def coprocessor_plan(self, preq, key_hint: Optional[bytes] = None,
                          force_backend: Optional[str] = None,

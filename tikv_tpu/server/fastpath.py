@@ -61,6 +61,13 @@ The entry also pre-binds the per-class trace/metering template: the
 compile-class key for the read pool's EWMA, the resource tag for RU
 attribution and the response envelope — so a hit charges RU and seals
 traces exactly as the slow path does without rebuilding any of it.
+
+Three tiers (``_ClassEntry.tier``) scale how much ceremony a hit
+skips: ``dispatch`` (device-cached TableScan — decode AND snapshot/
+routing hoisted), ``decode`` (host-routed IndexScan — only the wire
+decode hoisted, the full serving ceremony re-runs), and ``plan``
+(plan-IR — decode + plan re-analysis hoisted onto one cached
+PlanRequest with the TSO re-stamped; constants are class identity).
 """
 
 from __future__ import annotations
@@ -335,8 +342,15 @@ class WireTemplate:
 
 # request keys the fast path understands end to end; anything else in
 # the body carries semantics the template cannot replay — ineligible
+# (stale_read deliberately absent: the dispatch tier's snapshot has no
+# resolved-ts gate, so follower stale reads always take the full path)
 _ALLOWED_REQ_KEYS = frozenset((
     "tp", "dag", "force_backend", "paging_size", "resume_token",
+    "resource_group", "request_source", "deadline_ms", "trace_id"))
+
+# plan-IR request envelope: same eligibility rules, "plan" body
+_ALLOWED_PLAN_KEYS = frozenset((
+    "tp", "plan", "force_backend", "paging_size", "resume_token",
     "resource_group", "request_source", "deadline_ms", "trace_id"))
 
 
@@ -413,6 +427,57 @@ def _mark_slots(req: dict):
             raise _Ineligible("non-str trace id")
         marked["trace_id"] = _Slot(K_TRACE_ID, vtype=str)
     return marked, n_const
+
+
+def _mark_slots_plan(req: dict):
+    """Plan-IR variant of ``_mark_slots``: only the envelope scalars
+    rotate (``start_ts``, ``deadline_ms``, ``trace_id``) — every plan
+    constant stays FIXED BYTES, i.e. part of the class identity (a
+    changed constant is a structural miss that learns a sibling
+    class), so the hit path reuses ONE decoded PlanRequest with the
+    TSO re-stamped instead of re-walking the nested node tree."""
+    if not isinstance(req, dict):
+        raise _Ineligible("non-dict request")
+    if set(req) - _ALLOWED_PLAN_KEYS:
+        raise _Ineligible("unknown request fields")
+    if req.get("tp", 103) != 103 or req.get("force_backend") is not None \
+            or req.get("paging_size", 0) or \
+            req.get("resume_token") is not None:
+        raise _Ineligible("non-fast request options")
+    plan = req.get("plan")
+    if not isinstance(plan, dict):
+        raise _Ineligible("no plan body")
+    if "start_ts" not in plan or type(plan["start_ts"]) is not int:
+        raise _Ineligible("no start_ts")
+    marked = dict(req)
+    mplan = dict(plan)
+    mplan["start_ts"] = _Slot(K_START_TS, vtype=int)
+    marked["plan"] = mplan
+    if "deadline_ms" in marked:
+        if type(marked["deadline_ms"]) is not int:
+            raise _Ineligible("non-int deadline")
+        marked["deadline_ms"] = _Slot(K_DEADLINE, vtype=int)
+    if "trace_id" in marked:
+        if type(marked["trace_id"]) is not str:
+            raise _Ineligible("non-str trace id")
+        marked["trace_id"] = _Slot(K_TRACE_ID, vtype=str)
+    return marked, 0
+
+
+def _slot_originals(slots, req: dict, body: str) -> list:
+    """The learned request's own slot values, in template order — the
+    input of the byte-exact render round-trip self-validation."""
+    orig = []
+    for s in slots:
+        if s.kind == K_CONST:
+            orig.append(_const_at(req["dag"], s.index))
+        elif s.kind == K_START_TS:
+            orig.append(req[body]["start_ts"])
+        elif s.kind == K_DEADLINE:
+            orig.append(req["deadline_ms"])
+        else:
+            orig.append(req["trace_id"])
+    return orig
 
 
 def _dag_const_substituter(dag) -> Callable:
@@ -532,18 +597,34 @@ def _key_template(key: tuple):
 
 class _ClassEntry:
     """One learned request class: template + everything the hit path
-    needs pre-bound."""
+    needs pre-bound.
+
+    ``tier`` names how much of the ceremony a hit skips:
+
+    - ``dispatch`` — the original full fast path (device-cached
+      TableScan): skip decode AND snapshot/routing, jump straight to
+      the coalescer against the captured storage generation;
+    - ``decode`` — decode-only (host-routed IndexScan classes): skip
+      ``wire.unpack`` + ``dec_dag``, then run the FULL serving
+      ceremony (snapshot, routing, freshness) with the pre-built DAG,
+      so correctness never depends on the cached entry;
+    - ``plan`` — plan-IR classes: skip ``wire.unpack`` + ``dec_plan``
+      + plan re-analysis, re-stamp the TSO on one decoded
+      PlanRequest, then ``handle_plan`` runs its normal ceremony.
+    """
 
     __slots__ = (
-        "template", "make_dag", "class_key", "trace_class",
-        "range_start", "resource_group", "request_source", "tag",
-        "key_hint", "ranges", "base_key", "storage_ref", "config_gen",
-        "bkey", "share_fill", "n_est", "d2h_bytes", "hits",
-        "invalidated")
+        "template", "make_dag", "make_plan", "tier", "class_key",
+        "trace_class", "range_start", "resource_group",
+        "request_source", "tag", "key_hint", "ranges", "base_key",
+        "storage_ref", "config_gen", "bkey", "share_fill", "n_est",
+        "d2h_bytes", "hits", "invalidated")
 
     def __init__(self):
         self.hits = 0
         self.invalidated = None     # reason str once dead
+        self.tier = "dispatch"
+        self.make_plan = None
 
     def storage(self):
         ref = self.storage_ref
@@ -663,9 +744,6 @@ class FastPathCache:
             return False
         dag = info.get("dag")
         storage = info.get("storage")
-        if dag is None or storage is None:
-            self._note("bypass", "no_learn_info")
-            return False
         reject_key = info.get("class_key")
         with self._mu:
             if reject_key is not None and \
@@ -674,9 +752,22 @@ class FastPathCache:
                 # permanently-ineligible class at this config gen:
                 # skip the construction pipeline entirely
                 return False
-        if info.get("backend") != "device" or \
+        if info.get("plan") is not None:
+            return self._learn_plan(raw, req, info, reject_key)
+        if dag is None:
+            self._note("bypass", "no_learn_info")
+            return False
+        if storage is None or info.get("backend") != "device" or \
                 info.get("decision") not in ("device_batched",
                                              "device_solo"):
+            # no device-cached storage to pin a dispatch entry to —
+            # but an IndexScan class still repays hoisting the decode:
+            # admit a DECODE-tier template (the hit skips wire.unpack
+            # + dec_dag, the full ceremony still runs per request)
+            from ..copr.dag import IndexScanDesc
+            if dag.executors and \
+                    isinstance(dag.executors[0], IndexScanDesc):
+                return self._learn_decode(raw, req, info, reject_key)
             self._note("bypass", f"route_{info.get('decision') or 'host'}")
             return False
         lineage = getattr(storage, "feed_lineage", None)
@@ -691,16 +782,7 @@ class FastPathCache:
             # self-validation 1: byte-exact render round trip — the
             # template's encoder agrees with the client's msgpack for
             # THIS shape, or the class never fast-paths
-            orig = []
-            for s in slots:
-                if s.kind == K_CONST:
-                    orig.append(_const_at(req["dag"], s.index))
-                elif s.kind == K_START_TS:
-                    orig.append(req["dag"]["start_ts"])
-                elif s.kind == K_DEADLINE:
-                    orig.append(req["deadline_ms"])
-                else:
-                    orig.append(req["trace_id"])
+            orig = _slot_originals(slots, req, "dag")
             if template.render(orig) != raw:
                 raise _Ineligible("render mismatch")
             make_dag = _dag_const_substituter(dag)
@@ -766,6 +848,10 @@ class FastPathCache:
             return False
         ent.n_est = info.get("n_est")
         ent.d2h_bytes = info.get("d2h_bytes", 0.0)
+        self._admit(ent)
+        return True
+
+    def _admit(self, ent: _ClassEntry) -> None:
         with self._mu:
             # retire dead entries and any template this one SUPERSEDES
             # — same TEMPLATE IDENTITY (fixed segments + slot kinds: it
@@ -784,6 +870,105 @@ class FastPathCache:
             del self._entries[self.capacity:]
             self.learned += 1
         _count("learn", "ok")
+
+    def _learn_common(self, ent: _ClassEntry, req: dict) -> None:
+        """Envelope fields every tier pre-binds identically."""
+        ent.resource_group = req.get("resource_group", "default")
+        ent.request_source = req.get("request_source", "")
+        from ..resource_metering import ResourceTagFactory
+        ent.tag = ResourceTagFactory.tag(ent.resource_group or "default",
+                                         ent.request_source or "")
+        ent.key_hint = None
+        ent.base_key = None
+        ent.storage_ref = None
+        ent.config_gen = self.config_gen
+        ent.bkey = None
+        ent.share_fill = None
+        ent.n_est = None
+        ent.d2h_bytes = 0.0
+
+    def _learn_decode(self, raw: bytes, req: dict, info: dict,
+                      reject_key) -> bool:
+        """Admit a DECODE-tier class (host-routed IndexScan): the same
+        two self-validations as the dispatch tier — byte-exact render
+        round trip, constructor-rebuilds-the-decoded-DAG — but nothing
+        snapshot-bound is captured, because the hit replays the full
+        serving ceremony with only the wire decode hoisted."""
+        dag = info["dag"]
+        try:
+            marked, _ = _mark_slots(req)
+            segments, slots = _encode_segments(marked)
+            template = WireTemplate(segments, slots)
+            orig = _slot_originals(slots, req, "dag")
+            if template.render(orig) != raw:
+                raise _Ineligible("render mismatch")
+            make_dag = _dag_const_substituter(dag)
+            consts = [v for s, v in zip(slots, orig)
+                      if s.kind == K_CONST]
+            if make_dag(consts, dag.start_ts) != dag:
+                raise _Ineligible("constructor mismatch")
+        except Exception as e:   # noqa: BLE001 — ineligible, never fatal
+            reason = e.args[0] if isinstance(e, _Ineligible) and e.args \
+                else "learn_error"
+            self._note("bypass", str(reason)[:40])
+            self._reject(reject_key)
+            return False
+        ent = _ClassEntry()
+        ent.tier = "decode"
+        ent.template = template
+        ent.make_dag = make_dag
+        ent.class_key = info.get("class_key") or ("copr", dag.class_key())
+        ent.trace_class = ent.class_key
+        ent.range_start = dag.ranges[0].start if dag.ranges else None
+        ent.ranges = dag.ranges
+        self._learn_common(ent, req)
+        self._admit(ent)
+        return True
+
+    def _learn_plan(self, raw: bytes, req: dict, info: dict,
+                    reject_key) -> bool:
+        """Admit a PLAN-tier class: one decoded PlanRequest is cached
+        per wire shape (constants are class identity — only the TSO
+        envelope rotates), so a repeat skips ``wire.unpack`` +
+        ``dec_plan`` and jumps to ``handle_plan``, which runs its
+        normal per-leaf snapshot + fragment-routing ceremony."""
+        preq = info["plan"]
+        try:
+            marked, _ = _mark_slots_plan(req)
+            segments, slots = _encode_segments(marked)
+            template = WireTemplate(segments, slots)
+            orig = _slot_originals(slots, req, "plan")
+            if template.render(orig) != raw:
+                raise _Ineligible("render mismatch")
+            import dataclasses
+
+            def make_plan(start_ts: int, preq=preq):
+                return dataclasses.replace(preq, start_ts=start_ts)
+
+            # self-validation: re-stamping the learned TSO reproduces
+            # the decoded request exactly
+            if make_plan(preq.start_ts) != preq:
+                raise _Ineligible("constructor mismatch")
+        except Exception as e:   # noqa: BLE001 — ineligible, never fatal
+            reason = e.args[0] if isinstance(e, _Ineligible) and e.args \
+                else "learn_error"
+            self._note("bypass", str(reason)[:40])
+            self._reject(reject_key)
+            return False
+        ent = _ClassEntry()
+        ent.tier = "plan"
+        ent.template = template
+        ent.make_dag = None
+        ent.make_plan = make_plan
+        ent.class_key = info.get("class_key") or \
+            ("copr_plan", preq.class_key())
+        ent.trace_class = ent.class_key
+        leaves = preq.scan_leaves()
+        ent.range_start = leaves[0].ranges[0].start \
+            if leaves and leaves[0].ranges else None
+        ent.ranges = tuple(r for lf in leaves for r in lf.ranges)
+        self._learn_common(ent, req)
+        self._admit(ent)
         return True
 
     # ------------------------------------------------------ invalidation
@@ -828,10 +1013,14 @@ class FastPathCache:
     def stats(self) -> dict:
         with self._mu:
             total = self.hit + self.miss + self.bypass + self.fallback
+            tiers: dict = {}
+            for e in self._entries:
+                tiers[e.tier] = tiers.get(e.tier, 0) + 1
             return {
                 "enabled": self.enabled,
                 "capacity": self.capacity,
                 "classes": len(self._entries),
+                "tiers": tiers,
                 "learned": self.learned,
                 "hit": self.hit, "miss": self.miss,
                 "bypass": self.bypass, "fallback": self.fallback,

@@ -470,6 +470,13 @@ class Node:
             compact_ratio=config.coprocessor.tombstone_compact_ratio,
             max_delta_rows=config.coprocessor.delta_log_rows)
         self.device_runner = device_runner      # /health selection rollup
+        # replica device serving (kvproto stale_read at the copr layer):
+        # follower reads this store has served from its own columnar
+        # lines, regions those lines cover, and resolved-ts refusals
+        self._replica_reads = 0
+        self._replica_refused = 0
+        self._replica_regions: set = set()
+        self._replica_hint_regions: set = set()
         # cross-request device batching: the coalescing dispatcher +
         # cost-based admission router in front of the device backend
         # (server/coalescer.py); window 0 disables it
@@ -839,9 +846,21 @@ class Node:
                     rep = GLOBAL_RECORDER.maybe_report()
                     if rep is not None:
                         hb["resource_metering"] = rep
+                    # per-store HBM figures ride the heartbeat so PD's
+                    # replica-feed spread stays within device budgets
+                    hbm = getattr(self.device_runner, "hbm_stats", None)
+                    if callable(hbm):
+                        st = hbm()
+                        hb["device_hbm"] = {
+                            "budget_bytes": st.get("budget_bytes", 0),
+                            "resident_bytes": st.get("resident_bytes",
+                                                     0)}
                     self._refresh_feature_gate()
                     self._gc_manager_tick()
-                    self.pd.store_heartbeat(self.store_id, hb)
+                    hb_resp = self.pd.store_heartbeat(self.store_id, hb)
+                    if isinstance(hb_resp, dict):
+                        self._apply_replica_hints(
+                            hb_resp.get("replica_feed_regions") or ())
                     # advance resolved-ts watermarks with a fresh TSO
                     # (resolved_ts advance worker cadence).  The ts is
                     # registered in the concurrency manager FIRST so any
@@ -974,6 +993,13 @@ class Node:
         the host vectorized path and the device backend see dense tiles
         with stable identity across requests (copr/region_cache.py);
         everything else falls back to the row-at-a-time MVCC adapter.
+
+        ``req.stale_read`` is the follower device-serving path: this
+        replica mints/patches its OWN columnar line from applied state
+        (the DeltaSink publishes follower applies too) and serves with
+        NO consensus round trip, gated on ``start_ts ≤ resolved_ts``
+        (DataIsNotReady on miss — the client falls through to the
+        leader leg, kvproto stale_read semantics).
         """
         start = req.dag.ranges[0].start if req.dag.ranges else b""
         key_hint = encode_first(start)
@@ -987,13 +1013,23 @@ class Node:
             cm.read_ranges_check(req.dag.ranges, req.dag.start_ts)
         else:
             cm.read_range_check(None, None, req.dag.start_ts)
+        stale = getattr(req, "stale_read", False)
+        if stale:
+            self._check_replica_freshness(key_hint, req.dag.start_ts)
         with tracker.phase("snapshot"):
-            snap = self.raft_kv.snapshot(SnapContext(key_hint=key_hint))
+            snap = self.raft_kv.snapshot(
+                SnapContext(key_hint=key_hint, stale_read=stale))
         execs = req.dag.executors
         if execs and isinstance(execs[0], TableScanDesc):
-            with tracker.phase("columnar_cache"):
+            # the replica leg labels its cache access as replica_patch:
+            # same lookup + delta catch-up mechanics, but the span name
+            # keeps follower-feed latency separable from leader serving
+            with tracker.phase("replica_patch" if stale
+                               else "columnar_cache"):
                 ent = self.copr_cache.get(snap, req.dag)
             if ent is not None:
+                if stale:
+                    self._note_replica_read(snap.region.id)
                 learn = getattr(req, "fp_learn", None)
                 if learn is not None:
                     # fast-path learning (server/fastpath.py): the
@@ -1004,6 +1040,67 @@ class Node:
                     learn["epoch_version"] = snap.region.epoch.version
                 return ent
         return MvccScanStorage(MvccReader(snap), req.dag.start_ts)
+
+    def _check_replica_freshness(self, key_hint: bytes,
+                                 read_ts: int) -> int:
+        """Resolved-ts gate for a follower device read: closed
+        timestamps guarantee no commit at ts ≤ resolved_ts can newly
+        appear, so an applied-state snapshot is exact for any read at
+        or below the watermark.  Above it the replica REFUSES
+        (DataIsNotReady) rather than serving a possibly-incomplete
+        answer — the client's hedge falls through to the leader.  The
+        ``device::replica_stale`` failpoint forces the refusal (chaos
+        ``replica_lag``: exercises the fall-through leg)."""
+        from ..raftstore.metapb import DataIsNotReady
+        from ..utils.failpoint import fail_point
+        peer = self.raft_store.peer_by_key(key_hint)
+        rts = self.resolved_ts.resolver(peer.region.id).resolved_ts
+        if fail_point("device::replica_stale") is not None:
+            self._replica_refused += 1
+            raise DataIsNotReady(peer.region.id, 0, read_ts)
+        if read_ts > rts:
+            self._replica_refused += 1
+            raise DataIsNotReady(peer.region.id, rts, read_ts)
+        return peer.region.id
+
+    def _note_replica_read(self, region_id: int) -> None:
+        """Replica-serving accounting: regions this store has served a
+        follower device read for (the line is now a live replica feed,
+        kept patched by the delta stream) + the /metrics gauge."""
+        self._replica_reads += 1
+        if region_id not in self._replica_regions:
+            self._replica_regions.add(region_id)
+            sup = getattr(self, "device_supervisor", None)
+            if sup is not None:
+                sup.note_replica_feed(region_id)
+
+    def _apply_replica_hints(self, regions) -> None:
+        """PD replica placement landed in the store-heartbeat response:
+        hot regions this store should keep a warm follower feed for.
+        The hint marks the region a replica-feed target — its first
+        stale read mints the line OFF the failover path, and from then
+        on the delta stream keeps it patched; residency is still
+        arbitrated by the FeedArena's tenant-share eviction, so a hint
+        is advisory, never an HBM reservation."""
+        from ..utils.metrics import DEVICE_PLACEMENT_COUNTER
+        for rid in regions:
+            if rid in self._replica_hint_regions:
+                continue
+            self._replica_hint_regions.add(rid)
+            DEVICE_PLACEMENT_COUNTER.labels("replica_spread").inc()
+
+    def replica_serving_stats(self) -> dict:
+        """/health ``replica_serving`` rollup source."""
+        sup = getattr(self, "device_supervisor", None)
+        return {
+            "replica_reads": self._replica_reads,
+            "refused": self._replica_refused,
+            "replica_regions": sorted(self._replica_regions),
+            "placement_hints": sorted(self._replica_hint_regions),
+            "promotions": getattr(sup, "promotions", 0),
+            "demotions": getattr(sup, "demotions", 0),
+            "promotion_rebuilds": getattr(sup, "promotion_rebuilds", 0),
+        }
 
     def fastpath_snapshot(self, ent, start_ts: int):
         """Slim per-request snapshot ceremony for a fast-path hit

@@ -79,8 +79,9 @@ class PdService:
         return {"new_region_id": new_id, "new_peer_ids": peer_ids}
 
     def StoreHeartbeat(self, req: dict) -> dict:
-        self.pd.store_heartbeat(req["store_id"], req.get("stats", {}))
-        return {}
+        resp = self.pd.store_heartbeat(req["store_id"],
+                                       req.get("stats", {}))
+        return resp or {}
 
     def HotRegions(self, req: dict) -> dict:
         """Cluster-wide hot-region/hot-tenant RU view merged from the
@@ -186,8 +187,9 @@ class RemotePdClient:
         r = self._call("AskSplit", {"region": wire.enc_region(region)})
         return r["new_region_id"], r["new_peer_ids"]
 
-    def store_heartbeat(self, store_id: int, stats: dict) -> None:
-        self._call("StoreHeartbeat", {"store_id": store_id, "stats": stats})
+    def store_heartbeat(self, store_id: int, stats: dict):
+        return self._call("StoreHeartbeat",
+                          {"store_id": store_id, "stats": stats})
 
     def hot_regions(self, topk: int = 8) -> dict:
         return self._call("HotRegions", {"topk": topk})

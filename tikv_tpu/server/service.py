@@ -136,10 +136,12 @@ class KvService:
         if out is not None:
             return out
         req = wire.unpack(raw)
-        learnable = isinstance(req, dict) and "dag" in req and \
-            "plan" not in req and req.get("force_backend") is None and \
+        learnable = isinstance(req, dict) and \
+            ("dag" in req or "plan" in req) and \
+            req.get("force_backend") is None and \
             not req.get("paging_size") and \
             req.get("resume_token") is None and \
+            not req.get("stale_read") and \
             req.get("tp", REQ_TYPE_DAG) == REQ_TYPE_DAG
         if learnable:
             # learning channel: the endpoint/node fill in what the
@@ -148,7 +150,7 @@ class KvService:
         resp = self.handle(method, req)
         learn = req.pop("__fp_learn", None) if isinstance(req, dict) \
             else None
-        if learn and learn.get("storage") is not None and \
+        if learn and ("dag" in learn or "plan" in learn) and \
                 isinstance(resp, dict) and not resp.get("error"):
             try:
                 # learn from a FRESH unpack: the executed dict was
@@ -167,16 +169,20 @@ class KvService:
         ent, values = fp.find(raw)
         if ent is None:
             return None
-        # pre-commit generation guard (before any RU is charged, so
-        # the full-decode fallback never double-charges): the learned
-        # storage must still be its cache line's NEWEST generation —
-        # a delta patch, rebuild, epoch sweep or eviction since learn
-        # retires the entry and this request re-learns
-        storage = ent.storage()
-        if storage is None or not self.node.copr_cache.is_current(
-                ent.base_key, storage):
-            fp.drop(ent, "generation")
-            return None
+        storage = None
+        if ent.tier == "dispatch":
+            # pre-commit generation guard (before any RU is charged,
+            # so the full-decode fallback never double-charges): the
+            # learned storage must still be its cache line's NEWEST
+            # generation — a delta patch, rebuild, epoch sweep or
+            # eviction since learn retires the entry and this request
+            # re-learns.  decode/plan tiers skip this: they replay the
+            # full serving ceremony, which re-decides freshness itself
+            storage = ent.storage()
+            if storage is None or not self.node.copr_cache.is_current(
+                    ent.base_key, storage):
+                fp.drop(ent, "generation")
+                return None
         consts = []
         start_ts = 0
         deadline_ms = None
@@ -234,7 +240,8 @@ class KvService:
         # nest inside it, and a warm trace still decomposes ≥95% of a
         # now-much-shorter wall
         with tracker.span("fastpath"):
-            tracker.label("fastpath", "hit")
+            tracker.label("fastpath",
+                          "hit" if ent.tier == "dispatch" else ent.tier)
             dl = None
             if deadline_ms is not None:
                 dl = Deadline.after_ms(deadline_ms)
@@ -249,12 +256,29 @@ class KvService:
             # every thread handoff exactly as on the slow path
             from ..resource_metering import bind_request_tag
             bind_request_tag(ent.tag, group)
-            dag = ent.make_dag(consts, start_ts)
+            if ent.tier == "plan":
+                preq = ent.make_plan(start_ts)
+            else:
+                dag = ent.make_dag(consts, start_ts)
 
             def dispatch():
+                if ent.tier == "plan":
+                    # plan tier: the wire decode + plan re-analysis
+                    # are hoisted; handle_plan runs its normal per-
+                    # leaf snapshot + fragment-routing ceremony
+                    fp.note_hit(ent)
+                    return self.endpoint.handle_plan(
+                        preq, resource_group=ent.resource_group,
+                        request_source=ent.request_source)
                 creq = CopRequest(REQ_TYPE_DAG, dag,
                                   resource_group=ent.resource_group,
                                   request_source=ent.request_source)
+                if ent.tier == "decode":
+                    # decode tier: only the wire decode is skipped —
+                    # the full ceremony (snapshot, routing, freshness)
+                    # re-runs, so nothing snapshot-bound was captured
+                    fp.note_hit(ent)
+                    return self.endpoint.handle_async(creq)
                 got = self.node.fastpath_snapshot(ent, start_ts)
                 if got is None or got is not storage:
                     # the generation moved between the pre-commit
@@ -280,7 +304,10 @@ class KvService:
                         dispatch, "normal", deadline=dl,
                         class_key=ent.class_key, resource_group=group)
                     with tracker.span("await_deferred"):
-                        resp = d.wait()
+                        # the plan tier returns a finished CopResponse
+                        # (handle_plan is synchronous); dag tiers park
+                        # on the deferred device completion
+                        resp = d.wait() if hasattr(d, "wait") else d
                 except Exception as e:  # noqa: BLE001 — ride the wire
                     env = {"error": wire.enc_error(e)}
             finally:
@@ -702,6 +729,13 @@ class KvService:
             # plan-IR request: the operator superset (join/sort/window
             # + mixed per-fragment routing, copr/plan_ir.py)
             preq = req.pop("__plan", None) or wire.dec_plan(req["plan"])
+            learn = req.get("__fp_learn")
+            if learn is not None:
+                # plan-tier fast-path learning: the decoded request +
+                # compile-class key are all the template learner needs
+                # (no storage capture — hits replay the full ceremony)
+                learn["plan"] = preq
+                learn["class_key"] = req.get("__trace_class")
             resp = self.endpoint.handle_plan(
                 preq, force_backend=req.get("force_backend"),
                 resource_group=req.get("resource_group", "default"),
@@ -738,6 +772,7 @@ class KvService:
             resume_token=req.get("resume_token"),
             resource_group=req.get("resource_group", "default"),
             request_source=req.get("request_source", ""),
+            stale_read=req.get("stale_read", False),
             fp_learn=learn)
         # dispatch under the read-pool slot, await outside it: handle()
         # resolves the "__deferred" marker after the slot is released

@@ -135,6 +135,14 @@ class StatusServer:
                         # scrub passes/divergences, quarantines, and
                         # lifecycle invalidation counts
                         body["device_state"] = sup.stats()
+                    if hasattr(node, "replica_serving_stats"):
+                        # replicated device serving: follower replica
+                        # reads served/refused by the resolved-ts
+                        # gate, regions with a live replica feed, PD
+                        # placement hints, and the warm-promotion /
+                        # rebuild / demotion counts
+                        body["replica_serving"] = \
+                            node.replica_serving_stats()
                     # cold-path kill rollup: device-resolve builds
                     # (mvcc_resolve/h2d_stream phases), mint counters,
                     # and the streaming ingest pipeline's parse/upload

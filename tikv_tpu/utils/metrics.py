@@ -323,7 +323,12 @@ PEER_BREAKER_GAUGE = REGISTRY.gauge(
     labels=("peer_store",))
 HEDGE_COUNTER = REGISTRY.counter(
     "tikv_client_hedged_reads_total",
-    "hedged point reads by outcome (fired / follower_won / leader_won)",
+    "hedged reads by outcome — point gets (leader_fast / fired / "
+    "follower_won / leader_won) and device coprocessor hedges against "
+    "a follower replica feed (copr_leader_fast / copr_fired / "
+    "copr_follower_won / copr_leader_won / copr_stale_refused = the "
+    "lagging replica's resolved-ts gate refused and the leader leg "
+    "answered)",
     labels=("outcome",))
 DEVICE_SEL_ROUTE_COUNTER = REGISTRY.counter(
     "tikv_device_selection_route_total",
@@ -427,6 +432,19 @@ DEVICE_PLACEMENT_COUNTER = REGISTRY.counter(
     "slice, move = rebalance dropped an anchor off a hot slice, "
     "whole_mesh = feed large enough to shard over every chip)",
     labels=("decision",))
+DEVICE_REPLICA_FEEDS = REGISTRY.gauge(
+    "tikv_device_replica_feeds",
+    "regions this store holds a live follower replica feed for — a "
+    "delta-patched columnar line serving resolved-ts-gated stale "
+    "coprocessor reads (demoted leaders + stale-read-minted lines)")
+DEVICE_REPLICA_PROMOTION_COUNTER = REGISTRY.counter(
+    "tikv_device_replica_promotion_total",
+    "leader-gain promotions of an already-patched replica feed (warm "
+    "= scrub-digest re-verify passed and the feed serves as leader "
+    "state with zero columnar_build, rebuild = verify failed or "
+    "copr::replica_promote armed — lines invalidated, next request "
+    "pays the cold build)",
+    labels=("outcome",))
 DEVICE_JOIN_ROUTE_COUNTER = REGISTRY.counter(
     "tikv_device_join_route_total",
     "plan-IR join fragment routing outcomes (device = one-dispatch "

@@ -33,6 +33,12 @@ SPAN_VOCABULARY: dict[str, str] = {
     "kv_read": "point/scan MVCC read through Storage",
     "snapshot": "raft lease read + engine snapshot acquisition",
     "columnar_cache": "RegionColumnarCache lookup (hit/patch/build)",
+    "replica_patch": "follower replica-feed lookup + delta catch-up "
+                     "on the stale-read serving path (node.py "
+                     "_copr_snapshot, stale leg)",
+    "replica_promote": "leader-gain promotion of a warm replica feed: "
+                       "scrub-digest re-verify, never a "
+                       "columnar_build (device/supervisor.py)",
     "columnar_build": "full columnar line build from the MVCC snapshot",
     "delta_apply": "committed-write delta patch onto a cached line",
     "host_exec": "host (numpy) executor pipeline run",
