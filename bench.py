@@ -865,6 +865,267 @@ def run_write_churn(device_runner, iters: int):
         pd_server.stop()
 
 
+def run_split_under_churn(device_runner, iters: int):
+    """Config 6s: the elastic feed lifecycle under churn — a warm
+    region SPLITS while a writer thread races warm queries, then a
+    mass invalidation storms the re-mint governor.
+
+    What it proves (the elastic tentpole): a load-split is a SLICE,
+    not a rebuild — the cache slices its line into child lines at the
+    children's epochs and the device slices the resident feed by key
+    range (``device_split``), so the split and every child query that
+    follows mint ZERO full ``columnar_build``s (``# columnar_builds=``
+    adjudicates at 0).  Also measured: one placement ICI move of a
+    warm 10M-row feed (``# migration_ms=`` — the <100ms acceptance),
+    and a mass-invalidation leg where every region rebuilds at once
+    under the re-mint governor (bounded concurrency, peak queue depth
+    as ``# remint_queue_depth=``) vs an effectively-unthrottled
+    governor on the same storm.
+    """
+    import threading as _th
+
+    import jax as _jax
+
+    from tikv_tpu.codec.keys import table_record_key
+    from tikv_tpu.device import DeviceRunner
+    from tikv_tpu.device.supervisor import RemintGovernor
+    from tikv_tpu.executors.ranges import KeyRange
+    from tikv_tpu.parallel import make_mesh
+    from tikv_tpu.raftstore.metapb import NotLeaderError, Store
+    from tikv_tpu.server import (
+        Node, PdServer, RemoteError, RemotePdClient, TikvServer,
+        TxnClient,
+    )
+    from tikv_tpu.testing.dag import DagSelect
+    from tikv_tpu.testing.fixture import encode_table_row, int_table
+
+    n = int(os.environ.get("TIKV_TPU_BENCH_SPLIT_ROWS", 1 << 18))
+    # the node gets its own PLACEMENT runner: a device split slices a
+    # feed resident on one slice — whole-mesh-sharded feeds re-mint —
+    # so the parent must pin below the whole-mesh cutoff
+    device_runner = DeviceRunner(mesh=make_mesh(_jax.devices()),
+                                 chunk_rows=1 << 12, placement=True,
+                                 placement_rows=max(1 << 20, 2 * n))
+    pd_server = PdServer("127.0.0.1:0")
+    pd_server.start()
+    pd_addr = f"127.0.0.1:{pd_server.port}"
+    node = Node("127.0.0.1:0", RemotePdClient(pd_addr),
+                device_runner=device_runner, device_row_threshold=64)
+    # splits are driven explicitly below — no size-triggered ones
+    node.config.raftstore.region_split_size_mb = 1 << 20
+    node.config.raftstore.region_max_size_mb = 1 << 20
+    srv = TikvServer(node)
+    node.addr = f"127.0.0.1:{srv.port}"
+    node.pd.put_store(Store(node.store_id, node.addr))
+    srv.start()
+    try:
+        c = TxnClient(pd_addr)
+        table = int_table(2, table_id=9920)
+        tid = table.table_id
+        load_s = _bulk_load(c, node, table, n)
+
+        def region_dag(lo, hi):
+            sel = DagSelect.from_table(table, ["id", "c0", "c1"])
+            sel._ranges = [KeyRange(table_record_key(tid, lo),
+                                    table_record_key(tid, hi))]
+            return sel.aggregate(
+                [sel.col("c0")],
+                [("count_star", None), ("sum", sel.col("c1"))]
+            ).build(start_ts=c.tso())
+
+        def query(lo, hi):
+            while True:
+                try:
+                    return c.coprocessor(region_dag(lo, hi), timeout=600)
+                except RemoteError as e:
+                    if e.kind != "key_is_locked":
+                        raise   # a read raced an in-flight prewrite
+
+        def split_at(handle):
+            deadline = time.monotonic() + 30.0
+            while True:
+                try:
+                    return node.split_region(
+                        0, table_record_key(tid, handle))
+                except NotLeaderError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.02)
+
+        warm = query(0, n)                          # cold build (once)
+        assert sum(r[0] for r in warm["rows"]) == n
+
+        # -- split under churn: a writer races the split and the child
+        # queries; new handles land past the split point (right child)
+        next_h = [n]
+        stop = _th.Event()
+        wrote = [0]
+
+        def write_one(h, val):
+            while True:
+                try:
+                    c.txn_write([("put",) + encode_table_row(
+                        table, h, {"c0": h % 1024, "c1": val})])
+                    return
+                except RemoteError as e:
+                    # the write raced a split: cached region bounds
+                    # are stale — refresh routing and retry
+                    if e.kind not in ("not_leader", "epoch_not_match") \
+                            and "KeyNotInRegion" not in str(e):
+                        raise
+                    c._invalidate_region(table_record_key(tid, h))
+
+        def writer():
+            while not stop.is_set():
+                h = next_h[0]
+                next_h[0] += 1
+                write_one(h, 0)
+                wrote[0] += 1
+
+        sup = node.device_supervisor
+        mid = n // 2
+        # -- phase A: the writer races the split itself and the first
+        # child queries (answers stay exact; reads landing inside an
+        # in-flight commit batch are ts-scoped MVCC work, counted in
+        # ``served`` like config 6w, never a line rebuild)
+        wt = _th.Thread(target=writer, daemon=True)
+        wt.start()
+        lat = []
+        served = {"hit": 0, "delta": 0, "build": 0, "split": 0}
+        try:
+            t0 = time.perf_counter()
+            split_at(mid)
+            split_ms = (time.perf_counter() - t0) * 1e3
+            for _ in range(max(4, iters // 2)):
+                for lo, hi in ((0, mid), (mid, n)):
+                    t0 = time.perf_counter()
+                    r = query(lo, hi)
+                    lat.append(time.perf_counter() - t0)
+                    assert sum(x[0] for x in r["rows"]) == mid, (lo, hi)
+                    served[r["time_detail"]["labels"].get(
+                        "copr_cache", "hit")] += 1
+        finally:
+            stop.set()
+            wt.join(5)
+        assert sup.splits >= 1, \
+            f"the split re-minted instead of slicing: {sup.stats()}"
+
+        # -- phase B (the adjudicated window): sequential write→query
+        # rounds on BOTH children — every query serves off the sliced
+        # child line via delta maintenance, zero columnar_builds
+        before = dict(node.copr_cache.stats())
+        for i in range(max(8, iters)):
+            for lo, hi in ((0, mid), (mid, n)):
+                h = lo + (i % mid)          # update an existing row
+                write_one(h, i)
+                t0 = time.perf_counter()
+                r = query(lo, hi)
+                lat.append(time.perf_counter() - t0)
+                assert sum(x[0] for x in r["rows"]) == mid, (lo, hi)
+                td = r["time_detail"]
+                assert td["labels"]["copr_cache"] in ("hit", "delta"), \
+                    td["labels"]
+                assert "columnar_build" not in td["phases_ms"]
+        after = dict(node.copr_cache.stats())
+        columnar_builds = sum(
+            after.get(k, 0) - before.get(k, 0)
+            for k in ("misses", "rebuilds", "device_builds"))
+        lat_a = np.asarray(lat)
+
+        # -- mass invalidation: every region's line torn down at once,
+        # all rebuild concurrently — governed (cap 2) vs effectively
+        # unthrottled (cap = region count), same storm both times
+        k_regions = 8
+        bounds = sorted({0, n} | {i * n // k_regions
+                                  for i in range(1, k_regions)})
+        for b in bounds[1:-1]:
+            if b != n // 2:             # already split there
+                split_at(b)
+        spans = list(zip(bounds[:-1], bounds[1:]))
+        for lo, hi in spans:
+            query(lo, hi)               # every region warm
+
+        def storm(gov):
+            node.copr_cache.remint_gate = gov
+            with node.copr_cache._lock:
+                node.copr_cache._lines.clear()
+            errs = []
+
+            def one(span):
+                try:
+                    query(*span)
+                except Exception as e:   # noqa: BLE001
+                    errs.append(repr(e))
+            ths = [_th.Thread(target=one, args=(s,), daemon=True)
+                   for s in spans]
+            t0 = time.perf_counter()
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            node.copr_cache.remint_gate = None
+            assert not errs, errs
+            st = gov.stats()
+            return {"wall_ms": round(wall_ms, 3),
+                    "observed_max": st["observed_max"],
+                    "shed": st["shed"],
+                    "peak_depth": st["peak_depth"]}
+
+        bounded = storm(RemintGovernor(max_concurrent=2, max_queue=64))
+        unthrottled = storm(RemintGovernor(max_concurrent=k_regions,
+                                           max_queue=64))
+        assert bounded["observed_max"] <= 2, bounded
+
+        # -- placement ICI move of a warm 10M-row feed (the <100ms
+        # acceptance); scaled like the kernel configs so smoke runs
+        # stay cheap
+        scale = float(os.environ.get("TIKV_TPU_BENCH_SCALE", 1.0))
+        mrows = max(1 << 14, int(10 * (1 << 20) * scale))
+        # whole_mesh_rows above mrows: the feed pins to ONE slice (the
+        # thing a placement move migrates), never whole-mesh shards
+        prunner = DeviceRunner(mesh=make_mesh(_jax.devices()),
+                               placement=True,
+                               placement_rows=2 * mrows)
+        mtable, msnap = build_table(mrows, 1024)
+        prunner.handle_request(_dag_hash_agg(mtable), msnap)
+        placer = prunner.placer
+        anchor = prunner._feed_anchor(msnap)
+        owner = placer.owner(anchor)
+        migration_ms = None
+        if owner is not None:
+            src = placer.slices.index(owner)
+            dst = (src + 1) % len(placer.slices)
+            if placer.migrate(anchor, src, dst):
+                migration_ms = placer.stats()["last_migration_ms"]
+
+        return {
+            "rows": n,
+            "backend": warm["backend"],
+            "load_rows_per_sec": round(n / load_s, 1),
+            "split_ms": round(split_ms, 3),
+            "columnar_builds": columnar_builds,
+            "device_splits": sup.splits,
+            "split_fallbacks": sup.split_fallbacks,
+            "split_ok": bool(columnar_builds == 0 and sup.splits >= 1),
+            "p50_ms": round(float(np.percentile(lat_a, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lat_a, 99)) * 1e3, 3),
+            "rows_per_sec": round(
+                (n // 2) / float(np.percentile(lat_a, 50)), 1),
+            "churn_writes": wrote[0],
+            "migration_rows": mrows,
+            "migration_ms": None if migration_ms is None
+            else round(migration_ms, 3),
+            "migrations": placer.stats()["migrations"],
+            "remint_bounded": bounded,
+            "remint_unthrottled": unthrottled,
+            "remint_queue_depth": bounded["peak_depth"],
+        }
+    finally:
+        srv.stop()
+        pd_server.stop()
+
+
 def run_concurrent_serving(device_runner, iters: int):
     """Config 6b: heavy-traffic serving — 64+ concurrent warm gRPC
     clients over a Zipfian table/constant mix, measured twice on the
@@ -2201,6 +2462,16 @@ def main() -> None:
     except Exception as e:      # noqa: BLE001 — bench must still report
         configs["6w_write_churn"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # 6s: elastic feed lifecycle — split-under-churn adjudicated at
+    # zero columnar_builds, the 10M-row placement ICI move, and the
+    # governed vs unthrottled mass-invalidation re-mint storm
+    try:
+        configs["6s_split_under_churn"] = run_split_under_churn(
+            runner, iters)
+    except Exception as e:      # noqa: BLE001 — bench must still report
+        configs["6s_split_under_churn"] = {
+            "error": f"{type(e).__name__}: {e}"}
+
     # 6b: heavy-traffic concurrent serving — the cross-request
     # coalescer vs forced per-request dispatch on one seeded schedule
     try:
@@ -2391,6 +2662,29 @@ def main() -> None:
         print(f"# hbm_resident_mb= {cw.get('hbm_resident_mb', 0.0)} "
               f"(budget_mb={cw.get('hbm_budget_mb', 0.0)})",
               file=sys.stderr)
+    # 6s adjudication — first-class lines: the elastic-lifecycle claim
+    # (a split is a slice, a move is an ICI copy, a re-mint storm is
+    # bounded) must survive artifact truncation
+    c6s = configs.get("6s_split_under_churn", {})
+    if "columnar_builds" in c6s:
+        print(f"# columnar_builds= {c6s['columnar_builds']} "
+              f"(split_under_churn; adjudicates at 0, "
+              f"split_ok={c6s['split_ok']})", file=sys.stderr)
+        print(f"# 6s_split: split_ms={c6s['split_ms']} "
+              f"device_splits={c6s['device_splits']} "
+              f"fallbacks={c6s['split_fallbacks']} "
+              f"p50={c6s['p50_ms']}ms p99={c6s['p99_ms']}ms "
+              f"churn_writes={c6s['churn_writes']}", file=sys.stderr)
+        print(f"# migration_ms= {c6s['migration_ms']} "
+              f"({c6s['migration_rows']} rows over ICI; "
+              f"acceptance <100ms)", file=sys.stderr)
+        print(f"# remint_queue_depth= {c6s['remint_queue_depth']} "
+              f"(peak; bounded cap=2)", file=sys.stderr)
+        rb, ru = c6s["remint_bounded"], c6s["remint_unthrottled"]
+        print(f"# remint_storm= bounded_max={rb['observed_max']} "
+              f"bounded_wall_ms={rb['wall_ms']} shed={rb['shed']} "
+              f"unthrottled_max={ru['observed_max']} "
+              f"unthrottled_wall_ms={ru['wall_ms']}", file=sys.stderr)
     # 6b adjudication — first-class lines so the cross-request batching
     # claim (occupancy forms, router mix, batched P99 vs solo P99, zero
     # late acks) survives artifact truncation

@@ -392,8 +392,13 @@ def test_failpoint_inventory_resolves():
     # lagged the resolved-ts watermark, so hedge fall-through and
     # refusal accounting are steerable without real lag — and
     # copr::replica_promote, failing the leader-gain promotion's
-    # scrub-digest re-verify so the rebuild fallback path is provable)
-    assert len(sites) >= 75, f"only {len(sites)} unique sites"
+    # scrub-digest re-verify so the rebuild fallback path is provable;
+    # ≥77 since the elastic feed lifecycle: device::feed_migrate —
+    # bit-flip a plane mid-ICI-transfer so the destination's arrival
+    # re-verify must quarantine-and-rebuild instead of serving it —
+    # and device::device_split, failing the on-device key-range split
+    # so child regions fall back to governed host re-mint)
+    assert len(sites) >= 77, f"only {len(sites)} unique sites"
     for dev_site in ("device::hbm_oom", "device::feed_corrupt",
                      "device::d2h_corrupt", "copr::coalesce_dispatch",
                      "copr::coalesce_window", "device::mvcc_resolve",
@@ -401,7 +406,8 @@ def test_failpoint_inventory_resolves():
                      "device::mesh_rebuild", "device::join_dispatch",
                      "copr::plan_route", "copr::rc_throttle",
                      "copr::fastpath", "device::replica_stale",
-                     "copr::replica_promote"):
+                     "copr::replica_promote", "device::feed_migrate",
+                     "device::device_split"):
         assert dev_site in sites, f"missing fault site {dev_site}"
 
     nemesis_src = (root / "chaos" / "nemesis.py").read_text()

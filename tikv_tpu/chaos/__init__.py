@@ -26,7 +26,9 @@ from .invariants import (        # noqa: F401
     check_no_late_acks,
     check_no_lost_acks,
     check_no_quarantined_dispatch,
+    check_no_remint_on_move,
     check_no_stale_epoch,
+    check_remint_concurrency_bounded,
     check_read_correctness,
     check_replica_consistency,
     check_replica_read_correctness,
@@ -36,6 +38,7 @@ from .nemesis import (           # noqa: F401
     CRASH_SITES,
     DEGRADE_SITES,
     DEVICE_FAULT_KINDS,
+    ELASTIC_FAULT_KINDS,
     FASTPATH_FAULT_KINDS,
     FAULT_KINDS,
     PLAN_FAULT_KINDS,

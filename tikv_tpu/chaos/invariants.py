@@ -317,6 +317,47 @@ def check_no_cold_rebuild_on_serving_path(before, after,
             "rebuild during the failover window")
 
 
+def check_no_remint_on_move(before, after, placer_stats=None) -> None:
+    """Elastic-lifecycle contract: across a placement move window (a
+    rebalance, a drain, a join co-location pull) the host minted NO
+    new columnar line — the resident feed MIGRATED over ICI, digests
+    and journal position traveling with it.  ``before``/``after`` are
+    ``RegionColumnarCache.stats()`` snapshots bracketing the window;
+    ``placer_stats`` (optional, ``SlicePlacer.stats()``) additionally
+    proves at least one migration actually happened and none failed
+    arrival re-verify into the rebuild fallback."""
+    for ctr in ("misses", "rebuilds", "device_builds"):
+        if after.get(ctr, 0) > before.get(ctr, 0):
+            raise InvariantViolation(
+                f"re-mint on a placement move: cache counter {ctr!r} "
+                f"grew {before.get(ctr, 0)} -> {after.get(ctr, 0)} "
+                f"across the move window")
+    if placer_stats is not None:
+        if not placer_stats.get("migrations", 0):
+            raise InvariantViolation(
+                "no ICI migration recorded across the move window — "
+                "the move must have dropped and re-minted instead")
+        if placer_stats.get("migration_failures", 0):
+            raise InvariantViolation(
+                f"{placer_stats['migration_failures']} migration(s) "
+                "failed and fell back to drop-and-re-mint during the "
+                "move window")
+
+
+def check_remint_concurrency_bounded(governor_stats, bound) -> None:
+    """Re-mint storm-control contract: across a mass-invalidation (a
+    split storm, a quarantine drain) the host never ran more than
+    ``bound`` columnar rebuilds concurrently — the governor queued or
+    shed the rest.  ``governor_stats`` is ``RemintGovernor.stats()``;
+    ``observed_max`` is its high-water mark of simultaneously admitted
+    rebuilds."""
+    seen = governor_stats.get("observed_max", 0)
+    if seen > bound:
+        raise InvariantViolation(
+            f"re-mint concurrency exceeded its bound: observed "
+            f"{seen} simultaneous rebuilds > limit {bound}")
+
+
 def check_replica_read_correctness(leader_rows, follower_rows) -> None:
     """Replica-read answer parity: a follower-served coprocessor read
     at read_ts ≤ resolved_ts returns EXACTLY what the leader serves

@@ -96,6 +96,20 @@ FASTPATH_FAULT_KINDS = ("fastpath_fault",)
 # from behind the resolved-ts watermark.
 REPLICA_FAULT_KINDS = ("leader_kill", "replica_lag")
 
+# elastic-feed-lifecycle faults (own tuple, seeded-schedule
+# stability): migrate_fault arms device::feed_migrate at a percentage
+# so a plane transferred over ICI arrives bit-flipped — the arrival
+# re-verify on the destination slice must catch EVERY corrupted
+# transfer (drop the partial install, quarantine the source anchor,
+# rebuild from host) and never serve a silently-wrong plane.
+# split_storm arms device::device_split at a percentage so the
+# device-side region split falls back to host re-mint for the child
+# regions — under a storm of such fallbacks the re-mint governor must
+# bound concurrent columnar rebuilds
+# (check_remint_concurrency_bounded) while moves that CAN migrate
+# still mint nothing (check_no_remint_on_move).
+ELASTIC_FAULT_KINDS = ("migrate_fault", "split_storm")
+
 # the plain degrade-to-host failpoint sites the device_degrade nemesis
 # rotates over; the remaining device::* sites have dedicated kinds
 # above (the inventory test asserts the union covers EVERY device::*
@@ -184,6 +198,10 @@ def generate_schedule(seed: int, steps: int,
         elif kind == "leader_kill":
             out.append(_mk(kind))   # leader resolved at apply time
         elif kind == "replica_lag":
+            out.append(_mk(kind, pct=rng.choice((25, 50, 100))))
+        elif kind == "migrate_fault":
+            out.append(_mk(kind, pct=rng.choice((25, 50, 100))))
+        elif kind == "split_storm":
             out.append(_mk(kind, pct=rng.choice((25, 50, 100))))
         else:   # pragma: no cover
             raise ValueError(kind)
@@ -335,6 +353,24 @@ class Nemesis:
         if sid is None:
             sid = self.rng.choice(sorted(self.cluster.stores))
         self.cluster.restart_store(sid)
+
+    def _apply_migrate_fault(self, fault: Fault) -> None:
+        """Bit-flip a fraction of ICI feed migrations in flight: the
+        destination's arrival digest re-verify must reject the install
+        (quarantine + rebuild), never serve the corrupted plane."""
+        pct = fault.param("pct", 100)
+        failpoint.cfg("device::feed_migrate", f"{pct}%return")
+        self._heals.append(
+            lambda: failpoint.remove("device::feed_migrate"))
+
+    def _apply_split_storm(self, fault: Fault) -> None:
+        """Force a fraction of device-side region splits to fall back
+        to host re-mint — the re-mint governor must bound the rebuild
+        concurrency the resulting storm creates."""
+        pct = fault.param("pct", 100)
+        failpoint.cfg("device::device_split", f"{pct}%return")
+        self._heals.append(
+            lambda: failpoint.remove("device::device_split"))
 
     def _apply_replica_lag(self, fault: Fault) -> None:
         """Lagging replica: device::replica_stale forces the follower

@@ -160,6 +160,16 @@ class CoprocessorConfig:
     # group early instead of waiting out its window)
     fastpath_classes: int = 64
     dispatch_pipeline: bool = True
+    # re-mint storm control (device/supervisor.py RemintGovernor):
+    # remint_concurrency bounds concurrent cold columnar_build
+    # re-mints after a mass invalidation (0 = unthrottled — the
+    # pre-storm-control behavior); excess builds park in a priority
+    # queue (hot regions first, RU-debt tenants last) of at most
+    # remint_queue, past which the worst-priority waiter is shed with
+    # a ServerIsBusy carrying remint_retry_after_ms
+    remint_concurrency: int = 0
+    remint_queue: int = 32
+    remint_retry_after_ms: int = 50
 
 
 @dataclass
@@ -336,6 +346,7 @@ _ONLINE_FIELDS = {
     "coprocessor.flight_recorder_depth",
     "coprocessor.fastpath_classes",
     "coprocessor.dispatch_pipeline",
+    "coprocessor.remint_concurrency",
     "readpool.concurrency",
     "resource_metering.window_s",
     "resource_metering.topk",

@@ -230,6 +230,35 @@ class DeltaSink(Observer):
             st.covered_from = None
             self._export_depth(region.id, st)
 
+    def on_region_split(self, left, right, left_index: Optional[int],
+                        right_index: Optional[int]) -> None:
+        """Split observed BEFORE the post-split region_changed event:
+        pre-seed coverage so the split itself costs zero rebuilds.
+
+        Left: pre-record the NEW epoch version so the follow-up
+        ``on_region_changed(left)`` sees a same-version event and keeps
+        the log.  Retaining the pre-split entries is sound — they all
+        sit at index <= left_index (admin entries never log), and the
+        sliced child lines start exactly at left_index, so no bridge
+        ever replays them.  Right: the freshly minted region starts a
+        log whose coverage begins at its creation stamp, so the first
+        post-split write bridges instead of poisoning."""
+        with self._mu:
+            st = self._regions.get(left.id)
+            if st is not None:
+                st.epoch_version = left.epoch.version
+            if right_index is not None:
+                st = self._regions.setdefault(right.id, _RegionLog())
+                st.log.clear()
+                st.rows = 0
+                st.covered_from = right_index
+                st.epoch_version = right.epoch.version
+                self._regions.move_to_end(right.id)
+                while len(self._regions) > self.max_regions:
+                    dead_id, _st = self._regions.popitem(last=False)
+                    self._drop_gauges(dead_id)
+                self._export_depth(right.id, st)
+
     def on_peer_destroyed(self, region_id: int) -> None:
         self.drop_region(region_id)
 

@@ -66,15 +66,24 @@ point write costs a tile patch, not a cold feed.
 Lines are torn down as deliberately as they are maintained (the
 device-state supervisor, device/supervisor.py):
 
+- **device-side split** — a region split no longer invalidates the
+  parent line wholesale: :meth:`RegionColumnarCache.split_lines`
+  slices the parent's host state by key range into two CHILD lines
+  at the new epoch (fresh lineages, exact ``data_index`` stamps from
+  the split point), and the runner slices the parent's resident
+  device feed into digest-verified child feeds
+  (``split_resident_feeds``) — a load-split under churn mints zero
+  ``columnar_build``s.  Only the parent lines at the superseded
+  epoch retire;
 - **lifecycle invalidation** — :meth:`RegionColumnarCache.
-  invalidate_region` drops a region's lines on split/merge/epoch
-  change (superseded epochs only), snapshot apply and peer destroy,
-  instead of letting stale-epoch lines age out of the LRU.  Leader
-  loss is NOT a teardown event: the demoted store's lines stay
-  resident as replica feeds — still patched by the delta stream
-  (follower applies publish too) and served through the resolved-ts
-  stale-read gate — so a later leader transfer back is a warm
-  promotion, not a rebuild;
+  invalidate_region` drops a region's lines on merge/epoch change
+  (superseded epochs only — split children minted above survive),
+  snapshot apply and peer destroy, instead of letting stale-epoch
+  lines age out of the LRU.  Leader loss is NOT a teardown event:
+  the demoted store's lines stay resident as replica feeds — still
+  patched by the delta stream (follower applies publish too) and
+  served through the resolved-ts stale-read gate — so a later leader
+  transfer back is a warm promotion, not a rebuild;
 - **explicit feed teardown** — every retirement path (lifecycle,
   LRU eviction, rebuild replacement, failed bridge) fires the
   ``on_line_retired`` callback with the line's FeedLineage, which the
@@ -91,6 +100,7 @@ device-state supervisor, device/supervisor.py):
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Optional, Sequence
 
@@ -556,7 +566,7 @@ class FeedLineage:
 
     __slots__ = ("version", "_base", "_patches", "_max", "_mu",
                  "feed_digests", "region_hint", "cold_bundle",
-                 "__weakref__")
+                 "split_stash", "__weakref__")
 
     def __init__(self, max_patches: int = 64):
         self.version = 0
@@ -575,6 +585,11 @@ class FeedLineage:
         # mints the born-resident feed from them; any delta landing
         # first releases them (the host upload path is always correct)
         self.cold_bundle = None
+        # device-side region split (runner.split_resident_feeds): on a
+        # CHILD lineage, the digest-verified feed candidates sliced
+        # from the parent's resident planes — the child's first feed
+        # miss consumes a match instead of re-uploading from host
+        self.split_stash = None
 
     def stash_cold(self, bundle) -> None:
         bundle.lineage_v = self.version
@@ -840,6 +855,16 @@ class RegionColumnarCache:
         # failed bridge) — the device-state supervisor wires this to
         # DeviceRunner.drop_feed so HBM teardown is explicit
         self.on_line_retired = None
+        self.splits = 0         # region splits served by line slicing
+        # re-mint storm control: when set (a RemintGovernor from
+        # device/supervisor.py), every columnar_build first takes a
+        # concurrency permit from the priority queue — a mass
+        # invalidation degrades to bounded, hot-first rebuilds instead
+        # of a host-link stampede.  None = unthrottled (the default)
+        self.remint_gate = None
+        # decayed per-region request rate, the "hot regions first"
+        # priority signal for the governor: region id -> [rate, stamp]
+        self._heat: dict = {}
 
     # -- observability --------------------------------------------------
 
@@ -868,6 +893,7 @@ class RegionColumnarCache:
                "compactions": self.compactions,
                "invalidations": self.invalidations,
                "device_builds": self.device_builds,
+               "splits": self.splits,
                "resident_lines": len(lines), "lines": lines}
         if self._delta_source is not None:
             out["delta_log"] = self._delta_source.stats()
@@ -895,6 +921,34 @@ class RegionColumnarCache:
         region (device/supervisor.py ``on_role_change``)."""
         with self._lock:
             return sum(1 for key in self._lines if key[0] == region_id)
+
+    # -- region heat (storm-control priority signal) ---------------------
+
+    _HEAT_HALFLIFE_S = 30.0
+
+    def _note_heat(self, region_id: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            st = self._heat.get(region_id)
+            if st is None:
+                self._heat[region_id] = [1.0, now]
+                while len(self._heat) > 4096:
+                    self._heat.pop(next(iter(self._heat)))
+            else:
+                st[0] = st[0] * 0.5 ** ((now - st[1]) /
+                                        self._HEAT_HALFLIFE_S) + 1.0
+                st[1] = now
+
+    def region_heat(self, region_id: int) -> float:
+        """Decayed request rate for ``region_id`` — the rebuild-queue
+        priority: after a mass invalidation the regions users are
+        actually hitting re-mint first, cold tail last."""
+        with self._lock:
+            st = self._heat.get(region_id)
+            if st is None:
+                return 0.0
+            return st[0] * 0.5 ** ((time.monotonic() - st[1]) /
+                                   self._HEAT_HALFLIFE_S)
 
     # -- lifecycle teardown ---------------------------------------------
 
@@ -962,6 +1016,166 @@ class RegionColumnarCache:
             self._retire(line)
         return len(dropped)
 
+    # -- device-side region split ----------------------------------------
+
+    def split_lines(self, left, right, left_index: Optional[int],
+                    right_index: Optional[int]) -> list:
+        """Serve a region split by SLICING the parent's cached lines
+        into two child lines at the split key — the C-Store
+        reorganization-as-cheap-operation move: zero ``columnar_build``,
+        exact ``data_index`` stamps, fresh lineages at the children's
+        epochs.  The superseded parent lines are NOT retired here; the
+        imminent ``invalidate_region(left.id, keep_epoch=new)`` sweep
+        does that AFTER the device runner had its chance to slice the
+        resident parent feeds (device/supervisor.py orders the two).
+
+        Returns one split spec per sliced parent line for
+        ``DeviceRunner.split_resident_feeds``: {parent_lineage,
+        parent_version, pos, n_parent, left: {lineage, n}, right: ...}.
+        """
+        if left_index is None:
+            return []
+        old_epoch = left.epoch.version - 1
+        with self._lock:
+            parents = [(k, self._lines[k]) for k in list(self._lines)
+                       if k[0] == left.id and k[1] == old_epoch]
+        specs = []
+        for key, line in parents:
+            spec = self._split_one(key, line, left, right, left_index,
+                                   right_index)
+            if spec is not None:
+                specs.append(spec)
+                self.splits += 1
+        return specs
+
+    def _split_one(self, key, line, left, right, left_index: int,
+                   right_index: Optional[int]):
+        # a line lagging behind the split point bridges forward first
+        # (split admin commands don't bump data_index, so left_index is
+        # exactly the last pre-split write).  No snapshot is available
+        # here: deltas whose payloads spilled past short_value fail the
+        # bridge and the line just invalidates — rebuild fallback.
+        if line.state is None or line.data_index is None or \
+                line.data_index > left_index:
+            return None
+        if line.data_index < left_index:
+            try:
+                if self._bridge(line, None, left.id, left_index) is None:
+                    return None
+            except Exception:   # noqa: BLE001 — any surprise: rebuild
+                return None
+        with line.mu:
+            st = line.state
+            if st is None or line.data_index != left_index:
+                return None
+            n = st.n
+            lo_key, hi_key = table_record_range(st.table_id)
+            sk = right.start_key
+            if sk:
+                # region boundaries hold ENGINE keys (mode prefix +
+                # memcomparable); the handle comparison below needs
+                # the user-key form
+                try:
+                    sk = decode_key(sk)
+                except Exception:   # noqa: BLE001 — non-engine-form key
+                    return None
+            if not sk or sk <= lo_key:
+                pos = 0
+            elif sk >= hi_key:
+                pos = n
+            else:
+                try:
+                    pos = int(np.searchsorted(
+                        st.handles[:n], decode_record_handle(sk)))
+                except Exception:   # noqa: BLE001 — non-record split key
+                    return None
+            parent_lineage = st.lineage
+            parent_version = st.lineage.version
+            children = []
+            for side, region, data_index in (
+                    ("left", left, left_index),
+                    ("right", right, right_index)):
+                if data_index is None:
+                    continue    # no right peer on this store
+                lo, hi = (0, pos) if side == "left" else (pos, n)
+                child = self._child_state(st, lo, hi, region.id)
+                children.append({
+                    "side": side, "lineage": child.lineage,
+                    "n": child.n, "state": child,
+                    "key": (region.id, region.epoch.version) + key[2:],
+                    "data_index": data_index})
+        # insert the child lines under the global lock.  Capacity is
+        # deliberately NOT enforced here: evicting the (LRU-oldest)
+        # parent now would tear down the resident feed the device split
+        # is about to slice — the keep_epoch sweep right behind us
+        # retires the parents and restores the bound.
+        minted = []
+        with self._lock:
+            for ch in children:
+                ckey = ch["key"]
+                if ckey[1] < self._epoch_floor.get(ckey[0], 0) or \
+                        ckey in self._lines:
+                    continue    # a racing build won: keep its line
+                snap = ch["state"].publish()
+                self._lines[ckey] = _Line(ckey, ch["data_index"], snap,
+                                          ch["state"])
+                self._lines.move_to_end(ckey)
+                minted.append(ch)
+            self._publish_lines()
+        if not minted:
+            return None
+        spec = {"parent_lineage": parent_lineage,
+                "parent_version": parent_version,
+                "pos": pos, "n_parent": n, "left": None, "right": None}
+        for ch in minted:
+            # "state" rides along for the device split's digest
+            # re-anchor (child digests recompute from HOST truth);
+            # the spec is consumed synchronously in the apply path,
+            # so the strong ref is transient
+            spec[ch["side"]] = {"lineage": ch["lineage"], "n": ch["n"],
+                                "state": ch["state"]}
+        return spec
+
+    @staticmethod
+    def _child_state(st: "_LineState", lo: int, hi: int,
+                     region_id: int) -> "_LineState":
+        """Child _LineState = parent's rows [lo, hi) with fresh slack
+        buffers and a fresh FeedLineage (version 0 — the device split
+        mints the matching child feed at the same version)."""
+        child = _LineState.__new__(_LineState)
+        child.table_id = st.table_id
+        child.col_meta = dict(st.col_meta)
+        n = hi - lo
+        child.n = n
+        cap = n + max(_LineState.SLACK_MIN, n >> 3)
+        child.cap = cap
+        handles = np.empty(cap, np.int64)
+        handles[:n] = st.handles[lo:hi]
+        child.handles = handles
+        child.cols = {}
+        for cid, (vals, valid) in st.cols.items():
+            nv = np.empty(cap, dtype=vals.dtype)
+            nv[:n] = vals[lo:hi]
+            nm = np.zeros(cap, np.bool_)
+            nm[:n] = valid[lo:hi]
+            child.cols[cid] = [nv, nm]
+        if st.alive is not None:
+            alive = np.ones(cap, np.bool_)
+            alive[:n] = st.alive[lo:hi]
+            child.n_dead = int(n - np.count_nonzero(alive[:n]))
+            child.alive = alive if child.n_dead else None
+        else:
+            child.alive = None
+            child.n_dead = 0
+        # conservative: every parent lock travels to both children —
+        # extra locks only over-block a read, never under-block it
+        child.locks = dict(st.locks)
+        child.safe_ts = st.safe_ts
+        child.build_ts = st.build_ts
+        child.lineage = FeedLineage()
+        child.lineage.region_hint = region_id
+        return child
+
     # -- lookup ---------------------------------------------------------
 
     def get(self, snap, dag) -> Optional[MvccColumnarSnapshot]:
@@ -978,6 +1192,7 @@ class RegionColumnarCache:
                     tuple((c.col_id, c.is_pk_handle, c.field_type.tp)
                           for c in scan.columns))
         start_ts = dag.start_ts
+        self._note_heat(region.id)
         ent = lock_src = None
         while True:
             wait_ev = None
@@ -1030,6 +1245,7 @@ class RegionColumnarCache:
         if region is None or data_index is None or \
                 (region.id, region.epoch.version) != base_key[:2]:
             return None
+        self._note_heat(region.id)
         with self._lock:
             line = self._lines.get(base_key)
             got = self._lookup_locked(line, data_index, start_ts)
@@ -1130,11 +1346,28 @@ class RegionColumnarCache:
             # contains commits it must see)
         self.misses += 1
         tracker.label("copr_cache", "build")
-        with tracker.phase("columnar_build"):
-            tbl, safe_ts, locks, bundle = build_region_columnar_ex(
-                snap, scan.table_id, scan.columns, start_ts,
-                device_resolver=self.device_resolver,
-                stream_source=self.stream_source)
+        # storm control: take a re-mint permit BEFORE the build.  The
+        # governor parks us in its priority queue (hot regions first,
+        # RU-debt tenants last) and may shed the wait with a
+        # ServerIsBusy(retry_after_ms) instead — a mass invalidation
+        # degrades gracefully rather than stampeding the host link.
+        # Waiters on our _building event stay parked either way, so a
+        # shed surfaces to exactly one request per (line, version).
+        gate = self.remint_gate
+        ticket = None
+        if gate is not None:
+            with tracker.phase("remint_wait"):
+                ticket = gate.acquire(base_key[0],
+                                      heat=self.region_heat(base_key[0]))
+        try:
+            with tracker.phase("columnar_build"):
+                tbl, safe_ts, locks, bundle = build_region_columnar_ex(
+                    snap, scan.table_id, scan.columns, start_ts,
+                    device_resolver=self.device_resolver,
+                    stream_source=self.stream_source)
+        finally:
+            if ticket is not None:
+                gate.release(ticket)
         if bundle is not None:
             self.device_builds += 1
         ent = MvccColumnarSnapshot(tbl, start_ts, safe_ts, locks)

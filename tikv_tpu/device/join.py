@@ -200,6 +200,19 @@ class DeviceJoiner:
         with self._mu:
             return self._cache_bytes
 
+    def drop_all(self) -> int:
+        """Retire EVERY cached build dictionary — the quarantine-drain
+        teardown (placement.py ``_on_slice_trip``): a condemned slice's
+        joiner entries would otherwise die only by anchor weakref while
+        the budget still accounts their HBM on a chip nothing will
+        dispatch to again."""
+        with self._mu:
+            freed = self._cache_bytes
+            self._cache.clear()
+            self._anchor_refs.clear()
+            self._cache_bytes = 0
+        return freed
+
     def drop_anchor(self, anchor) -> int:
         """Feed teardown hook (runner.drop_feed): the anchor's build/
         probe planes die with its feed — stale-epoch join state must

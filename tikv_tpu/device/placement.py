@@ -24,10 +24,13 @@ single-device sub-runner per mesh slice and routes each feed anchor
 Placement is STICKY (a placed anchor keeps its slice — its HBM feed,
 request memos, and compile classes live there) until the opportunistic
 rebalance step (pd/scheduler.rebalance_donor) finds the spread
-unjustifiable; then the hottest slice's coldest anchor is dropped
-(``runner.drop_feed``) and re-pinned to the coolest slice — the next
-request rebuilds the feed there, the same add-then-remove shape as a
-balance-region operator.  Feeds above ``whole_mesh_rows`` bypass
+unjustifiable; then the hottest slice's coldest anchor MIGRATES to the
+coolest slice over ICI (:meth:`SlicePlacer.migrate`): its resident
+feeds travel between chips via ``device_put`` with their lineage
+versions and scrub digests, the destination re-verifies every plane on
+arrival before it serves, and only when migration is impossible (no
+digests, arrival divergence) does the move degrade to the old
+drop-and-re-mint over the narrow host link.  Feeds above ``whole_mesh_rows`` bypass
 placement and shard over the full mesh (scale-up wins past the point
 where one chip's HBM pass dominates the launch overhead).
 
@@ -35,11 +38,15 @@ A slice is NOT assumed healthy forever.  The placer shares the
 runner's :class:`~.supervisor.SliceHealthBoard` (dispatch/fetch
 faults, scrub quarantines and latency outliers strike per-slice
 scores, PR 3's slow-store shape): a QUARANTINED slice stops being
-scored — ``pick_slice`` excludes it, its sticky anchors DRAIN onto
-healthy slices through the same re-pin machinery rebalance uses
-(spread via ``pd.scheduler.drain_receivers``, feeds dropped through
-the PR 6 retirement path), and routing that still finds an anchor
-pinned to a dead slice fails it over on the spot.  Half-open canary
+scored — ``pick_slice`` excludes it, and its sticky anchors DRAIN
+onto healthy slices (spread via ``pd.scheduler.drain_receivers``, the
+evict-slow-store shape) by ICI migration first: the condemned chip's
+planes usually still verify, so the drain is a device copy per feed,
+not a recovery storm of host re-mints.  A feed that fails arrival
+verify (or carries no digests) drops through the PR 6 retirement path
+instead, and the draining slice's joiner build-side dictionaries
+retire explicitly so its HBM frees immediately.  Routing that still
+finds an anchor pinned to a dead slice fails it over on the spot.  Half-open canary
 probes re-admit the slice with a DECAYED (not reset) score, so the
 health penalty in the placement blend lets anchors trickle back —
 never a thundering re-pin.
@@ -138,6 +145,14 @@ class SlicePlacer:
         self._board = parent._board
         self.failovers = 0
         self.drained = 0
+        # ICI feed migration (the move path that skips the host link):
+        # total moves, cumulative/last wall time, children adopted at
+        # device-side splits, and moves that degraded to drop+re-mint
+        self.migrations = 0
+        self.migration_ms = 0.0
+        self.last_migration_ms = 0.0
+        self.migration_failures = 0
+        self.adoptions = 0
         if self._board is not None:
             self._board.add_trip_listener(self._on_slice_trip)
 
@@ -198,13 +213,27 @@ class SlicePlacer:
     def note_join(self, a, b) -> None:
         """Record one served join between anchors ``a`` and ``b`` —
         the decayed pair frequency the placement blend reads as 'these
-        two regions join often, pin them together'."""
+        two regions join often, pin them together'.  The affinity
+        CROSSING the co-location threshold while both anchors sit on
+        different healthy slices triggers an active pull: one side's
+        feeds migrate over ICI to the other's slice, so an
+        already-placed hot pair co-resides without waiting for a drop
+        or an LRU eviction to re-place it."""
         if a is b:
             return
         key = (min(id(a), id(b)), max(id(a), id(b)))
+        pull = None
         with self._mu:
             self._decay_pairs_locked()
-            self._pair_aff[key] = self._pair_aff.get(key, 0.0) + 1.0
+            old = self._pair_aff.get(key, 0.0)
+            self._pair_aff[key] = old + 1.0
+            if old < COLOCATE_AFFINITY <= old + 1.0:
+                ia = self._placed.get(id(a))
+                ib = self._placed.get(id(b))
+                dead = self._dead_locked()
+                if ia is not None and ib is not None and ia != ib and \
+                        ia not in dead and ib not in dead:
+                    pull = (a, ia, ib)
             while len(self._pair_aff) > 256:
                 # drop the weakest OTHER pair — never the pair just
                 # recorded, or at capacity a new hot pair would be
@@ -213,6 +242,11 @@ class SlicePlacer:
                 weakest = min((k for k in self._pair_aff if k != key),
                               key=self._pair_aff.get)
                 del self._pair_aff[weakest]
+        if pull is not None and self.migrate(*pull, reason="colocate"):
+            from ..utils import metrics as m
+            m.DEVICE_PLACEMENT_COUNTER.labels("colocate").inc()
+            with self._mu:
+                self.colocation_pins += 1
 
     def _partner_slice_locked(self, key: int,
                               dead: frozenset) -> Optional[int]:
@@ -344,16 +378,123 @@ class SlicePlacer:
     def forget(self, anchor) -> None:
         self._forget(id(anchor))
 
+    # -- ICI feed migration -------------------------------------------
+
+    def migrate(self, anchor, src: int, dst: int,
+                reason: str = "placement") -> bool:
+        """Move ``anchor``'s resident feeds from slice ``src`` to
+        ``dst`` over the device interconnect → True when the
+        destination serves the moved feeds.
+
+        The feeds travel with their lineage versions and scrub
+        digests (``extract_feeds``); the destination re-hashes every
+        plane on arrival BEFORE installing (``install_feeds``) — a
+        divergent plane quarantines the source copy and the move
+        reports False so the caller falls back to drop+re-mint from
+        host truth.  In-flight requests need no rescue choreography:
+        the source feeds are not dropped until after the pin flips,
+        and a request that raced onto the destination and re-minted a
+        NEWER generation there is never clobbered by the arriving
+        copy."""
+        from ..utils import metrics as m
+        from ..utils import tracker
+        if src == dst or not (0 <= src < len(self._slices)) or \
+                not (0 <= dst < len(self._slices)):
+            return False
+        src_r, dst_r = self._slices[src], self._slices[dst]
+        t0 = time.perf_counter()
+        with tracker.phase("feed_migrate"):
+            try:
+                feeds, skipped = src_r.extract_feeds(anchor)
+            except Exception:   # noqa: BLE001 — migration is best-effort
+                feeds, skipped = None, 0
+            if not feeds:
+                m.DEVICE_FEED_MIGRATION_COUNTER.labels(
+                    "no_digests").inc()
+                with self._mu:
+                    self.migration_failures += 1
+                return False
+            try:
+                verdict = dst_r.install_feeds(anchor, feeds)
+            except Exception:   # noqa: BLE001 — same contract
+                verdict = "corrupt"
+            if verdict != "moved":
+                # arrival verify caught divergence: never serve it —
+                # drop whatever landed and condemn the source copy
+                # (quarantine-and-rebuild, the scrub discipline)
+                dst_r.drop_feed(anchor, reason="migrate_verify")
+                try:
+                    src_r.quarantine(anchor, reason="migrate divergence")
+                except Exception:   # noqa: BLE001
+                    pass
+                m.DEVICE_FEED_MIGRATION_COUNTER.labels("corrupt").inc()
+                with self._mu:
+                    self.migration_failures += 1
+                return False
+            key = id(anchor)
+            ms = (time.perf_counter() - t0) * 1e3
+            with self._mu:
+                if key not in self._placed:
+                    try:
+                        self._refs[key] = weakref.ref(
+                            anchor, lambda _r, k=key: self._forget(k))
+                    except TypeError:
+                        pass    # untrackable: feeds moved, pin didn't
+                if key in self._refs:
+                    self._placed[key] = dst
+                self.migrations += 1
+                self.migration_ms += ms
+                self.last_migration_ms = ms
+        # the pin now points at dst: drop the source copy LAST so a
+        # dispatch already in flight on src finishes against resident
+        # planes (arena pins keep them alive through the kernel)
+        src_r.drop_feed(anchor, reason=reason)
+        m.DEVICE_FEED_MIGRATION_COUNTER.labels(
+            "partial" if skipped else "moved").inc()
+        return True
+
+    def adopt(self, parent, children) -> None:
+        """Pin device-split children to their parent's slice.  The
+        child feeds were sliced from the parent's resident planes ON
+        that slice (split_stash), so the children's first requests
+        must route there to consume them — anywhere else re-uploads
+        from host."""
+        from ..utils import metrics as m
+        with self._mu:
+            idx = self._placed.get(id(parent))
+            if idx is None or idx in self._dead_locked():
+                return
+            n = 0
+            for ch in children:
+                if ch is None:
+                    continue
+                k = id(ch)
+                try:
+                    self._refs[k] = weakref.ref(
+                        ch, lambda _r, kk=k: self._forget(kk))
+                except TypeError:
+                    continue
+                self._placed[k] = idx
+                n += 1
+            self.adoptions += n
+        if n:
+            m.DEVICE_PLACEMENT_COUNTER.labels("adopt").inc(n)
+
     # -- failure-domain drain -----------------------------------------
 
     def _on_slice_trip(self, idx: int, reason: str) -> None:
         """Board trip listener: drain every anchor stuck to the dead
-        slice — re-pin each onto a healthy slice (least-loaded-first
-        round-robin via ``drain_receivers``, the evict-slow-store
-        spread, NOT a single-receiver dump) and drop its device feeds
-        through the retirement path.  The next request per anchor
-        rebuilds its feed on the new slice; answers stay correct
-        throughout because a rebuild is just a cold hit."""
+        slice — MIGRATE each onto a healthy slice over ICI
+        (least-loaded-first round-robin via ``drain_receivers``, the
+        evict-slow-store spread, NOT a single-receiver dump).  A
+        condemned chip's planes usually still verify, so the drain is
+        a device copy per feed and the receivers serve warm; a feed
+        that can't travel (no digests, arrival divergence) drops
+        through the retirement path and its next request rebuilds cold
+        — answers stay correct throughout because a rebuild is just a
+        cold hit.  The dead slice's joiner build-side dictionaries
+        retire explicitly too: waiting for weakref GC would strand
+        HBM on a chip the budget still accounts."""
         from ..utils import metrics as m
         with self._mu:
             victims = [k for k, v in self._placed.items() if v == idx]
@@ -362,10 +503,9 @@ class SlicePlacer:
             dead = self._dead_locked() | {idx}
             targets = drain_receivers(self._scores_locked(),
                                       exclude=dead, k=len(victims))
-            anchors = []
+            moves = []
             for j, k in enumerate(victims):
-                if targets:
-                    self._placed[k] = targets[j]
+                tgt = targets[j] if targets else None
                 # no healthy receiver (total mesh death): keep the
                 # pin — route-time failover re-pins when a slice
                 # re-admits — but the feeds below STILL drop: HBM
@@ -373,10 +513,27 @@ class SlicePlacer:
                 ref = self._refs.get(k)
                 a = ref() if ref is not None else None
                 if a is not None:
-                    anchors.append(a)
+                    moves.append((a, tgt))
             self.drained += len(victims)
-        for a in anchors:
-            self._slices[idx].drop_feed(a, reason="failover")
+        for a, tgt in moves:
+            with self._mu:
+                if self._placed.get(id(a)) != idx:
+                    continue    # route-time failover won the race
+            moved = False
+            if tgt is not None:
+                try:
+                    moved = self.migrate(a, idx, tgt, reason="failover")
+                except Exception:   # noqa: BLE001 — drain must finish
+                    moved = False
+            if not moved:
+                if tgt is not None:
+                    with self._mu:
+                        if self._placed.get(id(a)) == idx:
+                            self._placed[id(a)] = tgt
+                self._slices[idx].drop_feed(a, reason="failover")
+        joiner = getattr(self._slices[idx], "_joiner", None)
+        if joiner is not None:
+            joiner.drop_all()
         m.DEVICE_FAILOVER_COUNTER.labels("drain").inc(len(victims))
 
     # -- rebalance ----------------------------------------------------
@@ -414,9 +571,14 @@ class SlicePlacer:
                     victim, v_stats = anchor, st
             if victim is None:
                 return False
-            self._placed[id(victim)] = cool
             self.moves += 1
-        donor.drop_feed(victim, reason="placement")
+        # outside the lock: the move itself is a device-side ICI copy
+        # (verify-on-arrival), falling back to the old drop+re-pin when
+        # the feeds can't travel (no digests / divergence)
+        if not self.migrate(victim, hot, cool, reason="placement"):
+            with self._mu:
+                self._placed[id(victim)] = cool
+            donor.drop_feed(victim, reason="placement")
         m.DEVICE_PLACEMENT_COUNTER.labels("move").inc()
         return True
 
@@ -474,5 +636,10 @@ class SlicePlacer:
                 "drained": self.drained,
                 "colocation_pins": self.colocation_pins,
                 "join_pairs": len(self._pair_aff),
+                "migrations": self.migrations,
+                "migration_ms": round(self.migration_ms, 3),
+                "last_migration_ms": round(self.last_migration_ms, 3),
+                "migration_failures": self.migration_failures,
+                "adoptions": self.adoptions,
             }
         return out

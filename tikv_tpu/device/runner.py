@@ -1649,6 +1649,27 @@ class DeviceRunner:
                 tracker.label("device_feed", "patch")
                 self._register_digests(lineage, feed_key, feed)
                 return feed
+        # device-side region split (supervisor.on_region_split): the
+        # parent feed was sliced by key range INTO this child lineage's
+        # stash — consume it instead of re-uploading from host.  The
+        # stash was digest-verified against the child's host truth at
+        # split time, so serving it is as safe as serving a scrubbed
+        # resident feed.
+        if lineage is not None and positional and cache is not None and \
+                getattr(lineage, "split_stash", None):
+            feed = self._take_split_feed(lineage, feed_key, n)
+            if feed is not None:
+                cache[feed_key] = feed
+                self._arena.admit(anchor)
+                if feed.get("lineage_v") == req_v or self._try_patch_feed(
+                        feed, lineage, used_infos, dtypes, n, req_v):
+                    tracker.label("device_feed", "split")
+                    self._register_digests(lineage, feed_key, feed)
+                    return feed
+                # the child moved past the stash and the journal could
+                # not bridge it: fall through to the upload (which
+                # replaces the cache entry)
+                feed = None
         # cold-path kill (device/mvcc.py): a device build left its
         # resolve artifacts on the lineage — mint the feed BORN
         # RESIDENT (H2D of raw version planes — or nothing, if the
@@ -1666,6 +1687,7 @@ class DeviceRunner:
                     if feed is not None:
                         tracker.label("device_feed", "device_resolve")
                         feed["lineage_v"] = req_v
+                        self._mark_splittable(feed, used_infos)
                         cache[feed_key] = feed
                         self._arena.admit(anchor)
                         self._register_digests(lineage, feed_key, feed)
@@ -1682,6 +1704,8 @@ class DeviceRunner:
             feed = self._build_flat(host_cols(), n)
         if lineage is not None:
             feed["lineage_v"] = req_v
+        if positional:
+            self._mark_splittable(feed, used_infos)
         if cache is not None:
             cache[feed_key] = feed
             # admission runs under the dispatch lock (this call site):
@@ -1898,6 +1922,10 @@ class DeviceRunner:
             # unminted cold-resolve artifacts (device version planes)
             # die with the line too
             drop_cold()
+        if getattr(anchor, "split_stash", None) is not None:
+            # unconsumed split-child candidates die with the lineage —
+            # their device planes must not outlive the line
+            anchor.split_stash = None
         freed = self._arena.drop(anchor, reason=reason)
         if self._joiner is not None:
             # join build/probe planes anchored on the same lineage die
@@ -2007,6 +2035,284 @@ class DeviceRunner:
             bad = lax.bitcast_convert_type(u.at[0].set(u[0] ^ 1),
                                            arr.dtype)
         feed["flat"] = (bad,) + feed["flat"][1:]
+
+    # ------------------------------------- ICI feed migration + split
+    #
+    # Elastic stress without the host link: a placement move, a
+    # quarantine drain, or a co-location pull copies the resident
+    # feed between slices over the device interconnect (device_put
+    # across the mesh) instead of dropping it and re-minting from
+    # host truth; a region split slices the parent feed by key range
+    # on device into two child feeds.  Both re-verify against the
+    # scrub-digest chain before anything serves.
+
+    @staticmethod
+    def _mark_splittable(feed: dict, used_infos) -> None:
+        """Positional full-snapshot feeds record which planes carry
+        the pk-handle column (sourced from state.handles, not
+        state.cols) — the metadata a device-side region split needs
+        to re-anchor child digests to host truth."""
+        if used_infos is not None:
+            feed["positional"] = True
+            feed["pk_flags"] = tuple(bool(i.is_pk_handle)
+                                     for i in used_infos)
+
+    def _take_split_feed(self, lineage, feed_key, n: int):
+        """Pop the stashed split-child feed matching this request's
+        shape (one-shot, like ``take_cold``): same columns and device
+        dtypes, same live row count, and the pad bucket THIS runner
+        would mint — a candidate sliced under a different feed unit
+        must not serve here.  Mutation races are benign: production
+        and consumption both run under the owning slice's dispatch
+        lock (children adopt the parent's slice)."""
+        stash = getattr(lineage, "split_stash", None)
+        if not stash:
+            return None
+        col_ids, dtypes, _ranges = feed_key
+        want_pad = self._pad_rows(max(n, 1))
+        for i, cand in enumerate(stash):
+            f = cand["feed"]
+            if cand["col_ids"] == col_ids and \
+                    cand["dtypes"] == tuple(dtypes) and \
+                    f.get("n_live") == n and f.get("n_pad") == want_pad:
+                del stash[i]
+                return dict(f)
+        return None
+
+    def extract_feeds(self, anchor):
+        """→ (migratable feeds by key, skipped count) for an ICI move
+        of ``anchor`` off this slice, or (None, 0) when nothing can
+        travel.  Only feeds carrying scrub digests are migratable —
+        the destination re-verifies on arrival, and a feed that
+        cannot be verified must re-mint from host truth instead of
+        serving unaudited (skipped counts those).  Snapshot under the
+        dispatch lock: (flat, digests) pairs update non-atomically on
+        the patch path."""
+        if not self._single:
+            return None, 0
+        bucket = self._arena.bucket(anchor, create=False)
+        if not bucket:
+            return None, 0
+        out = {}
+        skipped = 0
+        with self._dispatch_mu:
+            for k, v in bucket.items():
+                if not (isinstance(v, dict) and "flat" in v):
+                    continue
+                if v.get("digests") is None:
+                    skipped += 1
+                    continue
+                out[k] = dict(v)
+        return (out or None), skipped
+
+    def install_feeds(self, anchor, feeds: dict) -> str:
+        """Arrival side of an ICI feed migration → ``"moved"`` or
+        ``"corrupt"``.  Each plane is device_put onto this slice and
+        re-hashed against the digests that traveled with it BEFORE
+        anything installs — a plane diverging mid-flight (ICI fault,
+        HBM corruption on either end; chaos arms
+        ``device::feed_migrate``) quarantines-and-rebuilds, never
+        serves silently corrupt.  A feed the destination already
+        holds at the same or newer lineage generation is never
+        clobbered (a request raced the move and re-minted)."""
+        from ..utils.failpoint import fail_point
+        dev = self._mesh.devices.flat[0]
+        installed = {}
+        for fkey, feed in feeds.items():
+            flat = [jax.device_put(a, dev) for a in feed["flat"]]
+            if fail_point("device::feed_migrate") is not None:
+                # the injected mid-transfer fault: one bit flips on a
+                # transferred plane; the verify below must catch it
+                tmp = dict(feed)
+                tmp["flat"] = tuple(flat)
+                self.corrupt_resident_plane(tmp)
+                flat = list(tmp["flat"])
+            n = feed.get("n_live", 0)
+            arrived = []
+            for arr, want in zip(flat, feed["digests"]):
+                got = int(np.asarray(self.device_digest(arr, n)))
+                if got != int(np.asarray(want)):
+                    return "corrupt"
+                arrived.append(got)
+            nf = dict(feed)
+            nf["flat"] = tuple(flat)
+            # the digest chain must live where its planes live: a
+            # scalar still committed to the SOURCE slice would turn
+            # the next incremental patch into a cross-device subtract
+            nf["digests"] = tuple(
+                jax.device_put(jnp.asarray(w, jnp.uint64), dev)
+                for w in arrived)
+            installed[fkey] = nf
+            # pre-register the digest kernels so the first patch on
+            # the new slice mints no new compile class mid-churn
+            for a in nf["flat"]:
+                self._range_digest_kernel(a.dtype, a.shape[0])
+        with self._dispatch_mu:
+            bucket = self._arena.bucket(anchor)
+            if bucket is None:
+                return "corrupt"    # untrackable anchor: caller re-mints
+            for fkey, nf in installed.items():
+                cur = bucket.get(fkey)
+                if isinstance(cur, dict) and \
+                        cur.get("lineage_v") is not None and \
+                        nf.get("lineage_v") is not None and \
+                        cur["lineage_v"] >= nf["lineage_v"]:
+                    continue
+                bucket[fkey] = nf
+                self._register_digests(
+                    anchor if hasattr(anchor, "feed_digests") else None,
+                    fkey, nf)
+            self._arena.admit(anchor)
+        return "moved"
+
+    def _split_plane_kernel(self, dtype, n_pad_parent: int,
+                            n_pad_child: int, right: bool):
+        """Jitted key-range slice of one resident plane into a split
+        child: left takes rows [0, pos), right takes [pos, pos+n) via
+        a roll — the split position is traced, so every split of the
+        same (side, dtype, pad buckets) shares one compile class.
+        Rows past the child's live count zero out (padding invariant,
+        matching _build_flat's host zeros)."""
+        dt = np.dtype(dtype)
+        key = ("splitp", bool(right), str(dt), n_pad_parent, n_pad_child)
+        fn = self._kernel_cache.get(key)
+        if fn is None:
+            if right:
+                def kern(x, pos, n_child):
+                    y = jnp.roll(x, -pos)[:n_pad_child]
+                    iota = jnp.arange(n_pad_child)
+                    return jnp.where(iota < n_child, y,
+                                     jnp.zeros((), y.dtype))
+            else:
+                def kern(x, pos, n_child):
+                    y = x[:n_pad_child]
+                    iota = jnp.arange(n_pad_child)
+                    return jnp.where(iota < n_child, y,
+                                     jnp.zeros((), y.dtype))
+            fn = self._kernel_cache[key] = jax.jit(kern)
+        return fn
+
+    def split_resident_feeds(self, spec) -> str:
+        """Device-side region split of every resident feed anchored on
+        the parent lineage (``spec`` from RegionColumnarCache
+        .split_lines) → ``"split"`` when at least one child feed was
+        minted on device, else ``"none"``.  Fans out to whichever
+        runner holds the parent's bucket (placement slice, degraded
+        submesh, or this runner)."""
+        anchor = spec["parent_lineage"]
+        runners = [self]
+        if self._placer is not None:
+            runners.extend(self._placer.slices)
+        degraded = self._degraded_sub()
+        if degraded is not None:
+            runners.append(degraded)
+        for r in runners:
+            bucket = r._arena.bucket(anchor, create=False)
+            if bucket:
+                return r._split_local_feeds(bucket, spec)
+        return "none"
+
+    def _split_local_feeds(self, bucket, spec) -> str:
+        """Slice this runner's resident parent feeds into split-child
+        candidates, stashed on the child lineages for their first
+        request to consume (``_take_split_feed``).  Child digests are
+        recomputed from the children's HOST state — never derived
+        from device planes, so a corruption that landed on the parent
+        since its last scrub fails the verify here instead of
+        laundering into the child's recorded chain."""
+        if not self._single:
+            return "none"       # sharded whole-mesh feeds re-mint
+        out = "none"
+        with self._dispatch_mu:
+            for fkey, feed in list(bucket.items()):
+                if not (isinstance(feed, dict) and "flat" in feed):
+                    continue
+                if not feed.get("positional") or \
+                        feed.get("pk_flags") is None or \
+                        feed.get("digests") is None:
+                    continue
+                if feed.get("lineage_v") != spec["parent_version"] or \
+                        feed.get("n_live") != spec["n_parent"]:
+                    continue    # stale generation: positions lie
+                for side in ("left", "right"):
+                    child = spec.get(side)
+                    if child is None or child["n"] <= 0:
+                        continue
+                    cf = self._mint_split_child(feed, fkey, spec, child,
+                                                right=(side == "right"))
+                    if cf is not None:
+                        stash = getattr(child["lineage"], "split_stash",
+                                        None)
+                        if stash is None:
+                            stash = child["lineage"].split_stash = []
+                        stash.append({"col_ids": fkey[0],
+                                      "dtypes": tuple(fkey[1]),
+                                      "feed": cf})
+                        out = "split"
+        return out
+
+    def _mint_split_child(self, feed, fkey, spec, child, right: bool):
+        """One child feed: slice every parent plane on device, anchor
+        the child's digest chain to its host truth, and verify the
+        sliced planes against it (the split's arrival verify) — or
+        None when anything diverges (that child re-uploads)."""
+        from .supervisor import host_plane_digest
+        pos = spec["pos"]
+        n_child = child["n"]
+        n_pad_child = self._pad_rows(max(n_child, 1))
+        parent_pad = feed["n_pad"]
+        if n_pad_child > parent_pad:
+            return None
+        state = child["state"]
+        pos_arr = jnp.asarray(pos, jnp.int32)
+        n_arr = jnp.asarray(n_child, jnp.int32)
+        flat, digests = [], []
+        fi = 0
+        for ci, has_nulls in enumerate(feed["null_flags"]):
+            pk = feed["pk_flags"][ci]
+            dt = np.dtype(fkey[1][ci])
+            if pk:
+                vals = state.handles[:n_child]
+                valid = None
+            else:
+                bufs = state.cols.get(fkey[0][ci])
+                if bufs is None:
+                    return None
+                vals = bufs[0][:n_child]
+                valid = bufs[1][:n_child]
+            host_v = np.ascontiguousarray(vals.astype(dt, copy=False))
+            kern = self._split_plane_kernel(feed["flat"][fi].dtype,
+                                            parent_pad, n_pad_child,
+                                            right)
+            arr = kern(feed["flat"][fi], pos_arr, n_arr)
+            want = host_plane_digest(host_v, n_child)
+            if int(np.asarray(self.device_digest(arr, n_child))) != \
+                    int(want):
+                return None
+            flat.append(arr)
+            digests.append(want)
+            fi += 1
+            if has_nulls:
+                mask = np.ascontiguousarray(
+                    valid if valid is not None
+                    else np.ones(n_child, np.bool_))
+                kern = self._split_plane_kernel(np.bool_, parent_pad,
+                                                n_pad_child, right)
+                marr = kern(feed["flat"][fi], pos_arr, n_arr)
+                mwant = host_plane_digest(mask, n_child)
+                if int(np.asarray(self.device_digest(
+                        marr, n_child))) != int(mwant):
+                    return None
+                flat.append(marr)
+                digests.append(mwant)
+                fi += 1
+        cf = {"flat": tuple(flat), "null_flags": feed["null_flags"],
+              "n_pad": n_pad_child, "digests": tuple(digests),
+              "n_live": n_child, "lineage_v": child["lineage"].version,
+              "positional": True, "pk_flags": feed["pk_flags"]}
+        for a in cf["flat"]:
+            self._range_digest_kernel(a.dtype, a.shape[0])
+        return cf
 
     # --------------------------------------------------------------- kernels
 

@@ -940,6 +940,134 @@ class FeedArena:
             }
 
 
+class _RemintWaiter:
+    __slots__ = ("key", "shed", "region_id")
+
+    def __init__(self, key, region_id):
+        self.key = key
+        self.shed = False
+        self.region_id = region_id
+
+
+class RemintGovernor:
+    """Bounded, priority-ordered admission for cold ``columnar_build``
+    re-mints — the storm-control half of the elastic feed lifecycle.
+
+    When migration/split isn't possible (total slice death, digest
+    divergence, delta-envelope misses) every invalidated region wants a
+    host rebuild at once, and the narrow host link is exactly where a
+    recovery storm hurts.  The governor caps concurrent builds at
+    ``max_concurrent`` and parks the rest in a priority queue ordered
+    hot-regions-first (the cache's decayed request rate) with RU-debt
+    tenants last; past ``max_queue`` waiters, the WORST-priority waiter
+    is shed with ``ServerIsBusy(retry_after_ms=...)`` so cold-tail work
+    backs off instead of piling onto the link.
+
+    Wired as ``RegionColumnarCache.remint_gate`` (server/node.py);
+    ``max_concurrent <= 0`` disables admission entirely (the default —
+    tier-1 behavior is unchanged unless configured on).
+    """
+
+    def __init__(self, max_concurrent: int = 2, max_queue: int = 32,
+                 retry_after_ms: int = 50):
+        self.max_concurrent = int(max_concurrent)
+        self.max_queue = max(1, int(max_queue))
+        self.retry_after_ms = int(retry_after_ms)
+        self._cv = threading.Condition(threading.Lock())
+        self._active = 0
+        self._waiters: list = []
+        self._seq = 0
+        self.admitted = 0
+        self.queued = 0
+        self.shed = 0
+        self.observed_max = 0       # peak concurrent builds ever granted
+        self.peak_depth = 0         # deepest the wait queue ever got
+
+    @staticmethod
+    def _ru_debt() -> bool:
+        """Is the CURRENT request's tenant in RU debt?  Debtors rebuild
+        last: their burst already overdrew the shared budget."""
+        try:
+            from .. import resource_metering
+            from ..resource_control import GLOBAL_CONTROLLER, \
+                ResourceTagFactory
+            ctx = resource_metering.current_context()
+            tag = ctx.tag if ctx is not None else None
+            if tag is None:
+                return False
+            return GLOBAL_CONTROLLER.debt(
+                ResourceTagFactory.tenant(tag)) > 0
+        except Exception:   # noqa: BLE001 — priority hints never fail a build
+            return False
+
+    def acquire(self, region_id: int, heat: float = 0.0):
+        """Block until a build permit is granted; raises ServerIsBusy
+        (with the retry hint) when this waiter is shed.  Returns a
+        ticket for :meth:`release`."""
+        if self.max_concurrent <= 0:
+            return None             # disabled: free admission
+        from ..server.read_pool import ServerIsBusy
+        from ..utils.metrics import DEVICE_REMINT_QUEUE_DEPTH
+        with self._cv:
+            if self._active < self.max_concurrent and not self._waiters:
+                self._active += 1
+                self.admitted += 1
+                self.observed_max = max(self.observed_max, self._active)
+                return True
+            # smaller key = admitted sooner: debt-free before debtors,
+            # then hottest region, then FIFO
+            self._seq += 1
+            w = _RemintWaiter((1 if self._ru_debt() else 0, -heat,
+                               self._seq), region_id)
+            self._waiters.append(w)
+            self.queued += 1
+            if len(self._waiters) > self.max_queue:
+                worst = max(self._waiters, key=lambda x: x.key)
+                self._waiters.remove(worst)
+                worst.shed = True
+                self.shed += 1
+                self._cv.notify_all()
+            DEVICE_REMINT_QUEUE_DEPTH.set(len(self._waiters))
+            self.peak_depth = max(self.peak_depth, len(self._waiters))
+            while True:
+                if w.shed:
+                    raise ServerIsBusy(
+                        "re-mint queue overloaded",
+                        retry_after_ms=self.retry_after_ms)
+                if self._active < self.max_concurrent and \
+                        min(self._waiters, key=lambda x: x.key) is w:
+                    self._waiters.remove(w)
+                    self._active += 1
+                    self.admitted += 1
+                    self.observed_max = max(self.observed_max,
+                                            self._active)
+                    DEVICE_REMINT_QUEUE_DEPTH.set(len(self._waiters))
+                    # others re-check: more slots may still be free
+                    self._cv.notify_all()
+                    return True
+                self._cv.wait()
+
+    def release(self, ticket) -> None:
+        if ticket is None:
+            return
+        with self._cv:
+            self._active -= 1
+            self._cv.notify_all()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "max_concurrent": self.max_concurrent,
+                "active": self._active,
+                "depth": len(self._waiters),
+                "admitted": self.admitted,
+                "queued": self.queued,
+                "shed": self.shed,
+                "observed_max": self.observed_max,
+                "peak_depth": self.peak_depth,
+            }
+
+
 class DeviceStateSupervisor(Observer):
     """Lifecycle teardown + background scrub over device-resident state.
 
@@ -979,6 +1107,13 @@ class DeviceStateSupervisor(Observer):
         self.promotions = 0             # leader gains over a warm feed
         self.promotion_rebuilds = 0     # promotions that failed verify
         self.demotions = 0              # leader losses (feed retained)
+        # device-side split state machine
+        self.splits = 0                 # parent lines sliced on device
+        self.split_fallbacks = 0        # splits that fell back to re-mint
+        # the storm-control governor (wired by node.py onto the cache's
+        # remint_gate too; kept here so /health and chaos invariants
+        # read one rollup)
+        self.remint_governor = None
 
     # -- lifecycle events (CoprocessorHost observer) ------------------
     #
@@ -994,6 +1129,72 @@ class DeviceStateSupervisor(Observer):
             region.id, keep_epoch=region.epoch.version)
         if n:
             self._note_invalidations(n)
+
+    def on_region_split(self, left, right, left_index,
+                        right_index) -> None:
+        """A split is a slice, not a rebuild: the cache slices its
+        parent lines into child lines at the children's epochs (zero
+        ``columnar_build``), then the runner slices the resident parent
+        FEEDS into digest-verified child feeds on device (zero
+        ``feed_upload``).  This runs BEFORE the generic
+        ``on_region_changed`` retires the superseded parent lines —
+        peer.py orders the two events — so the parent planes are still
+        resident when the device split reads them.  The
+        ``device::device_split`` failpoint (and any slicing failure)
+        falls back to host re-mint for THIS split only."""
+        from ..utils import tracker
+        from ..utils.metrics import DEVICE_FEED_MIGRATION_COUNTER
+        if self._cache is None or \
+                not hasattr(self._cache, "split_lines"):
+            return
+        if fail_point("device::device_split") is not None:
+            DEVICE_FEED_MIGRATION_COUNTER.labels("split_fallback").inc()
+            with self._mu:
+                self.split_fallbacks += 1
+            return
+        with tracker.phase("device_split"):
+            try:
+                specs = self._cache.split_lines(left, right, left_index,
+                                                right_index)
+            except Exception:   # noqa: BLE001 — split must never fail apply
+                import logging
+                logging.getLogger(__name__).warning(
+                    "device-side split failed; falling back to re-mint",
+                    exc_info=True)
+                specs = []
+            runner = self._runner
+            child_anchors = []
+            parent = None
+            for spec in specs:
+                parent = spec["parent_lineage"]
+                ok = False
+                if runner is not None and \
+                        hasattr(runner, "split_resident_feeds"):
+                    try:
+                        ok = runner.split_resident_feeds(spec) == "split"
+                    except Exception:   # noqa: BLE001 — same contract
+                        ok = False
+                DEVICE_FEED_MIGRATION_COUNTER.labels(
+                    "split" if ok else "split_fallback").inc()
+                with self._mu:
+                    if ok:
+                        self.splits += 1
+                    else:
+                        self.split_fallbacks += 1
+                for side in ("left", "right"):
+                    ch = spec.get(side)
+                    if ch is not None:
+                        child_anchors.append(ch["lineage"])
+            # children serve where the parent lived: pin them to its
+            # slice so the first child request dispatches co-located
+            placer = getattr(runner, "_placer", None) \
+                if runner is not None else None
+            if placer is not None and parent is not None and \
+                    hasattr(placer, "adopt"):
+                try:
+                    placer.adopt(parent, child_anchors)
+                except Exception:   # noqa: BLE001 — placement is advisory
+                    pass
 
     def on_role_change(self, region_id: int, is_leader: bool) -> None:
         """Role flips drive the replica-feed state machine, not a
@@ -1320,8 +1521,12 @@ class DeviceStateSupervisor(Observer):
                 "promotions": self.promotions,
                 "promotion_rebuilds": self.promotion_rebuilds,
                 "demotions": self.demotions,
+                "splits": self.splits,
+                "split_fallbacks": self.split_fallbacks,
                 "last_scrub": dict(self._last_scrub),
             }
+        if self.remint_governor is not None:
+            out["remint"] = self.remint_governor.stats()
         if self._runner is not None and hasattr(self._runner,
                                                 "hbm_stats"):
             out["hbm"] = self._runner.hbm_stats()

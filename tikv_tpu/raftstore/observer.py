@@ -15,6 +15,12 @@ Events delivered:
   replaced wholesale at ``index`` (snapshot apply) — incremental
   subscribers (the columnar delta log) must drop everything they
   derived from earlier applied writes;
+- ``on_region_split(left, right, left_index, right_index)``: a split
+  was just executed, BEFORE the generic ``on_region_changed`` fires for
+  the surviving left region — subscribers that can serve the split
+  incrementally (delta-log coverage carry-over, device-side line/feed
+  slicing) act here; ``right_index`` is None when no right peer was
+  materialized on this store.  The generic event still follows;
 - ``on_region_changed(region)``: split/merge/conf-change/snapshot;
 - ``on_role_change(region_id, is_leader)``: leadership transitions;
 - ``on_peer_destroyed(region_id)``: the peer was removed from this
@@ -35,6 +41,9 @@ class Observer:
         pass
 
     def on_data_replaced(self, region_id: int, index: int) -> None:
+        pass
+
+    def on_region_split(self, left, right, left_index, right_index) -> None:
         pass
 
     def on_region_changed(self, region) -> None:
@@ -83,6 +92,14 @@ class CoprocessorHost:
         for obs in self._observers:
             try:
                 obs.on_data_replaced(region_id, index)
+            except Exception:   # noqa: BLE001
+                pass
+
+    def notify_region_split(self, left, right, left_index,
+                            right_index) -> None:
+        for obs in self._observers:
+            try:
+                obs.on_region_split(left, right, left_index, right_index)
             except Exception:   # noqa: BLE001
                 pass
 

@@ -968,6 +968,15 @@ class RaftPeer:
         left = replace(region, end_key=admin.split_key, epoch=new_epoch)
         self.peer_storage.persist_region(wb, left)
         self.store.create_split_peer(wb, right, was_leader=self.is_leader())
+        # split-aware observers (delta-log carry-over, device-side
+        # line/feed slicing) act BEFORE the generic region_changed
+        # sweep tears the parent's cache lines down.  Admin entries
+        # never bump data_index, so self.data_index IS the last
+        # pre-split write — the exact stamp for both children
+        right_peer = self.store.peers.get(right.id)
+        self.store.coprocessor_host.notify_region_split(
+            left, right, self.data_index,
+            right_peer.data_index if right_peer is not None else None)
         self.store.on_region_changed(self, left)
         return {"left": left, "right": right}
 

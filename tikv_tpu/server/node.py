@@ -518,6 +518,16 @@ class Node:
             self.device_supervisor.on_line_retired
         self.raft_store.coprocessor_host.register(self.device_supervisor)
         self.device_supervisor.start()
+        # re-mint storm control: bound concurrent cold columnar_build
+        # re-mints behind a hot-first priority queue (0 = unthrottled)
+        if config.coprocessor.remint_concurrency > 0:
+            from ..device.supervisor import RemintGovernor
+            gov = RemintGovernor(
+                max_concurrent=config.coprocessor.remint_concurrency,
+                max_queue=config.coprocessor.remint_queue,
+                retry_after_ms=config.coprocessor.remint_retry_after_ms)
+            self.copr_cache.remint_gate = gov
+            self.device_supervisor.remint_governor = gov
         # cold-path kill: device-side MVCC resolution as the columnar
         # build ladder's first rung, plus the streaming ingest→parse→H2D
         # pipeline that runs it during bulk loads (copr/stream_build.py)
@@ -643,6 +653,24 @@ class Node:
                 diff["device_row_threshold"]
         if "region_cache_capacity" in diff:
             self.copr_cache._capacity = diff["region_cache_capacity"]
+        if "remint_concurrency" in diff:
+            n = int(diff["remint_concurrency"])
+            if n <= 0:
+                self.copr_cache.remint_gate = None
+                self.device_supervisor.remint_governor = None
+            else:
+                gov = self.copr_cache.remint_gate
+                if gov is None:
+                    from ..device.supervisor import RemintGovernor
+                    gov = RemintGovernor(
+                        max_concurrent=n,
+                        max_queue=self.config.coprocessor.remint_queue,
+                        retry_after_ms=self.config.coprocessor
+                        .remint_retry_after_ms)
+                    self.copr_cache.remint_gate = gov
+                    self.device_supervisor.remint_governor = gov
+                else:
+                    gov.max_concurrent = n
         if "tombstone_compact_ratio" in diff:
             self.copr_cache._compact_ratio = \
                 diff["tombstone_compact_ratio"]

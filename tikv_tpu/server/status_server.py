@@ -135,6 +135,35 @@ class StatusServer:
                         # scrub passes/divergences, quarantines, and
                         # lifecycle invalidation counts
                         body["device_state"] = sup.stats()
+                    if sup is not None or dr is not None:
+                        # elastic feed lifecycle: ICI migrations
+                        # (moved/partial/failed + wall ms), device-side
+                        # splits vs re-mint fallbacks, and the
+                        # storm-control governor (active/depth/shed/
+                        # peak concurrency)
+                        fl: dict = {}
+                        placer = getattr(dr, "_placer", None) \
+                            if dr is not None else None
+                        if placer is not None:
+                            fl["migrations"] = placer.migrations
+                            fl["migration_ms"] = round(
+                                placer.migration_ms, 3)
+                            fl["last_migration_ms"] = round(
+                                placer.last_migration_ms, 3)
+                            fl["migration_failures"] = \
+                                placer.migration_failures
+                            fl["adoptions"] = placer.adoptions
+                        if sup is not None:
+                            fl["splits"] = getattr(sup, "splits", 0)
+                            fl["split_fallbacks"] = getattr(
+                                sup, "split_fallbacks", 0)
+                            gov = getattr(sup, "remint_governor", None)
+                            if gov is not None:
+                                fl["remint"] = gov.stats()
+                        if cc is not None:
+                            fl["line_splits"] = getattr(cc, "splits", 0)
+                        if fl:
+                            body["feed_lifecycle"] = fl
                     if hasattr(node, "replica_serving_stats"):
                         # replicated device serving: follower replica
                         # reads served/refused by the resolved-ts

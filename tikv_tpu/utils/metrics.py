@@ -432,6 +432,24 @@ DEVICE_PLACEMENT_COUNTER = REGISTRY.counter(
     "slice, move = rebalance dropped an anchor off a hot slice, "
     "whole_mesh = feed large enough to shard over every chip)",
     labels=("decision",))
+DEVICE_FEED_MIGRATION_COUNTER = REGISTRY.counter(
+    "tikv_device_feed_migration_total",
+    "ICI feed migrations between slices (moved = every feed arrived, "
+    "digest-verified, and the anchor flipped with zero re-mint, "
+    "partial = some feeds moved and the rest fell back to re-mint, "
+    "corrupt = arrival verify caught a plane diverging mid-flight — "
+    "quarantine-and-rebuild, never silent corruption, no_digests = "
+    "nothing migratable was resident so the move degraded to the old "
+    "drop+re-mint path, split = device-side region split minted child "
+    "feeds from the parent without a columnar_build, split_fallback = "
+    "device::device_split armed or the parent feed unusable — that "
+    "split re-minted from host truth)",
+    labels=("outcome",))
+DEVICE_REMINT_QUEUE_DEPTH = REGISTRY.gauge(
+    "tikv_device_remint_queue_depth",
+    "cold columnar_build re-mints parked in the storm-control "
+    "priority queue (hot regions first, RU-debt tenants last) "
+    "waiting for one of the bounded concurrency permits")
 DEVICE_REPLICA_FEEDS = REGISTRY.gauge(
     "tikv_device_replica_feeds",
     "regions this store holds a live follower replica feed for — a "

@@ -57,6 +57,15 @@ SPAN_VOCABULARY: dict[str, str] = {
     "feed_patch": "delta-dirty span patch of a resident feed",
     "shard_merge": "host-side merge of per-shard partial agg states",
     "mesh_rebuild": "elastic degrade: re-mint serving on a submesh",
+    "feed_migrate": "ICI move of a resident feed between slices "
+                    "(device_put across the mesh + arrival verify "
+                    "against the carried scrub digests)",
+    "device_split": "region split sliced on device: parent feed → two "
+                    "child feeds by key range, digests re-anchored to "
+                    "host truth before either child serves",
+    "remint_wait": "re-mint storm control: columnar_build parked in "
+                   "the priority rebuild queue for a concurrency "
+                   "permit (device/supervisor.py RemintGovernor)",
     # -- plan IR (copr/plan_ir.py, device/join.py) --
     "plan_route": "per-fragment host/device routing of a plan-IR "
                   "request (FragmentRouter)",
